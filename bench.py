@@ -1,0 +1,56 @@
+"""Headline benchmark: pair-interactions/sec/chip, single-chip Pallas
+direct-sum leapfrog (the BASELINE.json primary metric).
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+vs_baseline is measured throughput / the BASELINE.json north-star target
+(1e11 pair-interactions/sec/chip).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+NORTH_STAR = 1.0e11  # pair-interactions/sec/chip (BASELINE.json)
+
+
+def main() -> int:
+    n = int(os.environ.get("BENCH_N", 65536))
+    steps = int(os.environ.get("BENCH_STEPS", 20))
+
+    import jax
+
+    from gravity_tpu.bench import run_benchmark
+    from gravity_tpu.config import SimulationConfig
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    config = SimulationConfig(
+        model="plummer",
+        n=n,
+        dt=3600.0,
+        eps=1.0e9,
+        integrator="leapfrog",
+        force_backend="pallas" if on_tpu else "chunked",
+        dtype="float32",
+    )
+    stats = run_benchmark(config, warmup_steps=3, bench_steps=steps)
+    result = {
+        "metric": "pair_interactions_per_sec_per_chip",
+        "value": stats["pairs_per_sec_per_chip"],
+        "unit": "pairs/s/chip",
+        "vs_baseline": stats["pairs_per_sec_per_chip"] / NORTH_STAR,
+        "n": stats["n"],
+        "steps": stats["steps"],
+        "avg_step_s": stats["avg_step_s"],
+        "backend": stats["backend"],
+        "platform": stats["platform"],
+    }
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,27 @@
+"""gravity_tpu — a TPU-native N-body gravity simulation framework.
+
+Built from scratch in JAX/XLA/Pallas with the capabilities of the reference
+`pdpatel13/Gravity-Simulator-using-MPI-Spark-and-CUDA` (mounted at
+`/root/reference/`): direct-sum Newtonian gravity with the reference's exact
+behavioral constants, a symplectic integrator family, solar/random ICs plus
+benchmark model families, per-step trajectory recording, reference-format
+run logs — unified under one runtime with a tiled Pallas force kernel and
+`shard_map` collectives (all_gather / ppermute ring) instead of CUDA
+threads, MPI_Allgatherv, or Spark RDDs.
+"""
+
+from . import constants
+from .config import PRESETS, SimulationConfig
+from .simulation import Simulator
+from .state import ParticleState
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "PRESETS",
+    "ParticleState",
+    "SimulationConfig",
+    "Simulator",
+    "constants",
+    "__version__",
+]

@@ -1,0 +1,55 @@
+"""Benchmark harness: pair-interactions/sec/chip and time-per-step.
+
+The reference only measures wall-clock around its step loop
+(`/root/reference/mpi.c:189,239`, `/root/reference/cuda.cu:154,169-171`);
+this harness compiles the step once, warms up, then times a fixed number of
+steps with ``block_until_ready`` fencing — the BASELINE.json metric.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+
+from .config import SimulationConfig
+from .simulation import Simulator
+from .utils.timing import throughput
+
+
+def run_benchmark(
+    config: SimulationConfig, *, warmup_steps: int = 3, bench_steps: int = 20
+) -> dict:
+    from .ops.integrators import init_carry
+
+    sim = Simulator(config)
+    state = sim.state
+    acc = init_carry(sim.accel_fn, state)
+
+    # Compile + warm up with the SAME static n_steps as the timed block:
+    # _run_block retraces per distinct n_steps, so a different warmup shape
+    # would leave the timed call paying compilation inside the timer.
+    del warmup_steps
+    state, acc, _ = sim._run_block(state, acc, n_steps=bench_steps, record=False)
+    jax.block_until_ready(state.positions)
+
+    start = time.perf_counter()
+    state, acc, _ = sim._run_block(state, acc, n_steps=bench_steps, record=False)
+    jax.block_until_ready(state.positions)
+    elapsed = time.perf_counter() - start
+
+    stats = throughput(
+        sim.n_real,
+        bench_steps,
+        elapsed,
+        num_devices=sim.mesh.size if sim.mesh else 1,
+    )
+    stats.update(
+        model=config.model,
+        integrator=config.integrator,
+        backend=sim.backend,
+        sharding=config.sharding,
+        dtype=config.dtype,
+        platform=jax.devices()[0].platform,
+    )
+    return stats

@@ -1,0 +1,186 @@
+"""Command-line interface.
+
+The reference's public interface is three zero-argument executables with
+hardcoded knobs (`mpirun -np P ./mpi`, `./cuda`, `python pyspark.py` —
+`/root/reference/mpi.c:140`, `/root/reference/cuda.cu:120`,
+`/root/reference/pyspark.py:152`). This CLI exposes every knob while the
+defaults reproduce the reference constants, and `run` emits the reference's
+log shape so runs are drop-in comparable.
+
+Usage:
+    python -m gravity_tpu run --model random --n 1024 --steps 500 --dt 3600
+    python -m gravity_tpu run --preset reference-spark
+    python -m gravity_tpu sweep            # the pyspark.py benchmark sweep
+    python -m gravity_tpu bench --n 16384
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+
+from .config import PRESETS, SimulationConfig
+
+
+def _add_config_args(p: argparse.ArgumentParser) -> None:
+    defaults = SimulationConfig()
+    p.add_argument("--preset", choices=sorted(PRESETS), default=None)
+    p.add_argument("--model", default=None)
+    p.add_argument("--n", type=int, default=None)
+    p.add_argument("--steps", type=int, default=None)
+    p.add_argument("--dt", type=float, default=None)
+    p.add_argument("--seed", type=int, default=None)
+    p.add_argument("--g", type=float, default=None)
+    p.add_argument("--cutoff", type=float, default=None)
+    p.add_argument("--eps", type=float, default=None)
+    p.add_argument("--integrator",
+                   choices=["euler", "leapfrog", "verlet"], default=None)
+    p.add_argument("--dtype",
+                   choices=["float32", "float64", "bfloat16"], default=None)
+    p.add_argument("--force-backend", dest="force_backend",
+                   choices=["auto", "dense", "chunked", "pallas"], default=None)
+    p.add_argument("--chunk", type=int, default=None)
+    p.add_argument("--sharding",
+                   choices=["none", "allgather", "ring"], default=None)
+    p.add_argument("--log-dir", dest="log_dir", default=None)
+    p.add_argument("--trajectories", dest="record_trajectories",
+                   action="store_true", default=None)
+    p.add_argument("--trajectory-every", dest="trajectory_every",
+                   type=int, default=None)
+    p.add_argument("--checkpoint-every", dest="checkpoint_every",
+                   type=int, default=None)
+    p.add_argument("--checkpoint-dir", dest="checkpoint_dir", default=None)
+    p.add_argument("--config-json", default=None,
+                   help="path to a SimulationConfig JSON file")
+    del defaults
+
+
+def build_config(args: argparse.Namespace) -> SimulationConfig:
+    if args.config_json:
+        with open(args.config_json) as f:
+            config = SimulationConfig.from_json(f.read())
+    elif args.preset:
+        config = dataclasses.replace(PRESETS[args.preset])
+    else:
+        config = SimulationConfig()
+    for field in dataclasses.fields(SimulationConfig):
+        val = getattr(args, field.name, None)
+        if val is not None:
+            config = dataclasses.replace(config, **{field.name: val})
+    return config
+
+
+def cmd_run(args: argparse.Namespace) -> int:
+    from .simulation import Simulator
+    from .utils.logging import RunLogger
+    from .utils.trajectory import TrajectoryWriter
+
+    config = build_config(args)
+    logger = RunLogger(config.log_dir)
+    sim = Simulator(config)
+    writer = None
+    if config.record_trajectories:
+        import os
+
+        # every=1: the Simulator already strides frames by
+        # config.trajectory_every on-device; a second filter here would
+        # drop frames whose step isn't 0 mod every.
+        writer = TrajectoryWriter(
+            os.path.join(config.log_dir, f"trajectories_{logger.timestamp}"),
+            sim.n_real,
+            every=1,
+        )
+    ckpt_mgr = None
+    if config.checkpoint_every:
+        from .utils.checkpoint import make_checkpoint_manager
+
+        ckpt_mgr = make_checkpoint_manager(config.checkpoint_dir)
+    stats = sim.run(logger, trajectory_writer=writer,
+                    checkpoint_manager=ckpt_mgr)
+    stats.pop("final_state", None)
+    print(json.dumps(stats))
+    return 0
+
+
+def cmd_sweep(args: argparse.Namespace) -> int:
+    """The pyspark.py benchmark sweep (`/root/reference/pyspark.py:168-198`):
+    run the reference configurations back-to-back in one log file."""
+    from .simulation import Simulator
+    from .utils.logging import RunLogger
+
+    import os
+
+    from .utils.trajectory import TrajectoryWriter
+
+    config = build_config(args)
+    logger = RunLogger(config.log_dir)
+    sizes = args.sizes or [10, 100, 500, 1000]
+    for n in sizes:
+        logger.log_print(
+            f"\nStarting gravity simulation with {n} particles"
+        )
+        logger.log_print("Configuration:")
+        logger.log_print(f"- Number of steps: {config.steps}")
+        logger.log_print(f"- Time step: {config.dt:g} seconds")
+        cfg = dataclasses.replace(config, n=n)
+        sim = Simulator(cfg)
+        writer = None
+        if cfg.record_trajectories:
+            writer = TrajectoryWriter(
+                os.path.join(
+                    cfg.log_dir,
+                    f"trajectories_{logger.timestamp}_n{n}",
+                ),
+                sim.n_real,
+                every=1,
+            )
+        stats = sim.run(trajectory_writer=writer)
+        logger.performance(stats["total_time_s"], cfg.steps,
+                           pairs_per_sec=stats["pairs_per_sec"])
+        import numpy as np
+
+        logger.final_positions(np.asarray(stats["final_state"].positions))
+    logger.completed()
+    return 0
+
+
+def cmd_bench(args: argparse.Namespace) -> int:
+    from .bench import run_benchmark
+
+    config = build_config(args)
+    result = run_benchmark(config, warmup_steps=args.warmup,
+                           bench_steps=args.bench_steps)
+    print(json.dumps(result))
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="gravity_tpu")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p_run = sub.add_parser("run", help="run a simulation")
+    _add_config_args(p_run)
+    p_run.set_defaults(fn=cmd_run)
+
+    p_sweep = sub.add_parser(
+        "sweep", help="reference pyspark.py-style benchmark sweep"
+    )
+    _add_config_args(p_sweep)
+    p_sweep.add_argument("--sizes", type=int, nargs="*", default=None)
+    p_sweep.set_defaults(fn=cmd_sweep)
+
+    p_bench = sub.add_parser("bench", help="throughput benchmark")
+    _add_config_args(p_bench)
+    p_bench.add_argument("--warmup", type=int, default=3)
+    p_bench.add_argument("--bench-steps", dest="bench_steps", type=int,
+                         default=20)
+    p_bench.set_defaults(fn=cmd_bench)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,94 @@
+"""Configuration layer.
+
+The reference has no config system — every knob is a hardcoded constant
+(`/root/reference/cuda.cu:121-123`, `/root/reference/mpi.c:146-148`,
+`/root/reference/pyspark.py:183-186`); its only parameterization is the
+Spark sweep list (`pyspark.py:168-173`). Here: one dataclass whose defaults
+reproduce the reference constants, plus named presets for the reference
+workloads and the BASELINE benchmark configs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Optional
+
+from . import constants as C
+
+
+@dataclasses.dataclass
+class SimulationConfig:
+    # Workload
+    model: str = "random"  # see gravity_tpu.models.MODELS
+    n: int = 1024
+    steps: int = C.DEFAULT_STEPS
+    dt: float = C.DEFAULT_DT
+    seed: int = 0
+
+    # Physics
+    g: float = C.G
+    cutoff: float = C.CUTOFF_RADIUS
+    eps: float = 0.0  # Plummer softening (0 = reference semantics)
+
+    # Numerics / backend
+    integrator: str = "euler"  # euler (reference parity) | leapfrog | verlet
+    dtype: str = "float32"
+    force_backend: str = "auto"  # auto | dense | chunked | pallas
+    chunk: int = 1024
+
+    # Parallelism
+    sharding: str = "none"  # none | allgather | ring
+    mesh_shape: Optional[tuple] = None  # e.g. (8,); None = all local devices
+
+    # I/O & observability
+    log_dir: str = "gravity_logs_tpu"
+    record_trajectories: bool = False  # per-step positions (Spark capability)
+    trajectory_every: int = 1
+    progress_every: int = C.PROGRESS_EVERY
+    checkpoint_every: int = 0  # 0 = disabled
+    checkpoint_dir: str = "checkpoints"
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self), indent=2, default=str)
+
+    @staticmethod
+    def from_json(text: str) -> "SimulationConfig":
+        data = json.loads(text)
+        if data.get("mesh_shape") is not None:
+            data["mesh_shape"] = tuple(data["mesh_shape"])
+        return SimulationConfig(**data)
+
+
+# Named presets. The first three reproduce the reference workloads
+# (`cuda.cu:121-123`, `mpi.c:96-107,146-148`, `pyspark.py:168-173`);
+# the rest are the BASELINE.json benchmark configs.
+PRESETS = {
+    "reference-mpi": SimulationConfig(model="random", n=8, integrator="euler"),
+    "reference-cuda": SimulationConfig(model="random", n=50_000, integrator="euler"),
+    "reference-spark": SimulationConfig(
+        model="random", n=1000, integrator="euler", record_trajectories=True
+    ),
+    "baseline-1k": SimulationConfig(
+        model="random", n=1024, integrator="leapfrog", force_backend="dense"
+    ),
+    "baseline-16k": SimulationConfig(
+        model="plummer", n=16_384, integrator="leapfrog", force_backend="pallas",
+        eps=1.0e9,
+    ),
+    "baseline-262k": SimulationConfig(
+        model="cold_collapse", n=262_144, integrator="leapfrog",
+        force_backend="pallas", sharding="allgather", eps=1.0e9,
+    ),
+    # Galaxy models run in galactic natural units (G=1, kpc, 1e10 Msun —
+    # see gravity_tpu.utils.units): dt=0.002 time units (~9 kyr),
+    # eps=0.05 kpc softening.
+    "baseline-1m": SimulationConfig(
+        model="disk", n=1_048_576, integrator="leapfrog",
+        force_backend="pallas", sharding="ring", g=1.0, dt=2.0e-3, eps=0.05,
+    ),
+    "baseline-2m-merger": SimulationConfig(
+        model="merger", n=2_097_152, integrator="leapfrog",
+        force_backend="pallas", sharding="ring", g=1.0, dt=2.0e-3, eps=0.05,
+    ),
+}

@@ -1,0 +1,40 @@
+"""Physical and behavioral constants shared by every backend.
+
+These reproduce the cross-backend behavioral constants of the reference
+(`/root/reference/cuda.cu:11`, `/root/reference/mpi.c:9`,
+`/root/reference/pyspark.py:46` for G; `cuda.cu:39`, `mpi.c:64`,
+`pyspark.py:38` for the close-approach cutoff; `cuda.cu:123,155`,
+`mpi.c:147-148`, `pyspark.py:183-186` for dt/steps).
+"""
+
+# Newtonian gravitational constant [m^3 kg^-1 s^-2].
+G = 6.67430e-11
+
+# Close-approach cutoff: pairs with r < CUTOFF contribute zero force.
+# (The reference uses this instead of Plummer softening.)
+CUTOFF_RADIUS = 1e-10
+
+# Reference defaults for the step loop.
+DEFAULT_DT = 3600.0  # seconds
+DEFAULT_STEPS = 500
+
+# Solar-system seed bodies (`cuda.cu:81-96`, `mpi.c:76-94`,
+# `pyspark.py:124-141` — identical constants in all three backends).
+SUN_MASS = 1.989e30  # kg
+EARTH_ORBIT_RADIUS = 1.496e11  # m
+EARTH_ORBIT_SPEED = 29.78e3  # m/s
+EARTH_MASS = 5.972e24  # kg
+MARS_ORBIT_RADIUS = 2.279e11  # m
+MARS_ORBIT_SPEED = 24.077e3  # m/s
+MARS_MASS = 6.39e23  # kg
+
+# Random-IC distributions (`cuda.cu:129-131`, `mpi.c:98-104`,
+# `pyspark.py:146-148`).
+RANDOM_POS_BOUND = 3.0e11  # m; positions uniform in [-bound, bound]^3
+RANDOM_VEL_BOUND = 3.0e4  # m/s; velocities uniform in [-bound, bound]^3
+RANDOM_MASS_LOW = 1.0e23  # kg
+RANDOM_MASS_HIGH = 1.0e25  # kg
+
+# Progress print cadence ("Step k/STEPS" every 100 steps — `cuda.cu:164-166`,
+# `mpi.c:192-194`, `pyspark.py:109-110`).
+PROGRESS_EVERY = 100

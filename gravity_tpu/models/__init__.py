@@ -1,0 +1,52 @@
+"""Initial-condition model families.
+
+``solar`` and ``random_cube`` reproduce the reference's ICs exactly
+(`/root/reference/cuda.cu:81-96,125-138` and counterparts); ``plummer``,
+``cold_collapse``, ``disk``, and ``merger`` are the BASELINE benchmark
+families.
+"""
+
+from .cold_collapse import create_cold_collapse
+from .disk import create_disk
+from .merger import create_merger
+from .plummer import create_plummer
+from .random_cube import create_random_cube, generate_random_particles
+from .solar import create_solar_system
+
+def _solar(key, n, dtype):
+    if n != 3:
+        raise ValueError(
+            f"model 'solar' has exactly 3 bodies; got n={n}. "
+            "Use --n 3, or model 'random' for solar seed + random filler."
+        )
+    return create_solar_system(dtype=dtype)
+
+
+MODELS = {
+    "solar": _solar,
+    "random": lambda key, n, dtype: create_random_cube(key, n, dtype=dtype),
+    "plummer": lambda key, n, dtype: create_plummer(key, n, dtype=dtype),
+    "cold_collapse": lambda key, n, dtype: create_cold_collapse(
+        key, n, dtype=dtype
+    ),
+    "disk": lambda key, n, dtype: create_disk(key, n, dtype=dtype),
+    "merger": lambda key, n, dtype: create_merger(key, n, dtype=dtype),
+}
+
+
+def create_model(name: str, key, n: int, dtype):
+    if name not in MODELS:
+        raise ValueError(f"unknown model {name!r}; choose from {sorted(MODELS)}")
+    return MODELS[name](key, n, dtype)
+
+__all__ = [
+    "MODELS",
+    "create_model",
+    "create_cold_collapse",
+    "create_disk",
+    "create_merger",
+    "create_plummer",
+    "create_random_cube",
+    "create_solar_system",
+    "generate_random_particles",
+]

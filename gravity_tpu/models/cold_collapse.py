@@ -1,0 +1,42 @@
+"""Cold-collapse initial conditions (BASELINE config: 262,144-body collapse).
+
+A uniform-density sphere at rest (optionally with a small virial ratio of
+random velocities) that collapses under self-gravity — a classic stress test
+for force accuracy at close approach.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..state import ParticleState
+
+
+def create_cold_collapse(
+    key: jax.Array,
+    n: int,
+    *,
+    total_mass: float = 1.0e33,
+    radius: float = 1.0e13,
+    velocity_dispersion: float = 0.0,
+    dtype=jnp.float32,
+) -> ParticleState:
+    kr, kd, kv = jax.random.split(key, 3)
+    # Uniform in a ball: r ~ R * U^(1/3), isotropic direction.
+    u = jax.random.uniform(kr, (n,), dtype=dtype)
+    r = radius * u ** (1.0 / 3.0)
+    costh = jax.random.uniform(kd, (n,), dtype=dtype, minval=-1.0, maxval=1.0)
+    sinth = jnp.sqrt(jnp.maximum(0.0, 1.0 - costh * costh))
+    phi = jax.random.uniform(
+        jax.random.fold_in(kd, 1), (n,), dtype=dtype, minval=0.0,
+        maxval=2.0 * jnp.pi,
+    )
+    positions = r[:, None] * jnp.stack(
+        [sinth * jnp.cos(phi), sinth * jnp.sin(phi), costh], axis=1
+    )
+    velocities = velocity_dispersion * jax.random.normal(kv, (n, 3), dtype=dtype)
+    masses = jnp.full((n,), total_mass / n, dtype=dtype)
+    positions = positions - jnp.mean(positions, axis=0, keepdims=True)
+    velocities = velocities - jnp.mean(velocities, axis=0, keepdims=True)
+    return ParticleState(positions, velocities, masses)

@@ -1,0 +1,84 @@
+"""Exponential-disk (Milky-Way-like) initial conditions.
+
+BASELINE config: 1M-body Milky-Way disk. A thin exponential disk with
+Gaussian vertical structure around a central bulge point mass, on
+near-circular orbits set by the enclosed mass — a standard galaxy mock,
+sufficient for benchmarking the large-N force path.
+
+Generated in **galactic natural units** (G = 1, [L] = kpc,
+[M] = 1e10 Msun — see :mod:`gravity_tpu.utils.units`): galaxy-scale SI
+masses (~1e41 kg) overflow float32, and TPU compute is fp32/bf16. Run with
+``g=1.0`` (the ``baseline-1m`` preset does).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..state import ParticleState
+
+
+def create_disk(
+    key: jax.Array,
+    n: int,
+    *,
+    disk_mass: float = 5.0,      # 5e10 Msun of stars
+    bulge_mass: float = 1.0,     # central point mass (bulge+SMBH proxy)
+    scale_length: float = 3.0,   # kpc
+    scale_height: float = 0.3,   # kpc
+    g: float = 1.0,
+    dtype=jnp.float32,
+) -> ParticleState:
+    kr, kp, kz, kv = jax.random.split(key, 4)
+    f64 = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+
+    # Exponential surface density Sigma ~ exp(-R/Rd): enclosed-mass CDF is
+    # 1 - (1 + R/Rd) exp(-R/Rd); invert by bisection (vectorized, 40 rounds).
+    u = jax.random.uniform(kr, (n,), dtype=f64, minval=1e-7, maxval=1.0 - 1e-7)
+
+    def cdf(x):  # x = R/Rd
+        return 1.0 - (1.0 + x) * jnp.exp(-x)
+
+    lo = jnp.zeros((n,), f64)
+    hi = jnp.full((n,), 30.0, f64)
+
+    def body(_, carry):
+        lo, hi = carry
+        mid = 0.5 * (lo + hi)
+        below = cdf(mid) < u
+        return jnp.where(below, mid, lo), jnp.where(below, hi, mid)
+
+    lo, hi = jax.lax.fori_loop(0, 40, body, (lo, hi))
+    radius = 0.5 * (lo + hi) * scale_length
+
+    phi = jax.random.uniform(kp, (n,), dtype=f64, minval=0.0, maxval=2.0 * jnp.pi)
+    z = scale_height * jax.random.normal(kz, (n,), dtype=f64)
+    positions = jnp.stack(
+        [radius * jnp.cos(phi), radius * jnp.sin(phi), z], axis=1
+    )
+
+    # Circular speed from enclosed mass (bulge + disk interior to R).
+    m_enc = bulge_mass + disk_mass * cdf(radius / scale_length)
+    v_circ = jnp.sqrt(g * m_enc / jnp.maximum(radius, 1e-3 * scale_length))
+    sigma_v = 0.05 * v_circ  # mild velocity dispersion
+    noise = jax.random.normal(kv, (n, 3), dtype=f64)
+    velocities = jnp.stack(
+        [
+            -v_circ * jnp.sin(phi) + sigma_v * noise[:, 0],
+            v_circ * jnp.cos(phi) + sigma_v * noise[:, 1],
+            0.2 * sigma_v * noise[:, 2],
+        ],
+        axis=1,
+    )
+
+    # Particle 0 is the bulge point mass at rest; the rest share disk_mass.
+    m_star = disk_mass / (n - 1)
+    masses = jnp.concatenate(
+        [jnp.asarray([bulge_mass], f64), jnp.full((n - 1,), m_star, f64)]
+    )
+    positions = positions.at[0].set(jnp.zeros(3, f64))
+    velocities = velocities.at[0].set(jnp.zeros(3, f64))
+    return ParticleState(
+        positions.astype(dtype), velocities.astype(dtype), masses.astype(dtype)
+    )

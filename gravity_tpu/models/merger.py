@@ -1,0 +1,59 @@
+"""Two-galaxy merger initial conditions (BASELINE config: 2x1M merger).
+
+Two disks (see :mod:`.disk`) placed on an approach orbit with an impact
+parameter and inclination — the multi-slice benchmark workload. Like the
+disks, generated in galactic natural units (G = 1, kpc, 1e10 Msun); run
+with ``g=1.0``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..state import ParticleState
+from .disk import create_disk
+
+
+def _rotate_x(vecs, angle):
+    c, s = jnp.cos(angle), jnp.sin(angle)
+    rot = jnp.asarray([[1.0, 0.0, 0.0], [0.0, c, -s], [0.0, s, c]], vecs.dtype)
+    return vecs @ rot.T
+
+
+def create_merger(
+    key: jax.Array,
+    n: int,
+    *,
+    separation: float = 18.0,        # kpc (galactic units, like the disks)
+    impact_parameter: float = 3.0,   # kpc
+    approach_speed: float = 0.7,     # velocity units (~145 km/s)
+    inclination: float = 0.5,        # radians, second disk tilt
+    dtype=jnp.float32,
+    **disk_kwargs,
+) -> ParticleState:
+    """N total particles split evenly into two disks on a collision course."""
+    k1, k2 = jax.random.split(key)
+    n1 = n // 2
+    n2 = n - n1
+    d1 = create_disk(k1, n1, dtype=dtype, **disk_kwargs)
+    d2 = create_disk(k2, n2, dtype=dtype, **disk_kwargs)
+
+    half_sep = jnp.asarray(
+        [separation / 2, impact_parameter / 2, 0.0], d1.positions.dtype
+    )
+    dv = jnp.asarray([approach_speed / 2, 0.0, 0.0], d1.velocities.dtype)
+
+    d2_pos = _rotate_x(d2.positions, inclination)
+    d2_vel = _rotate_x(d2.velocities, inclination)
+
+    merged = ParticleState(
+        positions=jnp.concatenate(
+            [d1.positions - half_sep, d2_pos + half_sep], axis=0
+        ),
+        velocities=jnp.concatenate(
+            [d1.velocities + dv, d2_vel - dv], axis=0
+        ),
+        masses=jnp.concatenate([d1.masses, d2.masses], axis=0),
+    )
+    return merged

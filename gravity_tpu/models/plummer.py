@@ -1,0 +1,80 @@
+"""Plummer-sphere initial conditions (BASELINE config: 16,384-body sphere).
+
+Standard Aarseth-Henon-Wielen sampling of the Plummer (1911) density
+profile in virial equilibrium. Not present in the reference (which only has
+solar + uniform-random ICs); this is one of the benchmark model families
+from BASELINE.json.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..constants import G
+from ..state import ParticleState
+
+
+def create_plummer(
+    key: jax.Array,
+    n: int,
+    *,
+    total_mass: float = 1.0e30,
+    scale_radius: float = 1.0e12,
+    g: float = G,
+    dtype=jnp.float32,
+) -> ParticleState:
+    k1, k2, k3, k4, k5 = jax.random.split(key, 5)
+    f64 = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+
+    # Radius via inverse-CDF of the enclosed-mass profile:
+    # M(r)/M = (1 + (a/r)^2)^(-3/2)  =>  r = a / sqrt(X^(-2/3) - 1).
+    x = jax.random.uniform(k1, (n,), dtype=f64, minval=1e-8, maxval=1.0 - 1e-8)
+    r = scale_radius / jnp.sqrt(x ** (-2.0 / 3.0) - 1.0)
+
+    # Isotropic direction.
+    costh = jax.random.uniform(k2, (n,), dtype=f64, minval=-1.0, maxval=1.0)
+    sinth = jnp.sqrt(jnp.maximum(0.0, 1.0 - costh * costh))
+    phi = jax.random.uniform(k3, (n,), dtype=f64, minval=0.0, maxval=2.0 * jnp.pi)
+    positions = r[:, None] * jnp.stack(
+        [sinth * jnp.cos(phi), sinth * jnp.sin(phi), costh], axis=1
+    )
+
+    # Speed via von Neumann rejection on q = v/v_esc with
+    # g(q) = q^2 (1 - q^2)^(7/2); done as a fixed-round vectorized
+    # accept-resample (8 rounds drives the reject probability to ~1e-8).
+    def sample_q(key):
+        def body(carry, k):
+            q, ok = carry
+            ka, kb = jax.random.split(k)
+            q_new = jax.random.uniform(ka, (n,), dtype=f64)
+            y = jax.random.uniform(kb, (n,), dtype=f64, maxval=0.1)
+            accept = y < q_new**2 * (1.0 - q_new**2) ** 3.5
+            take = jnp.logical_and(accept, jnp.logical_not(ok))
+            return (jnp.where(take, q_new, q), jnp.logical_or(ok, accept)), None
+
+        keys = jax.random.split(key, 8)
+        (q, _), _ = jax.lax.scan(body, (jnp.full((n,), 0.5, f64), jnp.zeros(n, bool)), keys)
+        return q
+
+    q = sample_q(k4)
+    v_esc = jnp.sqrt(2.0 * g * total_mass) * (
+        r * r + scale_radius * scale_radius
+    ) ** (-0.25)
+    speed = q * v_esc
+    costh_v = jax.random.uniform(k5, (n,), dtype=f64, minval=-1.0, maxval=1.0)
+    sinth_v = jnp.sqrt(jnp.maximum(0.0, 1.0 - costh_v * costh_v))
+    phi_v = jax.random.uniform(
+        jax.random.fold_in(k5, 1), (n,), dtype=f64, minval=0.0, maxval=2.0 * jnp.pi
+    )
+    velocities = speed[:, None] * jnp.stack(
+        [sinth_v * jnp.cos(phi_v), sinth_v * jnp.sin(phi_v), costh_v], axis=1
+    )
+
+    masses = jnp.full((n,), total_mass / n, dtype=f64)
+    # Centre the realization exactly.
+    positions = positions - jnp.mean(positions, axis=0, keepdims=True)
+    velocities = velocities - jnp.mean(velocities, axis=0, keepdims=True)
+    return ParticleState(
+        positions.astype(dtype), velocities.astype(dtype), masses.astype(dtype)
+    )

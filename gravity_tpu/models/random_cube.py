@@ -1,0 +1,53 @@
+"""Uniform random-cube initial conditions — the reference's random filler.
+
+Distributions match `/root/reference/cuda.cu:129-131`,
+`/root/reference/mpi.c:98-104`, `/root/reference/pyspark.py:146-149`:
+pos ~ U(-3e11, 3e11)^3, vel ~ U(-3e4, 3e4)^3, mass ~ U(1e23, 1e25).
+Unlike the reference (unseeded `std::random_device` / `srand(time)` /
+`np.random`), generation is keyed and reproducible.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .. import constants as C
+from ..state import ParticleState
+from .solar import create_solar_system
+
+
+def generate_random_particles(
+    key: jax.Array, n: int, dtype=jnp.float32
+) -> ParticleState:
+    kp, kv, km = jax.random.split(key, 3)
+    positions = jax.random.uniform(
+        kp, (n, 3), dtype=dtype,
+        minval=-C.RANDOM_POS_BOUND, maxval=C.RANDOM_POS_BOUND,
+    )
+    velocities = jax.random.uniform(
+        kv, (n, 3), dtype=dtype,
+        minval=-C.RANDOM_VEL_BOUND, maxval=C.RANDOM_VEL_BOUND,
+    )
+    masses = jax.random.uniform(
+        km, (n,), dtype=dtype,
+        minval=C.RANDOM_MASS_LOW, maxval=C.RANDOM_MASS_HIGH,
+    )
+    return ParticleState(positions, velocities, masses)
+
+
+def create_random_cube(
+    key: jax.Array, n: int, *, include_solar: bool = True, dtype=jnp.float32
+) -> ParticleState:
+    """Solar seed padded with random particles up to N total — the IC used
+    by every reference `main` (`cuda.cu:125-138`, `mpi.c:96-107`,
+    `pyspark.py:175-184`)."""
+    if include_solar:
+        solar = create_solar_system(dtype=dtype)
+        if n < solar.n:
+            raise ValueError(f"n={n} smaller than solar seed ({solar.n})")
+        if n == solar.n:
+            return solar
+        rand = generate_random_particles(key, n - solar.n, dtype=dtype)
+        return ParticleState.concatenate([solar, rand])
+    return generate_random_particles(key, n, dtype=dtype)

@@ -1,0 +1,33 @@
+"""Solar-system seed: Sun, Earth, Mars — the exact reference constants.
+
+Reference: `/root/reference/cuda.cu:81-96`, `/root/reference/mpi.c:76-94`,
+`/root/reference/pyspark.py:124-141` (identical values in all three).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .. import constants as C
+from ..state import ParticleState
+
+
+def create_solar_system(dtype=jnp.float32) -> ParticleState:
+    positions = jnp.asarray(
+        [
+            [0.0, 0.0, 0.0],  # Sun
+            [C.EARTH_ORBIT_RADIUS, 0.0, 0.0],  # Earth
+            [C.MARS_ORBIT_RADIUS, 0.0, 0.0],  # Mars
+        ],
+        dtype=dtype,
+    )
+    velocities = jnp.asarray(
+        [
+            [0.0, 0.0, 0.0],
+            [0.0, C.EARTH_ORBIT_SPEED, 0.0],
+            [0.0, C.MARS_ORBIT_SPEED, 0.0],
+        ],
+        dtype=dtype,
+    )
+    masses = jnp.asarray([C.SUN_MASS, C.EARTH_MASS, C.MARS_MASS], dtype=dtype)
+    return ParticleState(positions, velocities, masses)

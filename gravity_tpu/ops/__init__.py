@@ -1,0 +1,41 @@
+"""Compute ops: force laws, integrators, diagnostics, Pallas kernels."""
+
+from .diagnostics import (
+    center_of_mass,
+    energy_drift,
+    kinetic_energy,
+    total_angular_momentum,
+    total_energy,
+    total_momentum,
+)
+from .forces import (
+    accelerations_vs,
+    pairwise_accelerations_chunked,
+    pairwise_accelerations_dense,
+    potential_energy,
+)
+from .integrators import (
+    INTEGRATORS,
+    leapfrog_kdk,
+    make_step_fn,
+    semi_implicit_euler,
+    velocity_verlet,
+)
+
+__all__ = [
+    "INTEGRATORS",
+    "accelerations_vs",
+    "center_of_mass",
+    "energy_drift",
+    "kinetic_energy",
+    "leapfrog_kdk",
+    "make_step_fn",
+    "pairwise_accelerations_chunked",
+    "pairwise_accelerations_dense",
+    "potential_energy",
+    "semi_implicit_euler",
+    "total_angular_momentum",
+    "total_energy",
+    "total_momentum",
+    "velocity_verlet",
+]

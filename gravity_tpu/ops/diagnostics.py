@@ -1,0 +1,53 @@
+"""Conserved-quantity diagnostics: energy, momentum, angular momentum, COM.
+
+The reference has no diagnostics (validation is eyeballing printed positions,
+`/root/reference/mpi.c:249-257`); these are the quantitative replacements the
+test suite uses (energy drift bounds, momentum conservation).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..constants import CUTOFF_RADIUS, G
+from ..state import ParticleState
+from .forces import potential_energy
+
+
+def kinetic_energy(state: ParticleState) -> jnp.ndarray:
+    v2 = jnp.sum(state.velocities * state.velocities, axis=-1)
+    return 0.5 * jnp.sum(state.masses * v2)
+
+
+def total_energy(
+    state: ParticleState,
+    *,
+    g: float = G,
+    cutoff: float = CUTOFF_RADIUS,
+    eps: float = 0.0,
+) -> jnp.ndarray:
+    return kinetic_energy(state) + potential_energy(
+        state.positions, state.masses, g=g, cutoff=cutoff, eps=eps
+    )
+
+
+def total_momentum(state: ParticleState) -> jnp.ndarray:
+    return jnp.sum(state.masses[:, None] * state.velocities, axis=0)
+
+
+def total_angular_momentum(state: ParticleState) -> jnp.ndarray:
+    return jnp.sum(
+        state.masses[:, None]
+        * jnp.cross(state.positions, state.velocities),
+        axis=0,
+    )
+
+
+def center_of_mass(state: ParticleState) -> jnp.ndarray:
+    m = jnp.sum(state.masses)
+    return jnp.sum(state.masses[:, None] * state.positions, axis=0) / m
+
+
+def energy_drift(initial_energy, current_energy) -> jnp.ndarray:
+    """|dE / E0| — the standard symplectic-integrator quality metric."""
+    return jnp.abs((current_energy - initial_energy) / initial_energy)

@@ -1,0 +1,27 @@
+"""Parallelism: device meshes, sharded force strategies, multi-slice."""
+
+from .mesh import (
+    DCN_AXIS,
+    SHARD_AXIS,
+    initialize_distributed,
+    make_particle_mesh,
+    num_shards,
+    particle_sharding,
+    particle_spec,
+    shard_state,
+)
+from .multislice import hierarchical_ring_accel
+from .sharded import make_sharded_accel_fn
+
+__all__ = [
+    "DCN_AXIS",
+    "SHARD_AXIS",
+    "hierarchical_ring_accel",
+    "initialize_distributed",
+    "make_particle_mesh",
+    "make_sharded_accel_fn",
+    "num_shards",
+    "particle_sharding",
+    "particle_spec",
+    "shard_state",
+]

@@ -1,0 +1,83 @@
+"""Device mesh construction and distributed initialization.
+
+TPU-native replacement for the reference's process bootstrap
+(`/root/reference/mpi.c:142-144` MPI_Init/Comm_rank/Comm_size and the
+SparkSession builder at `/root/reference/pyspark.py:49-53`): one
+``jax.distributed.initialize()`` (multi-host) plus a named ``Mesh`` whose
+axes carry the collectives. Single-axis ``("shard",)`` meshes ride ICI;
+the two-axis ``("dcn", "shard")`` mesh is the multi-slice layout where the
+outer axis crosses DCN (see :mod:`gravity_tpu.parallel.multislice`).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+SHARD_AXIS = "shard"
+DCN_AXIS = "dcn"
+
+
+def initialize_distributed(**kwargs) -> None:
+    """Multi-host bootstrap.
+
+    Calls ``jax.distributed.initialize`` directly (it auto-detects cluster
+    environments); checking ``jax.process_count()`` first would itself
+    initialize a single-process backend and make multi-host init
+    impossible. Swallows the error raised outside any cluster environment
+    so single-process callers can use this unconditionally.
+    """
+    try:
+        jax.distributed.initialize(**kwargs)
+    except (ValueError, RuntimeError):
+        if kwargs:
+            raise  # explicit coordinates that fail are a real error
+
+
+
+def make_particle_mesh(
+    mesh_shape: Optional[Sequence[int]] = None,
+    *,
+    num_slices: int = 1,
+) -> Mesh:
+    """A mesh whose axes shard the particle axis.
+
+    ``mesh_shape=None`` uses all visible devices on one ``"shard"`` axis.
+    ``num_slices > 1`` builds the hierarchical ``("dcn", "shard")`` mesh
+    used by the multi-slice path.
+    """
+    n_dev = len(jax.devices())
+    if mesh_shape is None:
+        if num_slices > 1:
+            if n_dev % num_slices:
+                raise ValueError(
+                    f"{n_dev} devices not divisible into {num_slices} slices"
+                )
+            mesh_shape = (num_slices, n_dev // num_slices)
+        else:
+            mesh_shape = (n_dev,)
+    axis_names = (
+        (DCN_AXIS, SHARD_AXIS) if len(mesh_shape) == 2 else (SHARD_AXIS,)
+    )
+    return jax.make_mesh(tuple(mesh_shape), axis_names)
+
+
+def particle_sharding(mesh: Mesh) -> NamedSharding:
+    """Shard the leading (particle) axis over every mesh axis."""
+    return NamedSharding(mesh, P(mesh.axis_names))
+
+
+def particle_spec(mesh: Mesh) -> P:
+    return P(mesh.axis_names)
+
+
+def shard_state(state, mesh: Mesh):
+    """Place a ParticleState on the mesh, sharded along the particle axis."""
+    sharding = particle_sharding(mesh)
+    return jax.tree.map(lambda x: jax.device_put(x, sharding), state)
+
+
+def num_shards(mesh: Mesh) -> int:
+    return mesh.size

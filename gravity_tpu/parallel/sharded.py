@@ -1,0 +1,116 @@
+"""Sharded direct-sum force strategies under ``shard_map``.
+
+TPU-native replacements for the reference's MPI exchange
+(`/root/reference/mpi.c:160,182` MPI_Bcast; `mpi.c:227-231` per-step
+MPI_Allgatherv; `mpi.c:236` MPI_Barrier):
+
+- **allgather** — each chip ``lax.all_gather``s (positions, masses) over the
+  mesh axis, then runs the local kernel for its particle slice against the
+  full source set. This is the direct translation of the MPI backend's
+  "compute my slice against everyone" loop (`mpi.c:196-216`), with the
+  barrier implicit in XLA program semantics. O(N) memory per chip.
+
+- **ring** — a systolic ``lax.ppermute`` ring: the source shard circulates
+  around the mesh axis; each chip accumulates partial accelerations from one
+  remote shard per hop. O(N/P) memory per chip, and XLA's latency-hiding
+  scheduler overlaps each hop's collective-permute with the force compute of
+  the previous hop — the ring-attention analog for N-body, and the scaling
+  path the reference lacks entirely (its only pattern is full replication).
+
+Both are pure functions of (positions, masses) so they slot into any
+integrator as the ``accel_fn``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..constants import CUTOFF_RADIUS, G
+from ..ops.forces import accelerations_vs
+
+# local_kernel(pos_targets (M,3), pos_sources (K,3), masses_sources (K,))
+# -> (M,3). Dense jnp and the Pallas kernel both implement this signature.
+LocalKernel = Callable[[jax.Array, jax.Array, jax.Array], jax.Array]
+
+
+def _allgather_accel(pos_l, m_l, *, axes, local_kernel):
+    all_pos = jax.lax.all_gather(pos_l, axes, tiled=True)
+    all_m = jax.lax.all_gather(m_l, axes, tiled=True)
+    return local_kernel(pos_l, all_pos, all_m)
+
+
+def _ring_accel(pos_l, m_l, *, axis, local_kernel):
+    """Systolic ring over one mesh axis: P hops, one source shard per hop."""
+    p = jax.lax.axis_size(axis)
+    perm = [(i, (i + 1) % p) for i in range(p)]
+
+    def hop(carry, _):
+        acc, src_pos, src_m = carry
+        # Kick off the permute "first" so XLA can overlap it with compute.
+        next_pos = jax.lax.ppermute(src_pos, axis, perm)
+        next_m = jax.lax.ppermute(src_m, axis, perm)
+        acc = acc + local_kernel(pos_l, src_pos, src_m)
+        return (acc, next_pos, next_m), None
+
+    acc0 = jnp.zeros_like(pos_l)
+    (acc, _, _), _ = jax.lax.scan(hop, (acc0, pos_l, m_l), None, length=p)
+    return acc
+
+
+def make_sharded_accel_fn(
+    mesh: Mesh,
+    masses: jax.Array,
+    *,
+    strategy: str = "allgather",
+    local_kernel: LocalKernel | None = None,
+    g: float = G,
+    cutoff: float = CUTOFF_RADIUS,
+    eps: float = 0.0,
+) -> Callable[[jax.Array], jax.Array]:
+    """Build ``accel_fn(positions) -> accelerations`` over a sharded mesh.
+
+    ``masses`` is captured and passed through shard_map explicitly (so it
+    shards along with positions). N must be divisible by mesh.size — pad with
+    ``ParticleState.pad_to`` otherwise (zero-mass padding is exact).
+    """
+    if local_kernel is None:
+        local_kernel = partial(accelerations_vs, g=g, cutoff=cutoff, eps=eps)
+    axes = mesh.axis_names
+    spec = P(axes)
+
+    if strategy == "allgather":
+        body = partial(_allgather_accel, axes=axes, local_kernel=local_kernel)
+    elif strategy == "ring":
+        if len(axes) == 1:
+            body = partial(_ring_accel, axis=axes[0], local_kernel=local_kernel)
+        else:
+            # Hierarchical: ring over the inner (ICI) axis of sources that
+            # were first gathered over the outer (DCN) axis — see multislice.
+            from .multislice import hierarchical_ring_accel
+
+            body = partial(
+                hierarchical_ring_accel,
+                outer_axis=axes[0],
+                inner_axis=axes[1],
+                local_kernel=local_kernel,
+            )
+    else:
+        raise ValueError(f"unknown sharding strategy {strategy!r}")
+
+    sharded = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+
+    def accel_fn(positions: jax.Array) -> jax.Array:
+        return sharded(positions, masses)
+
+    return accel_fn

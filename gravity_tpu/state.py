@@ -1,0 +1,108 @@
+"""Particle state: a structure-of-arrays pytree.
+
+The reference stores particles as arrays-of-structs (`struct Particle`
+at `/root/reference/cuda.cu:14-29`, `/root/reference/mpi.c:17-21`, the
+``Particle`` dataclass at `/root/reference/pyspark.py:10-29`). On TPU the
+idiomatic layout is SoA jnp arrays — ``positions (N, 3)``,
+``velocities (N, 3)``, ``masses (N,)`` — registered as a pytree so the whole
+state flows through ``jit``/``shard_map``/``scan`` and shards along the
+particle axis.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class ParticleState:
+    """SoA particle state. All leaves share the leading particle axis N."""
+
+    positions: jax.Array  # (N, 3)
+    velocities: jax.Array  # (N, 3)
+    masses: jax.Array  # (N,)
+
+    @property
+    def n(self) -> int:
+        return self.positions.shape[0]
+
+    @property
+    def dtype(self) -> Any:
+        return self.positions.dtype
+
+    def astype(self, dtype) -> "ParticleState":
+        return ParticleState(
+            positions=self.positions.astype(dtype),
+            velocities=self.velocities.astype(dtype),
+            masses=self.masses.astype(dtype),
+        )
+
+    def replace(self, **kwargs) -> "ParticleState":
+        return dataclasses.replace(self, **kwargs)
+
+    @staticmethod
+    def create(positions, velocities, masses, dtype=None) -> "ParticleState":
+        positions = jnp.asarray(positions)
+        velocities = jnp.asarray(velocities)
+        masses = jnp.asarray(masses)
+        if dtype is not None:
+            positions = positions.astype(dtype)
+            velocities = velocities.astype(dtype)
+            masses = masses.astype(dtype)
+        if positions.ndim != 2 or positions.shape[1] != 3:
+            raise ValueError(f"positions must be (N, 3), got {positions.shape}")
+        if velocities.shape != positions.shape:
+            raise ValueError(
+                f"velocities {velocities.shape} must match positions "
+                f"{positions.shape}"
+            )
+        if masses.shape != (positions.shape[0],):
+            raise ValueError(f"masses must be (N,), got {masses.shape}")
+        return ParticleState(positions, velocities, masses)
+
+    @staticmethod
+    def concatenate(states: list["ParticleState"]) -> "ParticleState":
+        return ParticleState(
+            positions=jnp.concatenate([s.positions for s in states], axis=0),
+            velocities=jnp.concatenate([s.velocities for s in states], axis=0),
+            masses=jnp.concatenate([s.masses for s in states], axis=0),
+        )
+
+    def pad_to(self, n_target: int) -> tuple["ParticleState", jax.Array]:
+        """Pad with zero-mass particles at rest far away; returns (state, valid mask).
+
+        Zero-mass padding exerts no force on real particles; padded particles
+        are parked at distinct far-away positions so they never trip the
+        close-approach cutoff against each other or real bodies.
+        """
+        n = self.n
+        if n_target < n:
+            raise ValueError(f"cannot pad {n} particles down to {n_target}")
+        if n_target == n:
+            return self, jnp.ones((n,), dtype=bool)
+        pad = n_target - n
+        far = jnp.asarray(1e18, dtype=self.dtype)
+        offs = (jnp.arange(pad, dtype=self.dtype) + 1.0) * jnp.asarray(
+            1e12, dtype=self.dtype
+        )
+        pad_pos = jnp.stack(
+            [far + offs, jnp.zeros_like(offs), jnp.zeros_like(offs)], axis=1
+        )
+        padded = ParticleState(
+            positions=jnp.concatenate([self.positions, pad_pos], axis=0),
+            velocities=jnp.concatenate(
+                [self.velocities, jnp.zeros((pad, 3), dtype=self.dtype)], axis=0
+            ),
+            masses=jnp.concatenate(
+                [self.masses, jnp.zeros((pad,), dtype=self.dtype)], axis=0
+            ),
+        )
+        mask = jnp.concatenate(
+            [jnp.ones((n,), dtype=bool), jnp.zeros((pad,), dtype=bool)]
+        )
+        return padded, mask

@@ -1,0 +1,14 @@
+"""Utilities: run logging, trajectory IO, timing, checkpointing."""
+
+from .logging import RunLogger
+from .timing import StepTimer, pairs_per_step, throughput
+from .trajectory import TrajectoryReader, TrajectoryWriter
+
+__all__ = [
+    "RunLogger",
+    "StepTimer",
+    "TrajectoryReader",
+    "TrajectoryWriter",
+    "pairs_per_step",
+    "throughput",
+]

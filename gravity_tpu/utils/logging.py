@@ -1,0 +1,94 @@
+"""Run logging — the reference's ``log_print`` contract, made reusable.
+
+Content contract replicated from the reference so runs are drop-in
+comparable (`/root/reference/mpi.c:110-138,242-262`,
+`/root/reference/pyspark.py:152-200`, `/root/reference/cuda.cu:98-117,140-175`):
+a timestamped file in a ``gravity_logs_*`` directory (auto-created), every
+message mirrored to stdout, a start banner with run parameters, ``Step
+k/STEPS`` progress lines, a ``Performance Statistics:`` section with total
+time and average time per step, a ``Final positions:`` section with one
+``Particle i: (x, y, z)`` line per particle, and a closing ``Simulation
+completed successfully`` line.
+"""
+
+from __future__ import annotations
+
+import datetime
+import os
+from typing import Optional
+
+import numpy as np
+
+
+class RunLogger:
+    """Mirrors messages to stdout and a timestamped log file."""
+
+    def __init__(
+        self,
+        log_dir: str = "gravity_logs_tpu",
+        prefix: str = "simulation_log",
+        quiet: bool = False,
+        timestamp: Optional[str] = None,
+    ):
+        os.makedirs(log_dir, exist_ok=True)
+        self.timestamp = timestamp or datetime.datetime.now().strftime(
+            "%Y%m%d_%H%M%S"
+        )
+        self.path = os.path.join(log_dir, f"{prefix}_{self.timestamp}.txt")
+        self.quiet = quiet
+
+    def log_print(self, message: str) -> None:
+        if not self.quiet:
+            print(message)
+        with open(self.path, "a") as f:
+            f.write(message + "\n")
+
+    # --- the reference log sections ---
+
+    def start_banner(
+        self, *, num_devices: int, num_particles: int, steps: int, dt: float,
+        model: str, integrator: str, backend: str, sharding: str,
+        dtype: str,
+    ) -> None:
+        self.log_print(
+            f"Starting TPU gravity simulation at {self.timestamp}"
+        )
+        self.log_print(f"Number of devices: {num_devices}")
+        self.log_print(f"Number of particles: {num_particles}")
+        self.log_print(f"Steps: {steps}")
+        self.log_print(f"Timestep: {dt:f} seconds")
+        self.log_print(
+            f"Model: {model} | Integrator: {integrator} | "
+            f"Force backend: {backend} | Sharding: {sharding} | Dtype: {dtype}"
+        )
+        self.log_print("")
+
+    def progress(self, step: int, total_steps: int) -> None:
+        self.log_print(f"Step {step}/{total_steps}")
+
+    def performance(self, total_time: float, steps: int,
+                    pairs_per_sec: Optional[float] = None) -> None:
+        self.log_print("\nPerformance Statistics:")
+        self.log_print(f"Total execution time: {total_time:.2f} seconds")
+        self.log_print(
+            f"Average time per step: {total_time / max(steps, 1):.4f} seconds"
+        )
+        if pairs_per_sec is not None:
+            self.log_print(
+                f"Pair interactions per second: {pairs_per_sec:.4e}"
+            )
+
+    def final_positions(self, positions, max_particles: int = 10) -> None:
+        positions = np.asarray(positions)
+        self.log_print("\nFinal positions:")
+        n = min(len(positions), max_particles)
+        for i in range(n):
+            x, y, z = positions[i]
+            self.log_print(f"Particle {i}: ({x:e}, {y:e}, {z:e})")
+        if len(positions) > n:
+            self.log_print(
+                f"... ({len(positions) - n} more particles omitted)"
+            )
+
+    def completed(self) -> None:
+        self.log_print("\nSimulation completed successfully")

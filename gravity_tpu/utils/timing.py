@@ -1,0 +1,67 @@
+"""Timing and throughput metrics.
+
+The reference's only observability is wall-clock around the step loop
+(`/root/reference/mpi.c:189,239`, `/root/reference/cuda.cu:154,169-171`,
+`/root/reference/pyspark.py:107,117-118`). We keep that metric (total time,
+avg time/step) and add the primary benchmark metric from BASELINE.json:
+pair-interactions per second (per chip).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+
+def pairs_per_step(n: int, *, direct_sum: bool = True) -> int:
+    """Pair interactions evaluated per force evaluation.
+
+    We count the full N*(N-1) directed interaction set (each of N particles
+    sums over N-1 sources), matching how the dense/Pallas kernels actually
+    evaluate it.
+    """
+    del direct_sum
+    return n * (n - 1)
+
+
+@dataclass
+class StepTimer:
+    """Wall-clock timer with per-step marks."""
+
+    start_time: float = 0.0
+    marks: list = field(default_factory=list)
+
+    def start(self) -> None:
+        self.start_time = time.perf_counter()
+        self.marks = []
+
+    def mark(self) -> float:
+        now = time.perf_counter()
+        self.marks.append(now)
+        return now - self.start_time
+
+    @property
+    def total(self) -> float:
+        last = self.marks[-1] if self.marks else time.perf_counter()
+        return last - self.start_time
+
+    def avg_step(self, steps: int) -> float:
+        return self.total / max(steps, 1)
+
+
+def throughput(
+    n: int, steps: int, total_time: float, *, num_devices: int = 1,
+    force_evals_per_step: int = 1,
+) -> dict:
+    """Benchmark summary: pair-interactions/sec (total and per chip)."""
+    pairs = pairs_per_step(n) * steps * force_evals_per_step
+    per_sec = pairs / total_time if total_time > 0 else float("inf")
+    return {
+        "n": n,
+        "steps": steps,
+        "total_time_s": total_time,
+        "avg_step_s": total_time / max(steps, 1),
+        "pair_interactions": pairs,
+        "pairs_per_sec": per_sec,
+        "pairs_per_sec_per_chip": per_sec / max(num_devices, 1),
+    }

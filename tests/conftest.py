@@ -1,0 +1,38 @@
+"""Test configuration: force an 8-device virtual CPU platform.
+
+The JAX analog of the reference's Spark `local[cores]` trick
+(`/root/reference/pyspark.py:49`): multi-device sharding is exercised
+without a pod via ``--xla_force_host_platform_device_count=8``. Must run
+before jax initializes a backend, hence the env mutation at import time.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+import pytest  # noqa: E402
+
+# The axon sitecustomize registers the tunneled TPU backend in every Python
+# process and force-overrides jax_platforms to "axon,cpu" — the env var
+# alone is not enough. Re-override after import so tests run on the
+# 8-device virtual CPU platform (true float64, deterministic).
+jax.config.update("jax_platforms", "cpu")
+
+
+@pytest.fixture
+def key():
+    return jax.random.PRNGKey(0)
+
+
+@pytest.fixture
+def x64():
+    """Enable float64 for the duration of a test (parity vs fp64 reference)."""
+    jax.config.update("jax_enable_x64", True)
+    yield
+    jax.config.update("jax_enable_x64", False)

@@ -1,0 +1,48 @@
+"""Behavioral oracle: the reference's math, re-implemented in NumPy float64.
+
+Implements the cross-backend spec from SURVEY §2f (force law
+F = G m_i m_j / r^2 along r_hat with r < 1e-10 -> zero force; semi-implicit
+Euler v-then-x update) as plain double-precision NumPy loops — the ground
+truth the MPI backend computes (`/root/reference/mpi.c:59-73,196-215`).
+Used by parity tests: same ICs -> trajectories must match within dtype
+tolerance.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+G = 6.67430e-11
+CUTOFF = 1e-10
+
+
+def accelerations(pos: np.ndarray, masses: np.ndarray) -> np.ndarray:
+    n = pos.shape[0]
+    acc = np.zeros((n, 3), dtype=np.float64)
+    for i in range(n):
+        for j in range(n):
+            if i == j:
+                continue
+            diff = pos[j] - pos[i]
+            r = np.sqrt(np.dot(diff, diff))
+            if r < CUTOFF:
+                continue
+            # F = G m_i m_j / r^2 * (diff / r); a_i = F / m_i
+            acc[i] += G * masses[j] * diff / r**3
+    return acc
+
+
+def step_semi_implicit_euler(pos, vel, masses, dt):
+    acc = accelerations(pos, masses)
+    vel = vel + acc * dt
+    pos = pos + vel * dt
+    return pos, vel
+
+
+def simulate(pos, vel, masses, dt, steps):
+    pos = pos.astype(np.float64).copy()
+    vel = vel.astype(np.float64).copy()
+    masses = masses.astype(np.float64)
+    for _ in range(steps):
+        pos, vel = step_semi_implicit_euler(pos, vel, masses, dt)
+    return pos, vel

@@ -1,0 +1,128 @@
+"""Force-law unit tests: analytic 2-body, cutoff, 3rd law, oracle parity."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gravity_tpu.constants import CUTOFF_RADIUS, G
+from gravity_tpu.models import create_solar_system
+from gravity_tpu.ops.forces import (
+    accelerations_vs,
+    pairwise_accelerations_chunked,
+    pairwise_accelerations_dense,
+    potential_energy,
+)
+
+from reference_oracle import accelerations as oracle_accelerations
+
+
+def test_two_body_analytic():
+    """a = G*m_other/r^2 toward the other body."""
+    r = 1.0e11
+    m1, m2 = 1.0e30, 2.0e24
+    pos = jnp.asarray([[0.0, 0.0, 0.0], [r, 0.0, 0.0]], jnp.float32)
+    masses = jnp.asarray([m1, m2], jnp.float32)
+    acc = pairwise_accelerations_dense(pos, masses)
+    np.testing.assert_allclose(acc[0, 0], G * m2 / r**2, rtol=1e-6)
+    np.testing.assert_allclose(acc[1, 0], -G * m1 / r**2, rtol=1e-6)
+    np.testing.assert_allclose(acc[:, 1:], 0.0, atol=1e-20)
+
+
+def test_cutoff_zeroes_close_pairs():
+    """r < 1e-10 -> zero force (reference cutoff), and no NaNs."""
+    pos = jnp.asarray([[0.0, 0.0, 0.0], [5e-11, 0.0, 0.0]], jnp.float32)
+    masses = jnp.asarray([1.0e30, 1.0e30], jnp.float32)
+    acc = pairwise_accelerations_dense(pos, masses)
+    assert bool(jnp.all(jnp.isfinite(acc)))
+    np.testing.assert_array_equal(np.asarray(acc), 0.0)
+
+
+def test_self_interaction_excluded():
+    pos = jnp.asarray([[1.0, 2.0, 3.0]], jnp.float32)
+    masses = jnp.asarray([1.0e30], jnp.float32)
+    acc = pairwise_accelerations_dense(pos, masses)
+    np.testing.assert_array_equal(np.asarray(acc), 0.0)
+
+
+def test_momentum_conservation_third_law(key, x64):
+    """sum_i m_i a_i == 0 (Newton's 3rd law in aggregate)."""
+    pos = jax.random.normal(key, (64, 3), jnp.float64) * 1e11
+    masses = jax.random.uniform(
+        jax.random.fold_in(key, 1), (64,), jnp.float64, minval=1e23,
+        maxval=1e25,
+    )
+    acc = pairwise_accelerations_dense(pos, masses)
+    total_force = jnp.sum(masses[:, None] * acc, axis=0)
+    scale = jnp.max(jnp.abs(masses[:, None] * acc))
+    np.testing.assert_allclose(
+        np.asarray(total_force / scale), 0.0, atol=1e-12
+    )
+
+
+def test_oracle_parity_random_n8(key, x64):
+    """Dense jnp force == the reference's per-pair loop math (fp64)."""
+    pos = np.asarray(
+        jax.random.uniform(key, (8, 3), jnp.float64, minval=-3e11, maxval=3e11)
+    )
+    masses = np.asarray(
+        jax.random.uniform(
+            jax.random.fold_in(key, 1), (8,), jnp.float64,
+            minval=1e23, maxval=1e25,
+        )
+    )
+    expected = oracle_accelerations(pos, masses)
+    got = pairwise_accelerations_dense(jnp.asarray(pos), jnp.asarray(masses))
+    np.testing.assert_allclose(np.asarray(got), expected, rtol=1e-12)
+
+
+def test_chunked_matches_dense(key, x64):
+    pos = jax.random.normal(key, (256, 3), jnp.float64) * 1e11
+    masses = jax.random.uniform(
+        jax.random.fold_in(key, 1), (256,), jnp.float64, minval=1e23,
+        maxval=1e25,
+    )
+    dense = pairwise_accelerations_dense(pos, masses)
+    chunked = pairwise_accelerations_chunked(pos, masses, chunk=64)
+    np.testing.assert_allclose(np.asarray(chunked), np.asarray(dense),
+                               rtol=1e-13)
+
+
+def test_accelerations_vs_rectangular(key, x64):
+    """Targets != sources: matches the target rows of the dense result."""
+    pos = jax.random.normal(key, (32, 3), jnp.float64) * 1e11
+    masses = jax.random.uniform(
+        jax.random.fold_in(key, 1), (32,), jnp.float64, minval=1e23,
+        maxval=1e25,
+    )
+    dense = pairwise_accelerations_dense(pos, masses)
+    sliced = accelerations_vs(pos[:8], pos, masses)
+    np.testing.assert_allclose(np.asarray(sliced), np.asarray(dense[:8]),
+                               rtol=1e-13)
+
+
+def test_softening_bounds_force():
+    """With eps > 0 the acceleration is bounded as r -> 0."""
+    eps = 1e9
+    pos = jnp.asarray([[0.0, 0.0, 0.0], [1.0, 0.0, 0.0]], jnp.float32)
+    masses = jnp.asarray([1.0e30, 1.0e30], jnp.float32)
+    acc = pairwise_accelerations_dense(pos, masses, eps=eps)
+    bound = G * 1.0e30 / eps**2
+    assert float(jnp.max(jnp.abs(acc))) <= bound
+
+
+def test_potential_energy_two_body(x64):
+    r = 1.0e11
+    m1, m2 = 1.0e30, 2.0e24
+    pos = jnp.asarray([[0.0, 0.0, 0.0], [r, 0.0, 0.0]], jnp.float64)
+    masses = jnp.asarray([m1, m2], jnp.float64)
+    pe = potential_energy(pos, masses)
+    np.testing.assert_allclose(float(pe), -G * m1 * m2 / r, rtol=1e-12)
+
+
+def test_solar_system_earth_acceleration(x64):
+    """Earth's acceleration toward the Sun ~ G*M_sun/r^2 (+ Mars term)."""
+    state = create_solar_system(dtype=jnp.float64)
+    acc = pairwise_accelerations_dense(state.positions, state.masses)
+    a_expected = -G * 1.989e30 / 1.496e11**2
+    np.testing.assert_allclose(float(acc[1, 0]), a_expected, rtol=1e-3)

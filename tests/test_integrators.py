@@ -1,0 +1,168 @@
+"""Integrator tests: oracle parity, convergence order, energy behavior."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gravity_tpu.constants import DEFAULT_DT, G
+from gravity_tpu.models import create_solar_system
+from gravity_tpu.ops.diagnostics import energy_drift, total_energy
+from gravity_tpu.ops.forces import pairwise_accelerations_dense
+from gravity_tpu.ops.integrators import (
+    init_carry,
+    leapfrog_kdk,
+    make_step_fn,
+    semi_implicit_euler,
+    velocity_verlet,
+)
+from gravity_tpu.state import ParticleState
+
+from reference_oracle import simulate as oracle_simulate
+
+
+def _accel_fn(masses, **kwargs):
+    return lambda pos: pairwise_accelerations_dense(pos, masses, **kwargs)
+
+
+def _two_body_circular(dtype=jnp.float64):
+    """Sun + satellite on an exactly circular orbit."""
+    m_sun = 1.989e30
+    r = 1.496e11
+    v = np.sqrt(G * m_sun / r)
+    pos = jnp.asarray([[0.0, 0.0, 0.0], [r, 0.0, 0.0]], dtype)
+    vel = jnp.asarray([[0.0, 0.0, 0.0], [0.0, v, 0.0]], dtype)
+    masses = jnp.asarray([m_sun, 1.0e3], dtype)
+    return ParticleState(pos, vel, masses)
+
+
+def test_euler_oracle_parity_500_steps(key, x64):
+    """Semi-implicit Euler at N=8, 500 steps, dt=3600 == the reference's
+    update loop math (the reference-mpi workload) to fp64 tolerance."""
+    state = create_solar_system(dtype=jnp.float64)
+    kpos, kvel, km = jax.random.split(key, 3)
+    rand = ParticleState(
+        positions=jax.random.uniform(kpos, (5, 3), jnp.float64,
+                                     minval=-3e11, maxval=3e11),
+        velocities=jax.random.uniform(kvel, (5, 3), jnp.float64,
+                                      minval=-3e4, maxval=3e4),
+        masses=jax.random.uniform(km, (5,), jnp.float64,
+                                  minval=1e23, maxval=1e25),
+    )
+    state = ParticleState.concatenate([state, rand])
+    exp_pos, exp_vel = oracle_simulate(
+        np.asarray(state.positions), np.asarray(state.velocities),
+        np.asarray(state.masses), DEFAULT_DT, 500,
+    )
+
+    accel = _accel_fn(state.masses)
+    step = make_step_fn("euler", accel, DEFAULT_DT)
+
+    def body(carry, _):
+        st, acc = carry
+        return step(st, acc), None
+
+    (final, _), _ = jax.lax.scan(
+        body, (state, init_carry(accel, state)), None, length=500
+    )
+    np.testing.assert_allclose(np.asarray(final.positions), exp_pos,
+                               rtol=1e-10)
+    np.testing.assert_allclose(np.asarray(final.velocities), exp_vel,
+                               rtol=1e-10)
+
+
+def test_earth_orbit_one_year(x64):
+    """Earth returns to its starting point after ~1 year of dt=3600 steps."""
+    state = create_solar_system(dtype=jnp.float64)
+    accel = _accel_fn(state.masses)
+    step = make_step_fn("leapfrog", accel, DEFAULT_DT)
+    steps = 8766  # hours in a year
+
+    def body(carry, _):
+        return step(*carry), None
+
+    (final, _), _ = jax.lax.scan(
+        body, (state, init_carry(accel, state)), None, length=steps
+    )
+    start = np.asarray(state.positions[1])
+    end = np.asarray(final.positions[1])
+    # Within a few percent of the orbit radius after a full revolution.
+    assert np.linalg.norm(end - start) < 0.05 * 1.496e11
+
+
+@pytest.mark.parametrize("integrator,order", [
+    ("euler", 1), ("leapfrog", 2), ("verlet", 2),
+])
+def test_convergence_order(integrator, order, x64):
+    """Halving dt reduces the endpoint error by ~2^order."""
+    state = _two_body_circular()
+    accel = _accel_fn(state.masses)
+    t_total = 400_000.0
+
+    def endpoint_error(n_steps):
+        dt = t_total / n_steps
+        step = make_step_fn(integrator, accel, dt)
+
+        def body(carry, _):
+            return step(*carry), None
+
+        (final, _), _ = jax.lax.scan(
+            body, (state, init_carry(accel, state)), None, length=n_steps
+        )
+        # Exact solution: circular orbit with angular rate v/r.
+        r = 1.496e11
+        v = np.sqrt(G * 1.989e30 / r)
+        theta = v / r * t_total
+        exact = np.asarray([r * np.cos(theta), r * np.sin(theta), 0.0])
+        return np.linalg.norm(np.asarray(final.positions[1]) - exact)
+
+    e1 = endpoint_error(400)
+    e2 = endpoint_error(800)
+    rate = np.log2(e1 / e2)
+    assert rate > order - 0.35, f"observed rate {rate:.2f} < {order}"
+
+
+@pytest.mark.parametrize("integrator", ["leapfrog", "verlet"])
+def test_symplectic_energy_bounded(integrator, x64):
+    """Symplectic integrators keep |dE/E| bounded over many orbits."""
+    state = _two_body_circular()
+    accel = _accel_fn(state.masses)
+    dt = 50_000.0
+    step = make_step_fn(integrator, accel, dt)
+    e0 = total_energy(state)
+
+    def body(carry, _):
+        st, acc = carry
+        st, acc = step(st, acc)
+        return (st, acc), total_energy(st)
+
+    (_, _), energies = jax.lax.scan(
+        body, (state, init_carry(accel, state)), None, length=2000
+    )
+    drift = np.abs((np.asarray(energies) - float(e0)) / float(e0))
+    assert drift.max() < 1e-4
+
+
+def test_leapfrog_verlet_equivalent(x64):
+    """KDK leapfrog and velocity Verlet are algebraically identical."""
+    state = _two_body_circular()
+    accel = _accel_fn(state.masses)
+    s1, a1 = leapfrog_kdk(state, 1000.0, accel)
+    s2, a2 = velocity_verlet(state, 1000.0, accel)
+    np.testing.assert_allclose(np.asarray(s1.positions),
+                               np.asarray(s2.positions), rtol=1e-12)
+    np.testing.assert_allclose(np.asarray(s1.velocities),
+                               np.asarray(s2.velocities), rtol=1e-12)
+
+
+def test_euler_matches_manual_step(x64):
+    """v += a dt; x += v_new dt — exactly the reference's update order."""
+    state = _two_body_circular()
+    accel = _accel_fn(state.masses)
+    dt = 3600.0
+    acc = accel(state.positions)
+    out = semi_implicit_euler(state, dt, accel)
+    v_new = state.velocities + acc * dt
+    x_new = state.positions + v_new * dt
+    np.testing.assert_allclose(np.asarray(out.velocities), np.asarray(v_new))
+    np.testing.assert_allclose(np.asarray(out.positions), np.asarray(x_new))

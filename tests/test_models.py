@@ -1,0 +1,114 @@
+"""Model-family (initial condition) tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gravity_tpu import constants as C
+from gravity_tpu.models import (
+    MODELS,
+    create_cold_collapse,
+    create_disk,
+    create_merger,
+    create_model,
+    create_plummer,
+    create_random_cube,
+    create_solar_system,
+)
+from gravity_tpu.ops.diagnostics import (
+    kinetic_energy,
+    total_momentum,
+)
+from gravity_tpu.ops.forces import potential_energy
+
+
+def test_solar_system_exact_constants(x64):
+    """The seed bodies carry the exact reference constants (SURVEY §2f)."""
+    s = create_solar_system(dtype=jnp.float64)
+    np.testing.assert_array_equal(
+        np.asarray(s.masses), [1.989e30, 5.972e24, 6.39e23]
+    )
+    np.testing.assert_array_equal(
+        np.asarray(s.positions),
+        [[0, 0, 0], [1.496e11, 0, 0], [2.279e11, 0, 0]],
+    )
+    np.testing.assert_array_equal(
+        np.asarray(s.velocities),
+        [[0, 0, 0], [0, 29.78e3, 0], [0, 24.077e3, 0]],
+    )
+
+
+def test_random_cube_ranges(key):
+    s = create_random_cube(key, 1000)
+    assert s.n == 1000
+    # First three are the solar seed.
+    assert float(s.masses[0]) == np.float32(1.989e30)
+    rand_pos = np.asarray(s.positions[3:])
+    rand_vel = np.asarray(s.velocities[3:])
+    rand_m = np.asarray(s.masses[3:])
+    assert np.all(np.abs(rand_pos) <= C.RANDOM_POS_BOUND)
+    assert np.all(np.abs(rand_vel) <= C.RANDOM_VEL_BOUND)
+    assert np.all(rand_m >= C.RANDOM_MASS_LOW)
+    assert np.all(rand_m <= C.RANDOM_MASS_HIGH)
+
+
+def test_random_cube_reproducible(key):
+    a = create_random_cube(key, 100)
+    b = create_random_cube(key, 100)
+    np.testing.assert_array_equal(np.asarray(a.positions),
+                                  np.asarray(b.positions))
+
+
+def test_plummer_virial_equilibrium(key):
+    """2T/|U| ~ 1 for a relaxed Plummer sphere."""
+    s = create_plummer(key, 4096)
+    t = float(kinetic_energy(s))
+    u = float(potential_energy(s.positions, s.masses))
+    ratio = 2 * t / abs(u)
+    assert 0.8 < ratio < 1.2, f"virial ratio {ratio}"
+
+
+def test_plummer_centered(key):
+    s = create_plummer(key, 2048)
+    com = np.asarray(total_momentum(s))
+    assert np.all(np.abs(com) < 1e-2 * float(jnp.sum(s.masses)) * 1.0)
+
+
+def test_cold_collapse_cold(key):
+    s = create_cold_collapse(key, 1024)
+    assert float(jnp.max(jnp.abs(s.velocities))) == 0.0
+    r = np.linalg.norm(np.asarray(s.positions), axis=1)
+    # Re-centering on the COM can push radii slightly past the nominal R.
+    assert r.max() <= 1.0e13 * 1.05
+
+
+def test_disk_rotates(key):
+    s = create_disk(key, 2048)
+    pos = np.asarray(s.positions[1:])
+    vel = np.asarray(s.velocities[1:])
+    # Angular momentum along +z for nearly all disk particles.
+    lz = pos[:, 0] * vel[:, 1] - pos[:, 1] * vel[:, 0]
+    assert (lz > 0).mean() > 0.95
+    # Thin: |z| << radius scale.
+    assert np.abs(pos[:, 2]).std() < 0.1 * np.linalg.norm(
+        pos[:, :2], axis=1
+    ).std()
+
+
+def test_merger_two_groups(key):
+    s = create_merger(key, 2000)
+    assert s.n == 2000
+    x = np.asarray(s.positions[:, 0])
+    # Two well-separated clumps along the separation axis.
+    assert (x < 0).sum() > 800 and (x > 0).sum() > 800
+
+
+@pytest.mark.parametrize("name", sorted(MODELS))
+def test_all_models_finite(key, name):
+    n = 3 if name == "solar" else 256
+    s = create_model(name, key, n, jnp.float32)
+    assert s.n == n
+    for leaf in (s.positions, s.velocities, s.masses):
+        assert bool(jnp.all(jnp.isfinite(leaf)))
+    assert bool(jnp.all(s.masses > 0))

@@ -1,0 +1,148 @@
+"""End-to-end Simulator tests: backends agree, sharding agrees, logs match
+the reference's log shape, trajectories stream to disk."""
+
+import dataclasses
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gravity_tpu.config import PRESETS, SimulationConfig
+from gravity_tpu.simulation import Simulator
+from gravity_tpu.utils.logging import RunLogger
+from gravity_tpu.utils.trajectory import TrajectoryReader, TrajectoryWriter
+
+
+def _small_config(**overrides):
+    base = dict(
+        model="random", n=64, steps=20, dt=3600.0, seed=1,
+        force_backend="dense", integrator="euler", log_dir=None,
+    )
+    base.update(overrides)
+    base.pop("log_dir")
+    return SimulationConfig(**base)
+
+
+def test_run_completes_and_reports():
+    sim = Simulator(_small_config())
+    stats = sim.run()
+    assert stats["n"] == 64
+    assert stats["steps"] == 20
+    assert stats["pairs_per_sec"] > 0
+    final = stats["final_state"]
+    assert final.positions.shape == (64, 3)
+    assert bool(jnp.all(jnp.isfinite(final.positions)))
+
+
+@pytest.mark.parametrize("backend", ["chunked", "pallas"])
+def test_backends_agree_with_dense(backend):
+    cfg_dense = _small_config(n=128, steps=10)
+    cfg_other = dataclasses.replace(cfg_dense, force_backend=backend)
+    final_dense = Simulator(cfg_dense).run()["final_state"]
+    final_other = Simulator(cfg_other).run()["final_state"]
+    np.testing.assert_allclose(
+        np.asarray(final_other.positions),
+        np.asarray(final_dense.positions),
+        rtol=1e-4,
+    )
+
+
+@pytest.mark.parametrize("strategy", ["allgather", "ring"])
+def test_sharded_run_matches_unsharded(strategy):
+    cfg = _small_config(n=96, steps=10, integrator="leapfrog")
+    cfg_sharded = dataclasses.replace(cfg, sharding=strategy)
+    final = Simulator(cfg).run()["final_state"]
+    final_sharded = Simulator(cfg_sharded).run()["final_state"]
+    np.testing.assert_allclose(
+        np.asarray(final_sharded.positions),
+        np.asarray(final.positions),
+        rtol=1e-4, atol=1e-3,
+    )
+    assert final_sharded.positions.shape == (96, 3)
+
+
+def test_reference_log_shape(tmp_path):
+    """The run log has the reference's sections (SURVEY §5 log contract)."""
+    cfg = _small_config(steps=200)
+    logger = RunLogger(str(tmp_path / "gravity_logs_tpu"), quiet=True)
+    Simulator(cfg).run(logger)
+    text = open(logger.path).read()
+    assert "Starting TPU gravity simulation at" in text
+    assert "Number of particles: 64" in text
+    assert "Step 100/200" in text
+    assert "Step 200/200" in text
+    assert "Performance Statistics:" in text
+    assert "Total execution time:" in text
+    assert "Average time per step:" in text
+    assert "Final positions:" in text
+    assert "Particle 0: (" in text
+    assert text.rstrip().endswith("Simulation completed successfully")
+
+
+def test_trajectory_recording(tmp_path):
+    """Per-step positions stream to disk (the Spark capability,
+    /root/reference/pyspark.py:104-121, without keeping them in RAM)."""
+    cfg = _small_config(n=32, steps=15, record_trajectories=True)
+    writer = TrajectoryWriter(str(tmp_path / "traj"), 32, flush_every=4)
+    Simulator(cfg).run(trajectory_writer=writer)
+    reader = TrajectoryReader(str(tmp_path / "traj"))
+    traj = reader.load()
+    assert traj.shape == (15, 32, 3)
+    assert reader.steps == list(range(1, 16))
+    track = reader.particle_track(5)
+    assert track.shape == (15, 3)
+    # Positions actually evolve.
+    assert np.linalg.norm(track[-1] - track[0]) > 0
+
+
+def test_trajectory_stride(tmp_path):
+    """trajectory_every strides frames on-device: only every k-th step's
+    positions are emitted/transferred."""
+    cfg = _small_config(n=16, steps=20, record_trajectories=True,
+                        trajectory_every=5)
+    writer = TrajectoryWriter(str(tmp_path / "traj"), 16, every=1)
+    Simulator(cfg).run(trajectory_writer=writer)
+    reader = TrajectoryReader(str(tmp_path / "traj"))
+    assert reader.steps == [5, 10, 15, 20]
+    assert reader.load().shape == (4, 16, 3)
+
+
+def test_trajectory_matches_run(tmp_path):
+    """Recorded final snapshot == the run's final state."""
+    cfg = _small_config(n=16, steps=8)
+    writer = TrajectoryWriter(str(tmp_path / "traj"), 16)
+    stats = Simulator(cfg).run(trajectory_writer=writer)
+    traj = TrajectoryReader(str(tmp_path / "traj")).load()
+    np.testing.assert_allclose(
+        traj[-1], np.asarray(stats["final_state"].positions), rtol=1e-6
+    )
+
+
+def test_presets_construct():
+    for name, preset in PRESETS.items():
+        assert preset.n > 0, name
+    # The reference-mpi preset is runnable in-test (N=8, as mpi.c).
+    cfg = dataclasses.replace(
+        PRESETS["reference-mpi"], steps=5, force_backend="dense"
+    )
+    stats = Simulator(cfg).run()
+    assert stats["n"] == 8
+
+
+def test_config_json_roundtrip():
+    cfg = _small_config(sharding="ring")
+    restored = SimulationConfig.from_json(cfg.to_json())
+    assert restored == cfg
+
+
+def test_x64_mode_run():
+    cfg = _small_config(n=16, steps=5, dtype="float64")
+    jax.config.update("jax_enable_x64", True)
+    try:
+        stats = Simulator(cfg).run()
+        assert stats["final_state"].positions.dtype == jnp.float64
+    finally:
+        jax.config.update("jax_enable_x64", False)

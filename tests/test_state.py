@@ -1,0 +1,83 @@
+"""ParticleState pytree tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gravity_tpu.state import ParticleState
+
+
+def _state(n=10):
+    return ParticleState.create(
+        np.random.RandomState(0).randn(n, 3),
+        np.random.RandomState(1).randn(n, 3),
+        np.abs(np.random.RandomState(2).randn(n)) + 1.0,
+        dtype=jnp.float32,
+    )
+
+
+def test_is_pytree():
+    s = _state()
+    leaves = jax.tree.leaves(s)
+    assert len(leaves) == 3
+    mapped = jax.tree.map(lambda x: x * 2, s)
+    assert isinstance(mapped, ParticleState)
+    np.testing.assert_allclose(
+        np.asarray(mapped.masses), np.asarray(s.masses) * 2
+    )
+
+
+def test_jit_through_state():
+    s = _state()
+
+    @jax.jit
+    def f(st):
+        return st.replace(positions=st.positions + 1.0)
+
+    out = f(s)
+    np.testing.assert_allclose(
+        np.asarray(out.positions), np.asarray(s.positions) + 1.0
+    )
+
+
+def test_create_validation():
+    with pytest.raises(ValueError):
+        ParticleState.create(np.zeros((4, 2)), np.zeros((4, 2)), np.zeros(4))
+    with pytest.raises(ValueError):
+        ParticleState.create(np.zeros((4, 3)), np.zeros((3, 3)), np.zeros(4))
+    with pytest.raises(ValueError):
+        ParticleState.create(np.zeros((4, 3)), np.zeros((4, 3)), np.zeros(5))
+
+
+def test_pad_to():
+    s = _state(10)
+    padded, mask = s.pad_to(16)
+    assert padded.n == 16
+    assert mask.sum() == 10
+    np.testing.assert_array_equal(np.asarray(padded.masses[10:]), 0.0)
+    # Padded particles are far from the origin and from each other.
+    pad_pos = np.asarray(padded.positions[10:])
+    assert np.all(np.linalg.norm(pad_pos, axis=1) > 1e17)
+    from scipy.spatial.distance import pdist
+
+    assert pdist(pad_pos).min() > 1e10
+
+
+def test_pad_to_noop_and_error():
+    s = _state(10)
+    same, mask = s.pad_to(10)
+    assert same is s
+    with pytest.raises(ValueError):
+        s.pad_to(5)
+
+
+def test_concatenate():
+    a, b = _state(4), _state(6)
+    c = ParticleState.concatenate([a, b])
+    assert c.n == 10
+
+
+def test_astype():
+    s = _state().astype(jnp.bfloat16)
+    assert s.dtype == jnp.bfloat16
