@@ -41,8 +41,11 @@ def _pair_weights(r2, masses_j, g, cutoff, eps, dtype):
     cutoff2 = jnp.asarray(cutoff, dtype) ** 2
     safe_r2 = jnp.where(r2_soft > cutoff2, r2_soft, jnp.asarray(1.0, dtype))
     inv_r = jax.lax.rsqrt(safe_r2)
-    inv_r3 = inv_r * inv_r * inv_r
-    w = jnp.asarray(g, dtype) * masses_j * inv_r3
+    # CRITICAL fp32 ordering: inv_r**3 alone underflows to zero for
+    # r > ~2e12 m (1e-39 < fp32 min normal 1.2e-38, flushed), silently
+    # zeroing every distant pair's force. Folding G*m_j in before the
+    # second/third reciprocal factors keeps all intermediates in range.
+    w = ((jnp.asarray(g, dtype) * masses_j) * inv_r) * inv_r * inv_r
     return jnp.where(r2_soft > cutoff2, w, jnp.asarray(0.0, dtype))
 
 

@@ -66,7 +66,9 @@ def _nbody_kernel(xi_ref, xjt_ref, mj_ref, acc_ref, *, g, cutoff, eps):
     valid = r2_soft > cutoff2
     safe = jnp.where(valid, r2_soft, jnp.asarray(1.0, dtype))
     inv_r = jax.lax.rsqrt(safe)
-    w = jnp.where(valid, jnp.asarray(g, dtype) * mj * (inv_r * inv_r * inv_r),
+    # fp32 ordering: inv_r**3 alone underflows (subnormal flush) for
+    # r > ~2e12 m, zeroing distant pairs — fold G*m_j in first.
+    w = jnp.where(valid, ((jnp.asarray(g, dtype) * mj) * inv_r) * inv_r * inv_r,
                   jnp.asarray(0.0, dtype))  # (TI, TJ)
 
     ax = jnp.sum(w * dx, axis=1, keepdims=True)  # (TI, 1)

@@ -111,6 +111,50 @@ def test_softening_bounds_force():
     assert float(jnp.max(jnp.abs(acc))) <= bound
 
 
+def test_fp32_no_subnormal_flush(key, x64):
+    """fp32 forces match f64 on a uniform sphere (r ~ 1e13 m).
+
+    Regression: inv_r**3 at these separations is ~1e-39 — below the fp32
+    normal range — and a naive evaluation order flushes it to zero,
+    silently dropping every distant pair (a ~6x net-force error on this
+    system). The weight computation must fold G*m_j in first.
+    """
+    from gravity_tpu.models import create_cold_collapse
+
+    state = create_cold_collapse(key, 512)
+    pos64 = jnp.asarray(np.asarray(state.positions), jnp.float64)
+    m64 = jnp.asarray(np.asarray(state.masses), jnp.float64)
+    pos32 = pos64.astype(jnp.float32)
+    m32 = m64.astype(jnp.float32)
+    e64 = np.asarray(pairwise_accelerations_dense(pos64, m64))
+    e32 = np.asarray(pairwise_accelerations_dense(pos32, m32))
+    rel = np.linalg.norm(e32 - e64, axis=1) / (
+        np.linalg.norm(e64, axis=1) + 1e-300
+    )
+    assert np.median(rel) < 1e-3, f"median fp32 error {np.median(rel):.2e}"
+
+
+def test_pallas_fp32_no_subnormal_flush(key, x64):
+    """Same regression for the Pallas kernel (interpret mode)."""
+    from gravity_tpu.models import create_cold_collapse
+    from gravity_tpu.ops.pallas_forces import pallas_pairwise_accelerations
+
+    state = create_cold_collapse(key, 512)
+    pos64 = jnp.asarray(np.asarray(state.positions), jnp.float64)
+    m64 = jnp.asarray(np.asarray(state.masses), jnp.float64)
+    e64 = np.asarray(pairwise_accelerations_dense(pos64, m64))
+    e32 = np.asarray(
+        pallas_pairwise_accelerations(
+            pos64.astype(jnp.float32), m64.astype(jnp.float32),
+            tile_i=32, tile_j=128, interpret=True,
+        )
+    )
+    rel = np.linalg.norm(e32 - e64, axis=1) / (
+        np.linalg.norm(e64, axis=1) + 1e-300
+    )
+    assert np.median(rel) < 1e-3, f"median fp32 error {np.median(rel):.2e}"
+
+
 def test_potential_energy_two_body(x64):
     r = 1.0e11
     m1, m2 = 1.0e30, 2.0e24
