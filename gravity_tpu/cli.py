@@ -40,8 +40,13 @@ def _add_config_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--dtype",
                    choices=["float32", "float64", "bfloat16"], default=None)
     p.add_argument("--force-backend", dest="force_backend",
-                   choices=["auto", "dense", "chunked", "pallas"], default=None)
+                   choices=["auto", "dense", "chunked", "pallas", "tree", "pm"],
+                   default=None)
     p.add_argument("--chunk", type=int, default=None)
+    p.add_argument("--tree-depth", dest="tree_depth", type=int, default=None)
+    p.add_argument("--tree-leaf-cap", dest="tree_leaf_cap", type=int,
+                   default=None)
+    p.add_argument("--pm-grid", dest="pm_grid", type=int, default=None)
     p.add_argument("--sharding",
                    choices=["none", "allgather", "ring"], default=None)
     p.add_argument("--log-dir", dest="log_dir", default=None)

@@ -34,8 +34,12 @@ class SimulationConfig:
     # Numerics / backend
     integrator: str = "euler"  # euler (reference parity) | leapfrog | verlet
     dtype: str = "float32"
-    force_backend: str = "auto"  # auto | dense | chunked | pallas
+    # auto | dense | chunked | pallas (direct sum) | tree (octree) | pm (FFT)
+    force_backend: str = "auto"
     chunk: int = 1024
+    tree_depth: int = 0  # 0 = auto (recommended_depth)
+    tree_leaf_cap: int = 32
+    pm_grid: int = 128
 
     # Parallelism
     sharding: str = "none"  # none | allgather | ring

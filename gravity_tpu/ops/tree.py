@@ -1,0 +1,320 @@
+"""Barnes-Hut-style octree gravity, redesigned for TPU.
+
+The BASELINE 1M-body config calls for a tree code. Classic Barnes-Hut is a
+pointer-chasing recursive traversal — hostile to an accelerator that wants
+static shapes and vectorized gathers. This module is the TPU-native
+redesign: a **levelized complete octree** over the bounding cube with
+monopole (mass + center-of-mass) cells, evaluated with **fixed-shape
+interaction lists** (the FMM decomposition restricted to monopoles):
+
+Build (O(N) scatter-adds, no pointers):
+  - normalize positions into the cube, compute integer cell coords at the
+    leaf level D;
+  - for every level d, cell mass and mass-weighted COM via
+    ``segment_sum`` over the particles' level-d cell ids (dense (8^d,)
+    arrays — the whole "tree" is a pyramid of flat arrays).
+
+Force (all static shapes):
+  - for each level d in [2, D]: each particle interacts with the cells in
+    its *interaction list* — children of its parent cell's radius-ws
+    neighborhood that are not in its own radius-ws neighborhood.
+    Relative to the particle's cell these are a fixed offset set from a
+    precomputed (8-parity, offsets) mask table, so the evaluation is one
+    vectorized gather + masked monopole kernel per level;
+  - at the leaf level, the (2ws+1)^3-cell near field is an exact direct
+    sum over the particles in neighboring cells, using Morton-sorted
+    particle arrays + per-cell (start, count) tables and a static
+    per-cell occupancy cap ``leaf_cap`` (overflow beyond the cap falls
+    back to a cell-size-softened monopole, so dense cells degrade
+    gracefully instead of dropping mass or blowing up).
+
+The effective opening criterion is "accept a cell once it is >= ws cells
+away at its level" — worst-case Barnes-Hut theta ~ 0.87/ws (~0.43 at the
+default ws=2). Accuracy on grid-resolved smooth fields: ~1e-3 median
+relative force error (see tests); strongly-concentrated unresolved cores
+degrade toward the resolution-limited (PM-like) regime.
+
+The reference has no fast method at all (SURVEY §2e: its only scaling is
+parallelizing the O(N^2) pair set); this is a capability add that makes
+the 1M-body configs tractable on one chip.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..constants import CUTOFF_RADIUS, G
+
+# ---------------------------------------------------------------------------
+# Interaction-list offset table: for each parity (cell coord mod 2 per axis)
+# a boolean mask over the 7x7x7 relative-offset cube selecting cells that
+# are children-of-parent-neighbors but not own-neighbors.
+# ---------------------------------------------------------------------------
+
+def _offsets(ws: int) -> np.ndarray:
+    """Relative-offset cube for well-separatedness ws: r in [-(2ws+1), 2ws+1]."""
+    rng = range(-(2 * ws + 1), 2 * ws + 2)
+    return np.array(
+        [(dx, dy, dz) for dx in rng for dy in rng for dz in rng],
+        dtype=np.int32,
+    )
+
+
+def _parity_mask_table(ws: int) -> np.ndarray:
+    """(8, |offsets|) mask: children of the parent's radius-ws neighborhood
+    that are NOT in the cell's own radius-ws neighborhood.
+
+    ws sets the opening criterion: accepted cells are >= ws cells away, so
+    the worst-case effective Barnes-Hut theta is ~0.87/ws (ws=2 -> ~0.43,
+    the classic accuracy point for monopole-only cells).
+    """
+    offs = _offsets(ws)
+    table = np.zeros((8, len(offs)), dtype=bool)
+    for p in range(8):
+        par = np.array([(p >> 2) & 1, (p >> 1) & 1, p & 1])
+        parent_cell = np.floor((par[None, :] + offs) / 2)
+        parent_ok = np.all(
+            (parent_cell >= -ws) & (parent_cell <= ws), axis=1
+        )
+        not_near = np.max(np.abs(offs), axis=1) > ws
+        table[p] = parent_ok & not_near
+    return table
+
+
+def _near_offsets(ws: int) -> np.ndarray:
+    rng = range(-ws, ws + 1)
+    return np.array(
+        [(dx, dy, dz) for dx in rng for dy in rng for dz in rng],
+        dtype=np.int32,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Tree build
+# ---------------------------------------------------------------------------
+
+def build_octree(positions, masses, depth: int):
+    """Levelized octree: per-level (cell_mass, cell_com) dense arrays.
+
+    Returns (levels, origin, span) where levels[d] = (mass (8^d,),
+    com (8^d, 3)) for d in [0, depth].
+    """
+    dtype = positions.dtype
+    lo = jnp.min(positions, axis=0)
+    hi = jnp.max(positions, axis=0)
+    span = jnp.max(hi - lo) * 1.0001 + jnp.asarray(1e-30, dtype)
+    origin = 0.5 * (hi + lo) - 0.5 * span
+
+    side = 1 << depth
+    u = (positions - origin[None, :]) / span  # in [0, 1)
+    coords = jnp.clip((u * side).astype(jnp.int32), 0, side - 1)  # (N, 3)
+
+    # COM via normalized weights: m * x overflows fp32 for heavy bodies
+    # (1e30 kg at 5e11 m -> 5e41), so accumulate with m_hat = m/max(m).
+    m_scale = jnp.maximum(jnp.max(masses), jnp.asarray(1e-37, dtype))
+    m_hat = masses / m_scale
+    levels = []
+    mw = m_hat[:, None] * positions
+    for d in range(depth + 1):
+        sd = 1 << d
+        cd = coords >> (depth - d)
+        ids = (cd[:, 0] * sd + cd[:, 1]) * sd + cd[:, 2]
+        n_cells = sd**3
+        cmass_hat = jax.ops.segment_sum(m_hat, ids, num_segments=n_cells)
+        cmw = jax.ops.segment_sum(mw, ids, num_segments=n_cells)
+        ccom = cmw / jnp.maximum(
+            cmass_hat, jnp.asarray(1e-37, dtype)
+        )[:, None]
+        levels.append((cmass_hat * m_scale, ccom))
+    return levels, origin, span, coords
+
+
+def _monopole_acc(pos, cell_mass, cell_com, mask, g, eps, dtype):
+    """Masked monopole kernel: pos (C, 3); cells (C, L[, 3]); mask (C, L)."""
+    diff = cell_com - pos[:, None, :]  # (C, L, 3)
+    r2 = jnp.sum(diff * diff, axis=-1) + jnp.asarray(eps * eps, dtype)
+    ok = jnp.logical_and(mask, cell_mass > 0)
+    safe = jnp.where(ok, r2, jnp.asarray(1.0, dtype))
+    inv_r = jax.lax.rsqrt(safe)
+    # fp32 ordering: fold G*m in before cubing (subnormal flush guard).
+    w = jnp.where(ok, ((jnp.asarray(g, dtype) * cell_mass) * inv_r)
+                  * inv_r * inv_r, jnp.asarray(0.0, dtype))
+    # Zero masked diffs too: a masked slot may hold inf/garbage COMs and
+    # 0 * inf = NaN would poison the contraction.
+    diff = jnp.where(ok[..., None], diff, jnp.asarray(0.0, dtype))
+    return jnp.einsum("cl,cld->cd", w, diff)
+
+
+def _pair_acc(pos, src_pos, src_mass, mask, g, cutoff, eps, dtype):
+    """Masked direct-sum kernel: pos (C, 3); sources (C, L[, 3])."""
+    diff = src_pos - pos[:, None, :]
+    r2 = jnp.sum(diff * diff, axis=-1)
+    r2s = r2 + jnp.asarray(eps * eps, dtype)
+    ok = jnp.logical_and(mask, r2s > jnp.asarray(cutoff * cutoff, dtype))
+    safe = jnp.where(ok, r2s, jnp.asarray(1.0, dtype))
+    inv_r = jax.lax.rsqrt(safe)
+    w = jnp.where(ok, ((jnp.asarray(g, dtype) * src_mass) * inv_r)
+                  * inv_r * inv_r, jnp.asarray(0.0, dtype))
+    diff = jnp.where(ok[..., None], diff, jnp.asarray(0.0, dtype))
+    return jnp.einsum("cl,cld->cd", w, diff)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("depth", "leaf_cap", "chunk", "ws", "g", "cutoff", "eps"),
+)
+def tree_accelerations(
+    positions: jax.Array,
+    masses: jax.Array,
+    *,
+    depth: int = 6,
+    leaf_cap: int = 32,
+    chunk: int = 1024,
+    ws: int = 2,
+    g: float = G,
+    cutoff: float = CUTOFF_RADIUS,
+    eps: float = 0.0,
+) -> jax.Array:
+    """Octree accelerations for all particles.
+
+    ``depth`` sets the leaf grid (2^depth per axis); pick so the typical
+    occupied leaf holds ~leaf_cap/4 particles. ``leaf_cap`` is the static
+    near-field occupancy cap: the first ``leaf_cap`` particles of each
+    neighbor cell are summed exactly, the remainder enters via the cell
+    monopole. ``ws`` is the well-separatedness (cells >= ws apart are
+    monopole-approximated; effective worst-case theta ~ 0.87/ws).
+    """
+    n = positions.shape[0]
+    dtype = positions.dtype
+    levels, origin, span, coords = build_octree(positions, masses, depth)
+    side = 1 << depth
+    m_scale = jnp.maximum(jnp.max(masses), jnp.asarray(1e-37, dtype))
+
+    # ---- Morton-ordered particle arrays + leaf (start, count) tables ----
+    leaf_ids = (coords[:, 0] * side + coords[:, 1]) * side + coords[:, 2]
+    order = jnp.argsort(leaf_ids)
+    sorted_pos = positions[order]
+    sorted_mass = masses[order]
+    n_leaves = side**3
+    leaf_count = jax.ops.segment_sum(
+        jnp.ones((n,), jnp.int32), leaf_ids, num_segments=n_leaves
+    )
+    leaf_start = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(leaf_count)[:-1]]
+    )
+
+    offsets = jnp.asarray(_offsets(ws))  # (L, 3)
+    parity_masks = jnp.asarray(_parity_mask_table(ws))  # (8, L)
+    near = jnp.asarray(_near_offsets(ws))  # ((2ws+1)^3, 3)
+
+    if n % chunk != 0:
+        chunk = n  # fall back to a single chunk for ragged N
+
+    def chunk_acc(args):
+        pos_c, coords_c = args  # (C, 3), (C, 3) leaf coords
+        acc = jnp.zeros_like(pos_c)
+
+        # Far field: levels 2..depth interaction lists.
+        for d in range(2, depth + 1):
+            sd = 1 << d
+            cmass, ccom = levels[d]
+            cd = coords_c >> (depth - d)  # (C, 3) level-d coords
+            parity = ((cd[:, 0] & 1) << 2) | ((cd[:, 1] & 1) << 1) | (
+                cd[:, 2] & 1
+            )
+            pmask = parity_masks[parity]  # (C, 343)
+            cell = cd[:, None, :] + offsets[None, :, :]  # (C, 343, 3)
+            in_bounds = jnp.all(
+                jnp.logical_and(cell >= 0, cell < sd), axis=-1
+            )
+            cell_cl = jnp.clip(cell, 0, sd - 1)
+            ids = (cell_cl[..., 0] * sd + cell_cl[..., 1]) * sd + cell_cl[..., 2]
+            mask = jnp.logical_and(pmask, in_bounds)
+            acc = acc + _monopole_acc(
+                pos_c, cmass[ids], ccom[ids], mask, g, eps, dtype
+            )
+
+        # Near field: exact pairs from the neighbor leaves (capped),
+        # plus a monopole correction for capped-out overflow.
+        cd = coords_c  # leaf coords
+        ncell = cd[:, None, :] + near[None, :, :]  # (C, 27, 3)
+        in_bounds = jnp.all(
+            jnp.logical_and(ncell >= 0, ncell < side), axis=-1
+        )
+        ncell_cl = jnp.clip(ncell, 0, side - 1)
+        nids = (ncell_cl[..., 0] * side + ncell_cl[..., 1]) * side + ncell_cl[..., 2]
+        starts = leaf_start[nids]  # (C, |near|)
+        counts = jnp.where(in_bounds, leaf_count[nids], 0)
+
+        k_idx = jnp.arange(leaf_cap, dtype=jnp.int32)  # (K,)
+        gather_idx = starts[..., None] + k_idx[None, None, :]  # (C, 27, K)
+        valid = k_idx[None, None, :] < counts[..., None]
+        gather_idx = jnp.clip(gather_idx, 0, n - 1)
+        flat = gather_idx.reshape(pos_c.shape[0], -1)  # (C, 27K)
+        src_pos = sorted_pos[flat]  # (C, 27K, 3)
+        src_mass = sorted_mass[flat]
+        acc = acc + _pair_acc(
+            pos_c, src_pos, src_mass,
+            valid.reshape(pos_c.shape[0], -1), g, cutoff, eps, dtype,
+        )
+
+        # Overflow correction: cells with count > leaf_cap contribute the
+        # monopole of their remaining mass (graceful Barnes-Hut fallback).
+        cmass_l, ccom_l = levels[depth]
+        over = counts > leaf_cap
+        over_any = jnp.any(over)
+
+        def add_overflow(acc):
+            # Remaining mass/COM = cell total minus the gathered prefix.
+            # Normalized-mass arithmetic throughout: m * x overflows fp32
+            # for heavy bodies (see build_octree).
+            src_mhat = (src_mass / m_scale).reshape(valid.shape)
+            pref_mhat = jnp.sum(jnp.where(valid, src_mhat, 0.0), axis=-1)
+            pref_mw = jnp.sum(
+                jnp.where(
+                    valid[..., None],
+                    src_mhat[..., None]
+                    * src_pos.reshape(valid.shape + (3,)),
+                    0.0,
+                ),
+                axis=-2,
+            )  # (C, 27, 3)
+            rem_mhat = jnp.maximum(
+                jnp.where(over, cmass_l[nids] / m_scale - pref_mhat, 0.0), 0.0
+            )
+            tot_mw = ccom_l[nids] * (cmass_l[nids] / m_scale)[..., None]
+            rem_com = (tot_mw - pref_mw) / jnp.maximum(
+                rem_mhat, jnp.asarray(1e-37, dtype)
+            )[..., None]
+            # Soften the overflow monopole by the leaf size: a target can
+            # sit arbitrarily close to (even inside) an overflowing cell,
+            # and an unsoftened point-monopole at its COM would produce
+            # huge spurious attraction. Cell-size softening bounds the
+            # error at the resolution scale (same contract as PM).
+            cell_h = span / side
+            eps_over = jnp.maximum(jnp.asarray(eps, dtype), 0.5 * cell_h)
+            return acc + _monopole_acc(
+                pos_c, rem_mhat * m_scale, rem_com, over, g, eps_over, dtype
+            )
+
+        acc = jax.lax.cond(over_any, add_overflow, lambda a: a, acc)
+        return acc
+
+    if n == chunk:
+        return chunk_acc((positions, coords))
+    pos_chunks = positions.reshape(n // chunk, chunk, 3)
+    coord_chunks = coords.reshape(n // chunk, chunk, 3)
+    acc = jax.lax.map(chunk_acc, (pos_chunks, coord_chunks))
+    return acc.reshape(n, 3)
+
+
+def recommended_depth(n: int, leaf_cap: int = 32) -> int:
+    """Leaf depth so the mean occupied-leaf load is ~leaf_cap/4."""
+    import math
+
+    target_cells = max(1, (4 * n) // leaf_cap)
+    return max(2, min(8, math.ceil(math.log(target_cells, 8))))

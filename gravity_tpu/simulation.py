@@ -58,6 +58,11 @@ def _resolve_backend(config: SimulationConfig) -> str:
 def make_local_kernel(config: SimulationConfig, backend: str):
     """LocalKernel (pos_i, pos_j, m_j) -> acc for the resolved backend."""
     common = dict(g=config.g, cutoff=config.cutoff, eps=config.eps)
+    if backend in ("tree", "pm"):
+        raise ValueError(
+            f"force backend {backend!r} is single-device for now; use "
+            "sharding='none' (sharded tree/pm is planned)"
+        )
     if backend in ("dense", "chunked"):
         # "chunked" differs only in the unsharded full-N path below; as a
         # local kernel (slice vs sources) dense jnp is the right shape.
@@ -137,6 +142,22 @@ class Simulator:
         if self.backend == "pallas":
             kernel = make_local_kernel(config, "pallas")
             return lambda pos: kernel(pos, pos, masses)
+        if self.backend == "tree":
+            from .ops.tree import recommended_depth, tree_accelerations
+
+            depth = config.tree_depth or recommended_depth(
+                state.n, config.tree_leaf_cap
+            )
+            return lambda pos: tree_accelerations(
+                pos, masses, depth=depth, leaf_cap=config.tree_leaf_cap,
+                **common,
+            )
+        if self.backend == "pm":
+            from .ops.pm import pm_accelerations
+
+            return lambda pos: pm_accelerations(
+                pos, masses, grid=config.pm_grid, g=config.g, eps=config.eps
+            )
         raise ValueError(self.backend)
 
     # --- the jitted hot loop ---

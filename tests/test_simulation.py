@@ -50,6 +50,29 @@ def test_backends_agree_with_dense(backend):
     )
 
 
+@pytest.mark.parametrize("backend", ["tree", "pm"])
+def test_fast_backends_run_and_approximate(backend):
+    """tree/pm backends run end-to-end and stay near the dense result over
+    a short horizon (they are approximations; tolerance is loose)."""
+    cfg = _small_config(
+        model="cold_collapse", n=512, steps=5, dt=50_000.0,
+        force_backend=backend, integrator="leapfrog",
+    )
+    cfg = dataclasses.replace(cfg, eps=2e11, pm_grid=64, tree_depth=4)
+    dense = Simulator(
+        dataclasses.replace(cfg, force_backend="dense")
+    ).run()["final_state"]
+    fast = Simulator(cfg).run()["final_state"]
+    disp_scale = float(
+        np.abs(np.asarray(dense.positions)).max()
+    )
+    err = np.abs(
+        np.asarray(fast.positions) - np.asarray(dense.positions)
+    ).max()
+    assert err < 0.05 * disp_scale, err
+    assert bool(jnp.all(jnp.isfinite(fast.positions)))
+
+
 @pytest.mark.parametrize("strategy", ["allgather", "ring"])
 def test_sharded_run_matches_unsharded(strategy):
     cfg = _small_config(n=96, steps=10, integrator="leapfrog")

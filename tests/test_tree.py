@@ -1,0 +1,151 @@
+"""Octree (Barnes-Hut-style) force accuracy tests vs direct sum."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gravity_tpu.constants import G
+from gravity_tpu.models import create_cold_collapse, create_plummer
+from gravity_tpu.ops.forces import pairwise_accelerations_dense
+from gravity_tpu.ops.tree import (
+    build_octree,
+    recommended_depth,
+    tree_accelerations,
+)
+
+
+def _rel_err(approx, exact):
+    num = np.linalg.norm(np.asarray(approx) - np.asarray(exact), axis=1)
+    den = np.linalg.norm(np.asarray(exact), axis=1) + 1e-300
+    return num / den
+
+
+def test_build_octree_conserves_mass(key):
+    state = create_plummer(key, 1024)
+    levels, origin, span, coords = build_octree(
+        state.positions, state.masses, depth=4
+    )
+    total = float(jnp.sum(state.masses))
+    for d, (cmass, ccom) in enumerate(levels):
+        assert float(jnp.sum(cmass)) == pytest.approx(total, rel=1e-5), d
+    # Root COM == global COM (expected value in f64 — the naive fp32
+    # m*x product overflows, which is exactly why build_octree normalizes).
+    m64 = np.asarray(state.masses, np.float64)
+    p64 = np.asarray(state.positions, np.float64)
+    com = (m64[:, None] * p64).sum(0) / m64.sum()
+    # The centered Plummer COM is a near-total cancellation (~1e4 vs
+    # positions ~1e12): tolerance scales with position magnitude.
+    np.testing.assert_allclose(
+        np.asarray(levels[0][1][0]), com, atol=1e-6 * np.abs(p64).max()
+    )
+
+
+def test_point_mass_exact_far(key):
+    """A lone distant point mass is reproduced (monopole is exact there)."""
+    probes = 1e10 * jax.random.normal(key, (128, 3), jnp.float32)
+    pos = jnp.concatenate(
+        [probes, jnp.asarray([[5e11, 0.0, 0.0]], jnp.float32)]
+    )
+    masses = jnp.concatenate(
+        [jnp.full((128,), 1e20, jnp.float32), jnp.asarray([1e30], jnp.float32)]
+    )
+    exact = pairwise_accelerations_dense(pos, masses)
+    approx = tree_accelerations(pos, masses, depth=4, leaf_cap=160)
+    rel = _rel_err(approx[:128], exact[:128])
+    assert np.median(rel) < 0.02, np.median(rel)
+
+
+@pytest.mark.parametrize("model", ["uniform", "cold", "disk"])
+def test_accuracy_vs_direct(key, model):
+    """Tree force error on grid-resolvable distributions is sub-percent to
+    a few percent (the tree, like PM, resolves structure down to the leaf
+    cell; strongly-concentrated unresolved cores are covered by
+    test_concentrated_core_bounded)."""
+    n = 2048
+    if model == "uniform":
+        pos = jax.random.uniform(key, (n, 3), jnp.float32) * 1e12
+        m = jax.random.uniform(
+            jax.random.fold_in(key, 1), (n,), jnp.float32,
+            minval=1e25, maxval=1e26,
+        )
+        eps, g = 1e9, G
+    elif model == "cold":
+        state = create_cold_collapse(key, n)
+        pos, m = state.positions, state.masses
+        eps, g = 2e11, G
+    else:
+        from gravity_tpu.models import create_disk
+
+        state = create_disk(key, n)
+        pos, m = state.positions, state.masses
+        eps, g = 0.05, 1.0
+    exact = pairwise_accelerations_dense(pos, m, g=g, eps=eps)
+    approx = tree_accelerations(pos, m, depth=5, g=g, eps=eps)
+    rel = _rel_err(approx, exact)
+    assert np.median(rel) < 0.05, f"median {np.median(rel):.4f}"
+    assert np.percentile(rel, 90) < 0.2, f"p90 {np.percentile(rel, 90):.4f}"
+
+
+def test_concentrated_core_bounded(key):
+    """A Plummer sphere with its ~50x halo/core dynamic range is NOT
+    resolved by a uniform-depth leaf grid; the capped near field +
+    cell-softened overflow monopole must keep the error bounded (no
+    blow-ups, no dropped mass), even though it is large. Adaptive
+    refinement is the future fix; this test pins the graceful-degradation
+    contract."""
+    state = create_plummer(key, 2048)
+    pos, m = state.positions, state.masses
+    exact = pairwise_accelerations_dense(pos, m, eps=1e10)
+    approx = tree_accelerations(pos, m, depth=5, leaf_cap=128, eps=1e10)
+    rel = _rel_err(approx, exact)
+    assert bool(jnp.all(jnp.isfinite(approx)))
+    assert np.median(rel) < 0.5, f"median {np.median(rel):.4f}"
+
+
+def test_overflow_cells_degrade_gracefully(key):
+    """With a tiny leaf_cap and a coarse grid, dense cells fall back to the
+    cell-size-softened monopole: the result UNDER-resolves (force tends
+    toward zero at unresolved scales) but never blows up or NaNs — the
+    same degradation contract as a too-coarse PM grid."""
+    state = create_plummer(key, 1024)
+    pos, m = state.positions, state.masses
+    exact = pairwise_accelerations_dense(pos, m, eps=1e10)
+    approx = tree_accelerations(pos, m, depth=3, leaf_cap=4, eps=1e10)
+    assert bool(jnp.all(jnp.isfinite(approx)))
+    # Never catastrophically over-estimates (under-resolution attenuates).
+    mag_ratio = np.linalg.norm(np.asarray(approx), axis=1) / (
+        np.linalg.norm(np.asarray(exact), axis=1) + 1e-300
+    )
+    assert np.percentile(mag_ratio, 99) < 3.0, np.percentile(mag_ratio, 99)
+
+
+def test_jit_and_chunked(key):
+    state = create_plummer(key, 1024)
+
+    @jax.jit
+    def f(p):
+        return tree_accelerations(p, state.masses, depth=4, chunk=256,
+                                  eps=1e10)
+
+    acc = f(state.positions)
+    full = tree_accelerations(state.positions, state.masses, depth=4,
+                              eps=1e10)
+    np.testing.assert_allclose(np.asarray(acc), np.asarray(full), rtol=1e-5)
+
+
+def test_momentum_approximately_conserved(key):
+    """Tree forces keep net momentum flux near zero on a resolved field
+    (not exactly — interaction lists are asymmetric — but well below the
+    field scale)."""
+    n = 2048
+    pos = jax.random.uniform(key, (n, 3), jnp.float32) * 1e12
+    m = jax.random.uniform(
+        jax.random.fold_in(key, 1), (n,), jnp.float32, minval=1e25,
+        maxval=1e26,
+    )
+    acc = tree_accelerations(pos, m, depth=5, eps=1e9)
+    mm = np.asarray(m)[:, None]
+    drift = np.abs(np.sum(mm * np.asarray(acc), axis=0))
+    scale = np.sum(mm * np.abs(np.asarray(acc)), axis=0)
+    assert np.all(drift < 0.02 * scale)
