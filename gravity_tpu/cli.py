@@ -54,6 +54,8 @@ def _add_config_args(p: argparse.ArgumentParser) -> None:
                    action="store_true", default=None)
     p.add_argument("--trajectory-every", dest="trajectory_every",
                    type=int, default=None)
+    p.add_argument("--trajectory-format", dest="trajectory_format",
+                   choices=["npy", "native"], default=None)
     p.add_argument("--checkpoint-every", dest="checkpoint_every",
                    type=int, default=None)
     p.add_argument("--checkpoint-dir", dest="checkpoint_dir", default=None)
@@ -92,11 +94,25 @@ def cmd_run(args: argparse.Namespace) -> int:
         # every=1: the Simulator already strides frames by
         # config.trajectory_every on-device; a second filter here would
         # drop frames whose step isn't 0 mod every.
-        writer = TrajectoryWriter(
-            os.path.join(config.log_dir, f"trajectories_{logger.timestamp}"),
-            sim.n_real,
-            every=1,
-        )
+        if config.trajectory_format == "native":
+            from .utils.trajectory import NativeTrajectoryWriter
+
+            writer = NativeTrajectoryWriter(
+                os.path.join(
+                    config.log_dir,
+                    f"trajectories_{logger.timestamp}.gtrj",
+                ),
+                sim.n_real,
+                every=1,
+            )
+        else:
+            writer = TrajectoryWriter(
+                os.path.join(
+                    config.log_dir, f"trajectories_{logger.timestamp}"
+                ),
+                sim.n_real,
+                every=1,
+            )
     ckpt_mgr = None
     if config.checkpoint_every:
         from .utils.checkpoint import make_checkpoint_manager

@@ -49,6 +49,7 @@ class SimulationConfig:
     log_dir: str = "gravity_logs_tpu"
     record_trajectories: bool = False  # per-step positions (Spark capability)
     trajectory_every: int = 1
+    trajectory_format: str = "npy"  # npy | native (C++ async GTRJ writer)
     progress_every: int = C.PROGRESS_EVERY
     checkpoint_every: int = 0  # 0 = disabled
     checkpoint_dir: str = "checkpoints"

@@ -1,0 +1,81 @@
+"""ctypes bindings for the native (C++) runtime components.
+
+The shared library is built on demand from ``runtime/*.cpp`` with g++
+(no pip/pybind11 dependency — plain C ABI + ctypes). Falls back cleanly:
+callers check :func:`native_available` and use the pure-Python path when
+the toolchain or library is missing.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+_SRC = os.path.join(_REPO_ROOT, "runtime", "trajectory_writer.cpp")
+_LIB_DIR = os.path.join(_REPO_ROOT, "runtime", "build")
+_LIB = os.path.join(_LIB_DIR, "libgravity_runtime.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_failed = False
+
+
+def _build() -> bool:
+    os.makedirs(_LIB_DIR, exist_ok=True)
+    cmd = [
+        "g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+        _SRC, "-o", _LIB,
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return True
+    except (subprocess.CalledProcessError, subprocess.TimeoutExpired,
+            FileNotFoundError):
+        return False
+
+
+def load_runtime() -> Optional[ctypes.CDLL]:
+    """Load (building if needed) the native runtime library, or None."""
+    global _lib, _build_failed
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _build_failed:
+            return None
+        if not os.path.exists(_LIB) or (
+            os.path.exists(_SRC)
+            and os.path.getmtime(_SRC) > os.path.getmtime(_LIB)
+        ):
+            if not _build():
+                _build_failed = True
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB)
+        except OSError:
+            _build_failed = True
+            return None
+        lib.gt_writer_open.restype = ctypes.c_void_p
+        lib.gt_writer_open.argtypes = [
+            ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint32,
+            ctypes.c_uint32,
+        ]
+        lib.gt_writer_append.restype = ctypes.c_int
+        lib.gt_writer_append.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+        ]
+        lib.gt_writer_error.restype = ctypes.c_int
+        lib.gt_writer_error.argtypes = [ctypes.c_void_p]
+        lib.gt_writer_close.restype = ctypes.c_int64
+        lib.gt_writer_close.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    return load_runtime() is not None

@@ -1,0 +1,152 @@
+// gravity_tpu native runtime: asynchronous binary trajectory writer.
+//
+// The reference's only trajectory recording is the Spark driver appending
+// whole position lists to Python RAM (/root/reference/pyspark.py:104-121).
+// Here: a C++ writer thread drains a bounded queue of frames to disk so
+// the simulation loop never blocks on IO (at 1M bodies a frame is 12 MB;
+// Python-side synchronous np.save stalls the step loop).
+//
+// File format "GTRJ" v1 (little-endian):
+//   header : magic 'GTRJ' | u32 version | u64 n_particles | u32 dtype_code
+//            (4 = f32, 8 = f64) | u32 reserved
+//   frames : repeated { i64 step | payload n_particles*3*itemsize bytes }
+// Frames are fixed-size, so random access is offset arithmetic; the
+// Python reader memmaps by frame index. A crash mid-write loses at most
+// the queued frames (file is flushed on every frame boundary batch).
+//
+// C API (ctypes-friendly): gt_writer_open / gt_writer_append /
+// gt_writer_error / gt_writer_close. Thread-safe for a single producer.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <condition_variable>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Frame {
+    int64_t step;
+    std::vector<uint8_t> payload;
+};
+
+struct Writer {
+    FILE* file = nullptr;
+    uint64_t n_particles = 0;
+    uint32_t itemsize = 4;
+    uint64_t frames_written = 0;
+
+    std::thread worker;
+    std::mutex mu;
+    std::condition_variable cv_push, cv_pop;
+    std::deque<Frame> queue;
+    size_t max_queue = 8;
+    bool closing = false;
+    int error = 0;
+
+    void run() {
+        for (;;) {
+            Frame frame;
+            {
+                std::unique_lock<std::mutex> lock(mu);
+                cv_pop.wait(lock, [&] { return closing || !queue.empty(); });
+                if (queue.empty()) break;  // closing && drained
+                frame = std::move(queue.front());
+                queue.pop_front();
+            }
+            cv_push.notify_one();
+            if (error) continue;  // drain without writing after an error
+            int64_t step_le = frame.step;
+            if (std::fwrite(&step_le, sizeof(step_le), 1, file) != 1 ||
+                std::fwrite(frame.payload.data(), 1, frame.payload.size(),
+                            file) != frame.payload.size()) {
+                std::lock_guard<std::mutex> lock(mu);
+                error = 1;
+                continue;
+            }
+            std::fflush(file);
+            frames_written++;
+        }
+    }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* gt_writer_open(const char* path, uint64_t n_particles,
+                     uint32_t itemsize, uint32_t max_queue) {
+    if (itemsize != 4 && itemsize != 8) return nullptr;
+    FILE* f = std::fopen(path, "wb");
+    if (!f) return nullptr;
+    const char magic[4] = {'G', 'T', 'R', 'J'};
+    uint32_t version = 1, reserved = 0;
+    if (std::fwrite(magic, 1, 4, f) != 4 ||
+        std::fwrite(&version, sizeof(version), 1, f) != 1 ||
+        std::fwrite(&n_particles, sizeof(n_particles), 1, f) != 1 ||
+        std::fwrite(&itemsize, sizeof(itemsize), 1, f) != 1 ||
+        std::fwrite(&reserved, sizeof(reserved), 1, f) != 1) {
+        std::fclose(f);
+        return nullptr;
+    }
+    auto* w = new Writer();
+    w->file = f;
+    w->n_particles = n_particles;
+    w->itemsize = itemsize;
+    if (max_queue > 0) w->max_queue = max_queue;
+    w->worker = std::thread([w] { w->run(); });
+    return w;
+}
+
+// Enqueue one frame (copies data; returns 0 on success). Blocks only when
+// the bounded queue is full (backpressure instead of unbounded memory).
+int gt_writer_append(void* handle, int64_t step, const void* data) {
+    auto* w = static_cast<Writer*>(handle);
+    if (!w || !data) return -1;
+    size_t nbytes = static_cast<size_t>(w->n_particles) * 3 * w->itemsize;
+    Frame frame;
+    frame.step = step;
+    frame.payload.assign(static_cast<const uint8_t*>(data),
+                         static_cast<const uint8_t*>(data) + nbytes);
+    {
+        std::unique_lock<std::mutex> lock(w->mu);
+        if (w->closing) return -2;
+        w->cv_push.wait(lock, [&] {
+            return w->queue.size() < w->max_queue || w->error;
+        });
+        if (w->error) return -3;
+        w->queue.push_back(std::move(frame));
+    }
+    w->cv_pop.notify_one();
+    return 0;
+}
+
+int gt_writer_error(void* handle) {
+    auto* w = static_cast<Writer*>(handle);
+    if (!w) return -1;
+    std::lock_guard<std::mutex> lock(w->mu);
+    return w->error;
+}
+
+// Flush, join the worker, close the file. Returns frames written, or a
+// negative value on IO error.
+int64_t gt_writer_close(void* handle) {
+    auto* w = static_cast<Writer*>(handle);
+    if (!w) return -1;
+    {
+        std::lock_guard<std::mutex> lock(w->mu);
+        w->closing = true;
+    }
+    w->cv_pop.notify_all();
+    w->worker.join();
+    std::fclose(w->file);
+    int64_t written = w->error ? -3 : static_cast<int64_t>(w->frames_written);
+    delete w;
+    return written;
+}
+
+}  // extern "C"

@@ -1,0 +1,77 @@
+"""Native (C++) runtime component tests: async GTRJ trajectory writer."""
+
+import numpy as np
+import pytest
+
+from gravity_tpu.utils.native import native_available
+from gravity_tpu.utils.trajectory import (
+    NativeTrajectoryReader,
+    NativeTrajectoryWriter,
+)
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="native runtime build unavailable"
+)
+
+
+def test_roundtrip(tmp_path):
+    path = str(tmp_path / "traj.gtrj")
+    n = 100
+    writer = NativeTrajectoryWriter(path, n)
+    frames = []
+    rng = np.random.RandomState(0)
+    for step in range(1, 11):
+        pos = rng.randn(n, 3).astype(np.float32)
+        frames.append(pos)
+        writer.record(step, pos)
+    writer.close()
+
+    reader = NativeTrajectoryReader(path)
+    assert reader.n == n
+    assert reader.num_frames == 10
+    assert reader.steps == list(range(1, 11))
+    data = reader.load()
+    np.testing.assert_array_equal(data, np.stack(frames))
+    np.testing.assert_array_equal(
+        reader.particle_track(7), np.stack(frames)[:, 7, :]
+    )
+
+
+def test_stride_and_f64(tmp_path):
+    path = str(tmp_path / "traj64.gtrj")
+    writer = NativeTrajectoryWriter(path, 8, every=3, dtype=np.float64)
+    for step in range(1, 13):
+        writer.record(step, np.full((8, 3), float(step)))
+    writer.close()
+    reader = NativeTrajectoryReader(path)
+    assert reader.dtype == np.float64
+    assert reader.steps == [3, 6, 9, 12]
+    np.testing.assert_array_equal(reader.load()[1], np.full((8, 3), 6.0))
+
+
+def test_backpressure_many_frames(tmp_path):
+    """Many frames through the bounded queue: all land, in order."""
+    path = str(tmp_path / "big.gtrj")
+    n = 4096
+    writer = NativeTrajectoryWriter(path, n, max_queue=2)
+    for step in range(200):
+        writer.record(step, np.full((n, 3), float(step), np.float32))
+    writer.close()
+    reader = NativeTrajectoryReader(path)
+    assert reader.num_frames == 200
+    data = reader.load()
+    np.testing.assert_array_equal(data[123], np.full((n, 3), 123.0))
+
+
+def test_shape_validation(tmp_path):
+    writer = NativeTrajectoryWriter(str(tmp_path / "x.gtrj"), 10)
+    with pytest.raises(ValueError):
+        writer.record(1, np.zeros((5, 3), np.float32))
+    writer.close()
+
+
+def test_bad_magic(tmp_path):
+    path = tmp_path / "bad.gtrj"
+    path.write_bytes(b"NOPE" + b"\0" * 40)
+    with pytest.raises(ValueError):
+        NativeTrajectoryReader(str(path))
