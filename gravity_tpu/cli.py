@@ -59,6 +59,13 @@ def _add_config_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--checkpoint-every", dest="checkpoint_every",
                    type=int, default=None)
     p.add_argument("--checkpoint-dir", dest="checkpoint_dir", default=None)
+    p.add_argument("--metrics", action="store_true", default=None,
+                   help="write a JSONL metrics stream next to the log")
+    p.add_argument("--profile", action="store_true", default=None,
+                   help="capture a jax.profiler trace of the run")
+    p.add_argument("--debug-check", dest="debug_check", action="store_true",
+                   default=None,
+                   help="cross-check Pallas vs jnp forces on final state")
     p.add_argument("--config-json", default=None,
                    help="path to a SimulationConfig JSON file")
     del defaults
@@ -118,8 +125,46 @@ def cmd_run(args: argparse.Namespace) -> int:
         from .utils.checkpoint import make_checkpoint_manager
 
         ckpt_mgr = make_checkpoint_manager(config.checkpoint_dir)
-    stats = sim.run(logger, trajectory_writer=writer,
-                    checkpoint_manager=ckpt_mgr)
+    metrics_logger = None
+    if config.metrics:
+        import os
+
+        from .utils.profiling import MetricsLogger
+
+        metrics_logger = MetricsLogger(
+            os.path.join(config.log_dir, f"metrics_{logger.timestamp}.jsonl")
+        )
+
+    def _go():
+        return sim.run(logger, trajectory_writer=writer,
+                       checkpoint_manager=ckpt_mgr,
+                       metrics_logger=metrics_logger)
+
+    if config.profile:
+        import os
+
+        from .utils.profiling import trace
+
+        with trace(os.path.join(config.log_dir,
+                                f"profile_{logger.timestamp}")):
+            stats = _go()
+    else:
+        stats = _go()
+
+    if config.debug_check:
+        from .utils.profiling import debug_check_forces
+
+        final = stats["final_state"]
+        check = debug_check_forces(
+            final.positions, final.masses,
+            g=config.g, cutoff=config.cutoff, eps=config.eps,
+        )
+        logger.log_print(
+            "Force kernel cross-check (Pallas vs jnp): "
+            f"max_rel_err={check['max_rel_err']:.3e} "
+            f"median_rel_err={check['median_rel_err']:.3e} "
+            f"(n={check['n_checked']})"
+        )
     stats.pop("final_state", None)
     print(json.dumps(stats))
     return 0

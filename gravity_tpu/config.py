@@ -53,6 +53,9 @@ class SimulationConfig:
     progress_every: int = C.PROGRESS_EVERY
     checkpoint_every: int = 0  # 0 = disabled
     checkpoint_dir: str = "checkpoints"
+    metrics: bool = False  # JSONL per-block metrics stream
+    profile: bool = False  # capture a jax.profiler trace of the run
+    debug_check: bool = False  # Pallas-vs-jnp force cross-check at end
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self), indent=2, default=str)

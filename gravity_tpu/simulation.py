@@ -196,6 +196,7 @@ class Simulator:
         steps: Optional[int] = None,
         trajectory_writer: Optional[TrajectoryWriter] = None,
         checkpoint_manager=None,
+        metrics_logger=None,
         start_step: int = 0,
     ) -> dict:
         """Run the configured number of steps; returns a results dict."""
@@ -228,6 +229,7 @@ class Simulator:
         acc = init_carry(self.accel_fn, state)
         timer = StepTimer()
         timer.start()
+        block_prev = 0.0
         step = start_step
         while step < total_steps:
             remaining = total_steps - step
@@ -243,9 +245,24 @@ class Simulator:
                 record_every=every if do_record else 1,
             )
             jax.block_until_ready(state.positions)
+            now = timer.mark()
+            block_elapsed = now - block_prev
+            block_prev = now
             step += n_steps
             if logger is not None:
                 logger.progress(step, total_steps)
+            if metrics_logger is not None:
+                from .utils.timing import pairs_per_step
+
+                metrics_logger.log(
+                    step=step,
+                    block_steps=n_steps,
+                    block_s=block_elapsed,
+                    pairs_per_sec=(
+                        pairs_per_step(self.n_real) * n_steps / block_elapsed
+                        if block_elapsed > 0 else None
+                    ),
+                )
             if trajectory_writer is not None and traj is not None:
                 # Host transfer before slicing: slicing a sharded array on
                 # device would force a resharding gather.

@@ -1,0 +1,57 @@
+"""Observability: metrics stream, profiler trace, force cross-check."""
+
+import glob
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gravity_tpu.config import SimulationConfig
+from gravity_tpu.models import create_plummer
+from gravity_tpu.simulation import Simulator
+from gravity_tpu.utils.profiling import (
+    MetricsLogger,
+    debug_check_forces,
+    device_memory_stats,
+    trace,
+)
+
+
+def test_metrics_stream(tmp_path):
+    cfg = SimulationConfig(model="random", n=32, steps=20, progress_every=5,
+                           force_backend="dense")
+    ml = MetricsLogger(str(tmp_path / "metrics.jsonl"))
+    Simulator(cfg).run(metrics_logger=ml)
+    records = ml.read()
+    assert len(records) == 4  # 20 steps / 5-step blocks
+    assert records[-1]["step"] == 20
+    assert all("block_s" in r and "pairs_per_sec" in r for r in records)
+
+
+def test_debug_check_forces(key):
+    state = create_plummer(key, 256)
+    result = debug_check_forces(state.positions, state.masses, eps=1e10)
+    assert result["n_checked"] == 256
+    assert result["max_rel_err"] < 1e-3
+
+
+def test_debug_check_samples_large_state(key):
+    state = create_plummer(key, 600)
+    result = debug_check_forces(state.positions, state.masses, eps=1e10,
+                                sample=128)
+    assert result["n_checked"] == 128
+
+
+def test_device_memory_stats():
+    stats = device_memory_stats()
+    assert len(stats) == len(jax.local_devices())
+    assert all("device" in s for s in stats)
+
+
+def test_profiler_trace(tmp_path):
+    with trace(str(tmp_path / "prof")):
+        jnp.dot(jnp.ones((64, 64)), jnp.ones((64, 64))).block_until_ready()
+    # An xplane trace file lands in the directory tree.
+    files = glob.glob(str(tmp_path / "prof" / "**" / "*"), recursive=True)
+    assert any(os.path.isfile(f) for f in files)
