@@ -212,6 +212,34 @@ def cmd_sweep(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_resume(args: argparse.Namespace) -> int:
+    """Resume a checkpointed run: restore the latest (or --step) snapshot
+    and continue to the configured total step count — recovery the
+    reference has no story for (SURVEY §5: any rank death kills the run)."""
+    from .simulation import Simulator
+    from .utils.checkpoint import (
+        make_checkpoint_manager,
+        restore_checkpoint,
+    )
+    from .utils.logging import RunLogger
+
+    config = build_config(args)
+    mgr = make_checkpoint_manager(config.checkpoint_dir)
+    state, step = restore_checkpoint(mgr, args.step)
+    if step >= config.steps:
+        print(json.dumps({"resumed_at": step, "steps": config.steps,
+                          "note": "checkpoint already at/past target"}))
+        return 0
+    logger = RunLogger(config.log_dir)
+    logger.log_print(f"Resuming from checkpoint at step {step}")
+    sim = Simulator(config, state=state)
+    stats = sim.run(logger, checkpoint_manager=mgr, start_step=step)
+    stats.pop("final_state", None)
+    stats["resumed_at"] = step
+    print(json.dumps(stats))
+    return 0
+
+
 def cmd_bench(args: argparse.Namespace) -> int:
     from .bench import run_benchmark
 
@@ -236,6 +264,14 @@ def main(argv=None) -> int:
     _add_config_args(p_sweep)
     p_sweep.add_argument("--sizes", type=int, nargs="*", default=None)
     p_sweep.set_defaults(fn=cmd_sweep)
+
+    p_resume = sub.add_parser(
+        "resume", help="resume from the latest checkpoint"
+    )
+    _add_config_args(p_resume)
+    p_resume.add_argument("--step", type=int, default=None,
+                          help="checkpoint step to restore (default latest)")
+    p_resume.set_defaults(fn=cmd_resume)
 
     p_bench = sub.add_parser("bench", help="throughput benchmark")
     _add_config_args(p_bench)

@@ -231,7 +231,10 @@ class Simulator:
         timer.start()
         block_prev = 0.0
         step = start_step
-        while step < total_steps:
+        # self.state/self._last_step stay current per block so the
+        # KeyboardInterrupt handler below can checkpoint mid-run.
+        try:
+          while step < total_steps:
             remaining = total_steps - step
             if record and remaining >= every:
                 # Whole strides only; any sub-stride tail runs unrecorded.
@@ -249,6 +252,7 @@ class Simulator:
             block_elapsed = now - block_prev
             block_prev = now
             step += n_steps
+            self.state, self._last_step = state, step
             if logger is not None:
                 logger.progress(step, total_steps)
             if metrics_logger is not None:
@@ -283,6 +287,18 @@ class Simulator:
                 from .utils.checkpoint import save_checkpoint
 
                 save_checkpoint(checkpoint_manager, step, state)
+        except KeyboardInterrupt:
+            # Graceful interrupt: persist what we have so `resume` works
+            # (the reference loses everything on any interruption).
+            if checkpoint_manager is not None and step > start_step:
+                from .utils.checkpoint import save_checkpoint
+
+                save_checkpoint(checkpoint_manager, step, self.state)
+                if logger is not None:
+                    logger.log_print(
+                        f"Interrupted at step {step}; checkpoint saved"
+                    )
+            raise
         timer.mark()
 
         self.state = state

@@ -1,0 +1,130 @@
+"""CLI end-to-end tests (in-process main() calls on the CPU platform)."""
+
+import glob
+import json
+import os
+
+import numpy as np
+import pytest
+
+from gravity_tpu.cli import main
+
+
+def test_run_command(tmp_path, capsys):
+    rc = main([
+        "run", "--model", "random", "--n", "32", "--steps", "10",
+        "--force-backend", "dense", "--log-dir", str(tmp_path / "logs"),
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    stats = json.loads(out.strip().splitlines()[-1])
+    assert stats["n"] == 32 and stats["steps"] == 10
+    logs = glob.glob(str(tmp_path / "logs" / "simulation_log_*.txt"))
+    assert len(logs) == 1
+    text = open(logs[0]).read()
+    assert "Simulation completed successfully" in text
+
+
+def test_run_with_trajectories(tmp_path, capsys):
+    rc = main([
+        "run", "--model", "random", "--n", "16", "--steps", "6",
+        "--force-backend", "dense", "--trajectories",
+        "--trajectory-every", "2", "--log-dir", str(tmp_path / "logs"),
+    ])
+    assert rc == 0
+    traj_dirs = glob.glob(str(tmp_path / "logs" / "trajectories_*"))
+    assert len(traj_dirs) == 1
+    from gravity_tpu.utils.trajectory import TrajectoryReader
+
+    reader = TrajectoryReader(traj_dirs[0])
+    assert reader.steps == [2, 4, 6]
+
+
+def test_run_native_trajectories(tmp_path, capsys):
+    from gravity_tpu.utils.native import native_available
+
+    if not native_available():
+        pytest.skip("no native runtime")
+    rc = main([
+        "run", "--model", "random", "--n", "16", "--steps", "4",
+        "--force-backend", "dense", "--trajectories",
+        "--trajectory-format", "native",
+        "--log-dir", str(tmp_path / "logs"),
+    ])
+    assert rc == 0
+    files = glob.glob(str(tmp_path / "logs" / "trajectories_*.gtrj"))
+    assert len(files) == 1
+    from gravity_tpu.utils.trajectory import NativeTrajectoryReader
+
+    assert NativeTrajectoryReader(files[0]).num_frames == 4
+
+
+def test_checkpoint_and_resume(tmp_path, capsys):
+    ckpt = str(tmp_path / "ckpt")
+    logs = str(tmp_path / "logs")
+    # Full 20-step run for ground truth.
+    main([
+        "run", "--model", "random", "--n", "24", "--steps", "20",
+        "--seed", "7", "--force-backend", "dense", "--log-dir", logs,
+    ])
+    truth = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+
+    # 10-step run with checkpointing, then resume to 20.
+    main([
+        "run", "--model", "random", "--n", "24", "--steps", "10",
+        "--seed", "7", "--force-backend", "dense", "--log-dir", logs,
+        "--checkpoint-every", "10", "--checkpoint-dir", ckpt,
+    ])
+    capsys.readouterr()
+    rc = main([
+        "resume", "--model", "random", "--n", "24", "--steps", "20",
+        "--seed", "7", "--force-backend", "dense", "--log-dir", logs,
+        "--checkpoint-dir", ckpt,
+    ])
+    assert rc == 0
+    resumed = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert resumed["resumed_at"] == 10
+    assert resumed["steps"] == 10  # ran the remaining 10
+    del truth  # positions compared via the Simulator-level resume test
+
+
+def test_resume_past_target(tmp_path, capsys):
+    ckpt = str(tmp_path / "ckpt")
+    main([
+        "run", "--model", "random", "--n", "8", "--steps", "5",
+        "--force-backend", "dense", "--log-dir", str(tmp_path / "logs"),
+        "--checkpoint-every", "5", "--checkpoint-dir", ckpt,
+    ])
+    capsys.readouterr()
+    rc = main([
+        "resume", "--model", "random", "--n", "8", "--steps", "5",
+        "--force-backend", "dense", "--log-dir", str(tmp_path / "logs"),
+        "--checkpoint-dir", ckpt,
+    ])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert "note" in out
+
+
+def test_sweep_command(tmp_path, capsys):
+    rc = main([
+        "sweep", "--sizes", "8", "16", "--steps", "5",
+        "--force-backend", "dense", "--log-dir", str(tmp_path / "logs"),
+    ])
+    assert rc == 0
+    logs = glob.glob(str(tmp_path / "logs" / "simulation_log_*.txt"))
+    text = open(logs[0]).read()
+    assert "Starting gravity simulation with 8 particles" in text
+    assert "Starting gravity simulation with 16 particles" in text
+    assert text.rstrip().endswith("Simulation completed successfully")
+
+
+def test_bench_command(tmp_path, capsys):
+    rc = main([
+        "bench", "--model", "random", "--n", "64", "--steps", "5",
+        "--force-backend", "dense", "--bench-steps", "3",
+        "--log-dir", str(tmp_path / "logs"),
+    ])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["pairs_per_sec_per_chip"] > 0
