@@ -76,6 +76,23 @@ def test_softening(key):
     )
 
 
+def test_softened_fast_path_self_pairs_and_padding(key):
+    """The mask-free softened kernel (eps² > cutoff²) stays exact for the
+    cases the dropped mask used to guard: self-pairs (zero via dx=0),
+    coincident particles (finite via eps), and zero-mass tile padding."""
+    pos, masses = _random_system(key, 200)
+    pos = pos.at[:4].set(pos[0])  # 4 coincident bodies
+    eps = 1e10
+    expected = pairwise_accelerations_dense(pos, masses, eps=eps)
+    got = pallas_pairwise_accelerations(
+        pos, masses, eps=eps, tile_i=32, tile_j=128, interpret=True
+    )
+    assert bool(jnp.all(jnp.isfinite(got)))
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(expected), rtol=2e-5, atol=1e-12
+    )
+
+
 def test_padding_is_exact(key):
     """Results are identical whether N is tile-aligned or ragged."""
     pos, masses = _random_system(key, 200)
