@@ -40,13 +40,19 @@ def _add_config_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--dtype",
                    choices=["float32", "float64", "bfloat16"], default=None)
     p.add_argument("--force-backend", dest="force_backend",
-                   choices=["auto", "dense", "chunked", "pallas", "tree", "pm"],
+                   choices=["auto", "dense", "chunked", "pallas", "tree",
+                            "pm", "p3m"],
                    default=None)
     p.add_argument("--chunk", type=int, default=None)
     p.add_argument("--tree-depth", dest="tree_depth", type=int, default=None)
     p.add_argument("--tree-leaf-cap", dest="tree_leaf_cap", type=int,
                    default=None)
     p.add_argument("--pm-grid", dest="pm_grid", type=int, default=None)
+    p.add_argument("--p3m-sigma-cells", dest="p3m_sigma_cells", type=float,
+                   default=None)
+    p.add_argument("--p3m-rcut-sigmas", dest="p3m_rcut_sigmas", type=float,
+                   default=None)
+    p.add_argument("--p3m-cap", dest="p3m_cap", type=int, default=None)
     p.add_argument("--sharding",
                    choices=["none", "allgather", "ring"], default=None)
     p.add_argument("--log-dir", dest="log_dir", default=None)

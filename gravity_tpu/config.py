@@ -34,12 +34,16 @@ class SimulationConfig:
     # Numerics / backend
     integrator: str = "euler"  # euler (reference parity) | leapfrog | verlet
     dtype: str = "float32"
-    # auto | dense | chunked | pallas (direct sum) | tree (octree) | pm (FFT)
+    # auto | dense | chunked | pallas (direct sum) | tree (octree) |
+    # pm (FFT mesh) | p3m (FFT mesh + cell-list pair correction)
     force_backend: str = "auto"
     chunk: int = 1024
     tree_depth: int = 0  # 0 = auto (recommended_depth)
     tree_leaf_cap: int = 32
     pm_grid: int = 128
+    p3m_sigma_cells: float = 1.25  # Ewald split scale, in PM cells
+    p3m_rcut_sigmas: float = 4.0  # short-range truncation, in sigmas
+    p3m_cap: int = 128  # static per-cell source cap of the cell list
 
     # Parallelism
     sharding: str = "none"  # none | allgather | ring

@@ -21,6 +21,7 @@ from .integrators import (
     semi_implicit_euler,
     velocity_verlet,
 )
+from .p3m import p3m_accelerations
 
 __all__ = [
     "INTEGRATORS",
@@ -30,6 +31,7 @@ __all__ = [
     "kinetic_energy",
     "leapfrog_kdk",
     "make_step_fn",
+    "p3m_accelerations",
     "pairwise_accelerations_chunked",
     "pairwise_accelerations_dense",
     "potential_energy",

@@ -1,0 +1,346 @@
+"""P3M (particle-particle particle-mesh) gravity: the high-accuracy fast
+force path for large N.
+
+The reference scales N only by parallelizing the O(N^2) pair set
+(`/root/reference/cuda.cu:53-60`, `/root/reference/pyspark.py:60-78` —
+SURVEY §2e); it has no fast method. On TPU the idiomatic O(N log N)
+decomposition with *controlled* accuracy is Hockney & Eastwood's P3M:
+
+- **Mesh (long-range):** the pair potential is split with the Ewald
+  kernel: -1/r = -erf(r/(sqrt(2) sigma))/r - erfc(r/(sqrt(2) sigma))/r.
+  The erf part is smooth everywhere (curvature scale sigma), so the
+  existing isolated-BC FFT solver (`pm.pm_solve`) computes it essentially
+  exactly once sigma is a cell or more — three big FFTs, which XLA
+  compiles to MXU-friendly batched stages.
+- **Pair (short-range):** the erfc remainder decays like a Gaussian and is
+  negligible beyond r_cut ~ 4 sigma, so it is an exact pairwise sum over a
+  static cell list: particles are binned into a cube grid with cell size
+  >= r_cut (so 27 neighbor cells cover every interacting pair), Morton
+  sorted, and evaluated with a per-cell static source cap. Overflow
+  beyond the cap falls back to a cell-size-softened monopole through the
+  same short-range kernel — the graceful-degradation contract shared with
+  the octree backend (`tree.py`).
+
+The Plummer softening eps lives entirely in the short-range term (the
+smooth long-range kernel needs no regularization), so the summed force is
+exactly the softened Newtonian force for every pair inside r_cut, and the
+smoothed-mesh approximation only touches pairs beyond ~4 sigma where the
+relative error is O(erfc(4/sqrt(2))) ~ 6e-5 plus the grid's own
+interpolation error.
+
+Typical accuracy at the defaults (sigma = 1.25 cells, r_cut = 4 sigma):
+~1e-3..1e-2 median relative force error — an order of magnitude tighter
+than the monopole octree at similar speed.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import lru_cache, partial
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.special import erf
+
+from ..constants import CUTOFF_RADIUS, G
+from .pm import bounding_cube, cic_deposit, cic_gather
+
+
+def binning_side(grid: int, sigma_cells: float, rcut_sigmas: float) -> int:
+    """Cell-list grid side so the bin size is >= r_cut (both scale with the
+    bounding cube, so this is static): side <= (grid-1)/(sigma_cells *
+    rcut_sigmas).
+
+    The floor of 2 cannot break 27-neighborhood coverage: at side <= 2
+    every cell is within Chebyshev distance 1 of every other, so the pair
+    sum degenerates to an (exact) all-pairs sum rather than dropping any
+    short-range pair.
+    """
+    return max(2, int((grid - 1) / (sigma_cells * rcut_sigmas)))
+
+
+@lru_cache(maxsize=8)
+def _force_kernel_hat(m2: int, sigma_cells: float, dtype_str: str):
+    """rfftn of the smoothed vector force kernel on the padded (2M)^3
+    separation grid, in grid units (h = 1) — one-time per (grid, sigma).
+
+    K_i(x) = -k(r) x_i with k(r) = erf(a r)/r^3 - (2a/sqrt(pi)) e^{-a^2
+    r^2}/r^2, a = 1/(sqrt(2) sigma): the analytic acceleration field of a
+    unit mass under the Ewald long-range kernel. Convolving the density
+    with K directly (rather than differentiating a potential grid) removes
+    the finite-difference error term entirely — k(r) is smooth, k(0) =
+    (4 a^3)/(3 sqrt(pi)), so the sampled kernel is exact at every
+    separation. Physical units: multiply the convolved field by g / h^2.
+
+    Computed in numpy so it stays eager (and cached) even when first hit
+    inside a jit trace; the returned numpy arrays become hoisted jit
+    constants.
+    """
+    import numpy as np
+    from scipy.special import erf as np_erf
+
+    cdtype = np.complex128 if dtype_str == "float64" else np.complex64
+    idx = np.arange(m2)
+    sep = np.where(idx < m2 // 2, idx, idx - m2).astype(np.float64)
+    sx = sep[:, None, None]
+    sy = sep[None, :, None]
+    sz = sep[None, None, :]
+    r2 = sx * sx + sy * sy + sz * sz
+    r = np.sqrt(r2)
+    a = 1.0 / (math.sqrt(2.0) * sigma_cells)
+    u = a * r
+    safe_r = np.maximum(r, 1e-20)
+    k = (
+        np_erf(u) / (safe_r * safe_r * safe_r)
+        - (2.0 * a / math.sqrt(math.pi))
+        * np.exp(-u * u) / (safe_r * safe_r)
+    )
+    k[0, 0, 0] = 4.0 * a**3 / (3.0 * math.sqrt(math.pi))
+    # Deconvolve the CIC assignment window (applied twice: deposit and
+    # gather). Per axis the CIC window is sinc^2; the Gaussian damping of
+    # the long-range kernel (e^{-k^2 sigma^2/2}, sigma >= h) bounds the
+    # high-k amplification, so this is the standard Hockney & Eastwood
+    # sharpening, not a noise amplifier.
+    fx = np.fft.fftfreq(m2)
+    fz = np.fft.rfftfreq(m2)
+    wx = np.sinc(fx) ** 2
+    wz = np.sinc(fz) ** 2
+    w = (
+        wx[:, None, None] * wx[None, :, None] * wz[None, None, :]
+    ) ** 2
+    return tuple(
+        (np.fft.rfftn(-k * s) / w).astype(cdtype) for s in (sx, sy, sz)
+    )
+
+
+def _mesh_accelerations(positions, masses, origin, span, *, grid, g,
+                        sigma_cells):
+    """Long-range accelerations: CIC deposit, three kernel convolutions
+    (isolated BCs via zero padding), CIC gather."""
+    dtype = positions.dtype
+    m = grid
+    m2 = 2 * m
+    h = span / (m - 1)
+    rho = cic_deposit(positions, masses, m, origin, h)
+    rho_p = jnp.zeros((m2, m2, m2), dtype).at[:m, :m, :m].set(rho)
+    rho_hat = jnp.fft.rfftn(rho_p)
+    khat = _force_kernel_hat(m2, sigma_cells, str(dtype))
+    acc_field = jnp.stack(
+        [
+            jnp.fft.irfftn(rho_hat * kh, s=(m2, m2, m2))[:m, :m, :m]
+            .astype(dtype)
+            for kh in khat
+        ],
+        axis=-1,
+    ) * (jnp.asarray(g, dtype) / (h * h))
+    return cic_gather(acc_field, positions, origin, h)
+
+
+def _short_range_w(r2, u, eps2, alpha3, dtype):
+    """diff-multiplier w(r) of the short-range pair force, u = alpha * r.
+
+    w = (r^2 + eps^2)^(-3/2) + alpha^3 * hfun(u) / u^2  where
+    hfun(u) = (2/sqrt(pi)) exp(-u^2) - erf(u)/u  (<= 0: the correction
+    subtracts the mesh's smooth kernel so the pair sum adds the exact
+    softened-Newtonian force for near pairs). hfun/u^2 is evaluated by
+    series below u = 0.05 (the exact form is 0/0 at u = 0). ``eps2`` may
+    be elementwise (the overflow fallback widens it per cell).
+    """
+    newt = jax.lax.rsqrt(r2 + eps2)
+    newt = newt * newt * newt
+    safe_u = jnp.maximum(u, jnp.asarray(1e-20, dtype))
+    two_over_sqrt_pi = jnp.asarray(2.0 / math.sqrt(math.pi), dtype)
+    exact = (
+        two_over_sqrt_pi * jnp.exp(-u * u) - erf(safe_u) / safe_u
+    ) / (safe_u * safe_u)
+    series = two_over_sqrt_pi * (
+        -2.0 / 3.0 + (2.0 / 5.0) * u * u
+    )
+    h_over_u2 = jnp.where(u < 0.05, series, exact)
+    return newt + alpha3 * h_over_u2
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "grid", "sigma_cells", "rcut_sigmas", "cap", "chunk",
+        "g", "cutoff", "eps",
+    ),
+)
+def p3m_accelerations(
+    positions: jax.Array,
+    masses: jax.Array,
+    *,
+    grid: int = 128,
+    sigma_cells: float = 1.25,
+    rcut_sigmas: float = 4.0,
+    cap: int = 128,
+    chunk: int = 4096,
+    g: float = G,
+    cutoff: float = CUTOFF_RADIUS,
+    eps: float = 0.0,
+) -> jax.Array:
+    """P3M accelerations for all particles (isolated boundary conditions).
+
+    ``grid`` is the PM mesh per axis; ``sigma_cells`` the Ewald split scale
+    in mesh cells; ``rcut_sigmas`` the short-range truncation (erfc at 4
+    sigma ~ 6e-5); ``cap`` the static per-cell source cap of the cell list
+    (overflow degrades to a softened monopole, never drops mass).
+    """
+    n = positions.shape[0]
+    dtype = positions.dtype
+    origin, span = bounding_cube(positions)
+    h = span / (grid - 1)
+    sigma = sigma_cells * h
+    alpha = 1.0 / (math.sqrt(2.0) * sigma)
+    rcut = rcut_sigmas * sigma
+
+    # ---- Long-range: smoothed vector-kernel FFT solve on the mesh. ----
+    acc = _mesh_accelerations(
+        positions, masses, origin, span,
+        grid=grid, g=g, sigma_cells=sigma_cells,
+    )
+
+    # ---- Short-range: cell-list pair sum of the erfc remainder. ----
+    side = binning_side(grid, sigma_cells, rcut_sigmas)
+    n_cells = side**3
+    u = (positions - origin[None, :]) / span
+    coords = jnp.clip((u * side).astype(jnp.int32), 0, side - 1)
+    cell_ids = (coords[:, 0] * side + coords[:, 1]) * side + coords[:, 2]
+
+    order = jnp.argsort(cell_ids)
+    sorted_pos = positions[order]
+    sorted_mass = masses[order]
+    cell_count = jax.ops.segment_sum(
+        jnp.ones((n,), jnp.int32), cell_ids, num_segments=n_cells
+    )
+    cell_start = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(cell_count)[:-1]]
+    )
+    m_scale = jnp.maximum(jnp.max(masses), jnp.asarray(1e-37, dtype))
+    # Per-cell mass/COM for the overflow fallback (normalized-mass
+    # accumulation: m * x overflows fp32 for planetary masses).
+    m_hat = masses / m_scale
+    cmass_hat = jax.ops.segment_sum(m_hat, cell_ids, num_segments=n_cells)
+    cmw = jax.ops.segment_sum(
+        m_hat[:, None] * positions, cell_ids, num_segments=n_cells
+    )
+    ccom = cmw / jnp.maximum(cmass_hat, jnp.asarray(1e-37, dtype))[:, None]
+
+    near = jnp.asarray(
+        [
+            (dx, dy, dz)
+            for dx in (-1, 0, 1)
+            for dy in (-1, 0, 1)
+            for dz in (-1, 0, 1)
+        ],
+        jnp.int32,
+    )
+
+    # Pad targets to a chunk multiple (padding targets is free: sources
+    # come from the gathered sorted arrays, and padded rows are sliced
+    # off) — collapsing to one chunk would materialize (n, 27*cap, 3)
+    # temporaries at exactly the large-N scale P3M targets.
+    chunk = min(chunk, n)
+    n_padded = ((n + chunk - 1) // chunk) * chunk
+    pad = n_padded - n
+
+    alpha_t = jnp.asarray(alpha, dtype)
+    alpha3_t = alpha_t * alpha_t * alpha_t
+
+    def pair_w(diff, src_m, ok):
+        """Masked short-range diff-multiplier for gathered sources."""
+        r2 = jnp.sum(diff * diff, axis=-1)
+        r = jnp.sqrt(r2)
+        ok = jnp.logical_and(ok, r2 < jnp.asarray(rcut * rcut, dtype))
+        ok = jnp.logical_and(
+            ok, r2 + jnp.asarray(eps * eps, dtype)
+            > jnp.asarray(cutoff * cutoff, dtype)
+        )
+        # r > 0 excludes self-pairs (and exact coincidences, which the
+        # mesh kernel handles smoothly).
+        ok = jnp.logical_and(ok, r2 > 0)
+        w = _short_range_w(
+            r2, alpha_t * r, jnp.asarray(eps * eps, dtype), alpha3_t, dtype
+        )
+        w = jnp.where(ok, jnp.asarray(g, dtype) * src_m * w, 0.0)
+        return w
+
+    def chunk_short(args):
+        pos_c, coords_c = args  # (C, 3) positions, (C, 3) cell coords
+        ncell = coords_c[:, None, :] + near[None, :, :]  # (C, 27, 3)
+        in_bounds = jnp.all(
+            jnp.logical_and(ncell >= 0, ncell < side), axis=-1
+        )
+        ncell_cl = jnp.clip(ncell, 0, side - 1)
+        nids = (
+            ncell_cl[..., 0] * side + ncell_cl[..., 1]
+        ) * side + ncell_cl[..., 2]
+        starts = cell_start[nids]  # (C, 27)
+        counts = jnp.where(in_bounds, cell_count[nids], 0)
+
+        k_idx = jnp.arange(cap, dtype=jnp.int32)
+        gather_idx = starts[..., None] + k_idx[None, None, :]  # (C, 27, K)
+        valid = k_idx[None, None, :] < counts[..., None]
+        gather_idx = jnp.clip(gather_idx, 0, n - 1)
+        flat = gather_idx.reshape(pos_c.shape[0], -1)  # (C, 27K)
+        src_pos = sorted_pos[flat]  # (C, 27K, 3)
+        src_m = sorted_mass[flat]
+        diff = src_pos - pos_c[:, None, :]
+        w = pair_w(diff, src_m, valid.reshape(pos_c.shape[0], -1))
+        acc_c = jnp.einsum("cl,cld->cd", w, diff)
+
+        # Overflow: cells holding more than `cap` sources contribute their
+        # remaining mass as a cell-size-softened monopole through the same
+        # short-range kernel (bounded error, no dropped mass).
+        over = counts > cap
+        over_any = jnp.any(over)
+
+        def add_overflow(acc_c):
+            src_mhat = (src_m / m_scale).reshape(valid.shape)
+            pref_mhat = jnp.sum(jnp.where(valid, src_mhat, 0.0), axis=-1)
+            pref_mw = jnp.sum(
+                jnp.where(
+                    valid[..., None],
+                    src_mhat[..., None] * src_pos.reshape(valid.shape + (3,)),
+                    0.0,
+                ),
+                axis=-2,
+            )
+            rem_mhat = jnp.maximum(
+                jnp.where(over, cmass_hat[nids] - pref_mhat, 0.0), 0.0
+            )
+            tot_mw = ccom[nids] * cmass_hat[nids][..., None]
+            rem_com = (tot_mw - pref_mw) / jnp.maximum(
+                rem_mhat, jnp.asarray(1e-37, dtype)
+            )[..., None]
+            diff_o = rem_com - pos_c[:, None, :]
+            r2 = jnp.sum(diff_o * diff_o, axis=-1)
+            r = jnp.sqrt(r2)
+            # Cell-size-softened: an overflowing cell's COM can sit
+            # arbitrarily close to a target.
+            cell_h = span / side
+            eps_o2 = jnp.maximum(
+                jnp.asarray(eps * eps, dtype),
+                (0.5 * cell_h) * (0.5 * cell_h),
+            )
+            w_o = _short_range_w(r2, alpha_t * r, eps_o2, alpha3_t, dtype)
+            w_o = jnp.where(
+                over, jnp.asarray(g, dtype) * rem_mhat * m_scale * w_o, 0.0
+            )
+            diff_o = jnp.where(over[..., None], diff_o, 0.0)
+            return acc_c + jnp.einsum("cl,cld->cd", w_o, diff_o)
+
+        return jax.lax.cond(over_any, add_overflow, lambda a: a, acc_c)
+
+    if n_padded == chunk:
+        short = chunk_short((positions, coords))
+    else:
+        pos_p = jnp.pad(positions, ((0, pad), (0, 0)))
+        coords_p = jnp.pad(coords, ((0, pad), (0, 0)))
+        pos_chunks = pos_p.reshape(n_padded // chunk, chunk, 3)
+        coord_chunks = coords_p.reshape(n_padded // chunk, chunk, 3)
+        short = jax.lax.map(
+            chunk_short, (pos_chunks, coord_chunks)
+        ).reshape(n_padded, 3)[:n]
+    return acc + short

@@ -87,7 +87,8 @@ def cic_gather(field, positions, origin, h):
 def _greens_function(m2, h, eps, dtype):
     """Softened -1/r kernel on the padded (2M)^3 grid, wrapped so that
     negative separations index from the top (circular convolution sees the
-    padded box as separation space)."""
+    padded box as separation space). (The P3M long-range kernel lives in
+    p3m._force_kernel_hat — a vector force kernel, not a potential.)"""
     idx = jnp.arange(m2)
     # Separation in cells: 0, 1, ..., M-1, then -M, ..., -1 (wrapped).
     sep = jnp.where(idx < m2 // 2, idx, idx - m2)
@@ -120,16 +121,36 @@ def pm_accelerations(
     tracks the system as it evolves). ``eps`` is the Plummer softening;
     values below half a cell are clamped to the grid resolution floor.
     """
-    dtype = positions.dtype
-    m = grid
-    m2 = 2 * m  # zero-padded transform size (isolated BCs)
+    origin, span = bounding_cube(positions)
+    return pm_solve(positions, masses, origin, span, grid=grid, g=g, eps=eps)
 
-    # Bounding cube with a small margin; cube (not box) keeps h isotropic.
+
+def bounding_cube(positions):
+    """(origin, span) of a cube containing all positions, small margin."""
+    dtype = positions.dtype
     lo = jnp.min(positions, axis=0)
     hi = jnp.max(positions, axis=0)
     span = jnp.max(hi - lo) * 1.02 + jnp.asarray(1e-30, dtype)
     center = 0.5 * (hi + lo)
     origin = center - 0.5 * span
+    return origin, span
+
+
+@partial(jax.jit, static_argnames=("grid", "g", "eps"))
+def pm_solve(
+    positions,
+    masses,
+    origin,
+    span,
+    *,
+    grid: int,
+    g: float,
+    eps: float,
+):
+    """PM solve (softened -1/r kernel) over an explicit bounding cube."""
+    dtype = positions.dtype
+    m = grid
+    m2 = 2 * m  # zero-padded transform size (isolated BCs)
     h = span / (m - 1)
 
     rho = cic_deposit(positions, masses, m, origin, h)

@@ -58,10 +58,10 @@ def _resolve_backend(config: SimulationConfig) -> str:
 def make_local_kernel(config: SimulationConfig, backend: str):
     """LocalKernel (pos_i, pos_j, m_j) -> acc for the resolved backend."""
     common = dict(g=config.g, cutoff=config.cutoff, eps=config.eps)
-    if backend in ("tree", "pm"):
+    if backend in ("tree", "pm", "p3m"):
         raise ValueError(
             f"force backend {backend!r} is single-device for now; use "
-            "sharding='none' (sharded tree/pm is planned)"
+            "sharding='none' (sharded tree/pm/p3m is planned)"
         )
     if backend in ("dense", "chunked"):
         # "chunked" differs only in the unsharded full-N path below; as a
@@ -157,6 +157,16 @@ class Simulator:
 
             return lambda pos: pm_accelerations(
                 pos, masses, grid=config.pm_grid, g=config.g, eps=config.eps
+            )
+        if self.backend == "p3m":
+            from .ops.p3m import p3m_accelerations
+
+            chunk = min(config.chunk, state.n)
+            return lambda pos: p3m_accelerations(
+                pos, masses, grid=config.pm_grid,
+                sigma_cells=config.p3m_sigma_cells,
+                rcut_sigmas=config.p3m_rcut_sigmas,
+                cap=config.p3m_cap, chunk=chunk, **common,
             )
         raise ValueError(self.backend)
 
