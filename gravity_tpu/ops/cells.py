@@ -1,0 +1,44 @@
+"""Shared cell-grid utilities for the fast solvers (tree, p3m).
+
+Both backends bin points into a cube grid derived from the source
+bounding cube and evaluate targets in fixed-size chunks under
+``lax.map`` (sequential chunks bound peak memory; each chunk's gathers
+and pair math are fully vectorized). Factored here so the coord formula
+and the pad-to-chunk-multiple scaffolding cannot drift apart.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def grid_coords(points, origin, span, side: int):
+    """Integer cell coords of ``points`` on a side^3 grid over the cube
+    (origin, span), clipped to the grid (coincident-with-boundary and
+    out-of-cube points land in edge cells)."""
+    u = (points - origin[None, :]) / span
+    return jnp.clip((u * side).astype(jnp.int32), 0, side - 1)
+
+
+def map_target_chunks(fn, targets, t_coords, chunk: int):
+    """Apply ``fn((pos_chunk (C,3), coord_chunk (C,3))) -> (C, 3)`` over
+    targets in chunks of ``chunk``, padding the tail chunk (padded rows
+    are computed and sliced off — padding targets never touches the
+    source-side structures)."""
+    n_t = targets.shape[0]
+    chunk = max(1, min(chunk, n_t))
+    n_padded = ((n_t + chunk - 1) // chunk) * chunk
+    pad = n_padded - n_t
+    if n_padded == chunk:
+        return fn((targets, t_coords))
+    pos_p = jnp.pad(targets, ((0, pad), (0, 0)))
+    coords_p = jnp.pad(t_coords, ((0, pad), (0, 0)))
+    out = jax.lax.map(
+        fn,
+        (
+            pos_p.reshape(n_padded // chunk, chunk, 3),
+            coords_p.reshape(n_padded // chunk, chunk, 3),
+        ),
+    )
+    return out.reshape(n_padded, 3)[:n_t]

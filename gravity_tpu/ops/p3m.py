@@ -43,6 +43,7 @@ import jax.numpy as jnp
 from jax.scipy.special import erf
 
 from ..constants import CUTOFF_RADIUS, G
+from .cells import grid_coords, map_target_chunks
 from .pm import bounding_cube, cic_deposit, cic_gather
 
 
@@ -113,10 +114,11 @@ def _force_kernel_hat(m2: int, sigma_cells: float, dtype_str: str):
     )
 
 
-def _mesh_accelerations(positions, masses, origin, span, *, grid, g,
-                        sigma_cells):
-    """Long-range accelerations: CIC deposit, three kernel convolutions
-    (isolated BCs via zero padding), CIC gather."""
+def _mesh_accelerations(targets, positions, masses, origin, span, *, grid,
+                        g, sigma_cells):
+    """Long-range accelerations at ``targets``: CIC deposit of the sources,
+    three kernel convolutions (isolated BCs via zero padding), CIC gather
+    at the targets."""
     dtype = positions.dtype
     m = grid
     m2 = 2 * m
@@ -133,7 +135,7 @@ def _mesh_accelerations(positions, masses, origin, span, *, grid, g,
         ],
         axis=-1,
     ) * (jnp.asarray(g, dtype) / (h * h))
-    return cic_gather(acc_field, positions, origin, h)
+    return cic_gather(acc_field, targets, origin, h)
 
 
 def _short_range_w(r2, u, eps2, alpha3, dtype):
@@ -167,7 +169,8 @@ def _short_range_w(r2, u, eps2, alpha3, dtype):
         "g", "cutoff", "eps",
     ),
 )
-def p3m_accelerations(
+def p3m_accelerations_vs(
+    targets: jax.Array,
     positions: jax.Array,
     masses: jax.Array,
     *,
@@ -180,12 +183,17 @@ def p3m_accelerations(
     cutoff: float = CUTOFF_RADIUS,
     eps: float = 0.0,
 ) -> jax.Array:
-    """P3M accelerations for all particles (isolated boundary conditions).
+    """P3M accelerations at ``targets`` from sources (positions, masses),
+    isolated boundary conditions.
 
-    ``grid`` is the PM mesh per axis; ``sigma_cells`` the Ewald split scale
-    in mesh cells; ``rcut_sigmas`` the short-range truncation (erfc at 4
-    sigma ~ 6e-5); ``cap`` the static per-cell source cap of the cell list
-    (overflow degrades to a softened monopole, never drops mass).
+    The mesh and cell list are built over the sources; targets may be any
+    points (under sharded evaluation each chip passes its target slice
+    with the full gathered source set — build replicated, evaluation
+    sharded). ``grid`` is the PM mesh per axis; ``sigma_cells`` the Ewald
+    split scale in mesh cells; ``rcut_sigmas`` the short-range truncation
+    (erfc at 4 sigma ~ 6e-5); ``cap`` the static per-cell source cap of
+    the cell list (overflow degrades to a softened monopole, never drops
+    mass).
     """
     n = positions.shape[0]
     dtype = positions.dtype
@@ -197,16 +205,16 @@ def p3m_accelerations(
 
     # ---- Long-range: smoothed vector-kernel FFT solve on the mesh. ----
     acc = _mesh_accelerations(
-        positions, masses, origin, span,
+        targets, positions, masses, origin, span,
         grid=grid, g=g, sigma_cells=sigma_cells,
     )
 
     # ---- Short-range: cell-list pair sum of the erfc remainder. ----
     side = binning_side(grid, sigma_cells, rcut_sigmas)
     n_cells = side**3
-    u = (positions - origin[None, :]) / span
-    coords = jnp.clip((u * side).astype(jnp.int32), 0, side - 1)
+    coords = grid_coords(positions, origin, span, side)
     cell_ids = (coords[:, 0] * side + coords[:, 1]) * side + coords[:, 2]
+    t_coords = grid_coords(targets, origin, span, side)
 
     order = jnp.argsort(cell_ids)
     sorted_pos = positions[order]
@@ -237,13 +245,6 @@ def p3m_accelerations(
         jnp.int32,
     )
 
-    # Pad targets to a chunk multiple (padding targets is free: sources
-    # come from the gathered sorted arrays, and padded rows are sliced
-    # off) — collapsing to one chunk would materialize (n, 27*cap, 3)
-    # temporaries at exactly the large-N scale P3M targets.
-    chunk = min(chunk, n)
-    n_padded = ((n + chunk - 1) // chunk) * chunk
-    pad = n_padded - n
 
     alpha_t = jnp.asarray(alpha, dtype)
     alpha3_t = alpha_t * alpha_t * alpha_t
@@ -333,14 +334,17 @@ def p3m_accelerations(
 
         return jax.lax.cond(over_any, add_overflow, lambda a: a, acc_c)
 
-    if n_padded == chunk:
-        short = chunk_short((positions, coords))
-    else:
-        pos_p = jnp.pad(positions, ((0, pad), (0, 0)))
-        coords_p = jnp.pad(coords, ((0, pad), (0, 0)))
-        pos_chunks = pos_p.reshape(n_padded // chunk, chunk, 3)
-        coord_chunks = coords_p.reshape(n_padded // chunk, chunk, 3)
-        short = jax.lax.map(
-            chunk_short, (pos_chunks, coord_chunks)
-        ).reshape(n_padded, 3)[:n]
+    # Chunked target evaluation (tail chunk padded, never collapsed to one
+    # whole-N chunk — that would materialize (n, 27*cap, 3) temporaries at
+    # exactly the large-N scale P3M targets).
+    short = map_target_chunks(chunk_short, targets, t_coords, chunk)
     return acc + short
+
+
+def p3m_accelerations(
+    positions: jax.Array,
+    masses: jax.Array,
+    **kwargs,
+) -> jax.Array:
+    """P3M accelerations for all particles (targets = sources)."""
+    return p3m_accelerations_vs(positions, positions, masses, **kwargs)

@@ -121,8 +121,26 @@ def pm_accelerations(
     tracks the system as it evolves). ``eps`` is the Plummer softening;
     values below half a cell are clamped to the grid resolution floor.
     """
+    return pm_accelerations_vs(positions, positions, masses, grid=grid,
+                               g=g, eps=eps)
+
+
+@partial(jax.jit, static_argnames=("grid", "g", "eps"))
+def pm_accelerations_vs(
+    targets: jax.Array,
+    positions: jax.Array,
+    masses: jax.Array,
+    *,
+    grid: int = 128,
+    g: float = G,
+    eps: float = 0.0,
+) -> jax.Array:
+    """PM accelerations at ``targets`` from sources (positions, masses) —
+    the mesh solve is over the sources, the field gather at the targets
+    (under sharded evaluation: replicated solve, sharded gather)."""
     origin, span = bounding_cube(positions)
-    return pm_solve(positions, masses, origin, span, grid=grid, g=g, eps=eps)
+    return pm_solve(targets, positions, masses, origin, span, grid=grid,
+                    g=g, eps=eps)
 
 
 def bounding_cube(positions):
@@ -138,6 +156,7 @@ def bounding_cube(positions):
 
 @partial(jax.jit, static_argnames=("grid", "g", "eps"))
 def pm_solve(
+    targets,
     positions,
     masses,
     origin,
@@ -147,7 +166,8 @@ def pm_solve(
     g: float,
     eps: float,
 ):
-    """PM solve (softened -1/r kernel) over an explicit bounding cube."""
+    """PM solve (softened -1/r kernel) over an explicit bounding cube:
+    deposit the sources, gather the field at the targets."""
     dtype = positions.dtype
     m = grid
     m2 = 2 * m  # zero-padded transform size (isolated BCs)
@@ -179,4 +199,4 @@ def pm_solve(
     acc_field = jnp.stack(
         [-grad_axis(phi, a) for a in range(3)], axis=-1
     )  # (M, M, M, 3)
-    return cic_gather(acc_field, positions, origin, h)
+    return cic_gather(acc_field, targets, origin, h)

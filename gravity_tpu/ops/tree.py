@@ -48,6 +48,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..constants import CUTOFF_RADIUS, G
+from .cells import grid_coords, map_target_chunks
 
 # ---------------------------------------------------------------------------
 # Interaction-list offset table: for each parity (cell coord mod 2 per axis)
@@ -110,8 +111,7 @@ def build_octree(positions, masses, depth: int):
     origin = 0.5 * (hi + lo) - 0.5 * span
 
     side = 1 << depth
-    u = (positions - origin[None, :]) / span  # in [0, 1)
-    coords = jnp.clip((u * side).astype(jnp.int32), 0, side - 1)  # (N, 3)
+    coords = grid_coords(positions, origin, span, side)  # (N, 3)
 
     # COM via normalized weights: m * x overflows fp32 for heavy bodies
     # (1e30 kg at 5e11 m -> 5e41), so accumulate with m_hat = m/max(m).
@@ -167,7 +167,8 @@ def _pair_acc(pos, src_pos, src_mass, mask, g, cutoff, eps, dtype):
     jax.jit,
     static_argnames=("depth", "leaf_cap", "chunk", "ws", "g", "cutoff", "eps"),
 )
-def tree_accelerations(
+def tree_accelerations_vs(
+    targets: jax.Array,
     positions: jax.Array,
     masses: jax.Array,
     *,
@@ -179,13 +180,16 @@ def tree_accelerations(
     cutoff: float = CUTOFF_RADIUS,
     eps: float = 0.0,
 ) -> jax.Array:
-    """Octree accelerations for all particles.
+    """Octree accelerations at ``targets`` from sources (positions, masses).
 
-    ``depth`` sets the leaf grid (2^depth per axis); pick so the typical
-    occupied leaf holds ~leaf_cap/4 particles. ``leaf_cap`` is the static
-    near-field occupancy cap: the first ``leaf_cap`` particles of each
-    neighbor cell are summed exactly, the remainder enters via the cell
-    monopole. ``ws`` is the well-separatedness (cells >= ws apart are
+    The tree is built over the sources; targets may be any points (under
+    sharded evaluation each chip passes its target slice with the full
+    gathered source set — the build is replicated, the evaluation
+    sharded). ``depth`` sets the leaf grid (2^depth per axis); pick so the
+    typical occupied leaf holds ~leaf_cap/4 particles. ``leaf_cap`` is the
+    static near-field occupancy cap: the first ``leaf_cap`` particles of
+    each neighbor cell are summed exactly, the remainder enters via the
+    cell monopole. ``ws`` is the well-separatedness (cells >= ws apart are
     monopole-approximated; effective worst-case theta ~ 0.87/ws).
     """
     n = positions.shape[0]
@@ -193,6 +197,9 @@ def tree_accelerations(
     levels, origin, span, coords = build_octree(positions, masses, depth)
     side = 1 << depth
     m_scale = jnp.maximum(jnp.max(masses), jnp.asarray(1e-37, dtype))
+
+    # Leaf coords of the targets (sources' come from build_octree).
+    t_coords = grid_coords(targets, origin, span, side)
 
     # ---- Morton-ordered particle arrays + leaf (start, count) tables ----
     leaf_ids = (coords[:, 0] * side + coords[:, 1]) * side + coords[:, 2]
@@ -211,8 +218,6 @@ def tree_accelerations(
     parity_masks = jnp.asarray(_parity_mask_table(ws))  # (8, L)
     near = jnp.asarray(_near_offsets(ws))  # ((2ws+1)^3, 3)
 
-    if n % chunk != 0:
-        chunk = n  # fall back to a single chunk for ragged N
 
     def chunk_acc(args):
         pos_c, coords_c = args  # (C, 3), (C, 3) leaf coords
@@ -304,12 +309,16 @@ def tree_accelerations(
         acc = jax.lax.cond(over_any, add_overflow, lambda a: a, acc)
         return acc
 
-    if n == chunk:
-        return chunk_acc((positions, coords))
-    pos_chunks = positions.reshape(n // chunk, chunk, 3)
-    coord_chunks = coords.reshape(n // chunk, chunk, 3)
-    acc = jax.lax.map(chunk_acc, (pos_chunks, coord_chunks))
-    return acc.reshape(n, 3)
+    return map_target_chunks(chunk_acc, targets, t_coords, chunk)
+
+
+def tree_accelerations(
+    positions: jax.Array,
+    masses: jax.Array,
+    **kwargs,
+) -> jax.Array:
+    """Octree accelerations for all particles (targets = sources)."""
+    return tree_accelerations_vs(positions, positions, masses, **kwargs)
 
 
 def recommended_depth(n: int, leaf_cap: int = 32) -> int:

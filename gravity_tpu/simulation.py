@@ -56,13 +56,17 @@ def _resolve_backend(config: SimulationConfig) -> str:
 
 
 def make_local_kernel(config: SimulationConfig, backend: str):
-    """LocalKernel (pos_i, pos_j, m_j) -> acc for the resolved backend."""
+    """LocalKernel (pos_targets, pos_sources, m_sources) -> acc for the
+    resolved backend.
+
+    The fast solvers (tree/pm/p3m) fit this signature too: each chip
+    rebuilds the tree/mesh from the full gathered source set (replicated
+    work, cheap — O(N) with small constants) and evaluates only its target
+    slice (the dominant cost, perfectly sharded). They require the
+    ``allgather`` strategy: a ring over source shards cannot build a
+    global tree or mesh.
+    """
     common = dict(g=config.g, cutoff=config.cutoff, eps=config.eps)
-    if backend in ("tree", "pm", "p3m"):
-        raise ValueError(
-            f"force backend {backend!r} is single-device for now; use "
-            "sharding='none' (sharded tree/pm/p3m is planned)"
-        )
     if backend in ("dense", "chunked"):
         # "chunked" differs only in the unsharded full-N path below; as a
         # local kernel (slice vs sources) dense jnp is the right shape.
@@ -72,6 +76,32 @@ def make_local_kernel(config: SimulationConfig, backend: str):
 
         interpret = jax.devices()[0].platform != "tpu"
         return make_pallas_local_kernel(interpret=interpret, **common)
+    if backend == "tree":
+        from .ops.tree import recommended_depth, tree_accelerations_vs
+
+        depth = config.tree_depth or recommended_depth(
+            config.n, config.tree_leaf_cap
+        )
+        return partial(
+            tree_accelerations_vs, depth=depth,
+            leaf_cap=config.tree_leaf_cap, **common,
+        )
+    if backend == "pm":
+        from .ops.pm import pm_accelerations_vs
+
+        return partial(
+            pm_accelerations_vs, grid=config.pm_grid, g=config.g,
+            eps=config.eps,
+        )
+    if backend == "p3m":
+        from .ops.p3m import p3m_accelerations_vs
+
+        return partial(
+            p3m_accelerations_vs, grid=config.pm_grid,
+            sigma_cells=config.p3m_sigma_cells,
+            rcut_sigmas=config.p3m_rcut_sigmas,
+            cap=config.p3m_cap, chunk=config.chunk, **common,
+        )
     raise ValueError(f"unknown force backend {backend!r}")
 
 
@@ -96,6 +126,14 @@ class Simulator:
         # exact — see ParticleState.pad_to).
         self.mesh = None
         if config.sharding != "none":
+            if config.sharding == "ring" and self.backend in (
+                "tree", "pm", "p3m"
+            ):
+                raise ValueError(
+                    f"force backend {self.backend!r} needs the full source "
+                    "set per chip to build its tree/mesh; use "
+                    "sharding='allgather'"
+                )
             from .parallel import (
                 make_particle_mesh,
                 make_sharded_accel_fn,
@@ -161,12 +199,11 @@ class Simulator:
         if self.backend == "p3m":
             from .ops.p3m import p3m_accelerations
 
-            chunk = min(config.chunk, state.n)
             return lambda pos: p3m_accelerations(
                 pos, masses, grid=config.pm_grid,
                 sigma_cells=config.p3m_sigma_cells,
                 rcut_sigmas=config.p3m_rcut_sigmas,
-                cap=config.p3m_cap, chunk=chunk, **common,
+                cap=config.p3m_cap, chunk=config.chunk, **common,
             )
         raise ValueError(self.backend)
 

@@ -74,11 +74,18 @@ class ParticleState:
         )
 
     def pad_to(self, n_target: int) -> tuple["ParticleState", jax.Array]:
-        """Pad with zero-mass particles at rest far away; returns (state, valid mask).
+        """Pad with zero-mass particles at rest; returns (state, valid mask).
 
-        Zero-mass padding exerts no force on real particles; padded particles
-        are parked at distinct far-away positions so they never trip the
-        close-approach cutoff against each other or real bodies.
+        Zero-mass padding exerts no force on real particles. Padded
+        particles are parked AT particle 0's position (not far away): the
+        fast solvers derive their bounding cube / octree / cell-list
+        geometry from source positions, and a distant parking spot would
+        inflate the cube until every real particle collapsed into one
+        cell. Coincident zero-mass padding is safe for every kernel (r=0
+        falls below the close-approach cutoff, softened kernels are
+        finite at r=0, and zero mass nullifies the source side); the only
+        cost is up to (devices-1) occupied slots in one cell-list cell,
+        which the overflow fallback already covers.
         """
         n = self.n
         if n_target < n:
@@ -86,12 +93,8 @@ class ParticleState:
         if n_target == n:
             return self, jnp.ones((n,), dtype=bool)
         pad = n_target - n
-        far = jnp.asarray(1e18, dtype=self.dtype)
-        offs = (jnp.arange(pad, dtype=self.dtype) + 1.0) * jnp.asarray(
-            1e12, dtype=self.dtype
-        )
-        pad_pos = jnp.stack(
-            [far + offs, jnp.zeros_like(offs), jnp.zeros_like(offs)], axis=1
+        pad_pos = jnp.broadcast_to(self.positions[0], (pad, 3)).astype(
+            self.dtype
         )
         padded = ParticleState(
             positions=jnp.concatenate([self.positions, pad_pos], axis=0),

@@ -87,6 +87,55 @@ def test_sharded_run_matches_unsharded(strategy):
     assert final_sharded.positions.shape == (96, 3)
 
 
+@pytest.mark.parametrize("backend", ["tree", "pm", "p3m"])
+def test_fast_backend_sharded_matches_unsharded(backend):
+    """Fast solvers under allgather sharding: replicated tree/mesh build,
+    sharded target evaluation — bit-comparable to the unsharded run."""
+    cfg = _small_config(
+        n=96, steps=5, integrator="leapfrog", force_backend=backend,
+        model="plummer", eps=1e10, pm_grid=32,
+    )
+    cfg_sharded = dataclasses.replace(cfg, sharding="allgather")
+    final = Simulator(cfg).run()["final_state"]
+    final_sharded = Simulator(cfg_sharded).run()["final_state"]
+    scale = float(np.abs(np.asarray(final.positions)).max())
+    np.testing.assert_allclose(
+        np.asarray(final_sharded.positions),
+        np.asarray(final.positions),
+        rtol=1e-4, atol=1e-5 * scale,
+    )
+    assert final_sharded.positions.shape == (96, 3)
+
+
+def test_fast_backend_sharded_padded_matches_unsharded():
+    """n NOT divisible by the device count: the zero-mass padding must not
+    perturb the bounding cube / cell list the fast solvers derive from
+    source positions (regression: far-away parking inflated the cube until
+    every real particle fell into one cell)."""
+    cfg = _small_config(
+        n=100, steps=5, integrator="leapfrog", force_backend="p3m",
+        model="plummer", eps=1e10, pm_grid=32,
+    )
+    cfg_sharded = dataclasses.replace(cfg, sharding="allgather")
+    final = Simulator(cfg).run()["final_state"]
+    final_sharded = Simulator(cfg_sharded).run()["final_state"]
+    scale = float(np.abs(np.asarray(final.positions)).max())
+    np.testing.assert_allclose(
+        np.asarray(final_sharded.positions),
+        np.asarray(final.positions),
+        rtol=1e-4, atol=1e-5 * scale,
+    )
+    assert final_sharded.positions.shape == (100, 3)
+
+
+def test_fast_backend_ring_raises():
+    cfg = _small_config(
+        n=96, force_backend="p3m", sharding="ring", model="plummer",
+    )
+    with pytest.raises(ValueError, match="allgather"):
+        Simulator(cfg)
+
+
 def test_reference_log_shape(tmp_path):
     """The run log has the reference's sections (SURVEY §5 log contract)."""
     cfg = _small_config(steps=200)

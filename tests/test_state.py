@@ -56,12 +56,17 @@ def test_pad_to():
     assert padded.n == 16
     assert mask.sum() == 10
     np.testing.assert_array_equal(np.asarray(padded.masses[10:]), 0.0)
-    # Padded particles are far from the origin and from each other.
+    # Padding must NOT perturb geometry-derived builds (bounding cube,
+    # octree, cell lists): parked at particle 0's position, zero mass.
     pad_pos = np.asarray(padded.positions[10:])
-    assert np.all(np.linalg.norm(pad_pos, axis=1) > 1e17)
-    from scipy.spatial.distance import pdist
-
-    assert pdist(pad_pos).min() > 1e10
+    np.testing.assert_array_equal(
+        pad_pos, np.broadcast_to(np.asarray(s.positions[0]), (6, 3))
+    )
+    # A padded run's bounding cube equals the unpadded one.
+    lo = np.asarray(padded.positions).min(0)
+    hi = np.asarray(padded.positions).max(0)
+    np.testing.assert_array_equal(lo, np.asarray(s.positions).min(0))
+    np.testing.assert_array_equal(hi, np.asarray(s.positions).max(0))
 
 
 def test_pad_to_noop_and_error():
