@@ -1,0 +1,114 @@
+"""Run the five BASELINE.json benchmark configs and report one JSON line
+each (plus a markdown table for BASELINE.md).
+
+Hardware adaptation: the dev environment exposes ONE real TPU chip (the
+axon tunnel) — the multi-chip configs (262k on v5p-8, 2x1M multi-slice)
+are measured single-chip here and their sharded paths are validated
+separately on the 8-device virtual CPU mesh (tests + dryrun_multichip);
+per-chip throughput is the comparable metric either way.
+
+Usage:
+    python benchmarks/run_baselines.py            # all configs
+    python benchmarks/run_baselines.py 1m 16k     # subset by tag
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+CONFIGS = {
+    # tag -> (description, SimulationConfig kwargs, bench kwargs)
+    "1k": (
+        "1024-body random cube, direct O(N^2) (CPU-parity baseline)",
+        dict(model="random", n=1024, dt=3600.0, integrator="leapfrog",
+             force_backend="dense"),
+        dict(bench_steps=100),
+    ),
+    "16k": (
+        "16,384-body Plummer sphere, single-chip Pallas",
+        dict(model="plummer", n=16_384, dt=3600.0, eps=1.0e9,
+             integrator="leapfrog", force_backend="pallas"),
+        dict(bench_steps=50),
+    ),
+    "262k": (
+        "262,144-body cold collapse, direct sum (sharded allgather on a "
+        "pod; single-chip Pallas here)",
+        dict(model="cold_collapse", n=262_144, dt=3600.0, eps=1.0e9,
+             integrator="leapfrog", force_backend="pallas"),
+        dict(bench_steps=5),
+    ),
+    "1m-tree": (
+        "1M-body Milky-Way disk, octree",
+        dict(model="disk", n=1_048_576, g=1.0, dt=2.0e-3, eps=0.05,
+             integrator="leapfrog", force_backend="tree",
+             tree_leaf_cap=32),
+        dict(bench_steps=3),
+    ),
+    "1m-p3m": (
+        "1M-body Milky-Way disk, P3M (grid=256, cap=64)",
+        dict(model="disk", n=1_048_576, g=1.0, dt=2.0e-3, eps=0.05,
+             integrator="leapfrog", force_backend="p3m", pm_grid=256,
+             p3m_cap=64, chunk=4096),
+        dict(bench_steps=3),
+    ),
+    "2m-merger": (
+        "2x1M-body galaxy merger, P3M (multi-slice DCN on a pod; "
+        "single-chip here)",
+        dict(model="merger", n=2_097_152, g=1.0, dt=2.0e-3, eps=0.05,
+             integrator="leapfrog", force_backend="p3m", pm_grid=256,
+             p3m_cap=64, chunk=4096),
+        dict(bench_steps=3),
+    ),
+}
+
+
+def run_one(tag: str) -> dict:
+    import jax
+
+    from gravity_tpu.bench import run_benchmark
+    from gravity_tpu.config import SimulationConfig
+
+    desc, cfg_kwargs, bench_kwargs = CONFIGS[tag]
+    platform = jax.devices()[0].platform
+    if platform != "tpu" and cfg_kwargs["force_backend"] == "pallas":
+        cfg_kwargs = dict(cfg_kwargs, force_backend="chunked")
+    config = SimulationConfig(**cfg_kwargs)
+    t0 = time.time()
+    stats = run_benchmark(config, **bench_kwargs)
+    stats.update(tag=tag, description=desc, wall_s=round(time.time() - t0, 1))
+    return stats
+
+
+def main(argv) -> int:
+    tags = argv or list(CONFIGS)
+    results = []
+    for tag in tags:
+        if tag not in CONFIGS:
+            print(f"unknown tag {tag!r}; choose from {list(CONFIGS)}")
+            return 2
+        try:
+            r = run_one(tag)
+        except Exception as e:  # keep going; report the failure
+            r = dict(tag=tag, error=f"{type(e).__name__}: {e}")
+        results.append(r)
+        print(json.dumps(r), flush=True)
+
+    # Markdown table for BASELINE.md.
+    print("\n| Config | N | backend | avg step (s) | pairs/s/chip |")
+    print("|---|---|---|---|---|")
+    for r in results:
+        if "error" in r:
+            print(f"| {r['tag']} | — | — | ERROR | {r['error']} |")
+            continue
+        print(
+            f"| {r['description']} | {r['n']:,} | {r['backend']} "
+            f"| {r['avg_step_s']:.4f} "
+            f"| {r['pairs_per_sec_per_chip']:.3e} |"
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
