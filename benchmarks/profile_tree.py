@@ -1,0 +1,96 @@
+"""Decompose the octree backend's per-step cost on the current platform.
+
+Times, separately: the pyramid build (segment_sums), the Morton sort +
+leaf tables, the far-field monopole levels, and the near-field pair
+gather — to identify what dominates on TPU (gathers vs scatters vs
+flops). Optionally captures a jax.profiler trace.
+
+Usage:
+    python benchmarks/profile_tree.py [N] [--trace DIR]
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def timed(fn, *args, iters=3, label=""):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / iters
+    print(f"{label:32s} {dt * 1e3:10.2f} ms")
+    return dt
+
+
+def main(argv) -> int:
+    n = int(argv[0]) if argv else 65536
+    trace_dir = None
+    if "--trace" in argv:
+        trace_dir = argv[argv.index("--trace") + 1]
+
+    from gravity_tpu.models import create_disk
+    from gravity_tpu.ops.tree import (
+        build_octree,
+        recommended_depth,
+        tree_accelerations,
+    )
+
+    platform = jax.devices()[0].platform
+    state = create_disk(jax.random.PRNGKey(0), n)
+    pos, masses = state.positions, state.masses
+    depth = recommended_depth(n)
+    side = 1 << depth
+    print(f"platform={platform} n={n} depth={depth} side={side}")
+
+    # 1. Pyramid build alone.
+    build = jax.jit(
+        lambda p, m: build_octree(p, m, depth)[0][depth][0]
+    )
+    timed(build, pos, masses, label="build_octree (segment_sums)")
+
+    # 2. Morton sort + permute alone.
+    def sort_part(p):
+        levels, origin, span, coords = build_octree(p, masses, depth)
+        leaf_ids = (
+            coords[:, 0] * side + coords[:, 1]
+        ) * side + coords[:, 2]
+        order = jnp.argsort(leaf_ids)
+        return p[order]
+
+    timed(jax.jit(sort_part), pos, label="build + argsort + permute")
+
+    # 3. Full tree force.
+    def full(p):
+        return tree_accelerations(p, masses, depth=depth, eps=0.05, g=1.0)
+
+    t_full = timed(jax.jit(full), pos, label="tree_accelerations (full)")
+
+    # 4. Direct-sum reference point at this n (chunked to bound memory).
+    from gravity_tpu.ops.forces import pairwise_accelerations_chunked
+
+    if n <= 262144:
+        def direct(p):
+            return pairwise_accelerations_chunked(
+                p, masses, chunk=2048, eps=0.05, g=1.0
+            )
+
+        t_dir = timed(jax.jit(direct), pos, label="direct chunked (ref)")
+        print(f"tree speedup vs direct: {t_dir / t_full:.2f}x")
+
+    if trace_dir:
+        with jax.profiler.trace(trace_dir):
+            jax.block_until_ready(jax.jit(full)(pos))
+        print(f"trace written to {trace_dir}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

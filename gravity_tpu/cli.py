@@ -47,6 +47,8 @@ def _add_config_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--tree-depth", dest="tree_depth", type=int, default=None)
     p.add_argument("--tree-leaf-cap", dest="tree_leaf_cap", type=int,
                    default=None)
+    p.add_argument("--tree-ws", dest="tree_ws", type=int, default=None,
+                   help="octree opening criterion (theta ~ 0.87/ws)")
     p.add_argument("--pm-grid", dest="pm_grid", type=int, default=None)
     p.add_argument("--p3m-sigma-cells", dest="p3m_sigma_cells", type=float,
                    default=None)

@@ -146,15 +146,19 @@ def potential_energy(
     n = positions.shape[0]
     gm = jnp.asarray(g, dtype) * masses
 
-    if n <= chunk or n % chunk != 0:
+    if n <= chunk:
         rows = _potential_rows(positions, positions, masses, cutoff, eps)
         # Each unordered pair is counted twice in the full matrix.
         return -0.5 * jnp.sum(gm * rows)
 
-    pos_chunks = positions.reshape(n // chunk, chunk, 3)
+    # Pad the i-axis to a chunk multiple (padded rows are dropped by the
+    # [:n] slice) so ragged N never falls back to the dense (N, N) matrix.
+    n_padded = ((n + chunk - 1) // chunk) * chunk
+    pos_p = jnp.pad(positions, ((0, n_padded - n), (0, 0)))
+    pos_chunks = pos_p.reshape(n_padded // chunk, chunk, 3)
 
     def one_chunk(pos_i):
         return _potential_rows(pos_i, positions, masses, cutoff, eps)
 
-    rows = jax.lax.map(one_chunk, pos_chunks).reshape(n)
+    rows = jax.lax.map(one_chunk, pos_chunks).reshape(n_padded)[:n]
     return -0.5 * jnp.sum(gm * rows)

@@ -84,7 +84,7 @@ def make_local_kernel(config: SimulationConfig, backend: str):
         )
         return partial(
             tree_accelerations_vs, depth=depth,
-            leaf_cap=config.tree_leaf_cap, **common,
+            leaf_cap=config.tree_leaf_cap, ws=config.tree_ws, **common,
         )
     if backend == "pm":
         from .ops.pm import pm_accelerations_vs
@@ -188,7 +188,7 @@ class Simulator:
             )
             return lambda pos: tree_accelerations(
                 pos, masses, depth=depth, leaf_cap=config.tree_leaf_cap,
-                **common,
+                ws=config.tree_ws, **common,
             )
         if self.backend == "pm":
             from .ops.pm import pm_accelerations
