@@ -74,6 +74,9 @@ def _add_config_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--debug-check", dest="debug_check", action="store_true",
                    default=None,
                    help="cross-check Pallas vs jnp forces on final state")
+    p.add_argument("--no-nan-check", dest="nan_check", action="store_false",
+                   default=None,
+                   help="disable the per-block divergence watchdog")
     p.add_argument("--config-json", default=None,
                    help="path to a SimulationConfig JSON file")
     del defaults

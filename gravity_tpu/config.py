@@ -61,6 +61,11 @@ class SimulationConfig:
     metrics: bool = False  # JSONL per-block metrics stream
     profile: bool = False  # capture a jax.profiler trace of the run
     debug_check: bool = False  # Pallas-vs-jnp force cross-check at end
+    # Divergence watchdog: per-block NaN/Inf state check; on detection the
+    # run aborts with an emergency checkpoint (when checkpointing is on)
+    # instead of silently integrating garbage. The reference has no
+    # failure detection of any kind (SURVEY §5).
+    nan_check: bool = True
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self), indent=2, default=str)

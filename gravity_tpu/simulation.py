@@ -105,6 +105,19 @@ def make_local_kernel(config: SimulationConfig, backend: str):
     raise ValueError(f"unknown force backend {backend!r}")
 
 
+class SimulationDiverged(RuntimeError):
+    """The state went NaN/Inf mid-run (integration blow-up, bad dt, or a
+    kernel fault). Carries the last finite step for post-mortems."""
+
+    def __init__(self, step: int):
+        super().__init__(
+            f"non-finite particle state detected after step {step} "
+            "(divergence watchdog; rerun with a smaller dt or softer eps, "
+            "or disable with nan_check=False)"
+        )
+        self.step = step
+
+
 class Simulator:
     """Orchestrates a full run for a :class:`SimulationConfig`."""
 
@@ -290,11 +303,33 @@ class Simulator:
             else:
                 n_steps = min(block, remaining)
                 do_record = False
+            prev_state, prev_step = state, step
             state, acc, traj = self._run_block(
                 state, acc, n_steps=n_steps, record=do_record,
                 record_every=every if do_record else 1,
             )
             jax.block_until_ready(state.positions)
+            if config.nan_check and not bool(
+                jnp.all(jnp.isfinite(state.positions))
+                & jnp.all(jnp.isfinite(state.velocities))
+            ):
+                # Divergence watchdog: abort with the last finite state
+                # persisted rather than integrating garbage to the end.
+                if checkpoint_manager is not None:
+                    from .utils.checkpoint import save_checkpoint
+
+                    save_checkpoint(
+                        checkpoint_manager, prev_step, prev_state
+                    )
+                if logger is not None:
+                    logger.log_print(
+                        f"DIVERGED within steps {prev_step + 1}.."
+                        f"{prev_step + n_steps}; last finite state is at "
+                        f"step {prev_step}"
+                        + (" (checkpoint saved)"
+                           if checkpoint_manager is not None else "")
+                    )
+                raise SimulationDiverged(prev_step)
             now = timer.mark()
             block_elapsed = now - block_prev
             block_prev = now

@@ -136,6 +136,36 @@ def test_fast_backend_ring_raises():
         Simulator(cfg)
 
 
+def test_divergence_watchdog(tmp_path):
+    """A blow-up (absurd dt overflows fp32 within a few steps) aborts with
+    SimulationDiverged and persists the last finite state for post-mortem
+    — the failure-detection story the reference lacks entirely."""
+    from gravity_tpu.simulation import SimulationDiverged
+    from gravity_tpu.utils.checkpoint import (
+        make_checkpoint_manager,
+        restore_checkpoint,
+    )
+
+    cfg = _small_config(
+        n=64, steps=100, dt=1e30, integrator="euler",
+        checkpoint_every=1000, checkpoint_dir=str(tmp_path / "ckpt"),
+    )
+    sim = Simulator(cfg)
+    mgr = make_checkpoint_manager(cfg.checkpoint_dir)
+    with pytest.raises(SimulationDiverged) as exc:
+        sim.run(checkpoint_manager=mgr)
+    state, step = restore_checkpoint(mgr)
+    assert step == exc.value.step
+    assert bool(jnp.all(jnp.isfinite(state.positions)))
+
+
+def test_divergence_watchdog_off():
+    cfg = _small_config(n=64, steps=20, dt=1e30, integrator="euler",
+                        nan_check=False)
+    stats = Simulator(cfg).run()  # completes (with garbage), no raise
+    assert stats["steps"] == 20
+
+
 def test_reference_log_shape(tmp_path):
     """The run log has the reference's sections (SURVEY §5 log contract)."""
     cfg = _small_config(steps=200)
