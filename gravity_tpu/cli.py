@@ -163,15 +163,24 @@ def cmd_run(args: argparse.Namespace) -> int:
         stats = _go()
 
     if config.debug_check:
+        from .simulation import make_local_kernel
         from .utils.profiling import debug_check_forces
 
         final = stats["final_state"]
+        # Audit the ACTIVE backend's kernel against the jnp direct sum
+        # (pallas: bit-level divergence check; tree/pm/p3m: live accuracy
+        # audit of the approximation).
+        kernel = (
+            make_local_kernel(config, sim.backend)
+            if sim.backend not in ("dense", "chunked") else None
+        )
         check = debug_check_forces(
             final.positions, final.masses,
             g=config.g, cutoff=config.cutoff, eps=config.eps,
+            kernel=kernel,
         )
         logger.log_print(
-            "Force kernel cross-check (Pallas vs jnp): "
+            f"Force cross-check ({sim.backend} vs jnp direct): "
             f"max_rel_err={check['max_rel_err']:.3e} "
             f"median_rel_err={check['median_rel_err']:.3e} "
             f"(n={check['n_checked']})"

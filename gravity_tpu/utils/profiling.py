@@ -82,17 +82,21 @@ def debug_check_forces(
     eps: float = 0.0,
     sample: int = 2048,
     seed: int = 0,
+    kernel=None,
 ) -> dict:
-    """Cross-check the Pallas kernel against the pure-jnp kernel on (a
+    """Cross-check a force kernel against the pure-jnp direct sum on (a
     sample of) live state. Returns {max_rel_err, median_rel_err, n_checked}.
+
+    ``kernel``: a LocalKernel (targets, sources, masses) -> acc; defaults
+    to the Pallas kernel. Passing the active backend's kernel (tree/p3m/
+    pm included) turns this into a live accuracy audit of fast solvers.
 
     The TPU analog of running compute-sanitizer on the reference's racy
     CUDA kernel (`/root/reference/cuda.cu:47-49`): by construction the only
-    possible defect is divergence between the two implementations.
+    possible defect is divergence between implementations.
     """
     from ..constants import CUTOFF_RADIUS, G
     from ..ops.forces import accelerations_vs
-    from ..ops.pallas_forces import pallas_accelerations_vs
 
     g = G if g is None else g
     cutoff = CUTOFF_RADIUS if cutoff is None else cutoff
@@ -102,13 +106,17 @@ def debug_check_forces(
         targets = positions[np.sort(idx)]
     else:
         targets = positions
-    interpret = jax.devices()[0].platform != "tpu"
+    if kernel is None:
+        from functools import partial
+
+        from ..ops.pallas_forces import pallas_accelerations_vs
+
+        interpret = jax.devices()[0].platform != "tpu"
+        kernel = partial(pallas_accelerations_vs, interpret=interpret,
+                         g=g, cutoff=cutoff, eps=eps)
     ref = accelerations_vs(targets, positions, masses, g=g, cutoff=cutoff,
                            eps=eps)
-    got = pallas_accelerations_vs(
-        targets, positions, masses, g=g, cutoff=cutoff, eps=eps,
-        interpret=interpret,
-    )
+    got = kernel(targets, positions, masses)
     ref_np = np.asarray(ref)
     got_np = np.asarray(got)
     denom = np.linalg.norm(ref_np, axis=1) + 1e-300
