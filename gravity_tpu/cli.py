@@ -260,6 +260,25 @@ def cmd_resume(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_traj(args: argparse.Namespace) -> int:
+    """Inspect a native GTRJ trajectory file via the C++ tool (info /
+    stats / dump) — durable-artifact tooling the reference's in-RAM
+    trajectory list (`/root/reference/pyspark.py:104-121`) never had."""
+    import subprocess
+
+    from .utils.native import gtrj_tool_path
+
+    tool = gtrj_tool_path()
+    if tool is None:
+        print("native toolchain unavailable (g++ required for gtrj_tool)")
+        return 1
+    cmd = [tool, args.traj_command, args.file]
+    if args.traj_command == "dump":
+        cmd.append(str(args.frame))
+        cmd.append(str(args.count))
+    return subprocess.run(cmd).returncode
+
+
 def cmd_bench(args: argparse.Namespace) -> int:
     from .bench import run_benchmark
 
@@ -292,6 +311,17 @@ def main(argv=None) -> int:
     p_resume.add_argument("--step", type=int, default=None,
                           help="checkpoint step to restore (default latest)")
     p_resume.set_defaults(fn=cmd_resume)
+
+    p_traj = sub.add_parser(
+        "traj", help="inspect a native GTRJ trajectory file"
+    )
+    p_traj.add_argument("traj_command", choices=["info", "stats", "dump"])
+    p_traj.add_argument("file")
+    p_traj.add_argument("--frame", type=int, default=0,
+                        help="frame index for dump (negative = from end)")
+    p_traj.add_argument("--count", type=int, default=10,
+                        help="particles to dump")
+    p_traj.set_defaults(fn=cmd_traj)
 
     p_bench = sub.add_parser("bench", help="throughput benchmark")
     _add_config_args(p_bench)

@@ -79,3 +79,26 @@ def load_runtime() -> Optional[ctypes.CDLL]:
 
 def native_available() -> bool:
     return load_runtime() is not None
+
+
+_TOOL_SRC = os.path.join(_REPO_ROOT, "runtime", "gtrj_tool.cpp")
+_TOOL_BIN = os.path.join(_LIB_DIR, "gtrj_tool")
+
+
+def gtrj_tool_path() -> Optional[str]:
+    """Path to the native GTRJ inspector binary (building on demand with
+    g++), or None when the toolchain is unavailable."""
+    with _lock:
+        if os.path.exists(_TOOL_BIN) and (
+            not os.path.exists(_TOOL_SRC)
+            or os.path.getmtime(_TOOL_SRC) <= os.path.getmtime(_TOOL_BIN)
+        ):
+            return _TOOL_BIN
+        os.makedirs(_LIB_DIR, exist_ok=True)
+        cmd = ["g++", "-O2", "-std=c++17", _TOOL_SRC, "-o", _TOOL_BIN]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        except (subprocess.CalledProcessError, subprocess.TimeoutExpired,
+                FileNotFoundError):
+            return None
+        return _TOOL_BIN

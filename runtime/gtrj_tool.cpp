@@ -1,0 +1,191 @@
+// gravity_tpu native runtime: GTRJ trajectory inspector.
+//
+// Companion to trajectory_writer.cpp (same GTRJ v1 format — see that file
+// for the layout). A standalone binary so trajectory files can be
+// inspected/converted without Python: the reference kept trajectories
+// only as in-RAM Python lists (/root/reference/pyspark.py:104-121); here
+// they are durable artifacts with native tooling.
+//
+//   gtrj_tool info  FILE            header + frame index summary
+//   gtrj_tool stats FILE            per-frame centroid / bbox / max step
+//   gtrj_tool dump  FILE FRAME [K]  first K particles of frame (csv)
+//
+// Exit codes: 0 ok, 1 usage, 2 bad/corrupt file.
+
+#include <cinttypes>
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct Header {
+    uint64_t n = 0;
+    uint32_t itemsize = 4;
+};
+
+bool read_header(FILE* f, Header* h) {
+    char magic[4];
+    uint32_t version = 0, dtype = 0, reserved = 0;
+    if (fread(magic, 1, 4, f) != 4 || memcmp(magic, "GTRJ", 4) != 0)
+        return false;
+    if (fread(&version, 4, 1, f) != 1 || version != 1) return false;
+    if (fread(&h->n, 8, 1, f) != 1) return false;
+    if (fread(&dtype, 4, 1, f) != 1) return false;
+    if (fread(&reserved, 4, 1, f) != 1) return false;
+    if (dtype != 4 && dtype != 8) return false;
+    h->itemsize = dtype;
+    return true;
+}
+
+int64_t frame_payload(const Header& h) {
+    return static_cast<int64_t>(h.n) * 3 * h.itemsize;
+}
+
+int64_t frame_count(FILE* f, const Header& h) {
+    long header_end = ftell(f);
+    fseek(f, 0, SEEK_END);
+    long size = ftell(f);
+    fseek(f, header_end, SEEK_SET);
+    int64_t frame_bytes = 8 + frame_payload(h);
+    return (size - header_end) / frame_bytes;
+}
+
+bool read_frame(FILE* f, const Header& h, int64_t* step,
+                std::vector<double>* xyz) {
+    if (fread(step, 8, 1, f) != 1) return false;
+    size_t count = static_cast<size_t>(h.n) * 3;
+    if (h.itemsize == 4) {
+        std::vector<float> buf(count);
+        if (fread(buf.data(), 4, count, f) != count) return false;
+        xyz->assign(buf.begin(), buf.end());
+    } else {
+        xyz->resize(count);
+        if (fread(xyz->data(), 8, count, f) != count) return false;
+    }
+    return true;
+}
+
+int cmd_info(FILE* f, const Header& h) {
+    int64_t frames = frame_count(f, h);
+    int64_t first_step = -1, last_step = -1;
+    long data_start = ftell(f);
+    int64_t frame_bytes = 8 + frame_payload(h);
+    if (frames > 0) {
+        if (fread(&first_step, 8, 1, f) != 1) return 2;
+        fseek(f, data_start + (frames - 1) * frame_bytes, SEEK_SET);
+        if (fread(&last_step, 8, 1, f) != 1) return 2;
+    }
+    printf("format: GTRJ v1\n");
+    printf("particles: %" PRIu64 "\n", h.n);
+    printf("dtype: f%u\n", h.itemsize * 8);
+    printf("frames: %" PRId64 "\n", frames);
+    printf("frame_bytes: %" PRId64 "\n", frame_bytes);
+    if (frames > 0)
+        printf("steps: %" PRId64 "..%" PRId64 "\n", first_step, last_step);
+    return 0;
+}
+
+int cmd_stats(FILE* f, const Header& h) {
+    std::vector<double> xyz;
+    int64_t step = 0;
+    printf("frame,step,cx,cy,cz,extent,max_disp\n");
+    std::vector<double> first;
+    int64_t idx = 0;
+    while (read_frame(f, h, &step, &xyz)) {
+        double c[3] = {0, 0, 0};
+        double lo[3] = {1e300, 1e300, 1e300};
+        double hi[3] = {-1e300, -1e300, -1e300};
+        for (uint64_t i = 0; i < h.n; i++) {
+            for (int d = 0; d < 3; d++) {
+                double v = xyz[i * 3 + d];
+                c[d] += v;
+                if (v < lo[d]) lo[d] = v;
+                if (v > hi[d]) hi[d] = v;
+            }
+        }
+        for (int d = 0; d < 3; d++) c[d] /= static_cast<double>(h.n);
+        double extent = 0;
+        for (int d = 0; d < 3; d++)
+            if (hi[d] - lo[d] > extent) extent = hi[d] - lo[d];
+        double max_disp = 0;
+        if (first.empty()) {
+            first = xyz;
+        } else {
+            for (uint64_t i = 0; i < h.n; i++) {
+                double dd = 0;
+                for (int d = 0; d < 3; d++) {
+                    double dv = xyz[i * 3 + d] - first[i * 3 + d];
+                    dd += dv * dv;
+                }
+                if (dd > max_disp) max_disp = dd;
+            }
+            max_disp = std::sqrt(max_disp);
+        }
+        printf("%" PRId64 ",%" PRId64 ",%g,%g,%g,%g,%g\n", idx, step, c[0],
+               c[1], c[2], extent, max_disp);
+        idx++;
+    }
+    return 0;
+}
+
+int cmd_dump(FILE* f, const Header& h, int64_t frame, uint64_t k) {
+    int64_t frames = frame_count(f, h);
+    if (frame < 0) frame += frames;  // python-style negative index
+    if (frame < 0 || frame >= frames) {
+        fprintf(stderr, "frame %" PRId64 " out of range (0..%" PRId64 ")\n",
+                frame, frames - 1);
+        return 2;
+    }
+    int64_t frame_bytes = 8 + frame_payload(h);
+    fseek(f, ftell(f) + frame * frame_bytes, SEEK_SET);
+    std::vector<double> xyz;
+    int64_t step = 0;
+    if (!read_frame(f, h, &step, &xyz)) return 2;
+    if (k == 0 || k > h.n) k = h.n;
+    printf("step,%" PRId64 "\n", step);
+    printf("i,x,y,z\n");
+    for (uint64_t i = 0; i < k; i++)
+        printf("%" PRIu64 ",%.9g,%.9g,%.9g\n", i, xyz[i * 3], xyz[i * 3 + 1],
+               xyz[i * 3 + 2]);
+    return 0;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+    if (argc < 3) {
+        fprintf(stderr,
+                "usage: gtrj_tool {info|stats|dump} FILE [FRAME [K]]\n");
+        return 1;
+    }
+    std::string cmd = argv[1];
+    FILE* f = fopen(argv[2], "rb");
+    if (!f) {
+        fprintf(stderr, "cannot open %s\n", argv[2]);
+        return 2;
+    }
+    Header h;
+    if (!read_header(f, &h)) {
+        fprintf(stderr, "not a GTRJ v1 file: %s\n", argv[2]);
+        fclose(f);
+        return 2;
+    }
+    int rc = 1;
+    if (cmd == "info") {
+        rc = cmd_info(f, h);
+    } else if (cmd == "stats") {
+        rc = cmd_stats(f, h);
+    } else if (cmd == "dump") {
+        int64_t frame = argc > 3 ? strtoll(argv[3], nullptr, 10) : 0;
+        uint64_t k = argc > 4 ? strtoull(argv[4], nullptr, 10) : 10;
+        rc = cmd_dump(f, h, frame, k);
+    } else {
+        fprintf(stderr, "unknown command %s\n", cmd.c_str());
+    }
+    fclose(f);
+    return rc;
+}

@@ -75,3 +75,59 @@ def test_bad_magic(tmp_path):
     path.write_bytes(b"NOPE" + b"\0" * 40)
     with pytest.raises(ValueError):
         NativeTrajectoryReader(str(path))
+
+
+def test_gtrj_tool_info_stats_dump(tmp_path):
+    """The C++ inspector agrees with the writer/reader on a real file."""
+    import subprocess
+
+    from gravity_tpu.utils.native import gtrj_tool_path
+
+    tool = gtrj_tool_path()
+    assert tool is not None
+    path = str(tmp_path / "traj.gtrj")
+    n = 32
+    writer = NativeTrajectoryWriter(path, n)
+    rng = np.random.RandomState(1)
+    frames = [rng.randn(n, 3).astype(np.float32) for _ in range(5)]
+    for k, pos in enumerate(frames):
+        writer.record(10 * (k + 1), pos)
+    writer.close()
+
+    info = subprocess.run([tool, "info", path], capture_output=True,
+                          text=True)
+    assert info.returncode == 0
+    assert "particles: 32" in info.stdout
+    assert "frames: 5" in info.stdout
+    assert "steps: 10..50" in info.stdout
+
+    stats = subprocess.run([tool, "stats", path], capture_output=True,
+                           text=True)
+    assert stats.returncode == 0
+    lines = stats.stdout.strip().splitlines()
+    assert len(lines) == 6  # header + 5 frames
+    # Frame 0 centroid matches numpy.
+    c0 = np.array([float(v) for v in lines[1].split(",")[2:5]])
+    np.testing.assert_allclose(c0, frames[0].mean(0), rtol=1e-5, atol=1e-6)
+
+    dump = subprocess.run([tool, "dump", path, "-1", "3"],
+                          capture_output=True, text=True)
+    assert dump.returncode == 0
+    assert dump.stdout.startswith("step,50")
+    row = dump.stdout.strip().splitlines()[2].split(",")
+    np.testing.assert_allclose(
+        [float(v) for v in row[1:]], frames[-1][0], rtol=1e-5
+    )
+
+
+def test_gtrj_tool_rejects_garbage(tmp_path):
+    import subprocess
+
+    from gravity_tpu.utils.native import gtrj_tool_path
+
+    tool = gtrj_tool_path()
+    bad = tmp_path / "bad.gtrj"
+    bad.write_bytes(b"NOPE" + b"\x00" * 64)
+    out = subprocess.run([tool, "info", str(bad)], capture_output=True,
+                         text=True)
+    assert out.returncode == 2
