@@ -8,6 +8,7 @@ families.
 
 from .cold_collapse import create_cold_collapse
 from .disk import create_disk
+from .hernquist import create_hernquist
 from .merger import create_merger
 from .plummer import create_plummer
 from .random_cube import create_random_cube, generate_random_particles
@@ -30,6 +31,7 @@ MODELS = {
         key, n, dtype=dtype
     ),
     "disk": lambda key, n, dtype: create_disk(key, n, dtype=dtype),
+    "hernquist": lambda key, n, dtype: create_hernquist(key, n, dtype=dtype),
     "merger": lambda key, n, dtype: create_merger(key, n, dtype=dtype),
 }
 
@@ -44,6 +46,7 @@ __all__ = [
     "create_model",
     "create_cold_collapse",
     "create_disk",
+    "create_hernquist",
     "create_merger",
     "create_plummer",
     "create_random_cube",

@@ -1,0 +1,83 @@
+"""Hernquist-sphere initial conditions (galaxy bulge / dark-halo profile).
+
+Hernquist (1990): rho(r) = M a / (2 pi r (r+a)^3), cumulative mass
+M(r)/M = r^2/(r+a)^2 — the standard centrally-cuspy galaxy profile
+(steeper than Plummer; exercises the fast solvers' concentration
+handling). Positions via exact inverse-CDF sampling; velocities
+isotropic Gaussian with the analytic Jeans radial dispersion
+(Hernquist 1990 eq. 10), truncated at the local escape speed — the
+standard quick-equilibrium construction.
+
+Not in the reference (which has only solar + uniform-random ICs,
+`/root/reference/cuda.cu:81-96,125-138`).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..constants import G
+from ..state import ParticleState
+
+
+def _jeans_sigma2(s, gm_over_a):
+    """Radial velocity dispersion^2 at s = r/a (Hernquist 1990 eq. 10),
+    in units where sigma^2 = gm_over_a * f(s)."""
+    # f(s) = 12 s (1+s)^3 ln(1+1/s) - s/(1+s) (25 + 52 s + 42 s^2 + 12 s^3)
+    s = jnp.maximum(s, 1e-8)
+    f = 12.0 * s * (1.0 + s) ** 3 * jnp.log1p(1.0 / s) - (
+        s / (1.0 + s)
+    ) * (25.0 + 52.0 * s + 42.0 * s * s + 12.0 * s ** 3)
+    # The bracket is analytically positive but cancels badly at large s
+    # (log1p keeps it stable to s ~ 1e4); clamp for safety.
+    return gm_over_a * jnp.maximum(f, 0.0) / 12.0
+
+
+def create_hernquist(
+    key: jax.Array,
+    n: int,
+    *,
+    total_mass: float = 1.0e30,
+    scale_radius: float = 1.0e12,
+    g: float = G,
+    r_max_scale: float = 50.0,
+    dtype=jnp.float32,
+) -> ParticleState:
+    k1, k2, k3, k4, k5 = jax.random.split(key, 5)
+    f64 = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+
+    # Inverse CDF with a truncation at r_max_scale * a (the untruncated
+    # profile has infinite extent; truncation keeps the bounding cube and
+    # the fp32 range sane): sample q in [0, q_max].
+    q_max = r_max_scale**2 / (1.0 + r_max_scale) ** 2
+    q = jax.random.uniform(k1, (n,), dtype=f64, minval=1e-10, maxval=q_max)
+    sq = jnp.sqrt(q)
+    r = scale_radius * sq / (1.0 - sq)
+
+    costh = jax.random.uniform(k2, (n,), dtype=f64, minval=-1.0, maxval=1.0)
+    sinth = jnp.sqrt(jnp.maximum(0.0, 1.0 - costh * costh))
+    phi = jax.random.uniform(
+        k3, (n,), dtype=f64, minval=0.0, maxval=2.0 * jnp.pi
+    )
+    positions = r[:, None] * jnp.stack(
+        [sinth * jnp.cos(phi), sinth * jnp.sin(phi), costh], axis=1
+    )
+
+    s = r / scale_radius
+    sigma2 = _jeans_sigma2(s, g * total_mass / scale_radius)
+    v = jnp.sqrt(sigma2)[:, None] * jax.random.normal(k4, (n, 3), dtype=f64)
+    # Truncate at the local escape speed v_esc^2 = 2GM/(r+a).
+    v_esc = jnp.sqrt(2.0 * g * total_mass / (r + scale_radius))
+    speed = jnp.linalg.norm(v, axis=1)
+    scale = jnp.minimum(1.0, 0.95 * v_esc / jnp.maximum(speed, 1e-300))
+    velocities = v * scale[:, None]
+    del k5
+
+    masses = jnp.full((n,), total_mass / n, dtype=f64)
+    positions = positions - jnp.mean(positions, axis=0, keepdims=True)
+    velocities = velocities - jnp.mean(velocities, axis=0, keepdims=True)
+    return ParticleState(
+        positions.astype(dtype), velocities.astype(dtype),
+        masses.astype(dtype),
+    )

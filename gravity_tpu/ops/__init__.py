@@ -3,10 +3,15 @@
 from .diagnostics import (
     center_of_mass,
     energy_drift,
+    half_mass_radius,
     kinetic_energy,
+    lagrangian_radii,
+    radial_density_profile,
     total_angular_momentum,
     total_energy,
     total_momentum,
+    velocity_dispersion,
+    virial_ratio,
 )
 from .forces import (
     accelerations_vs,
@@ -28,7 +33,9 @@ __all__ = [
     "accelerations_vs",
     "center_of_mass",
     "energy_drift",
+    "half_mass_radius",
     "kinetic_energy",
+    "lagrangian_radii",
     "leapfrog_kdk",
     "make_step_fn",
     "p3m_accelerations",
@@ -39,5 +46,8 @@ __all__ = [
     "total_angular_momentum",
     "total_energy",
     "total_momentum",
+    "radial_density_profile",
+    "velocity_dispersion",
     "velocity_verlet",
+    "virial_ratio",
 ]

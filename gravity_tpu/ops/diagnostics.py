@@ -7,6 +7,7 @@ test suite uses (energy drift bounds, momentum conservation).
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from ..constants import CUTOFF_RADIUS, G
@@ -44,8 +45,85 @@ def total_angular_momentum(state: ParticleState) -> jnp.ndarray:
 
 
 def center_of_mass(state: ParticleState) -> jnp.ndarray:
-    m = jnp.sum(state.masses)
-    return jnp.sum(state.masses[:, None] * state.positions, axis=0) / m
+    # Normalized weights: m * x overflows fp32 at planetary masses and
+    # astronomical coordinates (1e26 kg * 1e12 m * N); w <= 1 never does.
+    w = state.masses / jnp.sum(state.masses)
+    return jnp.sum(w[:, None] * state.positions, axis=0)
+
+
+def virial_ratio(
+    state: ParticleState,
+    *,
+    g: float = G,
+    cutoff: float = CUTOFF_RADIUS,
+    eps: float = 0.0,
+) -> jnp.ndarray:
+    """2T/|W| — 1.0 in virial equilibrium; the standard structural health
+    check for the equilibrium model families (Plummer/Hernquist/disk).
+
+    Computed with normalized masses so every intermediate fits fp32 even
+    when the raw energies (~1e39 J at solar-system masses) do not: with
+    m_hat = m/m_scale, T = m_scale * T_hat and W = m_scale^2 * W_hat, so
+    2T/|W| = 2 T_hat / (m_scale * |W_hat|).
+    """
+    m_scale = jnp.max(state.masses)
+    m_hat = state.masses / m_scale
+    v2 = jnp.sum(state.velocities * state.velocities, axis=-1)
+    t_hat = 0.5 * jnp.sum(m_hat * v2)
+    w_hat = potential_energy(
+        state.positions, m_hat, g=g, cutoff=cutoff, eps=eps
+    )
+    return 2.0 * t_hat / (m_scale * jnp.abs(w_hat))
+
+
+def lagrangian_radii(state: ParticleState, fractions=(0.1, 0.5, 0.9)):
+    """COM-centric radii enclosing the given mass fractions (the 0.5 entry
+    is the half-mass radius) — tracks collapse/expansion/core evolution."""
+    com = center_of_mass(state)
+    r = jnp.linalg.norm(state.positions - com[None, :], axis=1)
+    order = jnp.argsort(r)
+    m_sorted = state.masses[order]
+    cum = jnp.cumsum(m_sorted)
+    total = cum[-1]
+    r_sorted = r[order]
+    fracs = jnp.asarray(fractions, r.dtype)
+    idx = jnp.searchsorted(cum, fracs * total)
+    return r_sorted[jnp.clip(idx, 0, r.shape[0] - 1)]
+
+
+def half_mass_radius(state: ParticleState) -> jnp.ndarray:
+    return lagrangian_radii(state, (0.5,))[0]
+
+
+def velocity_dispersion(state: ParticleState) -> jnp.ndarray:
+    """Mass-weighted 1D velocity dispersion about the mean streaming
+    velocity (normalized weights — see center_of_mass)."""
+    w = state.masses / jnp.sum(state.masses)
+    vbar = jnp.sum(w[:, None] * state.velocities, axis=0)
+    dv = state.velocities - vbar[None, :]
+    return jnp.sqrt(jnp.sum(w * jnp.sum(dv * dv, axis=1)) / 3.0)
+
+
+def radial_density_profile(state: ParticleState, bins: int = 32):
+    """(r_mid, rho) mass-density profile in COM-centric log-spaced shells
+    spanning [r_min, r_max] of the realization."""
+    com = center_of_mass(state)
+    r = jnp.linalg.norm(state.positions - com[None, :], axis=1)
+    r_pos = jnp.maximum(r, 1e-300)
+    lo = jnp.log(jnp.min(r_pos) + 1e-300)
+    hi = jnp.log(jnp.max(r_pos) * 1.0001)
+    edges = jnp.exp(jnp.linspace(lo, hi, bins + 1))
+    idx = jnp.clip(jnp.searchsorted(edges, r_pos) - 1, 0, bins - 1)
+    m_in = jax.ops.segment_sum(state.masses, idx, num_segments=bins)
+    # Shell volumes in normalized radius (edges^3 overflows fp32 beyond
+    # ~7e12 m); fold the r_ref^3 back via three separate divisions so no
+    # intermediate leaves the fp32 range.
+    r_ref = edges[-1]
+    e_hat = edges / r_ref
+    vol_hat = (4.0 / 3.0) * jnp.pi * (e_hat[1:] ** 3 - e_hat[:-1] ** 3)
+    rho = ((m_in / r_ref) / r_ref) / r_ref / vol_hat
+    r_mid = jnp.sqrt(edges[1:] * edges[:-1])
+    return r_mid, rho
 
 
 def energy_drift(initial_energy, current_energy) -> jnp.ndarray:

@@ -170,3 +170,33 @@ def test_solar_system_earth_acceleration(x64):
     acc = pairwise_accelerations_dense(state.positions, state.masses)
     a_expected = -G * 1.989e30 / 1.496e11**2
     np.testing.assert_allclose(float(acc[1, 0]), a_expected, rtol=1e-3)
+
+
+def test_structure_diagnostics(key):
+    """Lagrangian radii / dispersion / density profile sanity on Plummer
+    (half-mass radius of a Plummer sphere = 1.3048 a)."""
+    from gravity_tpu.models import create_plummer
+    from gravity_tpu.ops.diagnostics import (
+        half_mass_radius,
+        lagrangian_radii,
+        radial_density_profile,
+        velocity_dispersion,
+        virial_ratio,
+    )
+
+    a = 1.0e12
+    state = create_plummer(key, 8192, scale_radius=a)
+    rh = float(half_mass_radius(state))
+    assert abs(rh - 1.3048 * a) / (1.3048 * a) < 0.1, rh
+    r = np.asarray(lagrangian_radii(state, (0.1, 0.5, 0.9)))
+    assert r[0] < r[1] < r[2]
+    assert float(velocity_dispersion(state)) > 0
+    vr = float(virial_ratio(state))
+    assert 0.8 < vr < 1.2, vr  # Plummer sampling is properly virial
+    r_mid, rho = radial_density_profile(state, bins=24)
+    assert r_mid.shape == (24,) and rho.shape == (24,)
+    # Density decreases from the core to the halo by orders of magnitude.
+    rho_np = np.asarray(rho)
+    inner = rho_np[: 8][rho_np[:8] > 0]
+    outer = rho_np[-4:][rho_np[-4:] > 0]
+    assert inner.max() > 100 * outer.min()

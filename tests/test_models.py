@@ -112,3 +112,37 @@ def test_all_models_finite(key, name):
     for leaf in (s.positions, s.velocities, s.masses):
         assert bool(jnp.all(jnp.isfinite(leaf)))
     assert bool(jnp.all(s.masses > 0))
+
+
+def test_hernquist_profile(key):
+    """Hernquist realization matches the analytic enclosed-mass profile
+    and sits near virial equilibrium."""
+    from gravity_tpu.models import create_hernquist
+    from gravity_tpu.ops.diagnostics import lagrangian_radii, virial_ratio
+
+    n = 8192
+    a = 1.0e12
+    state = create_hernquist(key, n, scale_radius=a)
+    # Analytic Lagrangian radii: M(r)/M = r^2/(r+a)^2 with the q<=q_max
+    # truncation at 50a -> r(f) = a sqrt(f q_max)/(1 - sqrt(f q_max)).
+    q_max = 50.0**2 / 51.0**2
+    r10, r50, r90 = np.asarray(
+        lagrangian_radii(state, (0.1, 0.5, 0.9))
+    )
+    for frac, got in [(0.1, r10), (0.5, r50), (0.9, r90)]:
+        sq = np.sqrt(frac * q_max)
+        expect = a * sq / (1.0 - sq)
+        assert abs(got - expect) / expect < 0.15, (frac, got, expect)
+    # Jeans-Maxwellian ICs are approximately virial (not exact).
+    vr = float(virial_ratio(state, eps=0.0))
+    assert 0.6 < vr < 1.4, vr
+
+
+def test_hernquist_finite_and_centered(key):
+    from gravity_tpu.models import create_hernquist
+
+    state = create_hernquist(key, 1024)
+    assert bool(jnp.all(jnp.isfinite(state.positions)))
+    assert bool(jnp.all(jnp.isfinite(state.velocities)))
+    com = np.asarray(state.positions).mean(0)
+    assert np.abs(com).max() < 1e-3 * np.abs(np.asarray(state.positions)).max()
