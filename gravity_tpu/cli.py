@@ -260,6 +260,59 @@ def cmd_resume(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_analyze(args: argparse.Namespace) -> int:
+    """Structure + conserved-quantity report for a checkpointed state (or
+    a fresh model realization): energy, virial ratio, Lagrangian radii,
+    velocity dispersion, COM drift. The quantitative replacement for the
+    reference's eyeball-the-printed-positions validation
+    (`/root/reference/mpi.c:249-257`)."""
+    import numpy as np
+
+    from .ops import diagnostics as diag
+    from .simulation import Simulator
+
+    config = build_config(args)
+    if args.checkpoint:
+        from .utils.checkpoint import (
+            make_checkpoint_manager,
+            restore_checkpoint,
+        )
+
+        mgr = make_checkpoint_manager(config.checkpoint_dir)
+        state, step = restore_checkpoint(mgr, args.step)
+    else:
+        state = Simulator(config).state
+        step = 0
+
+    lr = np.asarray(
+        diag.lagrangian_radii(state, (0.1, 0.25, 0.5, 0.75, 0.9))
+    )
+    report = {
+        "step": int(step),
+        "n": int(state.n),
+        "kinetic_energy": float(diag.kinetic_energy(state)),
+        "potential_energy": float(
+            diag.total_energy(state, g=config.g, cutoff=config.cutoff,
+                              eps=config.eps)
+            - diag.kinetic_energy(state)
+        ),
+        "virial_ratio": float(
+            diag.virial_ratio(state, g=config.g, cutoff=config.cutoff,
+                              eps=config.eps)
+        ),
+        "center_of_mass": np.asarray(diag.center_of_mass(state)).tolist(),
+        "total_momentum": np.asarray(diag.total_momentum(state)).tolist(),
+        "velocity_dispersion": float(diag.velocity_dispersion(state)),
+        "lagrangian_radii": {
+            "0.10": float(lr[0]), "0.25": float(lr[1]),
+            "0.50": float(lr[2]), "0.75": float(lr[3]),
+            "0.90": float(lr[4]),
+        },
+    }
+    print(json.dumps(report, indent=2))
+    return 0
+
+
 def cmd_traj(args: argparse.Namespace) -> int:
     """Inspect a native GTRJ trajectory file via the C++ tool (info /
     stats / dump) — durable-artifact tooling the reference's in-RAM
@@ -311,6 +364,16 @@ def main(argv=None) -> int:
     p_resume.add_argument("--step", type=int, default=None,
                           help="checkpoint step to restore (default latest)")
     p_resume.set_defaults(fn=cmd_resume)
+
+    p_an = sub.add_parser(
+        "analyze", help="diagnostics report for a checkpoint or model"
+    )
+    _add_config_args(p_an)
+    p_an.add_argument("--checkpoint", action="store_true",
+                      help="analyze the latest (or --step) checkpoint "
+                           "instead of a fresh model realization")
+    p_an.add_argument("--step", type=int, default=None)
+    p_an.set_defaults(fn=cmd_analyze)
 
     p_traj = sub.add_parser(
         "traj", help="inspect a native GTRJ trajectory file"

@@ -128,3 +128,35 @@ def test_bench_command(tmp_path, capsys):
     assert rc == 0
     out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert out["pairs_per_sec_per_chip"] > 0
+
+
+def test_analyze_fresh_model(capsys):
+    rc = main([
+        "analyze", "--model", "plummer", "--n", "512", "--eps", "1e10",
+    ])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["n"] == 512
+    assert 0.5 < out["virial_ratio"] < 1.5
+    assert out["lagrangian_radii"]["0.10"] < out["lagrangian_radii"]["0.90"]
+
+
+def test_analyze_checkpoint(tmp_path, capsys):
+    ckpt = str(tmp_path / "ckpt")
+    rc = main([
+        "run", "--model", "plummer", "--n", "128", "--steps", "10",
+        "--eps", "1e10", "--integrator", "leapfrog",
+        "--force-backend", "dense", "--checkpoint-every", "5",
+        "--checkpoint-dir", ckpt, "--log-dir", str(tmp_path / "logs"),
+    ])
+    assert rc == 0
+    capsys.readouterr()
+    rc = main([
+        "analyze", "--checkpoint", "--checkpoint-dir", ckpt,
+        "--eps", "1e10",
+    ])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["step"] == 10
+    assert out["n"] == 128
+    assert out["kinetic_energy"] > 0
