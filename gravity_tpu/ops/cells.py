@@ -21,6 +21,43 @@ def grid_coords(points, origin, span, side: int):
     return jnp.clip((u * side).astype(jnp.int32), 0, side - 1)
 
 
+def build_padded_cells(
+    sorted_pos, sorted_mass, sorted_cell_ids, cell_start, n_cells: int,
+    cap: int,
+):
+    """Dense per-cell source blocks from Morton-sorted particle arrays.
+
+    Returns (cells_pos (n_cells, cap, 3), cells_mass (n_cells, cap)) where
+    slot k of cell c holds the k-th particle of that cell (zero mass /
+    zero position beyond the cell's count — zero mass is an exact no-op
+    for every kernel here). Evaluators then gather whole (cap, 3) blocks
+    by cell id — contiguous slices with ~cap x fewer gather indices than
+    per-particle element gathers, which is what TPU gathers want.
+
+    One O(N) scatter per build: slot = rank-within-cell (sorted index
+    minus the cell's start); ranks >= cap are parked on a trash row.
+    """
+    n = sorted_pos.shape[0]
+    dtype = sorted_pos.dtype
+    idx = jnp.arange(n, dtype=jnp.int32)
+    cell_of = sorted_cell_ids
+    rank = idx - cell_start[cell_of]
+    slot = cell_of * cap + rank
+    # Overflow ranks scatter to a dedicated trash row (dropped on reshape).
+    slot = jnp.where(rank < cap, slot, n_cells * cap)
+    cells_pos = (
+        jnp.zeros((n_cells * cap + 1, 3), dtype)
+        .at[slot].set(sorted_pos, mode="drop")[: n_cells * cap]
+        .reshape(n_cells, cap, 3)
+    )
+    cells_mass = (
+        jnp.zeros((n_cells * cap + 1,), dtype)
+        .at[slot].set(sorted_mass, mode="drop")[: n_cells * cap]
+        .reshape(n_cells, cap)
+    )
+    return cells_pos, cells_mass
+
+
 def map_target_chunks(fn, targets, t_coords, chunk: int):
     """Apply ``fn((pos_chunk (C,3), coord_chunk (C,3))) -> (C, 3)`` over
     targets in chunks of ``chunk``, padding the tail chunk (padded rows

@@ -43,7 +43,7 @@ import jax.numpy as jnp
 from jax.scipy.special import erf
 
 from ..constants import CUTOFF_RADIUS, G
-from .cells import grid_coords, map_target_chunks
+from .cells import build_padded_cells, grid_coords, map_target_chunks
 from .pm import bounding_cube, cic_deposit, cic_gather
 
 
@@ -225,6 +225,9 @@ def p3m_accelerations_vs(
     cell_start = jnp.concatenate(
         [jnp.zeros((1,), jnp.int32), jnp.cumsum(cell_count)[:-1]]
     )
+    cells_pos, cells_mass = build_padded_cells(
+        sorted_pos, sorted_mass, cell_ids[order], cell_start, n_cells, cap
+    )
     m_scale = jnp.maximum(jnp.max(masses), jnp.asarray(1e-37, dtype))
     # Per-cell mass/COM for the overflow fallback (normalized-mass
     # accumulation: m * x overflows fp32 for planetary masses).
@@ -269,6 +272,7 @@ def p3m_accelerations_vs(
 
     def chunk_short(args):
         pos_c, coords_c = args  # (C, 3) positions, (C, 3) cell coords
+        c = pos_c.shape[0]
         ncell = coords_c[:, None, :] + near[None, :, :]  # (C, 27, 3)
         in_bounds = jnp.all(
             jnp.logical_and(ncell >= 0, ncell < side), axis=-1
@@ -277,18 +281,19 @@ def p3m_accelerations_vs(
         nids = (
             ncell_cl[..., 0] * side + ncell_cl[..., 1]
         ) * side + ncell_cl[..., 2]
-        starts = cell_start[nids]  # (C, 27)
         counts = jnp.where(in_bounds, cell_count[nids], 0)
 
+        # Whole-block gathers from the padded per-cell arrays: (C, 27)
+        # indices pulling contiguous (cap, 3) slices — ~cap x fewer
+        # gather indices than per-particle element gathers.
+        src_pos = cells_pos[nids]  # (C, 27, cap, 3)
+        src_m = cells_mass[nids]  # (C, 27, cap)
         k_idx = jnp.arange(cap, dtype=jnp.int32)
-        gather_idx = starts[..., None] + k_idx[None, None, :]  # (C, 27, K)
-        valid = k_idx[None, None, :] < counts[..., None]
-        gather_idx = jnp.clip(gather_idx, 0, n - 1)
-        flat = gather_idx.reshape(pos_c.shape[0], -1)  # (C, 27K)
-        src_pos = sorted_pos[flat]  # (C, 27K, 3)
-        src_m = sorted_mass[flat]
+        valid = k_idx[None, None, :] < counts[..., None]  # (C, 27, cap)
+        src_pos = src_pos.reshape(c, -1, 3)
+        src_m = src_m.reshape(c, -1)
         diff = src_pos - pos_c[:, None, :]
-        w = pair_w(diff, src_m, valid.reshape(pos_c.shape[0], -1))
+        w = pair_w(diff, src_m, valid.reshape(c, -1))
         acc_c = jnp.einsum("cl,cld->cd", w, diff)
 
         # Overflow: cells holding more than `cap` sources contribute their

@@ -50,7 +50,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..constants import CUTOFF_RADIUS, G
-from .cells import grid_coords, map_target_chunks
+from .cells import build_padded_cells, grid_coords, map_target_chunks
 
 # ---------------------------------------------------------------------------
 # Interaction-list offset table: for each parity (cell coord mod 2 per axis)
@@ -215,6 +215,10 @@ def tree_accelerations_vs(
     leaf_start = jnp.concatenate(
         [jnp.zeros((1,), jnp.int32), jnp.cumsum(leaf_count)[:-1]]
     )
+    cells_pos, cells_mass = build_padded_cells(
+        sorted_pos, sorted_mass, leaf_ids[order], leaf_start, n_leaves,
+        leaf_cap,
+    )
 
     offsets = jnp.asarray(_offsets(ws))  # (L, 3)
     parity_masks = jnp.asarray(_parity_mask_table(ws))  # (8, L)
@@ -254,19 +258,20 @@ def tree_accelerations_vs(
         )
         ncell_cl = jnp.clip(ncell, 0, side - 1)
         nids = (ncell_cl[..., 0] * side + ncell_cl[..., 1]) * side + ncell_cl[..., 2]
-        starts = leaf_start[nids]  # (C, |near|)
         counts = jnp.where(in_bounds, leaf_count[nids], 0)
 
+        # Whole-block gathers from the padded per-leaf arrays: (C, |near|)
+        # indices pulling contiguous (cap, 3) slices — ~cap x fewer gather
+        # indices than per-particle element gathers (TPU gathers want
+        # few, large slices).
+        c = pos_c.shape[0]
+        src_pos = cells_pos[nids].reshape(c, -1, 3)  # (C, 27K, 3)
+        src_mass = cells_mass[nids].reshape(c, -1)
         k_idx = jnp.arange(leaf_cap, dtype=jnp.int32)  # (K,)
-        gather_idx = starts[..., None] + k_idx[None, None, :]  # (C, 27, K)
         valid = k_idx[None, None, :] < counts[..., None]
-        gather_idx = jnp.clip(gather_idx, 0, n - 1)
-        flat = gather_idx.reshape(pos_c.shape[0], -1)  # (C, 27K)
-        src_pos = sorted_pos[flat]  # (C, 27K, 3)
-        src_mass = sorted_mass[flat]
         acc = acc + _pair_acc(
             pos_c, src_pos, src_mass,
-            valid.reshape(pos_c.shape[0], -1), g, cutoff, eps, dtype,
+            valid.reshape(c, -1), g, cutoff, eps, dtype,
         )
 
         # Overflow correction: cells with count > leaf_cap contribute the
