@@ -103,7 +103,12 @@ PRESETS = {
     # eps=0.05 kpc softening.
     "baseline-1m": SimulationConfig(
         model="disk", n=1_048_576, integrator="leapfrog",
-        force_backend="pallas", sharding="ring", g=1.0, dt=2.0e-3, eps=0.05,
+        force_backend="tree", g=1.0, dt=2.0e-3, eps=0.05,
+    ),
+    "baseline-1m-p3m": SimulationConfig(
+        model="disk", n=1_048_576, integrator="leapfrog",
+        force_backend="p3m", pm_grid=256, p3m_cap=64, chunk=4096,
+        g=1.0, dt=2.0e-3, eps=0.05,
     ),
     "baseline-2m-merger": SimulationConfig(
         model="merger", n=2_097_152, integrator="leapfrog",
