@@ -248,3 +248,14 @@ def test_x64_mode_run():
         assert stats["final_state"].positions.dtype == jnp.float64
     finally:
         jax.config.update("jax_enable_x64", False)
+
+
+def test_bfloat16_run():
+    """bf16 state runs end-to-end and stays finite (accuracy is fp32's
+    job; bf16 is the memory-saving option for huge N)."""
+    cfg = _small_config(n=128, steps=10, integrator="leapfrog",
+                        dtype="bfloat16", eps=1e10, model="plummer")
+    stats = Simulator(cfg).run()
+    final = stats["final_state"]
+    assert final.positions.dtype == jnp.bfloat16
+    assert bool(jnp.all(jnp.isfinite(final.positions.astype(jnp.float32))))
