@@ -49,6 +49,9 @@ def _add_config_args(p: argparse.ArgumentParser) -> None:
                    default=None)
     p.add_argument("--tree-ws", dest="tree_ws", type=int, default=None,
                    help="octree opening criterion (theta ~ 0.87/ws)")
+    p.add_argument("--tree-far", dest="tree_far",
+                   choices=["direct", "expansion"], default=None,
+                   help="octree far-field mode (expansion = gather-lean)")
     p.add_argument("--pm-grid", dest="pm_grid", type=int, default=None)
     p.add_argument("--p3m-sigma-cells", dest="p3m_sigma_cells", type=float,
                    default=None)

@@ -41,6 +41,7 @@ class SimulationConfig:
     tree_depth: int = 0  # 0 = auto (recommended_depth)
     tree_leaf_cap: int = 32
     tree_ws: int = 1  # opening criterion: theta ~ 0.87/ws (1=fast, 2=tight)
+    tree_far: str = "direct"  # far-field mode: direct | expansion (fast)
     pm_grid: int = 128
     p3m_sigma_cells: float = 1.25  # Ewald split scale, in PM cells
     p3m_rcut_sigmas: float = 4.0  # short-range truncation, in sigmas

@@ -84,7 +84,8 @@ def make_local_kernel(config: SimulationConfig, backend: str):
         )
         return partial(
             tree_accelerations_vs, depth=depth,
-            leaf_cap=config.tree_leaf_cap, ws=config.tree_ws, **common,
+            leaf_cap=config.tree_leaf_cap, ws=config.tree_ws,
+            far=config.tree_far, **common,
         )
     if backend == "pm":
         from .ops.pm import pm_accelerations_vs
@@ -201,7 +202,7 @@ class Simulator:
             )
             return lambda pos: tree_accelerations(
                 pos, masses, depth=depth, leaf_cap=config.tree_leaf_cap,
-                ws=config.tree_ws, **common,
+                ws=config.tree_ws, far=config.tree_far, **common,
             )
         if self.backend == "pm":
             from .ops.pm import pm_accelerations
