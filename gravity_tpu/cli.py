@@ -82,6 +82,10 @@ def _add_config_args(p: argparse.ArgumentParser) -> None:
                    help="disable the per-block divergence watchdog")
     p.add_argument("--config-json", default=None,
                    help="path to a SimulationConfig JSON file")
+    p.add_argument("--distributed", action="store_true", default=False,
+                   help="call jax.distributed.initialize() first "
+                        "(multi-host pods; run the same command on every "
+                        "host)")
     del defaults
 
 
@@ -100,11 +104,19 @@ def build_config(args: argparse.Namespace) -> SimulationConfig:
     return config
 
 
+def _maybe_distributed(args) -> None:
+    if getattr(args, "distributed", False):
+        from .parallel import initialize_distributed
+
+        initialize_distributed()
+
+
 def cmd_run(args: argparse.Namespace) -> int:
     from .simulation import Simulator
     from .utils.logging import RunLogger
     from .utils.trajectory import TrajectoryWriter
 
+    _maybe_distributed(args)
     config = build_config(args)
     logger = RunLogger(config.log_dir)
     sim = Simulator(config)
@@ -338,6 +350,7 @@ def cmd_traj(args: argparse.Namespace) -> int:
 def cmd_bench(args: argparse.Namespace) -> int:
     from .bench import run_benchmark
 
+    _maybe_distributed(args)
     config = build_config(args)
     result = run_benchmark(config, warmup_steps=args.warmup,
                            bench_steps=args.bench_steps)
