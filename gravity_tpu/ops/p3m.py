@@ -47,6 +47,29 @@ from .cells import build_padded_cells, grid_coords, map_target_chunks
 from .pm import bounding_cube, cic_deposit, cic_gather
 
 
+def check_p3m_sizing(
+    n: int, grid: int, sigma_cells: float, rcut_sigmas: float, cap: int
+) -> str | None:
+    """Return a warning string when the cell-list cap looks undersized.
+
+    Mean occupancy well above cap means large mass fractions take the
+    overflow-monopole fallback on NEAR pairs — bounded but badly degraded
+    accuracy (this is the single easiest way to silently mis-configure
+    P3M). Clustered models concentrate several-fold above the mean, hence
+    the 2x headroom in the check.
+    """
+    side = binning_side(grid, sigma_cells, rcut_sigmas)
+    mean_occ = n / side**3
+    if cap < 2.0 * mean_occ:
+        return (
+            f"p3m cap={cap} is below 2x the mean cell occupancy "
+            f"({mean_occ:.1f} at binning side {side}): dense cells will "
+            "overflow to the monopole fallback on near pairs. Raise "
+            "--p3m-cap or --pm-grid (finer mesh -> more, smaller cells)."
+        )
+    return None
+
+
 def binning_side(grid: int, sigma_cells: float, rcut_sigmas: float) -> int:
     """Cell-list grid side so the bin size is >= r_cut (both scale with the
     bounding cube, so this is static): side <= (grid-1)/(sigma_cells *

@@ -95,8 +95,16 @@ def make_local_kernel(config: SimulationConfig, backend: str):
             eps=config.eps,
         )
     if backend == "p3m":
-        from .ops.p3m import p3m_accelerations_vs
+        import warnings
 
+        from .ops.p3m import check_p3m_sizing, p3m_accelerations_vs
+
+        note = check_p3m_sizing(
+            config.n, config.pm_grid, config.p3m_sigma_cells,
+            config.p3m_rcut_sigmas, config.p3m_cap,
+        )
+        if note:
+            warnings.warn(note, stacklevel=2)
         return partial(
             p3m_accelerations_vs, grid=config.pm_grid,
             sigma_cells=config.p3m_sigma_cells,
@@ -211,8 +219,16 @@ class Simulator:
                 pos, masses, grid=config.pm_grid, g=config.g, eps=config.eps
             )
         if self.backend == "p3m":
-            from .ops.p3m import p3m_accelerations
+            import warnings
 
+            from .ops.p3m import check_p3m_sizing, p3m_accelerations
+
+            note = check_p3m_sizing(
+                state.n, config.pm_grid, config.p3m_sigma_cells,
+                config.p3m_rcut_sigmas, config.p3m_cap,
+            )
+            if note:
+                warnings.warn(note, stacklevel=2)
             return lambda pos: p3m_accelerations(
                 pos, masses, grid=config.pm_grid,
                 sigma_cells=config.p3m_sigma_cells,

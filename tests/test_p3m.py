@@ -155,3 +155,28 @@ def test_simulator_backend_runs(key):
     sim = Simulator(config)
     stats = sim.run()
     assert bool(jnp.all(jnp.isfinite(stats["final_state"].positions)))
+
+
+def test_cap_sizing_warning():
+    from gravity_tpu.ops.p3m import check_p3m_sizing
+
+    # 1M particles on a 25^3 cell list: mean occupancy 67 >> cap 64.
+    assert check_p3m_sizing(1_048_576, 128, 1.25, 4.0, 64) is not None
+    # Fine at grid 256 (side 51 -> occupancy ~7.9, cap 64).
+    assert check_p3m_sizing(1_048_576, 256, 1.25, 4.0, 64) is None
+
+
+def test_simulator_warns_on_small_cap():
+    import warnings
+
+    from gravity_tpu.config import SimulationConfig
+    from gravity_tpu.simulation import Simulator
+
+    config = SimulationConfig(
+        model="plummer", n=4096, steps=1, force_backend="p3m",
+        pm_grid=32, p3m_cap=4, eps=1e10,
+    )
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        Simulator(config)
+    assert any("p3m cap" in str(x.message) for x in w)
