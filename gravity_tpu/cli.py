@@ -72,6 +72,10 @@ def _add_config_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--checkpoint-dir", dest="checkpoint_dir", default=None)
     p.add_argument("--metrics", action="store_true", default=None,
                    help="write a JSONL metrics stream next to the log")
+    p.add_argument("--metrics-energy", dest="metrics_energy",
+                   action="store_true", default=None,
+                   help="include total-energy drift per block (one O(N^2/"
+                        "chunk) eval per block - opt-in)")
     p.add_argument("--profile", action="store_true", default=None,
                    help="capture a jax.profiler trace of the run")
     p.add_argument("--debug-check", dest="debug_check", action="store_true",

@@ -60,6 +60,7 @@ class SimulationConfig:
     checkpoint_every: int = 0  # 0 = disabled
     checkpoint_dir: str = "checkpoints"
     metrics: bool = False  # JSONL per-block metrics stream
+    metrics_energy: bool = False  # add per-block total-energy drift (costly)
     profile: bool = False  # capture a jax.profiler trace of the run
     debug_check: bool = False  # Pallas-vs-jnp force cross-check at end
     # Divergence watchdog: per-block NaN/Inf state check; on detection the

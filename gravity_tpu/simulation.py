@@ -304,6 +304,7 @@ class Simulator:
 
         state = self.state
         acc = init_carry(self.accel_fn, state)
+        self._e0 = None
         timer = StepTimer()
         timer.start()
         block_prev = 0.0
@@ -357,6 +358,19 @@ class Simulator:
             if metrics_logger is not None:
                 from .utils.timing import pairs_per_step
 
+                extra = {}
+                if config.metrics_energy:
+                    e = float(diagnostics.total_energy(
+                        self.final_state(), g=config.g,
+                        cutoff=config.cutoff, eps=config.eps,
+                    ))
+                    if self._e0 is None:
+                        self._e0 = e
+                    extra["total_energy"] = e
+                    extra["energy_drift"] = (
+                        abs((e - self._e0) / self._e0)
+                        if self._e0 else None
+                    )
                 metrics_logger.log(
                     step=step,
                     block_steps=n_steps,
@@ -365,6 +379,7 @@ class Simulator:
                         pairs_per_step(self.n_real) * n_steps / block_elapsed
                         if block_elapsed > 0 else None
                     ),
+                    **extra,
                 )
             if trajectory_writer is not None and traj is not None:
                 # Host transfer before slicing: slicing a sharded array on

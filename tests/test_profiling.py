@@ -55,3 +55,24 @@ def test_profiler_trace(tmp_path):
     # An xplane trace file lands in the directory tree.
     files = glob.glob(str(tmp_path / "prof" / "**" / "*"), recursive=True)
     assert any(os.path.isfile(f) for f in files)
+
+
+def test_metrics_energy_stream(tmp_path):
+    from gravity_tpu.config import SimulationConfig
+    from gravity_tpu.simulation import Simulator
+    from gravity_tpu.utils.profiling import MetricsLogger
+
+    cfg = SimulationConfig(
+        model="plummer", n=128, steps=20, integrator="leapfrog",
+        force_backend="dense", eps=1e10, metrics=True, metrics_energy=True,
+        progress_every=10,
+    )
+    path = str(tmp_path / "metrics.jsonl")
+    ml = MetricsLogger(path)
+    Simulator(cfg).run(metrics_logger=ml)
+    rows = ml.read()
+    assert len(rows) == 2
+    assert all("total_energy" in r for r in rows)
+    # Leapfrog at this dt: tiny bounded drift.
+    assert rows[-1]["energy_drift"] is not None
+    assert rows[-1]["energy_drift"] < 0.05
