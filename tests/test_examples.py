@@ -1,0 +1,27 @@
+"""The examples/ scripts run end-to-end at tiny scale."""
+
+import subprocess
+import sys
+
+
+def _run(args):
+    return subprocess.run(
+        [sys.executable] + args, capture_output=True, text=True,
+        timeout=600,
+        env={"JAX_PLATFORMS": "cpu", "PYTHONPATH": "/root/repo",
+             "PATH": "/usr/bin:/bin:/usr/local/bin"},
+        cwd="/root/repo",
+    )
+
+
+def test_solar_system_example():
+    out = _run(["examples/solar_system.py", "--steps-per-day", "2"])
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "closure error" in out.stdout
+
+
+def test_galaxy_merger_example():
+    out = _run(["examples/galaxy_merger.py", "--n", "512", "--steps", "10",
+                "--backend", "chunked"])
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "energy drift" in out.stdout
