@@ -177,3 +177,23 @@ def test_expansion_far_field_bounded(key, model):
     assert bool(jnp.all(jnp.isfinite(approx)))
     assert np.median(rel) < 0.2, f"median {np.median(rel):.4f}"
     assert np.percentile(rel, 90) < 0.5, f"p90 {np.percentile(rel, 90):.4f}"
+
+
+def test_quadrupole_improves_accuracy(key):
+    """Quadrupole cell moments (default) cut the far-field error ~4-8x
+    vs monopole-only at the same ws — theta^2 -> theta^3."""
+    n = 2048
+    pos = jax.random.uniform(key, (n, 3), jnp.float32) * 1e12
+    m = jax.random.uniform(
+        jax.random.fold_in(key, 1), (n,), jnp.float32, minval=1e25,
+        maxval=1e26,
+    )
+    exact = pairwise_accelerations_dense(pos, m, eps=1e9)
+    rel_q = _rel_err(
+        tree_accelerations(pos, m, depth=5, quad=True, eps=1e9), exact
+    )
+    rel_m = _rel_err(
+        tree_accelerations(pos, m, depth=5, quad=False, eps=1e9), exact
+    )
+    assert np.median(rel_q) < 0.005, np.median(rel_q)
+    assert np.median(rel_q) < 0.5 * np.median(rel_m)
