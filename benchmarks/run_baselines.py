@@ -50,7 +50,7 @@ CONFIGS = {
         "1M-body Milky-Way disk, P3M (grid=256, cap=64)",
         dict(model="disk", n=1_048_576, g=1.0, dt=2.0e-3, eps=0.05,
              integrator="leapfrog", force_backend="p3m", pm_grid=256,
-             p3m_cap=64, chunk=4096),
+             p3m_cap=64),
         dict(bench_steps=3),
     ),
     "2m-merger": (
@@ -58,7 +58,7 @@ CONFIGS = {
         "single-chip here)",
         dict(model="merger", n=2_097_152, g=1.0, dt=2.0e-3, eps=0.05,
              integrator="leapfrog", force_backend="p3m", pm_grid=256,
-             p3m_cap=64, chunk=4096),
+             p3m_cap=64),
         dict(bench_steps=3),
     ),
 }

@@ -58,6 +58,8 @@ def _add_config_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--p3m-rcut-sigmas", dest="p3m_rcut_sigmas", type=float,
                    default=None)
     p.add_argument("--p3m-cap", dest="p3m_cap", type=int, default=None)
+    p.add_argument("--fast-chunk", dest="fast_chunk", type=int, default=None,
+                   help="target-chunk size for tree/p3m evaluation")
     p.add_argument("--sharding",
                    choices=["none", "allgather", "ring"], default=None)
     p.add_argument("--log-dir", dest="log_dir", default=None)

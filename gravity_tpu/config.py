@@ -46,6 +46,9 @@ class SimulationConfig:
     p3m_sigma_cells: float = 1.25  # Ewald split scale, in PM cells
     p3m_rcut_sigmas: float = 4.0  # short-range truncation, in sigmas
     p3m_cap: int = 128  # static per-cell source cap of the cell list
+    # Target-chunk size for the fast solvers' lax.map (bigger chunks =
+    # fewer sequential trips; memory per chunk ~ chunk * 27 * cap * 16 B).
+    fast_chunk: int = 4096
 
     # Parallelism
     sharding: str = "none"  # none | allgather | ring
@@ -109,7 +112,7 @@ PRESETS = {
     ),
     "baseline-1m-p3m": SimulationConfig(
         model="disk", n=1_048_576, integrator="leapfrog",
-        force_backend="p3m", pm_grid=256, p3m_cap=64, chunk=4096,
+        force_backend="p3m", pm_grid=256, p3m_cap=64,
         g=1.0, dt=2.0e-3, eps=0.05,
     ),
     "baseline-2m-merger": SimulationConfig(

@@ -85,7 +85,7 @@ def make_local_kernel(config: SimulationConfig, backend: str):
         return partial(
             tree_accelerations_vs, depth=depth,
             leaf_cap=config.tree_leaf_cap, ws=config.tree_ws,
-            far=config.tree_far, **common,
+            far=config.tree_far, chunk=config.fast_chunk, **common,
         )
     if backend == "pm":
         from .ops.pm import pm_accelerations_vs
@@ -109,7 +109,7 @@ def make_local_kernel(config: SimulationConfig, backend: str):
             p3m_accelerations_vs, grid=config.pm_grid,
             sigma_cells=config.p3m_sigma_cells,
             rcut_sigmas=config.p3m_rcut_sigmas,
-            cap=config.p3m_cap, chunk=config.chunk, **common,
+            cap=config.p3m_cap, chunk=config.fast_chunk, **common,
         )
     raise ValueError(f"unknown force backend {backend!r}")
 
@@ -210,7 +210,8 @@ class Simulator:
             )
             return lambda pos: tree_accelerations(
                 pos, masses, depth=depth, leaf_cap=config.tree_leaf_cap,
-                ws=config.tree_ws, far=config.tree_far, **common,
+                ws=config.tree_ws, far=config.tree_far,
+                chunk=config.fast_chunk, **common,
             )
         if self.backend == "pm":
             from .ops.pm import pm_accelerations
@@ -233,7 +234,7 @@ class Simulator:
                 pos, masses, grid=config.pm_grid,
                 sigma_cells=config.p3m_sigma_cells,
                 rcut_sigmas=config.p3m_rcut_sigmas,
-                cap=config.p3m_cap, chunk=config.chunk, **common,
+                cap=config.p3m_cap, chunk=config.fast_chunk, **common,
             )
         raise ValueError(self.backend)
 
