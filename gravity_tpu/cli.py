@@ -281,6 +281,64 @@ def cmd_resume(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_validate(args: argparse.Namespace) -> int:
+    """Self-test battery on the current platform: force-kernel
+    cross-check, two-body orbital closure, and symplectic energy drift.
+    The quantitative replacement for the reference's eyeball validation
+    — runnable on any install to confirm the physics end-to-end."""
+    import numpy as np
+
+    from .constants import G
+    from .ops import diagnostics as diag
+    from .config import SimulationConfig
+    from .simulation import Simulator
+    from .utils.profiling import debug_check_forces
+
+    checks = {}
+
+    # 1. Active force kernel vs the jnp direct sum on a Plummer state.
+    from .models import create_plummer
+    import jax as _jax
+
+    state = create_plummer(_jax.random.PRNGKey(0), 2048)
+    res = debug_check_forces(state.positions, state.masses, eps=1e9)
+    checks["kernel_cross_check"] = {
+        "median_rel_err": res["median_rel_err"],
+        "ok": res["median_rel_err"] < 1e-3,
+    }
+
+    # 2. Earth orbital closure over one year (leapfrog, dt = 1 h).
+    cfg = SimulationConfig(
+        model="solar", n=3, steps=int(365.25 * 24), dt=3600.0,
+        integrator="leapfrog", force_backend="dense",
+    )
+    sim = Simulator(cfg)
+    start = np.asarray(sim.state.positions[1])
+    final = np.asarray(sim.run()["final_state"].positions[1])
+    closure = float(
+        np.linalg.norm(final - start) / np.linalg.norm(start)
+    )
+    checks["earth_year_closure"] = {
+        "rel_closure_err": closure, "ok": closure < 0.05,
+    }
+
+    # 3. Energy drift over 500 leapfrog steps on a Plummer sphere.
+    cfg = SimulationConfig(
+        model="plummer", n=512, steps=500, dt=3600.0, eps=1e10,
+        integrator="leapfrog", force_backend="dense",
+    )
+    sim = Simulator(cfg)
+    e0 = float(diag.total_energy(sim.state, g=G, eps=1e10))
+    sim.run()
+    e1 = float(diag.total_energy(sim.final_state(), g=G, eps=1e10))
+    drift = abs((e1 - e0) / e0)
+    checks["leapfrog_energy_drift"] = {"drift": drift, "ok": drift < 0.01}
+
+    ok = all(c["ok"] for c in checks.values())
+    print(json.dumps({"ok": ok, "checks": checks}, indent=2))
+    return 0 if ok else 1
+
+
 def cmd_analyze(args: argparse.Namespace) -> int:
     """Structure + conserved-quantity report for a checkpointed state (or
     a fresh model realization): energy, virial ratio, Lagrangian radii,
@@ -386,6 +444,11 @@ def main(argv=None) -> int:
     p_resume.add_argument("--step", type=int, default=None,
                           help="checkpoint step to restore (default latest)")
     p_resume.set_defaults(fn=cmd_resume)
+
+    p_val = sub.add_parser(
+        "validate", help="physics self-test battery on this platform"
+    )
+    p_val.set_defaults(fn=cmd_validate)
 
     p_an = sub.add_parser(
         "analyze", help="diagnostics report for a checkpoint or model"

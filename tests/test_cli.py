@@ -160,3 +160,11 @@ def test_analyze_checkpoint(tmp_path, capsys):
     assert out["step"] == 10
     assert out["n"] == 128
     assert out["kinetic_energy"] > 0
+
+
+def test_validate_command(capsys):
+    rc = main(["validate"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert out["ok"] is True
+    assert out["checks"]["earth_year_closure"]["ok"]
