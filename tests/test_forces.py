@@ -200,3 +200,45 @@ def test_structure_diagnostics(key):
     inner = rho_np[: 8][rho_np[:8] > 0]
     outer = rho_np[-4:][rho_np[-4:] > 0]
     assert inner.max() > 100 * outer.min()
+
+
+def test_force_invariances(key):
+    """Physical invariances of the direct-sum kernel: G-linearity,
+    source-mass linearity, translation invariance, rotation
+    equivariance."""
+    from gravity_tpu.ops.forces import accelerations_vs
+
+    n = 256
+    k1, k2 = jax.random.split(key)
+    pos = jax.random.uniform(k1, (n, 3), jnp.float32) * 1e12
+    m = jax.random.uniform(k2, (n,), jnp.float32, minval=1e25, maxval=1e26)
+    base = np.asarray(accelerations_vs(pos, pos, m, eps=1e9))
+
+    # G-linearity.
+    double_g = np.asarray(accelerations_vs(pos, pos, m, g=2 * G, eps=1e9))
+    np.testing.assert_allclose(double_g, 2 * base, rtol=1e-5)
+
+    # Source-mass linearity.
+    double_m = np.asarray(accelerations_vs(pos, pos, 2 * m, eps=1e9))
+    np.testing.assert_allclose(double_m, 2 * base, rtol=1e-5)
+
+    # Translation invariance (fp32: shift comparable to the system size).
+    shift = jnp.asarray([1e11, -2e11, 3e11], jnp.float32)
+    shifted = np.asarray(
+        accelerations_vs(pos + shift, pos + shift, m, eps=1e9)
+    )
+    np.testing.assert_allclose(
+        shifted, base, rtol=5e-3, atol=np.abs(base).max() * 5e-3
+    )
+
+    # Rotation equivariance: a(Rx) = R a(x) for a rotation R.
+    th = 0.7
+    R = jnp.asarray(
+        [[np.cos(th), -np.sin(th), 0], [np.sin(th), np.cos(th), 0],
+         [0, 0, 1]], jnp.float32,
+    )
+    rotated = np.asarray(accelerations_vs(pos @ R.T, pos @ R.T, m, eps=1e9))
+    np.testing.assert_allclose(
+        rotated, base @ np.asarray(R).T, rtol=5e-3,
+        atol=np.abs(base).max() * 5e-3,
+    )
