@@ -172,16 +172,26 @@ def cmd_run(args: argparse.Namespace) -> int:
                        checkpoint_manager=ckpt_mgr,
                        metrics_logger=metrics_logger)
 
-    if config.profile:
-        import os
+    from .simulation import SimulationDiverged
 
-        from .utils.profiling import trace
+    try:
+        if config.profile:
+            import os
 
-        with trace(os.path.join(config.log_dir,
-                                f"profile_{logger.timestamp}")):
+            from .utils.profiling import trace
+
+            with trace(os.path.join(config.log_dir,
+                                    f"profile_{logger.timestamp}")):
+                stats = _go()
+        else:
             stats = _go()
-    else:
-        stats = _go()
+    except SimulationDiverged as e:
+        # Clean failure: the watchdog already checkpointed the last
+        # finite state (when checkpointing is on); resume with a smaller
+        # dt via `gravity_tpu resume --dt ...`.
+        print(json.dumps({"error": "diverged", "last_finite_step": e.step,
+                          "message": str(e)}), file=sys.stderr)
+        return 2
 
     if config.debug_check:
         from .simulation import make_local_kernel

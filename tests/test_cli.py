@@ -168,3 +168,27 @@ def test_validate_command(capsys):
     assert rc == 0
     assert out["ok"] is True
     assert out["checks"]["earth_year_closure"]["ok"]
+
+
+def test_divergence_then_resume_with_smaller_dt(tmp_path, capsys):
+    """Full recovery flow: a run that blows up exits 2 with the last
+    finite state checkpointed; `resume` with a sane dt completes."""
+    ckpt = str(tmp_path / "ckpt")
+    rc = main([
+        "run", "--model", "plummer", "--n", "64", "--steps", "40",
+        "--dt", "1e30", "--integrator", "euler", "--force-backend",
+        "dense", "--eps", "1e10", "--checkpoint-every", "10",
+        "--checkpoint-dir", ckpt, "--log-dir", str(tmp_path / "logs"),
+        "--seed", "1",
+    ])
+    assert rc == 2
+    capsys.readouterr()
+    rc = main([
+        "resume", "--model", "plummer", "--n", "64", "--steps", "40",
+        "--dt", "3600", "--integrator", "euler", "--force-backend",
+        "dense", "--eps", "1e10", "--checkpoint-dir", ckpt,
+        "--log-dir", str(tmp_path / "logs"), "--seed", "1",
+    ])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["steps"] == 40
