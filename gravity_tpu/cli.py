@@ -62,6 +62,11 @@ def _add_config_args(p: argparse.ArgumentParser) -> None:
                    help="target-chunk size for tree/p3m evaluation")
     p.add_argument("--sharding",
                    choices=["none", "allgather", "ring"], default=None)
+    p.add_argument("--mesh-shape", dest="mesh_shape",
+                   type=lambda s: tuple(int(x) for x in s.split(",")),
+                   default=None,
+                   help="device mesh shape, e.g. 8 or 2,4 (outer axis = "
+                        "DCN for multi-slice)")
     p.add_argument("--log-dir", dest="log_dir", default=None)
     p.add_argument("--trajectories", dest="record_trajectories",
                    action="store_true", default=None)

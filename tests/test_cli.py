@@ -192,3 +192,14 @@ def test_divergence_then_resume_with_smaller_dt(tmp_path, capsys):
     assert rc == 0
     out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert out["steps"] == 40
+
+
+def test_mesh_shape_flag(tmp_path, capsys):
+    rc = main([
+        "run", "--model", "random", "--n", "64", "--steps", "3",
+        "--sharding", "ring", "--mesh-shape", "2,4",
+        "--force-backend", "dense", "--log-dir", str(tmp_path / "logs"),
+    ])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["n"] == 64
