@@ -415,6 +415,23 @@ def cmd_traj(args: argparse.Namespace) -> int:
 
     from .utils.native import gtrj_tool_path
 
+    if args.traj_command == "export":
+        # GTRJ -> (steps.npy, positions.npy) for numpy/matplotlib interop.
+        import numpy as np
+
+        from .utils.trajectory import NativeTrajectoryReader
+
+        reader = NativeTrajectoryReader(args.file)
+        base = args.file[:-5] if args.file.endswith(".gtrj") else args.file
+        traj = reader.load()
+        np.save(base + "_positions.npy", traj)
+        np.save(base + "_steps.npy", np.asarray(reader.steps))
+        print(json.dumps({
+            "frames": int(traj.shape[0]), "particles": int(traj.shape[1]),
+            "positions": base + "_positions.npy",
+            "steps": base + "_steps.npy",
+        }))
+        return 0
     tool = gtrj_tool_path()
     if tool is None:
         print("native toolchain unavailable (g++ required for gtrj_tool)")
@@ -478,7 +495,8 @@ def main(argv=None) -> int:
     p_traj = sub.add_parser(
         "traj", help="inspect a native GTRJ trajectory file"
     )
-    p_traj.add_argument("traj_command", choices=["info", "stats", "dump"])
+    p_traj.add_argument("traj_command",
+                        choices=["info", "stats", "dump", "export"])
     p_traj.add_argument("file")
     p_traj.add_argument("--frame", type=int, default=0,
                         help="frame index for dump (negative = from end)")

@@ -203,3 +203,27 @@ def test_mesh_shape_flag(tmp_path, capsys):
     assert rc == 0
     out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert out["n"] == 64
+
+
+def test_traj_export(tmp_path, capsys):
+    from gravity_tpu.utils.native import native_available
+
+    if not native_available():
+        pytest.skip("no native runtime")
+    rc = main([
+        "run", "--model", "random", "--n", "16", "--steps", "4",
+        "--force-backend", "dense", "--trajectories",
+        "--trajectory-format", "native",
+        "--log-dir", str(tmp_path / "logs"),
+    ])
+    assert rc == 0
+    capsys.readouterr()
+    f = glob.glob(str(tmp_path / "logs" / "trajectories_*.gtrj"))[0]
+    rc = main(["traj", "export", f])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["frames"] == 4 and out["particles"] == 16
+    arr = np.load(out["positions"])
+    assert arr.shape == (4, 16, 3)
+    steps = np.load(out["steps"])
+    assert list(steps) == [1, 2, 3, 4]
