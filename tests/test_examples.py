@@ -25,3 +25,20 @@ def test_galaxy_merger_example():
                 "--backend", "chunked"])
     assert out.returncode == 0, out.stderr[-2000:]
     assert "energy drift" in out.stdout
+
+
+def test_plot_trajectory_example(tmp_path):
+    from gravity_tpu.cli import main as cli_main
+    import glob as _glob
+
+    rc = cli_main([
+        "run", "--model", "random", "--n", "16", "--steps", "5",
+        "--force-backend", "dense", "--trajectories",
+        "--log-dir", str(tmp_path / "logs"),
+    ])
+    assert rc == 0
+    traj_dir = _glob.glob(str(tmp_path / "logs" / "trajectories_*"))[0]
+    out = _run(["examples/plot_trajectory.py", traj_dir, "--out",
+                str(tmp_path / "p.png")])
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert (tmp_path / "p.png").exists()
