@@ -17,35 +17,15 @@ import sys
 NORTH_STAR = 1.0e11  # pair-interactions/sec/chip (BASELINE.json)
 
 
-def _tpu_tunnel_alive(timeout_s: int = 60) -> bool:
-    """Probe TPU device init in a subprocess: the axon tunnel, when
-    wedged, makes jax.devices() hang forever (no error), which would
-    hang this benchmark too. A hung probe means fall back to CPU."""
-    import subprocess
-
-    try:
-        probe = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            capture_output=True, timeout=timeout_s, cwd=os.path.dirname(
-                os.path.abspath(__file__)
-            ),
-        )
-        return probe.returncode == 0
-    except subprocess.TimeoutExpired:
-        return False
-
-
 def main() -> int:
     n = int(os.environ.get("BENCH_N", 65536))
     steps = int(os.environ.get("BENCH_STEPS", 20))
 
     import jax
 
-    if not _tpu_tunnel_alive():
-        # Wedged tunnel: force the CPU platform before first device use
-        # (the axon sitecustomize overrides the JAX_PLATFORMS env var,
-        # so this must be the in-process config update).
-        jax.config.update("jax_platforms", "cpu")
+    from gravity_tpu.utils.platform import ensure_live_backend
+
+    ensure_live_backend()
 
     from gravity_tpu.bench import run_benchmark
     from gravity_tpu.config import SimulationConfig

@@ -38,11 +38,14 @@ def run_benchmark(
     jax.block_until_ready(state.positions)
     elapsed = time.perf_counter() - start
 
+    from .ops.integrators import FORCE_EVALS_PER_STEP
+
     stats = throughput(
         sim.n_real,
         bench_steps,
         elapsed,
         num_devices=sim.mesh.size if sim.mesh else 1,
+        force_evals_per_step=FORCE_EVALS_PER_STEP[config.integrator],
     )
     stats.update(
         model=config.model,

@@ -36,7 +36,8 @@ def _add_config_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--cutoff", type=float, default=None)
     p.add_argument("--eps", type=float, default=None)
     p.add_argument("--integrator",
-                   choices=["euler", "leapfrog", "verlet"], default=None)
+                   choices=["euler", "leapfrog", "verlet", "yoshida4"],
+                   default=None)
     p.add_argument("--dtype",
                    choices=["float32", "float64", "bfloat16"], default=None)
     p.add_argument("--force-backend", dest="force_backend",
@@ -512,6 +513,14 @@ def main(argv=None) -> int:
     p_bench.set_defaults(fn=cmd_bench)
 
     args = parser.parse_args(argv)
+    if args.command != "traj" and not getattr(args, "distributed", False):
+        # Every device-touching command would hang forever on a wedged
+        # axon tunnel; bound that with a subprocess probe + CPU fallback.
+        # Multi-host runs skip the probe: a sibling process initializing
+        # the TPU would race the coordination barrier.
+        from .utils.platform import ensure_live_backend
+
+        ensure_live_backend()
     return args.fn(args)
 
 

@@ -32,7 +32,7 @@ class SimulationConfig:
     eps: float = 0.0  # Plummer softening (0 = reference semantics)
 
     # Numerics / backend
-    integrator: str = "euler"  # euler (reference parity) | leapfrog | verlet
+    integrator: str = "euler"  # euler (reference parity) | leapfrog | verlet | yoshida4
     dtype: str = "float32"
     # auto | dense | chunked | pallas (direct sum) | tree (octree) |
     # pm (FFT mesh) | p3m (FFT mesh + cell-list pair correction)

@@ -20,15 +20,18 @@ from .forces import (
     potential_energy,
 )
 from .integrators import (
+    FORCE_EVALS_PER_STEP,
     INTEGRATORS,
     leapfrog_kdk,
     make_step_fn,
     semi_implicit_euler,
     velocity_verlet,
+    yoshida4,
 )
 from .p3m import p3m_accelerations
 
 __all__ = [
+    "FORCE_EVALS_PER_STEP",
     "INTEGRATORS",
     "accelerations_vs",
     "center_of_mass",
@@ -50,4 +53,5 @@ __all__ = [
     "velocity_dispersion",
     "velocity_verlet",
     "virial_ratio",
+    "yoshida4",
 ]

@@ -6,7 +6,8 @@ backends (`/root/reference/cuda.cu:63-78`, `/root/reference/mpi.c:206-215`,
 `/root/reference/pyspark.py:88-102`). That is the parity integrator here.
 
 We additionally provide leapfrog KDK (kick-drift-kick) — the standard
-N-body workhorse, second order and symplectic — and velocity Verlet.
+N-body workhorse, second order and symplectic — velocity Verlet, and a
+4th-order Yoshida composition integrator.
 Each integrator is a pure function ``(state, dt, accel_fn) -> state`` so it
 composes with ``jit``/``scan``/``shard_map`` and any force backend.
 """
@@ -76,10 +77,51 @@ def velocity_verlet(
     return state.replace(positions=new_x, velocities=new_v), new_acc
 
 
+# Yoshida (1990) 4th-order symplectic composition coefficients: three
+# leapfrog sub-steps of sizes (w1, w0, w1)*dt with w0 negative.
+_Y4_W1 = 1.0 / (2.0 - 2.0 ** (1.0 / 3.0))
+_Y4_W0 = 1.0 - 2.0 * _Y4_W1
+
+
+def yoshida4(
+    state: ParticleState,
+    dt,
+    accel_fn: AccelFn,
+    acc: Optional[jax.Array] = None,
+) -> tuple[ParticleState, jax.Array]:
+    """4th-order symplectic (Yoshida) integrator; returns (state, acc).
+
+    Composition of three KDK leapfrog sub-steps with step sizes
+    (w1, w0, w1)*dt where w1 = 1/(2-2^(1/3)), w0 = 1 - 2*w1 < 0. Costs three
+    force evaluations per step (the closing kick of each sub-step is the
+    opening kick of the next, threaded via the carried ``acc``), and the
+    per-step energy error scales as O(dt^5) (global O(dt^4)) versus
+    leapfrog's O(dt^3)/O(dt^2) — worth it whenever force evals are cheap
+    relative to the accuracy gain, e.g. few-body orbit integrations.
+    """
+    if acc is None:
+        acc = accel_fn(state.positions)
+    for w in (_Y4_W1, _Y4_W0, _Y4_W1):
+        state, acc = leapfrog_kdk(state, w * dt, accel_fn, acc)
+    return state, acc
+
+
 INTEGRATORS = {
     "euler": semi_implicit_euler,
     "leapfrog": leapfrog_kdk,
     "verlet": velocity_verlet,
+    "yoshida4": yoshida4,
+}
+
+# Net force evaluations per step under the carried-acc scheme of
+# make_step_fn: euler recomputes (1); leapfrog/verlet reuse the carry so the
+# one closing evaluation is the whole cost (1); yoshida4 is three chained
+# KDK sub-steps (3). Used for throughput accounting (pairs/s).
+FORCE_EVALS_PER_STEP = {
+    "euler": 1,
+    "leapfrog": 1,
+    "verlet": 1,
+    "yoshida4": 3,
 }
 
 
@@ -89,8 +131,8 @@ def make_step_fn(integrator: str, accel_fn: AccelFn, dt):
     The carried ``acc`` is always an (N, 3) array so it threads through
     ``lax.scan`` with a fixed pytree structure (seed it with
     :func:`init_carry`). Semi-implicit Euler recomputes it each step (a
-    one-force-eval method already); leapfrog/verlet reuse it, saving the
-    redundant opening force evaluation.
+    one-force-eval method already); leapfrog/verlet/yoshida4 reuse it,
+    saving the redundant opening force evaluation.
     """
     if integrator == "euler":
 
@@ -100,7 +142,7 @@ def make_step_fn(integrator: str, accel_fn: AccelFn, dt):
             return _euler_update(state, acc_here, dt), acc_here
 
         return step
-    if integrator in ("leapfrog", "verlet"):
+    if integrator in ("leapfrog", "verlet", "yoshida4"):
         fn = INTEGRATORS[integrator]
 
         def step(state, acc):

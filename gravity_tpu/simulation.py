@@ -23,7 +23,7 @@ import numpy as np
 from .config import SimulationConfig
 from .models import create_model
 from .ops.forces import accelerations_vs, pairwise_accelerations_chunked
-from .ops.integrators import init_carry, make_step_fn
+from .ops.integrators import FORCE_EVALS_PER_STEP, init_carry, make_step_fn
 from .ops import diagnostics
 from .state import ParticleState
 from .utils.logging import RunLogger
@@ -418,9 +418,7 @@ class Simulator:
 
         self.state = state
         total_time = timer.total
-        # Every integrator costs one force eval per step: euler by
-        # construction, leapfrog/verlet via the carried-acc reuse.
-        evals = 1
+        evals = FORCE_EVALS_PER_STEP[config.integrator]
         stats = throughput(
             self.n_real,
             total_steps - start_step,

@@ -1,0 +1,87 @@
+"""Backend liveness guard for the axon TPU tunnel.
+
+The dev environment reaches its one TPU chip through a tunnel that can
+wedge: ``jax.devices()`` then hangs forever instead of erroring, which
+would hang any entry point that touches a device. The guard probes device
+init in a *subprocess* (so the hang is bounded by a timeout) and, when the
+tunnel is down, falls back to the CPU platform before first device use.
+
+The axon sitecustomize force-sets ``jax_platforms="axon,cpu"`` and ignores
+the ``JAX_PLATFORMS`` env var, so the fallback must be the in-process
+``jax.config.update("jax_platforms", "cpu")``.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def tpu_tunnel_alive(timeout_s: float = 60.0) -> bool:
+    """True iff ``jax.devices()`` completes (in a subprocess) in time."""
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            capture_output=True, timeout=timeout_s, cwd=_REPO_ROOT,
+        )
+        return probe.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def _force_cpu() -> None:
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except RuntimeError:
+        pass  # backend already initialized; use what we have
+
+
+def ensure_live_backend(probe_timeout_s: float = 60.0) -> bool:
+    """Fall back to CPU if the configured platform needs a dead tunnel.
+
+    Returns True when the TPU path is (believed) usable, False when the
+    guard switched to — or found itself already on — the CPU platform.
+    No-ops (returns False) when the platform is already CPU-only, e.g.
+    under the test conftest or a virtual host-device mesh. Set
+    ``GRAVITY_TPU_NO_PROBE=1`` to skip the probe and trust the configured
+    platform (returns True).
+    """
+    import jax
+
+    if "xla_force_host_platform_device_count" in os.environ.get(
+        "XLA_FLAGS", ""
+    ):
+        # Virtual-mesh run: CPU is the intended platform.
+        _force_cpu()
+        return False
+    platforms = jax.config.jax_platforms or ""
+    if platforms and all(
+        p.strip() == "cpu" for p in platforms.split(",") if p.strip()
+    ):
+        return False
+    if not platforms:
+        # No explicit platform selection (the axon sitecustomize always
+        # sets one): only a TPU runtime install could hang device init, so
+        # skip the probe-subprocess tax everywhere else.
+        import importlib.util
+
+        if importlib.util.find_spec("libtpu") is None:
+            return True
+    if os.environ.get("GRAVITY_TPU_NO_PROBE"):
+        return True
+    if tpu_tunnel_alive(probe_timeout_s):
+        return True
+    print(
+        "warning: TPU backend unreachable (wedged tunnel?); "
+        "falling back to the CPU platform",
+        file=sys.stderr,
+    )
+    _force_cpu()
+    return False
