@@ -34,8 +34,9 @@ class SimulationConfig:
     # Numerics / backend
     integrator: str = "euler"  # euler (reference parity) | leapfrog | verlet | yoshida4
     dtype: str = "float32"
-    # auto | dense | chunked | pallas (direct sum) | tree (octree) |
-    # pm (FFT mesh) | p3m (FFT mesh + cell-list pair correction)
+    # auto | dense | chunked | pallas (direct sum) | cpp (native XLA FFI
+    # host kernel, CPU platform) | tree (octree) | pm (FFT mesh) |
+    # p3m (FFT mesh + cell-list pair correction)
     force_backend: str = "auto"
     chunk: int = 1024
     tree_depth: int = 0  # 0 = auto (recommended_depth)

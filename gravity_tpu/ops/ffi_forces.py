@@ -1,0 +1,113 @@
+"""Native C++ direct-sum force kernel via XLA FFI (CPU platform).
+
+The host-side native compute component of the framework: the reference
+implements its force loop natively twice (`/root/reference/mpi.c:196-205`,
+`/root/reference/cuda.cu:32-60`); on TPU the on-device equivalent is the
+Pallas kernel, and this module is the *host* native path — a multithreaded
+C++ row-sum kernel (``runtime/ffi_forces.cpp``) compiled with plain g++
+against ``jax.ffi.include_dir()`` and registered as the XLA custom call
+``gt_accelerations_vs`` through ``ctypes`` + ``jax.ffi.pycapsule``.
+
+Because it is an XLA custom call, it composes with ``jit`` — and with
+``shard_map``, so the sharded allgather/ring strategies can use it as
+their local kernel on the CPU platform (fast fp64 oracle runs, parity
+tests at sizes the pure-Python oracle cannot reach).
+"""
+
+from __future__ import annotations
+
+import threading
+
+import jax
+import jax.numpy as jnp
+
+from ..constants import CUTOFF_RADIUS, G
+
+_register_lock = threading.Lock()
+_registered: bool | None = None
+
+
+def ffi_forces_available() -> bool:
+    """True iff the native kernel built, loaded, and registered."""
+    global _registered
+    with _register_lock:
+        if _registered is not None:
+            return _registered
+        from ..utils.native import load_ffi_library
+
+        lib = load_ffi_library()
+        if lib is None:
+            _registered = False
+            return False
+        try:
+            jax.ffi.register_ffi_target(
+                "gt_accelerations_vs",
+                jax.ffi.pycapsule(lib.GtAccelerationsVs),
+                platform="cpu",
+            )
+            _registered = True
+        except Exception:
+            _registered = False
+        return _registered
+
+
+def ffi_accelerations_vs(
+    pos_i: jax.Array,
+    pos_j: jax.Array,
+    masses_j: jax.Array,
+    *,
+    g: float = G,
+    cutoff: float = CUTOFF_RADIUS,
+    eps: float = 0.0,
+) -> jax.Array:
+    """Accelerations on `pos_i` (M, 3) from sources `pos_j`/`masses_j`.
+
+    Same contract as :func:`gravity_tpu.ops.forces.accelerations_vs`
+    (cutoff on the *softened* r^2; self-pairs excluded by the cutoff), so
+    it drops into the sharded strategies as a local kernel. CPU platform
+    only — raises RuntimeError when the native library is unavailable or
+    the array backend is not CPU.
+    """
+    if not ffi_forces_available():
+        raise RuntimeError(
+            "native FFI force kernel unavailable (g++ or jax.ffi headers "
+            "missing); use the jnp backends instead"
+        )
+    if jax.default_backend() != "cpu":
+        raise RuntimeError(
+            "native FFI force kernel is registered for the CPU platform "
+            f"only (current default backend: {jax.default_backend()!r})"
+        )
+    out_type = jax.ShapeDtypeStruct(pos_i.shape, pos_i.dtype)
+    call = jax.ffi.ffi_call("gt_accelerations_vs", out_type)
+    return call(
+        pos_i, pos_j, masses_j,
+        g=float(g), cutoff=float(cutoff), eps=float(eps),
+    )
+
+
+def ffi_pairwise_accelerations(
+    positions: jax.Array,
+    masses: jax.Array,
+    *,
+    g: float = G,
+    cutoff: float = CUTOFF_RADIUS,
+    eps: float = 0.0,
+) -> jax.Array:
+    """All-pairs accelerations (targets == sources) on the native kernel."""
+    return ffi_accelerations_vs(
+        positions, positions, masses, g=g, cutoff=cutoff, eps=eps
+    )
+
+
+def make_ffi_local_kernel(
+    *, g: float = G, cutoff: float = CUTOFF_RADIUS, eps: float = 0.0
+):
+    """A LocalKernel closure for the sharded strategies (CPU platform)."""
+
+    def kernel(pos_i, pos_j, masses_j):
+        return ffi_accelerations_vs(
+            pos_i, pos_j, masses_j, g=g, cutoff=cutoff, eps=eps
+        )
+
+    return kernel

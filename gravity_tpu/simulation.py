@@ -76,6 +76,25 @@ def make_local_kernel(config: SimulationConfig, backend: str):
 
         interpret = jax.devices()[0].platform != "tpu"
         return make_pallas_local_kernel(interpret=interpret, **common)
+    if backend == "cpp":
+        if jax.devices()[0].platform != "cpu":
+            raise ValueError(
+                "force_backend='cpp' (native XLA FFI kernel) is a CPU-"
+                "platform backend; on TPU use 'pallas'"
+            )
+        if config.dtype not in ("float32", "float64"):
+            raise ValueError(
+                f"force_backend='cpp' supports float32/float64, not "
+                f"{config.dtype!r}"
+            )
+        from .ops.ffi_forces import ffi_forces_available, make_ffi_local_kernel
+
+        if not ffi_forces_available():
+            raise RuntimeError(
+                "native FFI force kernel unavailable (g++ toolchain or "
+                "jax.ffi headers missing)"
+            )
+        return make_ffi_local_kernel(**common)
     if backend == "tree":
         from .ops.tree import recommended_depth, tree_accelerations_vs
 
@@ -199,8 +218,8 @@ class Simulator:
             return lambda pos: pairwise_accelerations_chunked(
                 pos, masses, chunk=max(chunk, 1), **common
             )
-        if self.backend == "pallas":
-            kernel = make_local_kernel(config, "pallas")
+        if self.backend in ("pallas", "cpp"):
+            kernel = make_local_kernel(config, self.backend)
             return lambda pos: kernel(pos, pos, masses)
         if self.backend == "tree":
             from .ops.tree import recommended_depth, tree_accelerations

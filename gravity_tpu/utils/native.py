@@ -1,9 +1,9 @@
 """ctypes bindings for the native (C++) runtime components.
 
-The shared library is built on demand from ``runtime/*.cpp`` with g++
-(no pip/pybind11 dependency — plain C ABI + ctypes). Falls back cleanly:
-callers check :func:`native_available` and use the pure-Python path when
-the toolchain or library is missing.
+The shared libraries and tools are built on demand from ``runtime/*.cpp``
+with g++ (no pip/pybind11 dependency — plain C ABI + ctypes). Falls back
+cleanly: callers check the ``*_available`` predicates and use the
+pure-Python path when the toolchain or library is missing.
 """
 
 from __future__ import annotations
@@ -12,76 +12,152 @@ import ctypes
 import os
 import subprocess
 import threading
-from typing import Optional
+from typing import Optional, Sequence
 
 _REPO_ROOT = os.path.dirname(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 )
-_SRC = os.path.join(_REPO_ROOT, "runtime", "trajectory_writer.cpp")
-_LIB_DIR = os.path.join(_REPO_ROOT, "runtime", "build")
-_LIB = os.path.join(_LIB_DIR, "libgravity_runtime.so")
+_RUNTIME = os.path.join(_REPO_ROOT, "runtime")
+_LIB_DIR = os.path.join(_RUNTIME, "build")
 
 _lock = threading.Lock()
-_lib: Optional[ctypes.CDLL] = None
-_build_failed = False
 
 
-def _build() -> bool:
+def _build_if_stale(
+    src: str, out: str, extra_flags: Sequence[str] = (), *,
+    timeout: float = 180.0,
+) -> bool:
+    """(Re)build ``out`` from ``src`` when missing or older than ``src``.
+
+    Compiles to a temp path and renames into place, so an interrupted
+    build can never leave a truncated artifact that poisons the
+    mtime-staleness check. Returns False on any toolchain failure.
+    """
+    if os.path.exists(out) and (
+        not os.path.exists(src)
+        or os.path.getmtime(src) <= os.path.getmtime(out)
+    ):
+        return True
     os.makedirs(_LIB_DIR, exist_ok=True)
-    cmd = [
-        "g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
-        _SRC, "-o", _LIB,
-    ]
+    tmp = f"{out}.tmp.{os.getpid()}"
+    cmd = ["g++", "-std=c++17", *extra_flags, src, "-o", tmp]
     try:
-        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        subprocess.run(cmd, check=True, capture_output=True, timeout=timeout)
+        os.replace(tmp, out)
         return True
     except (subprocess.CalledProcessError, subprocess.TimeoutExpired,
-            FileNotFoundError):
+            FileNotFoundError, OSError):
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
         return False
+
+
+class _LazyLibrary:
+    """Build-once, load-once CDLL with negative-result caching.
+
+    ``flags_fn`` returns the extra g++ flags, or None when a build
+    prerequisite (e.g. the jax FFI headers) is unavailable.
+    """
+
+    def __init__(self, src: str, out: str, flags_fn):
+        self._src = src
+        self._out = out
+        self._flags_fn = flags_fn
+        self._lib: Optional[ctypes.CDLL] = None
+        self._failed = False
+
+    def load(self) -> Optional[ctypes.CDLL]:
+        with _lock:
+            if self._lib is not None:
+                return self._lib
+            if self._failed:
+                return None
+            flags = self._flags_fn()
+            if flags is None or not _build_if_stale(
+                self._src, self._out, flags
+            ):
+                self._failed = True
+                return None
+            try:
+                self._lib = ctypes.CDLL(self._out)
+            except OSError:
+                # The artifact exists but won't load (e.g. truncated by a
+                # crash mid-rename on an exotic filesystem): drop it so
+                # the next process retries the build instead of caching
+                # the corruption forever.
+                try:
+                    os.unlink(self._out)
+                except OSError:
+                    pass
+                self._failed = True
+                return None
+            return self._lib
+
+
+_SHARED_FLAGS = ("-O3", "-shared", "-fPIC", "-pthread")
+
+_runtime_lib = _LazyLibrary(
+    os.path.join(_RUNTIME, "trajectory_writer.cpp"),
+    os.path.join(_LIB_DIR, "libgravity_runtime.so"),
+    lambda: _SHARED_FLAGS,
+)
+
+
+def _ffi_flags() -> Optional[tuple]:
+    try:
+        import jax.ffi
+
+        return (*_SHARED_FLAGS, f"-I{jax.ffi.include_dir()}")
+    except Exception:
+        return None
+
+
+_ffi_lib = _LazyLibrary(
+    os.path.join(_RUNTIME, "ffi_forces.cpp"),
+    os.path.join(_LIB_DIR, "libgravity_ffi.so"),
+    _ffi_flags,
+)
 
 
 def load_runtime() -> Optional[ctypes.CDLL]:
     """Load (building if needed) the native runtime library, or None."""
-    global _lib, _build_failed
-    with _lock:
-        if _lib is not None:
-            return _lib
-        if _build_failed:
-            return None
-        if not os.path.exists(_LIB) or (
-            os.path.exists(_SRC)
-            and os.path.getmtime(_SRC) > os.path.getmtime(_LIB)
-        ):
-            if not _build():
-                _build_failed = True
-                return None
-        try:
-            lib = ctypes.CDLL(_LIB)
-        except OSError:
-            _build_failed = True
-            return None
-        lib.gt_writer_open.restype = ctypes.c_void_p
-        lib.gt_writer_open.argtypes = [
-            ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint32,
-            ctypes.c_uint32,
-        ]
-        lib.gt_writer_append.restype = ctypes.c_int
-        lib.gt_writer_append.argtypes = [
-            ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
-        ]
-        lib.gt_writer_error.restype = ctypes.c_int
-        lib.gt_writer_error.argtypes = [ctypes.c_void_p]
-        lib.gt_writer_close.restype = ctypes.c_int64
-        lib.gt_writer_close.argtypes = [ctypes.c_void_p]
-        _lib = lib
-        return _lib
+    lib = _runtime_lib.load()
+    if lib is None or hasattr(lib, "_gt_proto_done"):
+        return lib
+    lib.gt_writer_open.restype = ctypes.c_void_p
+    lib.gt_writer_open.argtypes = [
+        ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint32,
+        ctypes.c_uint32,
+    ]
+    lib.gt_writer_append.restype = ctypes.c_int
+    lib.gt_writer_append.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+    ]
+    lib.gt_writer_error.restype = ctypes.c_int
+    lib.gt_writer_error.argtypes = [ctypes.c_void_p]
+    lib.gt_writer_close.restype = ctypes.c_int64
+    lib.gt_writer_close.argtypes = [ctypes.c_void_p]
+    lib._gt_proto_done = True
+    return lib
 
 
 def native_available() -> bool:
     return load_runtime() is not None
 
 
-_TOOL_SRC = os.path.join(_REPO_ROOT, "runtime", "gtrj_tool.cpp")
+def load_ffi_library() -> Optional[ctypes.CDLL]:
+    """Load (building on demand) the XLA FFI kernel library, or None.
+
+    Compiled against the headers JAX ships (``jax.ffi.include_dir()``) —
+    no pip dependencies; the handler symbol is registered by
+    :mod:`gravity_tpu.ops.ffi_forces` via ``jax.ffi.pycapsule``.
+    """
+    return _ffi_lib.load()
+
+
+_TOOL_SRC = os.path.join(_RUNTIME, "gtrj_tool.cpp")
 _TOOL_BIN = os.path.join(_LIB_DIR, "gtrj_tool")
 
 
@@ -89,16 +165,6 @@ def gtrj_tool_path() -> Optional[str]:
     """Path to the native GTRJ inspector binary (building on demand with
     g++), or None when the toolchain is unavailable."""
     with _lock:
-        if os.path.exists(_TOOL_BIN) and (
-            not os.path.exists(_TOOL_SRC)
-            or os.path.getmtime(_TOOL_SRC) <= os.path.getmtime(_TOOL_BIN)
-        ):
+        if _build_if_stale(_TOOL_SRC, _TOOL_BIN, ("-O2",), timeout=120):
             return _TOOL_BIN
-        os.makedirs(_LIB_DIR, exist_ok=True)
-        cmd = ["g++", "-O2", "-std=c++17", _TOOL_SRC, "-o", _TOOL_BIN]
-        try:
-            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-        except (subprocess.CalledProcessError, subprocess.TimeoutExpired,
-                FileNotFoundError):
-            return None
-        return _TOOL_BIN
+        return None

@@ -1,0 +1,132 @@
+// Native host-side direct-sum gravity kernel, exposed to JAX as an XLA FFI
+// custom call ("gt_accelerations_vs", CPU platform).
+//
+// TPU-native analog of the reference's native force backends: on TPU the
+// on-device kernel layer is Pallas (user C++/CUDA cannot run on TPU cores),
+// so the framework's C++ compute component lives host-side — a
+// multithreaded float64/float32 row-sum kernel with the same decomposition
+// as the MPI backend's per-rank loop (/root/reference/mpi.c:196-205: each
+// worker computes full row sums for its row slice; no shared accumulator,
+// so the reference CUDA kernel's cross-thread race, cuda.cu:47-49, is
+// impossible by construction).
+//
+// Physics contract (identical to gravity_tpu.ops.forces.accelerations_vs):
+//   a_i = sum_j G * m_j * (x_j - x_i) / (r^2 + eps^2)^(3/2)
+//   with (r^2 + eps^2) <= cutoff^2  ->  zero contribution
+// (the reference's r < 1e-10 close-approach cutoff, cuda.cu:39 / mpi.c:64 /
+// pyspark.py:38, generalized with optional Plummer softening).
+//
+// Built with plain g++ against the headers shipped in jax.ffi.include_dir();
+// registered from Python via ctypes + jax.ffi.pycapsule (no pybind11).
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <thread>
+#include <vector>
+
+#include "xla/ffi/api/ffi.h"
+
+namespace ffi = xla::ffi;
+
+namespace {
+
+template <typename T>
+void AccelRows(const T* pi, const T* pj, const T* mj, T* out, int64_t k,
+               double g, double cutoff, double eps, int64_t row0,
+               int64_t row1) {
+  const T c2 = static_cast<T>(cutoff) * static_cast<T>(cutoff);
+  const T e2 = static_cast<T>(eps) * static_cast<T>(eps);
+  const T gt = static_cast<T>(g);
+  for (int64_t i = row0; i < row1; ++i) {
+    const T xi = pi[3 * i], yi = pi[3 * i + 1], zi = pi[3 * i + 2];
+    T ax = 0, ay = 0, az = 0;
+    for (int64_t j = 0; j < k; ++j) {
+      const T dx = pj[3 * j] - xi;
+      const T dy = pj[3 * j + 1] - yi;
+      const T dz = pj[3 * j + 2] - zi;
+      const T r2 = dx * dx + dy * dy + dz * dz + e2;
+      if (r2 <= c2) continue;  // cutoff (covers the r == 0 self-pair)
+      const T inv_r = T(1) / std::sqrt(r2);
+      // Same factor ordering as the jnp/Pallas kernels: fold G*m_j in
+      // before cubing 1/r so fp32 intermediates never hit subnormals.
+      const T w = ((gt * mj[j]) * inv_r) * inv_r * inv_r;
+      ax += w * dx;
+      ay += w * dy;
+      az += w * dz;
+    }
+    out[3 * i] = ax;
+    out[3 * i + 1] = ay;
+    out[3 * i + 2] = az;
+  }
+}
+
+template <typename T>
+void AccelThreaded(const T* pi, const T* pj, const T* mj, T* out, int64_t m,
+                   int64_t k, double g, double cutoff, double eps) {
+  const int64_t min_rows_per_thread = 64;
+  int64_t want = (m + min_rows_per_thread - 1) / min_rows_per_thread;
+  int64_t hw = static_cast<int64_t>(std::thread::hardware_concurrency());
+  int64_t nthreads = std::max<int64_t>(1, std::min(want, std::max<int64_t>(1, hw)));
+  if (nthreads == 1) {
+    AccelRows(pi, pj, mj, out, k, g, cutoff, eps, 0, m);
+    return;
+  }
+  std::vector<std::thread> threads;
+  threads.reserve(nthreads);
+  const int64_t rows = (m + nthreads - 1) / nthreads;
+  for (int64_t t = 0; t < nthreads; ++t) {
+    const int64_t r0 = t * rows;
+    const int64_t r1 = std::min(m, r0 + rows);
+    if (r0 >= r1) break;
+    threads.emplace_back(AccelRows<T>, pi, pj, mj, out, k, g, cutoff, eps,
+                         r0, r1);
+  }
+  for (auto& th : threads) th.join();
+}
+
+ffi::Error AccelerationsVs(ffi::AnyBuffer pos_i, ffi::AnyBuffer pos_j,
+                           ffi::AnyBuffer masses_j,
+                           ffi::Result<ffi::AnyBuffer> acc, double g,
+                           double cutoff, double eps) {
+  auto di = pos_i.dimensions();
+  auto dj = pos_j.dimensions();
+  auto dm = masses_j.dimensions();
+  if (di.size() != 2 || di[1] != 3 || dj.size() != 2 || dj[1] != 3 ||
+      dm.size() != 1 || dm[0] != dj[0]) {
+    return ffi::Error::InvalidArgument(
+        "expected pos_i (M,3), pos_j (K,3), masses_j (K,)");
+  }
+  const int64_t m = di[0];
+  const int64_t k = dj[0];
+  auto dtype = pos_i.element_type();
+  if (pos_j.element_type() != dtype || masses_j.element_type() != dtype ||
+      acc->element_type() != dtype) {
+    return ffi::Error::InvalidArgument("mixed dtypes");
+  }
+  if (dtype == ffi::DataType::F64) {
+    AccelThreaded(pos_i.typed_data<double>(), pos_j.typed_data<double>(),
+                  masses_j.typed_data<double>(), acc->typed_data<double>(),
+                  m, k, g, cutoff, eps);
+  } else if (dtype == ffi::DataType::F32) {
+    AccelThreaded(pos_i.typed_data<float>(), pos_j.typed_data<float>(),
+                  masses_j.typed_data<float>(), acc->typed_data<float>(), m,
+                  k, g, cutoff, eps);
+  } else {
+    return ffi::Error::InvalidArgument("only f32/f64 supported");
+  }
+  return ffi::Error::Success();
+}
+
+}  // namespace
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(
+    GtAccelerationsVs, AccelerationsVs,
+    ffi::Ffi::Bind()
+        .Arg<ffi::AnyBuffer>()   // pos_i (M, 3)
+        .Arg<ffi::AnyBuffer>()   // pos_j (K, 3)
+        .Arg<ffi::AnyBuffer>()   // masses_j (K,)
+        .Ret<ffi::AnyBuffer>()   // acc (M, 3)
+        .Attr<double>("g")
+        .Attr<double>("cutoff")
+        .Attr<double>("eps"));

@@ -1,0 +1,128 @@
+"""Native XLA FFI force-kernel tests: parity, jit, sharding, end-to-end.
+
+The C++ kernel (runtime/ffi_forces.cpp) implements the same physics
+contract as ops.forces.accelerations_vs — the cross-backend spec of
+SURVEY §2f (`/root/reference/mpi.c:59-73` force law and cutoff) — so
+every test here is a parity check against the jnp implementation.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gravity_tpu.ops.ffi_forces import (
+    ffi_accelerations_vs,
+    ffi_forces_available,
+    ffi_pairwise_accelerations,
+    make_ffi_local_kernel,
+)
+from gravity_tpu.ops.forces import (
+    accelerations_vs,
+    pairwise_accelerations_dense,
+)
+
+pytestmark = pytest.mark.skipif(
+    not ffi_forces_available(),
+    reason="native FFI kernel unavailable (no g++ toolchain?)",
+)
+
+
+def _random_system(key, n, dtype):
+    kp, kv, km = jax.random.split(key, 3)
+    pos = jax.random.uniform(kp, (n, 3), dtype, minval=-3e11, maxval=3e11)
+    masses = jax.random.uniform(km, (n,), dtype, minval=1e23, maxval=1e25)
+    return pos, masses
+
+
+def test_fp64_parity_vs_jnp(key, x64):
+    pos, masses = _random_system(key, 321, jnp.float64)
+    got = ffi_pairwise_accelerations(pos, masses)
+    want = pairwise_accelerations_dense(pos, masses)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-12)
+
+
+def test_fp32_parity_vs_jnp(key):
+    pos, masses = _random_system(key, 256, jnp.float32)
+    got = ffi_pairwise_accelerations(pos, masses)
+    want = pairwise_accelerations_dense(pos, masses)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=3e-4,
+        atol=float(np.abs(np.asarray(want)).max()) * 3e-4,
+    )
+
+
+def test_rectangular_targets_sources(key, x64):
+    """vs-form with M != K (the sharded local-kernel shape)."""
+    pos, masses = _random_system(key, 96, jnp.float64)
+    targets = pos[:32]
+    got = ffi_accelerations_vs(targets, pos, masses)
+    want = accelerations_vs(targets, pos, masses)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-12)
+
+
+def test_softening_and_cutoff_semantics(key, x64):
+    """eps folds into r^2 before the cutoff test, exactly like jnp."""
+    pos, masses = _random_system(key, 64, jnp.float64)
+    # Coincident pair: self-pair-style zero through the cutoff.
+    pos = pos.at[1].set(pos[0])
+    for eps in (0.0, 1e9):
+        got = ffi_pairwise_accelerations(pos, masses, eps=eps)
+        want = pairwise_accelerations_dense(pos, masses, eps=eps)
+        # 1/sqrt vs lax.rsqrt differ by ~1 ulp, amplified by cancellation
+        # in the row sums: allow a few e-12 relative.
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=1e-11
+        )
+        assert np.isfinite(np.asarray(got)).all()
+
+
+def test_jit_and_grad_free_composition(key, x64):
+    """The custom call composes with jit (scan-style repeated use)."""
+    pos, masses = _random_system(key, 128, jnp.float64)
+
+    @jax.jit
+    def two_evals(p):
+        a1 = ffi_pairwise_accelerations(p, masses)
+        return ffi_pairwise_accelerations(p + 0.0 * a1, masses)
+
+    np.testing.assert_allclose(
+        np.asarray(two_evals(pos)),
+        np.asarray(pairwise_accelerations_dense(pos, masses)),
+        rtol=1e-12,
+    )
+
+
+def test_sharded_local_kernel(key, x64):
+    """The native kernel as the local kernel under shard_map allgather."""
+    from gravity_tpu.parallel import make_particle_mesh, shard_state
+    from gravity_tpu.parallel.sharded import make_sharded_accel_fn
+    from gravity_tpu.state import ParticleState
+
+    pos, masses = _random_system(key, 64, jnp.float64)
+    state = ParticleState(pos, jnp.zeros_like(pos), masses)
+    mesh = make_particle_mesh((8,))
+    state = shard_state(state, mesh)
+    accel_fn = make_sharded_accel_fn(
+        mesh, state.masses, strategy="allgather",
+        local_kernel=make_ffi_local_kernel(),
+    )
+    got = accel_fn(state.positions)
+    want = pairwise_accelerations_dense(pos, masses)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-12)
+
+
+def test_simulator_cpp_backend(key):
+    """End-to-end Simulator run on force_backend='cpp' matches 'dense'."""
+    from gravity_tpu.config import SimulationConfig
+    from gravity_tpu.simulation import Simulator
+
+    base = dict(model="random", n=48, steps=25, seed=3)
+    s_cpp = Simulator(SimulationConfig(force_backend="cpp", **base))
+    s_ref = Simulator(SimulationConfig(force_backend="dense", **base))
+    out_cpp = s_cpp.run()["final_state"]
+    out_ref = s_ref.run()["final_state"]
+    np.testing.assert_allclose(
+        np.asarray(out_cpp.positions), np.asarray(out_ref.positions),
+        rtol=1e-5,
+    )
