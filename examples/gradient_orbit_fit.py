@@ -1,0 +1,90 @@
+"""Differentiable-simulator demo: fit a transfer-orbit launch velocity by
+gradient descent *through the integrator*.
+
+The whole simulator is a pure JAX program, so ``jax.grad`` flows through
+the scanned leapfrog rollout — a capability class the reference's
+imperative C/CUDA/Spark loops cannot express. Here: find the launch
+velocity that carries a probe from Earth's orbit radius to a target point
+in a fixed flight time, by differentiating the endpoint miss through the
+full N-body integration.
+
+    python examples/gradient_orbit_fit.py [--iters 300] [--steps 60]
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=300)
+    ap.add_argument("--steps", type=int, default=60,
+                    help="integration steps over the flight")
+    args = ap.parse_args()
+    if args.iters < 1 or args.steps < 1:
+        ap.error("--iters and --steps must be >= 1")
+
+    import jax
+    import jax.numpy as jnp
+
+    jax.config.update("jax_enable_x64", True)
+
+    from gravity_tpu.ops.forces import pairwise_accelerations_dense
+    from gravity_tpu.ops.integrators import init_carry, make_step_fn
+    from gravity_tpu.state import ParticleState
+
+    m_sun = 1.989e30
+    r0 = 1.496e11  # launch radius = Earth's orbit
+    flight_time = 8.0e6  # ~93 days
+    dt = flight_time / args.steps
+    masses = jnp.asarray([m_sun, 1.0], jnp.float64)
+    pos = jnp.asarray([[0.0, 0.0, 0.0], [r0, 0.0, 0.0]], jnp.float64)
+    # Target: 40 degrees ahead, half-way out toward Mars' orbit radius.
+    theta = jnp.deg2rad(40.0)
+    r_t = 1.85e11
+    target = jnp.asarray(
+        [r_t * jnp.cos(theta), r_t * jnp.sin(theta), 0.0], jnp.float64
+    )
+
+    accel = lambda p: pairwise_accelerations_dense(p, masses)  # noqa: E731
+    step = make_step_fn("leapfrog", accel, dt)
+
+    @jax.jit
+    def endpoint_miss(v0):
+        st = ParticleState(
+            pos, jnp.stack([jnp.zeros(3, jnp.float64), v0]), masses
+        )
+
+        def body(carry, _):
+            s, a = step(*carry)
+            return (s, a), None
+
+        (st, _), _ = jax.lax.scan(
+            body, (st, init_carry(accel, st)), None, length=args.steps
+        )
+        return jnp.sum(((st.positions[1] - target) / r0) ** 2)
+
+    v = jnp.asarray([0.0, 2.98e4, 0.0], jnp.float64)  # circular guess
+    val_and_grad = jax.jit(jax.value_and_grad(endpoint_miss))
+    # Endpoint ~linear in v0 -> ~quadratic loss; lr ~ 0.7 / Hessian.
+    lr = 0.35 / (flight_time / r0) ** 2
+    for i in range(args.iters):
+        val, g = val_and_grad(v)
+        v = v - lr * g
+        if i % 50 == 0 or i == args.iters - 1:
+            print(f"iter {i:4d}  miss^2 = {float(val):.3e} (r0^2 units)")
+
+    miss_km = float(jnp.sqrt(val)) * r0 / 1e3
+    speed = float(jnp.linalg.norm(v))
+    print(f"\nfitted launch velocity: {[round(float(x), 1) for x in v]} m/s "
+          f"(|v| = {speed:.1f} m/s)")
+    print(f"endpoint miss: {miss_km:.3e} km over a "
+          f"{flight_time / 86400:.0f}-day flight")
+    ok = miss_km < 1.0e4  # within 10,000 km of the target
+    print("FIT OK" if ok else "FIT DID NOT CONVERGE")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -27,6 +27,13 @@ def test_galaxy_merger_example():
     assert "energy drift" in out.stdout
 
 
+def test_gradient_orbit_fit_example():
+    out = _run(["examples/gradient_orbit_fit.py", "--iters", "120",
+                "--steps", "30"])
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "FIT OK" in out.stdout
+
+
 def test_plot_trajectory_example(tmp_path):
     from gravity_tpu.cli import main as cli_main
     import glob as _glob
