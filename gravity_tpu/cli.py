@@ -61,6 +61,13 @@ def _add_config_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--p3m-cap", dest="p3m_cap", type=int, default=None)
     p.add_argument("--fast-chunk", dest="fast_chunk", type=int, default=None,
                    help="target-chunk size for tree/p3m evaluation")
+    p.add_argument("--adaptive", action="store_true", default=None,
+                   help="adaptive dt: steps*dt becomes the target "
+                        "simulated time, dt the per-step ceiling")
+    p.add_argument("--eta", type=float, default=None,
+                   help="adaptive-timestep safety factor")
+    p.add_argument("--timestep-criterion", dest="timestep_criterion",
+                   choices=["auto", "accel", "velocity"], default=None)
     p.add_argument("--sharding",
                    choices=["none", "allgather", "ring"], default=None)
     p.add_argument("--mesh-shape", dest="mesh_shape",
@@ -132,6 +139,19 @@ def cmd_run(args: argparse.Namespace) -> int:
     config = build_config(args)
     logger = RunLogger(config.log_dir)
     sim = Simulator(config)
+
+    if config.adaptive and (
+        config.record_trajectories or config.checkpoint_every
+        or config.metrics
+    ):
+        print(
+            "error: --adaptive runs one data-dependent while_loop on "
+            "device; per-step trajectory/checkpoint/metrics streaming "
+            "is unavailable in this mode",
+            file=sys.stderr,
+        )
+        return 1
+
     writer = None
     if config.record_trajectories:
         import os
@@ -174,6 +194,8 @@ def cmd_run(args: argparse.Namespace) -> int:
         )
 
     def _go():
+        if config.adaptive:
+            return sim.run_adaptive(logger)
         return sim.run(logger, trajectory_writer=writer,
                        checkpoint_manager=ckpt_mgr,
                        metrics_logger=metrics_logger)

@@ -51,6 +51,15 @@ class SimulationConfig:
     # fewer sequential trips; memory per chunk ~ chunk * 27 * cap * 16 B).
     fast_chunk: int = 4096
 
+    # Adaptive time stepping (capability add; the reference is fixed-dt
+    # only). When on, `steps * dt` becomes the target simulated time and
+    # dt the per-step ceiling; see gravity_tpu.ops.adaptive.
+    adaptive: bool = False
+    eta: float = 0.025  # timestep safety factor
+    # auto (accel when eps > 0, else velocity) | accel | velocity
+    timestep_criterion: str = "auto"
+    adaptive_max_steps: int = 1_000_000  # runaway-subdivision bound
+
     # Parallelism
     sharding: str = "none"  # none | allgather | ring
     mesh_shape: Optional[tuple] = None  # e.g. (8,); None = all local devices

@@ -19,6 +19,11 @@ from .forces import (
     pairwise_accelerations_dense,
     potential_energy,
 )
+from .adaptive import (
+    acceleration_timestep,
+    adaptive_run,
+    velocity_timestep,
+)
 from .integrators import (
     FORCE_EVALS_PER_STEP,
     INTEGRATORS,
@@ -33,7 +38,9 @@ from .p3m import p3m_accelerations
 __all__ = [
     "FORCE_EVALS_PER_STEP",
     "INTEGRATORS",
+    "acceleration_timestep",
     "accelerations_vs",
+    "adaptive_run",
     "center_of_mass",
     "energy_drift",
     "half_mass_radius",
@@ -51,6 +58,7 @@ __all__ = [
     "total_momentum",
     "radial_density_profile",
     "velocity_dispersion",
+    "velocity_timestep",
     "velocity_verlet",
     "virial_ratio",
     "yoshida4",

@@ -309,18 +309,7 @@ class Simulator:
             # Block size must be a multiple of the recording stride.
             block = max(1, block // every) * every
 
-        if logger is not None:
-            logger.start_banner(
-                num_devices=self.mesh.size if self.mesh else 1,
-                num_particles=self.n_real,
-                steps=total_steps,
-                dt=config.dt,
-                model=config.model,
-                integrator=config.integrator,
-                backend=self.backend,
-                sharding=config.sharding,
-                dtype=config.dtype,
-            )
+        self._banner(logger, total_steps, config.integrator)
 
         state = self.state
         acc = init_carry(self.accel_fn, state)
@@ -347,10 +336,7 @@ class Simulator:
                 record_every=every if do_record else 1,
             )
             jax.block_until_ready(state.positions)
-            if config.nan_check and not bool(
-                jnp.all(jnp.isfinite(state.positions))
-                & jnp.all(jnp.isfinite(state.velocities))
-            ):
+            if config.nan_check and not self._state_finite(state):
                 # Divergence watchdog: abort with the last finite state
                 # persisted rather than integrating garbage to the end.
                 if checkpoint_manager is not None:
@@ -447,15 +433,119 @@ class Simulator:
         )
         if trajectory_writer is not None:
             trajectory_writer.close()
+        return self._finish(logger, total_time, total_steps - start_step,
+                            stats)
+
+    def _banner(self, logger: Optional[RunLogger], steps: int,
+                integrator_label: str) -> None:
+        if logger is not None:
+            logger.start_banner(
+                num_devices=self.mesh.size if self.mesh else 1,
+                num_particles=self.n_real,
+                steps=steps,
+                dt=self.config.dt,
+                model=self.config.model,
+                integrator=integrator_label,
+                backend=self.backend,
+                sharding=self.config.sharding,
+                dtype=self.config.dtype,
+            )
+
+    @staticmethod
+    def _state_finite(state: ParticleState) -> bool:
+        return bool(
+            jnp.all(jnp.isfinite(state.positions))
+            & jnp.all(jnp.isfinite(state.velocities))
+        )
+
+    def _finish(self, logger: Optional[RunLogger], total_time: float,
+                steps: int, stats: dict) -> dict:
+        """Shared run epilogue: perf log, final positions, results dict."""
         if logger is not None:
             logger.performance(
-                total_time, total_steps - start_step,
-                pairs_per_sec=stats["pairs_per_sec"],
+                total_time, steps, pairs_per_sec=stats["pairs_per_sec"]
             )
             logger.final_positions(np.asarray(self.final_state().positions))
             logger.completed()
         stats["final_state"] = self.final_state()
         return stats
+
+    def run_adaptive(self, logger: Optional[RunLogger] = None) -> dict:
+        """Adaptive-dt run to t_end = steps * dt (see ops.adaptive).
+
+        One jitted ``lax.while_loop`` — the step count is data-dependent,
+        so per-step trajectory/checkpoint/metrics streaming is not
+        available in this mode (use fixed-dt runs for those).
+        """
+        from .ops.adaptive import adaptive_run
+
+        config = self.config
+        t_end = config.steps * config.dt
+        criterion = config.timestep_criterion
+        if criterion == "auto":
+            criterion = "accel" if config.eps > 0.0 else "velocity"
+        if config.integrator not in ("euler", "leapfrog"):
+            # "euler" is only the config default, not a real request for
+            # adaptive Euler; anything else would be silently ignored.
+            raise ValueError(
+                f"adaptive mode integrates with KDK leapfrog; "
+                f"integrator={config.integrator!r} is not supported "
+                "(use fixed-dt runs for verlet/yoshida4)"
+            )
+
+        self._banner(
+            logger, config.steps,
+            f"adaptive-kdk ({criterion}, eta={config.eta})",
+        )
+
+        run_fn = jax.jit(
+            partial(
+                adaptive_run,
+                accel_fn=self.accel_fn,
+                t_end=t_end,
+                dt_max=config.dt,
+                eta=config.eta,
+                eps=config.eps,
+                criterion=criterion,
+                max_steps=config.adaptive_max_steps,
+            )
+        )
+        timer = StepTimer()
+        timer.start()
+        res = run_fn(self.state)
+        jax.block_until_ready(res.state.positions)
+        timer.mark()
+
+        self.state = res.state
+        steps_taken = int(res.steps)
+        if config.nan_check and not self._state_finite(res.state):
+            if logger is not None:
+                logger.log_print(
+                    f"DIVERGED during adaptive run (after {steps_taken} "
+                    "steps)"
+                )
+            raise SimulationDiverged(steps_taken)
+
+        stats = throughput(
+            self.n_real,
+            max(steps_taken, 1),
+            timer.total,
+            num_devices=self.mesh.size if self.mesh else 1,
+        )
+        stats.update(
+            t_end=t_end,
+            t_reached=float(res.t),
+            adaptive_steps=steps_taken,
+            dt_min=float(res.dt_min),
+            dt_max_used=float(res.dt_max_used),
+            criterion=criterion,
+        )
+        if steps_taken >= config.adaptive_max_steps and logger is not None:
+            logger.log_print(
+                f"WARNING: max_steps={config.adaptive_max_steps} hit at "
+                f"t={float(res.t):.6g} of {t_end:.6g}"
+            )
+        return self._finish(logger, timer.total, steps_taken, stats)
 
     def final_state(self) -> ParticleState:
         """State restricted to the real (unpadded) particles, on host-default
