@@ -61,6 +61,15 @@ def _add_config_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--p3m-cap", dest="p3m_cap", type=int, default=None)
     p.add_argument("--fast-chunk", dest="fast_chunk", type=int, default=None,
                    help="target-chunk size for tree/p3m evaluation")
+    p.add_argument("--merge-radius", dest="merge_radius", type=float,
+                   default=None,
+                   help="merge pairs closer than this radius (inelastic "
+                        "collision; 0 = off)")
+    p.add_argument("--merge-k", dest="merge_k", type=int, default=None)
+    p.add_argument("--merge-every", dest="merge_every", type=int,
+                   default=None,
+                   help="steps between collision checks (physics cadence, "
+                        "independent of --progress-every)")
     p.add_argument("--adaptive", action="store_true", default=None,
                    help="adaptive dt: steps*dt becomes the target "
                         "simulated time, dt the per-step ceiling")
@@ -142,12 +151,12 @@ def cmd_run(args: argparse.Namespace) -> int:
 
     if config.adaptive and (
         config.record_trajectories or config.checkpoint_every
-        or config.metrics
+        or config.metrics or config.merge_radius > 0.0
     ):
         print(
             "error: --adaptive runs one data-dependent while_loop on "
             "device; per-step trajectory/checkpoint/metrics streaming "
-            "is unavailable in this mode",
+            "and --merge-radius are unavailable in this mode",
             file=sys.stderr,
         )
         return 1

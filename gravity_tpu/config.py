@@ -60,6 +60,17 @@ class SimulationConfig:
     timestep_criterion: str = "auto"
     adaptive_max_steps: int = 1_000_000  # runaway-subdivision bound
 
+    # Collision handling (capability add; the reference lets colliding
+    # particles pass through each other). radius > 0 enables a per-block
+    # merge pass: pairs closer than the radius merge inelastically (mass
+    # and momentum conserved), the donor becomes a massless tracer.
+    merge_radius: float = 0.0
+    merge_k: int = 16  # candidate-pair cap per merge pass
+    # Merge-check cadence in steps. Upper-bounds the run's block size so
+    # the physics cadence stays independent of the progress_every
+    # logging knob.
+    merge_every: int = 100
+
     # Parallelism
     sharding: str = "none"  # none | allgather | ring
     mesh_shape: Optional[tuple] = None  # e.g. (8,); None = all local devices

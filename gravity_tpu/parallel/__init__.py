@@ -11,7 +11,7 @@ from .mesh import (
     shard_state,
 )
 from .multislice import hierarchical_ring_accel
-from .sharded import make_sharded_accel_fn
+from .sharded import make_sharded_accel2, make_sharded_accel_fn
 
 __all__ = [
     "DCN_AXIS",
@@ -19,6 +19,7 @@ __all__ = [
     "hierarchical_ring_accel",
     "initialize_distributed",
     "make_particle_mesh",
+    "make_sharded_accel2",
     "make_sharded_accel_fn",
     "num_shards",
     "particle_sharding",

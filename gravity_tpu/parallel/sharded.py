@@ -62,20 +62,20 @@ def _ring_accel(pos_l, m_l, *, axis, local_kernel):
     return acc
 
 
-def make_sharded_accel_fn(
+def make_sharded_accel2(
     mesh: Mesh,
-    masses: jax.Array,
     *,
     strategy: str = "allgather",
     local_kernel: LocalKernel | None = None,
     g: float = G,
     cutoff: float = CUTOFF_RADIUS,
     eps: float = 0.0,
-) -> Callable[[jax.Array], jax.Array]:
-    """Build ``accel_fn(positions) -> accelerations`` over a sharded mesh.
+) -> Callable[[jax.Array, jax.Array], jax.Array]:
+    """Build ``(positions, masses) -> accelerations`` over a sharded mesh.
 
-    ``masses`` is captured and passed through shard_map explicitly (so it
-    shards along with positions). N must be divisible by mesh.size — pad with
+    Masses are a traced operand (they shard along with positions), so the
+    same compiled program serves runs whose masses change (e.g. particle
+    merging). N must be divisible by mesh.size — pad with
     ``ParticleState.pad_to`` otherwise (zero-mass padding is exact).
     """
     if local_kernel is None:
@@ -102,12 +102,30 @@ def make_sharded_accel_fn(
     else:
         raise ValueError(f"unknown sharding strategy {strategy!r}")
 
-    sharded = jax.shard_map(
+    return jax.shard_map(
         body,
         mesh=mesh,
         in_specs=(spec, spec),
         out_specs=spec,
         check_vma=False,
+    )
+
+
+def make_sharded_accel_fn(
+    mesh: Mesh,
+    masses: jax.Array,
+    *,
+    strategy: str = "allgather",
+    local_kernel: LocalKernel | None = None,
+    g: float = G,
+    cutoff: float = CUTOFF_RADIUS,
+    eps: float = 0.0,
+) -> Callable[[jax.Array], jax.Array]:
+    """``accel_fn(positions)`` with ``masses`` captured — the convenience
+    wrapper over :func:`make_sharded_accel2`."""
+    sharded = make_sharded_accel2(
+        mesh, strategy=strategy, local_kernel=local_kernel,
+        g=g, cutoff=cutoff, eps=eps,
     )
 
     def accel_fn(positions: jax.Array) -> jax.Array:

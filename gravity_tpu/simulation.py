@@ -175,20 +175,32 @@ class Simulator:
                     "set per chip to build its tree/mesh; use "
                     "sharding='allgather'"
                 )
-            from .parallel import (
-                make_particle_mesh,
-                make_sharded_accel_fn,
-                shard_state,
-            )
+            from .parallel import make_particle_mesh, shard_state
 
             self.mesh = make_particle_mesh(config.mesh_shape)
             p = self.mesh.size
             n_pad = math.ceil(state.n / p) * p
             state, _ = state.pad_to(n_pad)
             state = shard_state(state, self.mesh)
-            self.accel_fn = make_sharded_accel_fn(
+
+        self.state = state
+        self._build_fns()
+
+    def _build_fns(self) -> None:
+        """Build the (positions, masses) -> acc function and the jitted
+        block runner.
+
+        Masses reach the hot loop as a TRACED operand (read off the
+        scanned ParticleState), not as a baked closure constant — so runs
+        whose masses change mid-flight (particle merging) keep hitting
+        the same compiled block instead of retracing.
+        """
+        config = self.config
+        if self.mesh is not None:
+            from .parallel import make_sharded_accel2
+
+            self._accel2 = make_sharded_accel2(
                 self.mesh,
-                state.masses,
                 strategy=config.sharding,
                 local_kernel=make_local_kernel(config, self.backend),
                 g=config.g,
@@ -196,47 +208,50 @@ class Simulator:
                 eps=config.eps,
             )
         else:
-            self.accel_fn = self._unsharded_accel_fn(state)
+            self._accel2 = self._unsharded_accel2()
 
-        self.state = state
-        self._step = make_step_fn(config.integrator, self.accel_fn, config.dt)
+        # Convenience one-arg wrapper (carry seeding, run_adaptive, the
+        # bench harness): reads the CURRENT self.state's masses.
+        self.accel_fn = lambda pos: self._accel2(pos, self.state.masses)
         self._run_block = jax.jit(
             self._block_fn,
             static_argnames=("n_steps", "record", "record_every"),
         )
 
-    def _unsharded_accel_fn(self, state: ParticleState):
+    def _unsharded_accel2(self):
+        """(positions, masses) -> accelerations for the resolved backend."""
         config = self.config
-        masses = state.masses
+        n = self.state.n
         common = dict(g=config.g, cutoff=config.cutoff, eps=config.eps)
         if self.backend == "dense":
-            return lambda pos: accelerations_vs(pos, pos, masses, **common)
+            return lambda pos, m: accelerations_vs(pos, pos, m, **common)
         if self.backend == "chunked":
-            chunk = min(config.chunk, state.n)
-            while state.n % chunk:
+            chunk = min(config.chunk, n)
+            while n % chunk:
                 chunk //= 2
-            return lambda pos: pairwise_accelerations_chunked(
-                pos, masses, chunk=max(chunk, 1), **common
+            chunk = max(chunk, 1)
+            return lambda pos, m: pairwise_accelerations_chunked(
+                pos, m, chunk=chunk, **common
             )
         if self.backend in ("pallas", "cpp"):
             kernel = make_local_kernel(config, self.backend)
-            return lambda pos: kernel(pos, pos, masses)
+            return lambda pos, m: kernel(pos, pos, m)
         if self.backend == "tree":
             from .ops.tree import recommended_depth, tree_accelerations
 
             depth = config.tree_depth or recommended_depth(
-                state.n, config.tree_leaf_cap
+                n, config.tree_leaf_cap
             )
-            return lambda pos: tree_accelerations(
-                pos, masses, depth=depth, leaf_cap=config.tree_leaf_cap,
+            return lambda pos, m: tree_accelerations(
+                pos, m, depth=depth, leaf_cap=config.tree_leaf_cap,
                 ws=config.tree_ws, far=config.tree_far,
                 chunk=config.fast_chunk, **common,
             )
         if self.backend == "pm":
             from .ops.pm import pm_accelerations
 
-            return lambda pos: pm_accelerations(
-                pos, masses, grid=config.pm_grid, g=config.g, eps=config.eps
+            return lambda pos, m: pm_accelerations(
+                pos, m, grid=config.pm_grid, g=config.g, eps=config.eps
             )
         if self.backend == "p3m":
             import warnings
@@ -244,13 +259,13 @@ class Simulator:
             from .ops.p3m import check_p3m_sizing, p3m_accelerations
 
             note = check_p3m_sizing(
-                state.n, config.pm_grid, config.p3m_sigma_cells,
+                n, config.pm_grid, config.p3m_sigma_cells,
                 config.p3m_rcut_sigmas, config.p3m_cap,
             )
             if note:
                 warnings.warn(note, stacklevel=2)
-            return lambda pos: p3m_accelerations(
-                pos, masses, grid=config.pm_grid,
+            return lambda pos, m: p3m_accelerations(
+                pos, m, grid=config.pm_grid,
                 sigma_cells=config.p3m_sigma_cells,
                 rcut_sigmas=config.p3m_rcut_sigmas,
                 cap=config.p3m_cap, chunk=config.fast_chunk, **common,
@@ -261,9 +276,18 @@ class Simulator:
 
     def _block_fn(self, state: ParticleState, acc, *, n_steps: int,
                   record: bool, record_every: int = 1):
+        # The step fn binds masses from the TRACED state, so mass edits
+        # between blocks (merging) don't invalidate the compiled block.
+        masses = state.masses
+        step = make_step_fn(
+            self.config.integrator,
+            lambda pos: self._accel2(pos, masses),
+            self.config.dt,
+        )
+
         def body(carry, _):
             st, a = carry
-            st, a = self._step(st, a)
+            st, a = step(st, a)
             return (st, a), None
 
         if not record:
@@ -305,6 +329,10 @@ class Simulator:
         record = trajectory_writer is not None
         every = max(1, config.trajectory_every) if record else 1
         block = max(1, min(config.progress_every, total_steps))
+        if config.merge_radius > 0.0:
+            # Collision checks happen at block boundaries; their cadence
+            # is a physics knob (merge_every), not the logging cadence.
+            block = max(1, min(block, config.merge_every))
         if record:
             # Block size must be a multiple of the recording stride.
             block = max(1, block // every) * every
@@ -318,6 +346,7 @@ class Simulator:
         timer.start()
         block_prev = 0.0
         step = start_step
+        merged_total = 0
         # self.state/self._last_step stay current per block so the
         # KeyboardInterrupt handler below can checkpoint mid-run.
         try:
@@ -361,6 +390,33 @@ class Simulator:
             self.state, self._last_step = state, step
             if logger is not None:
                 logger.progress(step, total_steps)
+            if config.merge_radius > 0.0:
+                from .ops.encounters import merge_close_pairs
+
+                # Cap the (chunk, N) detection buffers at ~2^24 elements
+                # so the pass neither OOMs nor crosses int32 indexing at
+                # million-body N.
+                merge_chunk = max(1, min(1024, (1 << 24) // max(state.n, 1)))
+                res = merge_close_pairs(
+                    state, config.merge_radius, k=config.merge_k,
+                    chunk=merge_chunk,
+                )
+                if int(res.n_merged) > 0:
+                    state = res.state
+                    self.state = state
+                    merged_total += int(res.n_merged)
+                    if logger is not None:
+                        logger.log_print(
+                            f"merged {int(res.n_merged)} pair(s) at step "
+                            f"{step} ({merged_total} total)"
+                        )
+                    # Masses are traced through the block, so no retrace —
+                    # just reseed the force carry from the merged state.
+                    # Re-baseline the energy-drift metric: a merger
+                    # physically dissipates kinetic energy, which is not
+                    # integrator drift.
+                    acc = init_carry(self.accel_fn, state)
+                    self._e0 = None
             if metrics_logger is not None:
                 from .utils.timing import pairs_per_step
 
@@ -431,6 +487,8 @@ class Simulator:
             num_devices=self.mesh.size if self.mesh else 1,
             force_evals_per_step=evals,
         )
+        if config.merge_radius > 0.0:
+            stats["merged_pairs"] = merged_total
         if trajectory_writer is not None:
             trajectory_writer.close()
         return self._finish(logger, total_time, total_steps - start_step,

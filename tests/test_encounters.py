@@ -1,0 +1,179 @@
+"""Close-encounter detection and merging tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gravity_tpu.ops.encounters import (
+    closest_pairs,
+    merge_close_pairs,
+    min_separation,
+)
+from gravity_tpu.state import ParticleState
+
+
+def _brute_pairs(pos, masses):
+    """All (d, i, j) pairs among massive particles, ascending."""
+    out = []
+    n = len(pos)
+    for i in range(n):
+        for j in range(i + 1, n):
+            if masses[i] > 0 and masses[j] > 0:
+                out.append((float(np.linalg.norm(pos[j] - pos[i])), i, j))
+    return sorted(out)
+
+
+def test_closest_pairs_matches_brute_force(key, x64):
+    n = 200
+    pos = jax.random.uniform(key, (n, 3), jnp.float64, minval=-1.0, maxval=1.0)
+    masses = jnp.ones((n,), jnp.float64)
+    d, i_, j_ = closest_pairs(pos, masses, k=8, chunk=64)
+    want = _brute_pairs(np.asarray(pos), np.asarray(masses))[:8]
+    np.testing.assert_allclose(np.asarray(d), [w[0] for w in want],
+                               rtol=1e-12)
+    for t in range(8):
+        assert (int(i_[t]), int(j_[t])) == (want[t][1], want[t][2])
+
+
+def test_zero_mass_excluded(key, x64):
+    pos = jnp.asarray(
+        [[-0.5, 0.0, 0.0], [-0.5 + 1e-6, 0.0, 0.0], [1.0, 0.0, 0.0],
+         [2.0, 0.0, 0.0]], jnp.float64
+    )
+    masses = jnp.asarray([1.0, 0.0, 1.0, 1.0], jnp.float64)  # tracer at 1
+    d, i_, j_ = closest_pairs(pos, masses, k=2, chunk=2)
+    # Nearest *massive* pair is (2, 3), not the tracer pair (0, 1).
+    assert (int(i_[0]), int(j_[0])) == (2, 3)
+    assert float(d[0]) == pytest.approx(1.0)
+
+
+def test_k_exceeds_pair_count(x64):
+    pos = jnp.asarray([[0.0, 0.0, 0.0], [1.0, 0.0, 0.0]], jnp.float64)
+    masses = jnp.ones((2,), jnp.float64)
+    d, i_, j_ = closest_pairs(pos, masses, k=5, chunk=2)
+    assert np.isfinite(np.asarray(d)).sum() == 1
+    assert list(np.asarray(i_[1:])) == [-1] * 4
+
+
+def test_merge_conserves_mass_and_momentum(key, x64):
+    n = 32
+    kp, kv, km = jax.random.split(key, 3)
+    pos = jax.random.uniform(kp, (n, 3), jnp.float64)
+    vel = jax.random.normal(kv, (n, 3), jnp.float64)
+    masses = jax.random.uniform(km, (n,), jnp.float64, minval=1.0, maxval=2.0)
+    # Plant a guaranteed close pair.
+    pos = pos.at[5].set(pos[3] + 1e-9)
+    state = ParticleState(pos, vel, masses)
+    res = merge_close_pairs(state, 1e-6, k=8, chunk=8)
+    assert int(res.n_merged) == 1
+    new = res.state
+    assert new.positions.shape == state.positions.shape  # static shapes
+    np.testing.assert_allclose(
+        float(jnp.sum(new.masses)), float(jnp.sum(masses)), rtol=1e-14
+    )
+    np.testing.assert_allclose(
+        np.asarray(jnp.sum(new.masses[:, None] * new.velocities, axis=0)),
+        np.asarray(jnp.sum(masses[:, None] * vel, axis=0)),
+        rtol=1e-12,
+    )
+    # Donor (higher index) is now a massless tracer at the merge point.
+    assert float(new.masses[5]) == 0.0
+    np.testing.assert_allclose(np.asarray(new.positions[5]),
+                               np.asarray(new.positions[3]), rtol=0)
+
+
+def test_greedy_one_merge_per_particle_then_cascade(x64):
+    """Chain a-b-c: one pass merges only the closest pair; a second pass
+    completes the cascade to a single massive body."""
+    pos = jnp.asarray(
+        [[0.0, 0.0, 0.0], [1e-9, 0.0, 0.0], [3e-9, 0.0, 0.0],
+         [10.0, 0.0, 0.0]], jnp.float64
+    )
+    vel = jnp.zeros_like(pos)
+    masses = jnp.asarray([1.0, 1.0, 1.0, 1.0], jnp.float64)
+    state = ParticleState(pos, vel, masses)
+    res1 = merge_close_pairs(state, 1e-6, k=8, chunk=4)
+    assert int(res1.n_merged) == 1
+    assert float(res1.state.masses[0]) == 2.0  # a absorbed b
+    assert float(res1.state.masses[2]) == 1.0  # c untouched this pass
+    res2 = merge_close_pairs(res1.state, 1e-6, k=8, chunk=4)
+    assert int(res2.n_merged) == 1
+    assert float(res2.state.masses[0]) == 3.0
+    res3 = merge_close_pairs(res2.state, 1e-6, k=8, chunk=4)
+    assert int(res3.n_merged) == 0  # fixed point
+
+
+def test_min_separation(key, x64):
+    n = 64
+    pos = jax.random.uniform(key, (n, 3), jnp.float64)
+    masses = jnp.ones((n,), jnp.float64)
+    want = _brute_pairs(np.asarray(pos), np.asarray(masses))[0][0]
+    assert float(min_separation(pos, masses, chunk=16)) == pytest.approx(
+        want, rel=1e-12
+    )
+
+
+def test_simulator_merge_integration(tmp_path, capsys):
+    """Head-on binary collision through the CLI: the pair merges, mass is
+    conserved, and the run completes with merged_pairs in the stats."""
+    import json
+
+    from gravity_tpu.cli import main
+
+    rc = main([
+        "run", "--model", "solar", "--n", "3", "--steps", "40",
+        "--dt", "50000", "--integrator", "leapfrog",
+        "--force-backend", "dense", "--merge-radius", "1e10",
+        "--log-dir", str(tmp_path / "logs"),
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    stats = json.loads(out.strip().splitlines()[-1])
+    assert "merged_pairs" in stats
+
+
+def test_simulator_merge_conserves_mass(x64):
+    """Two bodies on a collision course merge mid-run; total mass and
+    momentum are conserved through the Simulator block loop."""
+    from gravity_tpu.config import SimulationConfig
+    from gravity_tpu.simulation import Simulator
+
+    pos = jnp.asarray([[-1e8, 0.0, 0.0], [1e8, 0.0, 0.0]], jnp.float64)
+    vel = jnp.asarray([[1e4, 0.0, 0.0], [-1e4, 0.0, 0.0]], jnp.float64)
+    masses = jnp.asarray([1e26, 2e26], jnp.float64)
+    state = ParticleState(pos, vel, masses)
+    config = SimulationConfig(
+        n=2, steps=100, dt=1000.0, integrator="leapfrog",
+        force_backend="dense", merge_radius=5e7, dtype="float64",
+        progress_every=10,
+    )
+    sim = Simulator(config, state=state)
+    stats = sim.run()
+    assert stats["merged_pairs"] == 1
+    final = stats["final_state"]
+    np.testing.assert_allclose(
+        float(jnp.sum(final.masses)), 3e26, rtol=1e-12
+    )
+    np.testing.assert_allclose(
+        np.asarray(jnp.sum(final.masses[:, None] * final.velocities,
+                           axis=0)),
+        np.asarray(jnp.sum(masses[:, None] * vel, axis=0)),
+        atol=1e12,  # |p| ~ 3e30; relative ~3e-19
+    )
+
+
+def test_forces_finite_after_merge(key, x64):
+    """Merged state (with its zero-mass donor) feeds cleanly back into
+    the force kernel."""
+    from gravity_tpu.ops.forces import pairwise_accelerations_dense
+
+    n = 16
+    pos = jax.random.uniform(key, (n, 3), jnp.float64)
+    pos = pos.at[1].set(pos[0] + 1e-10)
+    state = ParticleState(pos, jnp.zeros_like(pos), jnp.ones((n,)))
+    res = merge_close_pairs(state, 1e-6, k=4, chunk=4)
+    acc = pairwise_accelerations_dense(
+        res.state.positions, res.state.masses
+    )
+    assert np.isfinite(np.asarray(acc)).all()
