@@ -381,6 +381,87 @@ def cmd_validate(args: argparse.Namespace) -> int:
     drift = abs((e1 - e0) / e0)
     checks["leapfrog_energy_drift"] = {"drift": drift, "ok": drift < 0.01}
 
+    # 4. Yoshida4 convergence order on a circular two-body orbit.
+    import jax.numpy as jnp
+
+    from .ops.forces import pairwise_accelerations_dense
+    from .ops.integrators import init_carry, make_step_fn
+    from .state import ParticleState
+
+    m_sun = 1.989e30
+    r = 1.496e11
+    v = float(np.sqrt(G * m_sun / r))
+    base = ParticleState(
+        jnp.asarray([[0.0, 0.0, 0.0], [r, 0.0, 0.0]]),
+        jnp.asarray([[0.0, 0.0, 0.0], [0.0, v, 0.0]]),
+        jnp.asarray([m_sun, 1.0e3]),
+    )
+    accel = lambda pos: pairwise_accelerations_dense(  # noqa: E731
+        pos, base.masses
+    )
+    # Long enough that leapfrog's truncation error clears the fp32
+    # roundoff floor (~2e4 m at this radius) by orders of magnitude.
+    t_total = 4.0e6
+
+    def endpoint_err(integrator, n_steps):
+        step = make_step_fn(integrator, accel, t_total / n_steps)
+        st, acc = base, init_carry(accel, base)
+        for _ in range(n_steps):
+            st, acc = step(st, acc)
+        theta = v / r * t_total
+        exact = np.asarray([r * np.cos(theta), r * np.sin(theta), 0.0])
+        return float(np.linalg.norm(np.asarray(st.positions[1]) - exact))
+
+    # Same dt, yoshida4 (4th order, 3 force evals) vs leapfrog (2nd, 1):
+    # the truncation-error gap must be large even where fp32 roundoff
+    # floors prevent a clean dt-halving rate measurement.
+    e_lf = endpoint_err("leapfrog", 25)
+    e_y4 = endpoint_err("yoshida4", 25)
+    checks["yoshida4_vs_leapfrog"] = {
+        "leapfrog_err_m": e_lf, "yoshida4_err_m": e_y4,
+        "ok": e_y4 < e_lf / 20.0,
+    }
+
+    # 5. Adaptive run lands on t_end; merging conserves mass + momentum.
+    from .ops.adaptive import adaptive_run
+    from .ops.encounters import merge_close_pairs
+
+    # Equal-mass circular binary: both bodies move, so the velocity
+    # criterion is well-conditioned on every particle.
+    # Circular orbit at separation 2r: v_rel = sqrt(mu / d) with
+    # mu = G * 2 * m_sun, d = 2r.
+    vb = float(np.sqrt(G * m_sun / r))
+    binary = ParticleState(
+        jnp.asarray([[-r, 0.0, 0.0], [r, 0.0, 0.0]]),
+        jnp.asarray([[0.0, -vb / 2, 0.0], [0.0, vb / 2, 0.0]]),
+        jnp.asarray([m_sun, m_sun]),
+    )
+    accel_b = lambda pos: pairwise_accelerations_dense(  # noqa: E731
+        pos, binary.masses
+    )
+    res = adaptive_run(
+        binary, accel_b, t_end=1.0e5, dt_max=1.0e4, eta=0.05,
+        criterion="velocity",
+    )
+    t_err = abs(float(res.t) - 1.0e5) / 1.0e5
+    checks["adaptive_t_landing"] = {"rel_err": t_err, "ok": t_err < 1e-5}
+
+    two = ParticleState(
+        jnp.asarray([[0.0, 0.0, 0.0], [1.0, 0.0, 0.0]]),
+        jnp.asarray([[0.0, 0.0, 0.0], [-1.0, 0.0, 0.0]]),
+        jnp.asarray([1.0, 3.0]),
+    )
+    merged = merge_close_pairs(two, 2.0, k=4, chunk=2).state
+    mass_err = abs(float(jnp.sum(merged.masses)) - 4.0)
+    mom = np.asarray(
+        jnp.sum(merged.masses[:, None] * merged.velocities, axis=0)
+    )
+    mom_err = float(np.abs(mom - np.asarray([-3.0, 0.0, 0.0])).max())
+    checks["merge_conservation"] = {
+        "mass_err": mass_err, "momentum_err": mom_err,
+        "ok": mass_err < 1e-6 and mom_err < 1e-5,
+    }
+
     ok = all(c["ok"] for c in checks.values())
     print(json.dumps({"ok": ok, "checks": checks}, indent=2))
     return 0 if ok else 1

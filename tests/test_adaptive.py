@@ -179,6 +179,28 @@ def test_adaptive_rejects_other_integrators():
         sim.run_adaptive()
 
 
+def test_fp32_no_nan_when_acceleration_underflows():
+    """fp32 regression: a particle whose acceleration underflows to zero
+    (XLA flushes subnormals) must not turn the criterion into 0/0 NaN —
+    the floor divisor has to be a NORMAL fp32 value."""
+    r = 1.496e11
+    m_sun = 1.989e30
+    v = float(np.sqrt(G * m_sun / r))
+    # The sun's acceleration from a 1e3 kg satellite underflows in fp32.
+    state = ParticleState(
+        jnp.asarray([[0.0, 0.0, 0.0], [r, 0.0, 0.0]], jnp.float32),
+        jnp.asarray([[0.0, 0.0, 0.0], [0.0, v, 0.0]], jnp.float32),
+        jnp.asarray([m_sun, 1.0e3], jnp.float32),
+    )
+    accel = _accel_fn(state.masses)
+    res = adaptive_run(
+        state, accel, t_end=1.0e4, dt_max=1.0e3, eta=0.05,
+        criterion="velocity", max_steps=200_000,
+    )
+    assert np.isfinite(float(res.t)), "criterion produced NaN dt"
+    assert np.isfinite(np.asarray(res.state.positions)).all()
+
+
 def test_accel_criterion_requires_eps():
     with pytest.raises(ValueError, match="eps > 0"):
         make_timestep_fn("accel", eta=0.01, eps=0.0, dt_max=1.0)
