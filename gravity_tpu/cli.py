@@ -61,6 +61,10 @@ def _add_config_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--p3m-cap", dest="p3m_cap", type=int, default=None)
     p.add_argument("--fast-chunk", dest="fast_chunk", type=int, default=None,
                    help="target-chunk size for tree/p3m evaluation")
+    p.add_argument("--external", default=None,
+                   help="analytic background field spec, e.g. "
+                        "'nfw:gm=1e13,rs=2e20' or "
+                        "'pointmass:gm=1.3e20 + uniform:gz=-9.8'")
     p.add_argument("--merge-radius", dest="merge_radius", type=float,
                    default=None,
                    help="merge pairs closer than this radius (inelastic "
@@ -516,6 +520,24 @@ def cmd_analyze(args: argparse.Namespace) -> int:
             "0.90": float(lr[4]),
         },
     }
+    if config.external:
+        # Keep analyze consistent with run/metrics, whose total_energy
+        # includes the background field. virial_ratio above remains the
+        # SELF-gravity diagnostic.
+        import jax.numpy as jnp
+
+        from .ops.external import parse_external
+
+        phi = parse_external(config.external, kind="potential")
+        e_ext = float(jnp.sum(state.masses * phi(state.positions)))
+        report["external_potential_energy"] = e_ext
+        report["total_energy"] = (
+            report["kinetic_energy"] + report["potential_energy"] + e_ext
+        )
+        report["note"] = (
+            "virial_ratio covers self-gravity only; total_energy includes "
+            "the external field"
+        )
     print(json.dumps(report, indent=2))
     return 0
 

@@ -60,6 +60,12 @@ class SimulationConfig:
     timestep_criterion: str = "auto"
     adaptive_max_steps: int = 1_000_000  # runaway-subdivision bound
 
+    # Analytic background field added to self-gravity (capability add).
+    # Spec string, e.g. "nfw:gm=1e13,rs=2e20" or
+    # "pointmass:gm=1.3e20 + uniform:gz=-9.8"; "" = none.
+    # See gravity_tpu.ops.external.
+    external: str = ""
+
     # Collision handling (capability add; the reference lets colliding
     # particles pass through each other). radius > 0 enables a per-block
     # merge pass: pairs closer than the radius merge inelastically (mass

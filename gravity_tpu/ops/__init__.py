@@ -24,6 +24,7 @@ from .adaptive import (
     adaptive_run,
     velocity_timestep,
 )
+from .external import parse_external
 from .integrators import (
     FORCE_EVALS_PER_STEP,
     INTEGRATORS,
@@ -51,6 +52,7 @@ __all__ = [
     "p3m_accelerations",
     "pairwise_accelerations_chunked",
     "pairwise_accelerations_dense",
+    "parse_external",
     "potential_energy",
     "semi_implicit_euler",
     "total_angular_momentum",

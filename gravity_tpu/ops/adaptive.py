@@ -34,12 +34,7 @@ from ..state import ParticleState
 from .integrators import AccelFn, leapfrog_kdk
 
 
-def _tiny(dtype):
-    # Must stay in the NORMAL range: XLA flushes fp32 subnormals to zero
-    # (FTZ), and a flushed floor turns 0/max(0, floor) into 0/0 = NaN.
-    # Divisions by the floor may overflow to inf, which is benign here:
-    # an infinite per-particle timescale just loses the min().
-    return jnp.asarray(1e-290 if dtype == jnp.float64 else 1e-37, dtype)
+from .numerics import tiny as _tiny  # noqa: E402  (FTZ-safe divisor floor)
 
 
 def acceleration_timestep(acc, *, eta: float, eps: float, dt_max: float,

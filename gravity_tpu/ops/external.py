@@ -1,0 +1,265 @@
+"""Analytic external (background) potentials (capability add).
+
+The reference computes self-gravity only. Real workloads routinely embed
+the N-body system in a fixed background — a central point mass, a dark-
+matter halo, a uniform tidal field. Each potential here is a pure
+``positions (N, 3) -> accelerations (N, 3)`` function, so it composes
+with every force backend by simple addition, costs O(N), and
+differentiates/shards like everything else.
+
+Spec strings (CLI `--external`; sum several terms by joining them with
+``" + "`` — commas separate a single term's parameters):
+
+    pointmass:gm=1.3e20              central point mass (optionally x/y/z)
+    plummer:gm=...,a=...             Plummer sphere background
+    nfw:gm=...,rs=...                NFW halo (gm = 4*pi*G*rho0*rs^3)
+    hernquist:gm=...,a=...           Hernquist bulge
+    logarithmic:v0=...,rc=...        flat-rotation-curve halo
+    uniform:gx=...,gy=...,gz=...     constant field
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+
+ExternalAccel = Callable[[jax.Array], jax.Array]
+
+
+def _r(pos, center, dtype):
+    d = pos - jnp.asarray(center, dtype)
+    r2 = jnp.sum(d * d, axis=-1, keepdims=True)
+    return d, r2
+
+
+from .numerics import tiny as _tiny  # noqa: E402  (FTZ-safe divisor floor)
+
+
+def point_mass(gm: float, center=(0.0, 0.0, 0.0),
+               eps: float = 0.0) -> ExternalAccel:
+    """a = -GM * r_vec / (r^2 + eps^2)^(3/2)."""
+
+    def accel(pos):
+        dtype = pos.dtype
+        d, r2 = _r(pos, center, dtype)
+        r2 = r2 + jnp.asarray(eps * eps, dtype)
+        inv_r = jax.lax.rsqrt(jnp.maximum(r2, _tiny(dtype)))
+        return -jnp.asarray(gm, dtype) * d * inv_r * inv_r * inv_r
+
+    return accel
+
+
+def plummer(gm: float, a: float, center=(0.0, 0.0, 0.0)) -> ExternalAccel:
+    """Plummer sphere: a = -GM * r_vec / (r^2 + a^2)^(3/2)."""
+    return point_mass(gm, center, eps=a)
+
+
+def hernquist(gm: float, a: float, center=(0.0, 0.0, 0.0)) -> ExternalAccel:
+    """Hernquist (1990) bulge: a = -GM * r_vec / (r * (r + a)^2)."""
+
+    def accel(pos):
+        dtype = pos.dtype
+        d, r2 = _r(pos, center, dtype)
+        r = jnp.sqrt(jnp.maximum(r2, _tiny(dtype)))
+        denom = r * (r + jnp.asarray(a, dtype)) ** 2
+        return -jnp.asarray(gm, dtype) * d / jnp.maximum(denom, _tiny(dtype))
+
+    return accel
+
+
+def nfw(gm: float, rs: float, center=(0.0, 0.0, 0.0)) -> ExternalAccel:
+    """NFW halo with gm = 4*pi*G*rho0*rs^3:
+    a = -gm * [ln(1+x) - x/(1+x)] * r_hat / r^2,  x = r/rs."""
+
+    def accel(pos):
+        dtype = pos.dtype
+        d, r2 = _r(pos, center, dtype)
+        # One consistent radius floor for BOTH the mass fraction and the
+        # 1/r^2 divisor: m_frac ~ x^2/2 near 0, so a ~ gm*r/(2*rs^2) -> 0
+        # linearly, as the true profile does. A mismatched clamp would
+        # freeze m_frac while 1/r^2 diverges.
+        r = jnp.maximum(
+            jnp.sqrt(jnp.maximum(r2, _tiny(dtype))),
+            jnp.asarray(1e-8 * rs, dtype),
+        )
+        x = r / jnp.asarray(rs, dtype)
+        m_frac = jnp.log1p(x) - x / (1.0 + x)  # enclosed-mass profile
+        a_mag = jnp.asarray(gm, dtype) * m_frac / (r * r)
+        return -a_mag * d / r
+
+    return accel
+
+
+def logarithmic(v0: float, rc: float,
+                center=(0.0, 0.0, 0.0)) -> ExternalAccel:
+    """Logarithmic halo (flat rotation curve v0 at r >> rc):
+    a = -v0^2 * r_vec / (r^2 + rc^2)."""
+
+    def accel(pos):
+        dtype = pos.dtype
+        d, r2 = _r(pos, center, dtype)
+        return (
+            -jnp.asarray(v0 * v0, dtype) * d
+            / (r2 + jnp.asarray(rc * rc, dtype))
+        )
+
+    return accel
+
+
+def uniform(gx: float = 0.0, gy: float = 0.0,
+            gz: float = 0.0) -> ExternalAccel:
+    """Constant acceleration field."""
+
+    def accel(pos):
+        return jnp.broadcast_to(
+            jnp.asarray([gx, gy, gz], pos.dtype), pos.shape
+        )
+
+    return accel
+
+
+def combine(fields: Sequence[ExternalAccel]) -> ExternalAccel:
+    """Sum of external fields (accelerations or potentials alike)."""
+
+    def accel(pos):
+        total = fields[0](pos)
+        for f in fields[1:]:
+            total = total + f(pos)
+        return total
+
+    return accel
+
+
+# --- per-particle potentials phi(x), for energy accounting -------------
+# E_ext = sum_i m_i * phi(x_i); each phi satisfies a = -grad(phi).
+
+
+def point_mass_phi(gm, center=(0.0, 0.0, 0.0), eps: float = 0.0):
+    def phi(pos):
+        dtype = pos.dtype
+        _, r2 = _r(pos, center, dtype)
+        r2 = r2 + jnp.asarray(eps * eps, dtype)
+        return (
+            -jnp.asarray(gm, dtype)
+            * jax.lax.rsqrt(jnp.maximum(r2, _tiny(dtype)))
+        )[..., 0]
+
+    return phi
+
+
+def plummer_phi(gm, a, center=(0.0, 0.0, 0.0)):
+    return point_mass_phi(gm, center, eps=a)
+
+
+def hernquist_phi(gm, a, center=(0.0, 0.0, 0.0)):
+    def phi(pos):
+        dtype = pos.dtype
+        _, r2 = _r(pos, center, dtype)
+        r = jnp.sqrt(jnp.maximum(r2, _tiny(dtype)))
+        return (-jnp.asarray(gm, dtype) / (r + jnp.asarray(a, dtype)))[..., 0]
+
+    return phi
+
+
+def nfw_phi(gm, rs, center=(0.0, 0.0, 0.0)):
+    def phi(pos):
+        dtype = pos.dtype
+        _, r2 = _r(pos, center, dtype)
+        r = jnp.maximum(
+            jnp.sqrt(jnp.maximum(r2, _tiny(dtype))),
+            jnp.asarray(1e-8 * rs, dtype),
+        )
+        x = r / jnp.asarray(rs, dtype)
+        return (-jnp.asarray(gm, dtype) * jnp.log1p(x) / r)[..., 0]
+
+    return phi
+
+
+def logarithmic_phi(v0, rc, center=(0.0, 0.0, 0.0)):
+    def phi(pos):
+        dtype = pos.dtype
+        _, r2 = _r(pos, center, dtype)
+        return (
+            0.5 * jnp.asarray(v0 * v0, dtype)
+            * jnp.log(r2 + jnp.asarray(rc * rc, dtype))
+        )[..., 0]
+
+    return phi
+
+
+def uniform_phi(gx: float = 0.0, gy: float = 0.0, gz: float = 0.0):
+    def phi(pos):
+        g = jnp.asarray([gx, gy, gz], pos.dtype)
+        return -jnp.sum(pos * g, axis=-1)
+
+    return phi
+
+
+_FACTORIES = {
+    "pointmass": (point_mass, point_mass_phi, {"gm"}, {"x", "y", "z", "eps"}),
+    "plummer": (plummer, plummer_phi, {"gm", "a"}, {"x", "y", "z"}),
+    "hernquist": (hernquist, hernquist_phi, {"gm", "a"}, {"x", "y", "z"}),
+    "nfw": (nfw, nfw_phi, {"gm", "rs"}, {"x", "y", "z"}),
+    "logarithmic": (logarithmic, logarithmic_phi, {"v0", "rc"},
+                    {"x", "y", "z"}),
+    "uniform": (uniform, uniform_phi, set(), {"gx", "gy", "gz"}),
+}
+
+
+def parse_external(spec: str, kind: str = "accel") -> ExternalAccel:
+    """Build an external-field function from a spec string.
+
+    ``"nfw:gm=1e13,rs=2e20"`` or a sum of terms joined by ``" + "``
+    (whitespace required around the plus, so exponent signs like
+    ``1e+20`` pass through untouched):
+    ``"pointmass:gm=1.3e20 + uniform:gz=-9.8"``.
+
+    ``kind="accel"`` returns positions -> accelerations (N, 3);
+    ``kind="potential"`` returns positions -> per-particle phi (N,), with
+    a = -grad(phi) — used for external-energy accounting.
+    """
+    import re
+
+    if kind not in ("accel", "potential"):
+        raise ValueError(f"unknown kind {kind!r}")
+    fields = []
+    for term in re.split(r"\s\+\s", spec):
+        term = term.strip()
+        if not term:
+            continue
+        name, _, argstr = term.partition(":")
+        name = name.strip().lower()
+        if name not in _FACTORIES:
+            raise ValueError(
+                f"unknown external potential {name!r}; "
+                f"choose from {sorted(_FACTORIES)}"
+            )
+        accel_fac, phi_fac, required, optional = _FACTORIES[name]
+        factory = accel_fac if kind == "accel" else phi_fac
+        kwargs = {}
+        for kv in filter(None, (s.strip() for s in argstr.split(","))):
+            key, _, val = kv.partition("=")
+            key = key.strip().lower()
+            if key not in required | optional:
+                raise ValueError(
+                    f"unknown parameter {key!r} for {name!r} "
+                    f"(accepts {sorted(required | optional)})"
+                )
+            kwargs[key] = float(val)
+        missing = required - kwargs.keys()
+        if missing:
+            raise ValueError(
+                f"external potential {name!r} needs {sorted(missing)}"
+            )
+        center = (
+            kwargs.pop("x", 0.0), kwargs.pop("y", 0.0), kwargs.pop("z", 0.0)
+        )
+        if name == "uniform":
+            fields.append(factory(**kwargs))
+        else:
+            fields.append(factory(center=center, **kwargs))
+    if not fields:
+        raise ValueError(f"empty external-potential spec {spec!r}")
+    return fields[0] if len(fields) == 1 else combine(fields)

@@ -1,0 +1,16 @@
+"""Shared numeric helpers for the ops layer."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def tiny(dtype):
+    """Smallest safe positive divisor floor for a dtype.
+
+    Must stay in the NORMAL range: XLA flushes fp32 subnormals to zero
+    (FTZ), and a flushed floor turns 0/max(0, floor) into 0/0 = NaN.
+    Divisions by the floor may overflow to inf, which callers treat as a
+    benign "infinite timescale / zero field" limit.
+    """
+    return jnp.asarray(1e-290 if dtype == jnp.float64 else 1e-37, dtype)

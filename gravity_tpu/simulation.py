@@ -210,6 +210,18 @@ class Simulator:
         else:
             self._accel2 = self._unsharded_accel2()
 
+        self._ext_phi = None
+        if config.external:
+            from .ops.external import parse_external
+
+            ext = parse_external(config.external)
+            # Parsed once here; energy() reuses the potential twin.
+            self._ext_phi = parse_external(config.external, kind="potential")
+            self_gravity = self._accel2
+            # O(N) elementwise add: composes with every backend and
+            # shards trivially with the positions.
+            self._accel2 = lambda pos, m: self_gravity(pos, m) + ext(pos)
+
         # Convenience one-arg wrapper (carry seeding, run_adaptive, the
         # bench harness): reads the CURRENT self.state's masses.
         self.accel_fn = lambda pos: self._accel2(pos, self.state.masses)
@@ -422,10 +434,10 @@ class Simulator:
 
                 extra = {}
                 if config.metrics_energy:
-                    e = float(diagnostics.total_energy(
-                        self.final_state(), g=config.g,
-                        cutoff=config.cutoff, eps=config.eps,
-                    ))
+                    # self.energy() includes the external field's
+                    # potential energy, keeping drift meaningful under
+                    # --external.
+                    e = float(self.energy())
                     if self._e0 is None:
                         self._e0 = e
                     extra["total_energy"] = e
@@ -616,7 +628,15 @@ class Simulator:
         )
 
     def energy(self):
-        return diagnostics.total_energy(
-            self.final_state(), g=self.config.g, cutoff=self.config.cutoff,
+        """Total conserved energy: kinetic + self-gravity potential +
+        (when configured) the external field's potential energy — so the
+        drift metric keeps measuring integrator health under
+        --external."""
+        state = self.final_state()
+        e = diagnostics.total_energy(
+            state, g=self.config.g, cutoff=self.config.cutoff,
             eps=self.config.eps,
         )
+        if self._ext_phi is not None:
+            e = e + jnp.sum(state.masses * self._ext_phi(state.positions))
+        return e

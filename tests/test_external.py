@@ -1,0 +1,197 @@
+"""External (background) potential tests: analytic limits, spec parsing,
+and Simulator composition."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gravity_tpu.constants import G
+from gravity_tpu.ops.external import (
+    combine,
+    hernquist,
+    logarithmic,
+    nfw,
+    parse_external,
+    plummer,
+    point_mass,
+    uniform,
+)
+
+
+def test_point_mass_matches_self_gravity(x64):
+    """External point mass == a real particle of the same GM."""
+    from gravity_tpu.ops.forces import accelerations_vs
+
+    gm = G * 1.989e30
+    pos = jnp.asarray(
+        [[1.5e11, 0.0, 0.0], [0.0, 2.0e11, 1.0e10]], jnp.float64
+    )
+    ext = point_mass(gm)(pos)
+    want = accelerations_vs(
+        pos, jnp.zeros((1, 3), jnp.float64),
+        jnp.asarray([1.989e30], jnp.float64),
+    )
+    np.testing.assert_allclose(np.asarray(ext), np.asarray(want), rtol=1e-12)
+
+
+def test_far_field_limits(x64):
+    """Plummer/Hernquist/NFW all approach point-mass at r >> scale."""
+    gm, a = 1.0e20, 1.0e9
+    pos = jnp.asarray([[1.0e14, 0.0, 0.0]], jnp.float64)
+    pm = np.asarray(point_mass(gm)(pos))
+    np.testing.assert_allclose(np.asarray(plummer(gm, a)(pos)), pm,
+                               rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(hernquist(gm, a)(pos)), pm,
+                               rtol=1e-4)
+    # NFW: gm here is 4*pi*G*rho0*rs^3; enclosed mass grows ~log r, so
+    # compare against its own analytic magnitude instead.
+    x = 1.0e14 / a
+    m_frac = np.log1p(x) - x / (1 + x)
+    want = gm * m_frac / 1.0e28
+    got = -float(nfw(gm, a)(pos)[0, 0])
+    np.testing.assert_allclose(got, want, rtol=1e-10)
+
+
+def test_logarithmic_flat_rotation_curve(x64):
+    """v_circ = sqrt(r * |a|) -> v0 for r >> rc."""
+    v0, rc = 2.2e5, 1.0e19
+    for r in (1.0e21, 1.0e22):
+        pos = jnp.asarray([[r, 0.0, 0.0]], jnp.float64)
+        a_mag = float(-logarithmic(v0, rc)(pos)[0, 0])
+        v_circ = np.sqrt(r * a_mag)
+        np.testing.assert_allclose(v_circ, v0, rtol=1e-3)
+
+
+def test_uniform_and_combine(x64):
+    pos = jnp.zeros((4, 3), jnp.float64)
+    f = combine([uniform(gz=-9.8), uniform(gz=-0.2, gx=1.0)])
+    acc = np.asarray(f(pos))
+    np.testing.assert_allclose(acc[:, 2], -10.0)
+    np.testing.assert_allclose(acc[:, 0], 1.0)
+
+
+def test_parse_external_specs(x64):
+    pos = jnp.asarray([[1.0e11, 0.0, 0.0]], jnp.float64)
+    f = parse_external("pointmass:gm=1.3e20 + uniform:gz=-9.8")
+    acc = np.asarray(f(pos))
+    assert acc[0, 0] < 0 and acc[0, 2] == pytest.approx(-9.8)
+    # Offset center.
+    f2 = parse_external("pointmass:gm=1.3e20,x=2.0e11")
+    assert float(f2(pos)[0, 0]) > 0  # pulled toward +x center
+
+    with pytest.raises(ValueError, match="unknown external"):
+        parse_external("blackhole:gm=1")
+    with pytest.raises(ValueError, match="needs"):
+        parse_external("nfw:gm=1e13")
+    with pytest.raises(ValueError, match="unknown parameter"):
+        parse_external("pointmass:gm=1,zz=3")
+
+
+def test_tracer_orbit_in_external_field(x64):
+    """A massless tracer on a circular orbit in an external point-mass
+    field stays on it through the Simulator (self-gravity is zero)."""
+    from gravity_tpu.config import SimulationConfig
+    from gravity_tpu.simulation import Simulator
+    from gravity_tpu.state import ParticleState
+
+    gm = G * 1.989e30
+    r = 1.496e11
+    v = float(np.sqrt(gm / r))
+    state = ParticleState(
+        jnp.asarray([[r, 0.0, 0.0]], jnp.float64),
+        jnp.asarray([[0.0, v, 0.0]], jnp.float64),
+        jnp.asarray([0.0], jnp.float64),  # massless tracer
+    )
+    period = 2 * np.pi * r / v
+    steps = 500
+    config = SimulationConfig(
+        n=1, steps=steps, dt=period / steps, integrator="leapfrog",
+        force_backend="dense", external=f"pointmass:gm={gm}",
+        dtype="float64",
+    )
+    sim = Simulator(config, state=state)
+    final = sim.run()["final_state"]
+    closure = float(
+        np.linalg.norm(np.asarray(final.positions[0]) - np.asarray([r, 0, 0]))
+    )
+    assert closure / r < 1e-3
+
+
+@pytest.mark.parametrize("spec", [
+    "pointmass:gm=1.3e20,eps=1e9",
+    "plummer:gm=1.3e20,a=1e10",
+    "hernquist:gm=1.3e20,a=1e10",
+    "nfw:gm=1e13,rs=2e11",
+    "logarithmic:v0=2.2e5,rc=1e10",
+    "uniform:gx=1.0,gz=-9.8",
+    "pointmass:gm=1.3e20 + logarithmic:v0=2e5,rc=1e10",
+])
+def test_potential_gradient_matches_acceleration(spec, x64):
+    """a == -grad(phi) for every field, checked by autodiff."""
+    accel = parse_external(spec)
+    phi = parse_external(spec, kind="potential")
+    pos = jnp.asarray(
+        [[1.3e11, -0.7e11, 0.4e11], [2.0e10, 1.0e10, -3.0e10]],
+        jnp.float64,
+    )
+    grad_phi = jax.vmap(jax.grad(lambda x: phi(x[None])[0]))(pos)
+    np.testing.assert_allclose(
+        np.asarray(accel(pos)), -np.asarray(grad_phi), rtol=1e-9
+    )
+
+
+def test_energy_conserved_with_external(x64):
+    """Simulator.energy() includes the external potential energy: a
+    tracer orbit in a point-mass field conserves it to high accuracy."""
+    from gravity_tpu.config import SimulationConfig
+    from gravity_tpu.simulation import Simulator
+    from gravity_tpu.state import ParticleState
+
+    gm = G * 1.989e30
+    r = 1.496e11
+    v = float(np.sqrt(gm / r)) * 0.9  # eccentric: KE <-> PE exchange
+    state = ParticleState(
+        jnp.asarray([[r, 0.0, 0.0]], jnp.float64),
+        jnp.asarray([[0.0, v, 0.0]], jnp.float64),
+        jnp.asarray([1.0e3], jnp.float64),
+    )
+    config = SimulationConfig(
+        n=1, steps=300, dt=20000.0, integrator="leapfrog",
+        force_backend="dense", external=f"pointmass:gm={gm}",
+        dtype="float64",
+    )
+    sim = Simulator(config, state=state)
+    e0 = float(sim.energy())
+    sim.run()
+    e1 = float(sim.energy())
+    assert abs((e1 - e0) / e0) < 1e-6
+
+
+def test_nfw_small_r_regular(x64):
+    """NFW acceleration vanishes toward the center instead of diverging
+    (regression: the 1/r^2 divisor must share the mass-fraction clamp)."""
+    f = parse_external("nfw:gm=1e13,rs=2e20")
+    radii = [1e12, 1e10, 1e8, 1.0]
+    mags = [
+        float(jnp.linalg.norm(f(jnp.asarray([[r, 0.0, 0.0]], jnp.float64))))
+        for r in radii
+    ]
+    assert all(m1 >= m2 for m1, m2 in zip(mags, mags[1:])), mags
+    assert mags[-1] < 1e-12
+
+
+def test_external_composes_with_sharding(key, x64):
+    """Sharded run + external field == unsharded run + external field."""
+    from gravity_tpu.config import SimulationConfig
+    from gravity_tpu.simulation import Simulator
+
+    base = dict(model="plummer", n=64, steps=10, dt=1e4, seed=2,
+                dtype="float64", force_backend="dense",
+                integrator="leapfrog",
+                external="logarithmic:v0=2e5,rc=1e19")
+    s1 = Simulator(SimulationConfig(sharding="allgather", **base))
+    s2 = Simulator(SimulationConfig(**base))
+    p1 = np.asarray(s1.run()["final_state"].positions)
+    p2 = np.asarray(s2.run()["final_state"].positions)
+    np.testing.assert_allclose(p1, p2, rtol=1e-9)
