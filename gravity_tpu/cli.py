@@ -36,8 +36,14 @@ def _add_config_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--cutoff", type=float, default=None)
     p.add_argument("--eps", type=float, default=None)
     p.add_argument("--integrator",
-                   choices=["euler", "leapfrog", "verlet", "yoshida4"],
+                   choices=["euler", "leapfrog", "verlet", "yoshida4",
+                            "multirate"],
                    default=None)
+    p.add_argument("--multirate-k", dest="multirate_k", type=int,
+                   default=None,
+                   help="fast-rung capacity (0 = auto: n/8)")
+    p.add_argument("--multirate-sub", dest="multirate_sub", type=int,
+                   default=None, help="substeps per outer step")
     p.add_argument("--dtype",
                    choices=["float32", "float64", "bfloat16"], default=None)
     p.add_argument("--force-backend", dest="force_backend",

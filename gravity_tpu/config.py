@@ -32,7 +32,11 @@ class SimulationConfig:
     eps: float = 0.0  # Plummer softening (0 = reference semantics)
 
     # Numerics / backend
-    integrator: str = "euler"  # euler (reference parity) | leapfrog | verlet | yoshida4
+    # euler (reference parity) | leapfrog | verlet | yoshida4 |
+    # multirate (two-rung block timesteps; see ops.multirate)
+    integrator: str = "euler"
+    multirate_k: int = 0  # fast-rung capacity; 0 = auto (n // 8)
+    multirate_sub: int = 4  # substeps per outer step for the fast rung
     dtype: str = "float32"
     # auto | dense | chunked | pallas (direct sum) | cpp (native XLA FFI
     # host kernel, CPU platform) | tree (octree) | pm (FFT mesh) |

@@ -122,6 +122,9 @@ FORCE_EVALS_PER_STEP = {
     "leapfrog": 1,
     "verlet": 1,
     "yoshida4": 3,
+    # One FULL (N, N) eval per outer step; the S rectangular (K, N) fast
+    # kicks are not counted, so reported pairs/s is conservative.
+    "multirate": 1,
 }
 
 

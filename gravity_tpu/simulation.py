@@ -211,6 +211,7 @@ class Simulator:
             self._accel2 = self._unsharded_accel2()
 
         self._ext_phi = None
+        ext = None
         if config.external:
             from .ops.external import parse_external
 
@@ -221,6 +222,28 @@ class Simulator:
             # O(N) elementwise add: composes with every backend and
             # shards trivially with the positions.
             self._accel2 = lambda pos, m: self_gravity(pos, m) + ext(pos)
+
+        self._local_vs_kernel = None
+        if config.integrator == "multirate":
+            if self.mesh is not None:
+                raise ValueError(
+                    "integrator='multirate' needs unsharded state (the "
+                    "fast-rung gather would reshard every substep); use "
+                    "sharding='none'"
+                )
+            if config.multirate_k < 0 or config.multirate_sub < 1:
+                raise ValueError(
+                    "multirate_k must be >= 0 (0 = auto) and "
+                    "multirate_sub >= 1; got "
+                    f"k={config.multirate_k}, sub={config.multirate_sub}"
+                )
+            base_kernel = make_local_kernel(config, self.backend)
+            if ext is not None:
+                self._local_vs_kernel = (
+                    lambda ti, sj, m: base_kernel(ti, sj, m) + ext(ti)
+                )
+            else:
+                self._local_vs_kernel = base_kernel
 
         # Convenience one-arg wrapper (carry seeding, run_adaptive, the
         # bench harness): reads the CURRENT self.state's masses.
@@ -291,11 +314,24 @@ class Simulator:
         # The step fn binds masses from the TRACED state, so mass edits
         # between blocks (merging) don't invalidate the compiled block.
         masses = state.masses
-        step = make_step_fn(
-            self.config.integrator,
-            lambda pos: self._accel2(pos, masses),
-            self.config.dt,
-        )
+        if self.config.integrator == "multirate":
+            from .ops.multirate import make_multirate_step_fn
+
+            k = self.config.multirate_k or max(1, state.n // 8)
+            step = make_multirate_step_fn(
+                self._local_vs_kernel, self.config.dt,
+                k=min(k, state.n), n_sub=self.config.multirate_sub,
+                # The once-per-step full eval goes through the backend's
+                # memory-bounded path (chunked/tree/...), not the dense
+                # rectangular kernel used for the (K, N) fast kicks.
+                accel_full=self._accel2,
+            )
+        else:
+            step = make_step_fn(
+                self.config.integrator,
+                lambda pos: self._accel2(pos, masses),
+                self.config.dt,
+            )
 
         def body(carry, _):
             st, a = carry

@@ -1,0 +1,240 @@
+"""Two-rung block-timestep tests: selection, limits, accuracy payoff."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gravity_tpu.constants import G
+from gravity_tpu.ops.forces import accelerations_vs
+from gravity_tpu.ops.integrators import init_carry, make_step_fn
+from gravity_tpu.ops.multirate import (
+    make_multirate_step_fn,
+    select_fast,
+    two_rung_step,
+)
+from gravity_tpu.ops.diagnostics import total_energy
+from gravity_tpu.state import ParticleState
+
+
+def _accel_vs(pos_i, pos_j, masses_j):
+    return accelerations_vs(pos_i, pos_j, masses_j)
+
+
+def test_select_fast_prefers_high_accel_massive(x64):
+    acc = jnp.asarray(
+        [[1.0, 0, 0], [5.0, 0, 0], [3.0, 0, 0], [9.0, 0, 0]], jnp.float64
+    )
+    masses = jnp.asarray([1.0, 1.0, 1.0, 0.0], jnp.float64)  # 3 is massless
+    idx = select_fast(acc, masses, k=2)
+    assert set(np.asarray(idx).tolist()) == {1, 2}
+
+
+def test_all_fast_equals_substepped_leapfrog(x64):
+    """k = N makes every particle fast: the scheme must reduce exactly to
+    plain leapfrog at dt/S (slow kicks hit nobody)."""
+    key = jax.random.PRNGKey(3)
+    kp, kv, km = jax.random.split(key, 3)
+    n, s = 8, 4
+    pos = jax.random.uniform(kp, (n, 3), jnp.float64, minval=-1e11,
+                             maxval=1e11)
+    vel = jax.random.normal(kv, (n, 3), jnp.float64) * 1e3
+    masses = jax.random.uniform(km, (n,), jnp.float64, minval=1e24,
+                                maxval=1e26)
+    state = ParticleState(pos, vel, masses)
+    dt = 5.0e4
+
+    acc0 = _accel_vs(pos, pos, masses)
+    mr_state, _ = two_rung_step(
+        state, acc0, dt, accel_vs=_accel_vs, k=n, n_sub=s
+    )
+
+    accel = lambda p: _accel_vs(p, p, masses)  # noqa: E731
+    step = make_step_fn("leapfrog", accel, dt / s)
+    st, acc = state, init_carry(accel, state)
+    for _ in range(s):
+        st, acc = step(st, acc)
+
+    np.testing.assert_allclose(
+        np.asarray(mr_state.positions), np.asarray(st.positions), rtol=1e-12
+    )
+    np.testing.assert_allclose(
+        np.asarray(mr_state.velocities), np.asarray(st.velocities),
+        rtol=1e-12,
+    )
+
+
+def _binary_in_cloud(key, n_cloud=64):
+    """A tight binary (short dynamical time) inside a wide slow cloud."""
+    m = 5.0e26
+    a_bin = 5.0e8  # tight separation
+    v_bin = float(np.sqrt(G * 2 * m * (1 / a_bin - 1 / (2 * a_bin))))
+    kp, kv = jax.random.split(key)
+    cloud_pos = jax.random.uniform(
+        kp, (n_cloud, 3), jnp.float64, minval=-3e11, maxval=3e11
+    )
+    cloud_vel = jnp.zeros((n_cloud, 3), jnp.float64)
+    cloud_m = jnp.full((n_cloud,), 1.0e22, jnp.float64)
+    pos = jnp.concatenate([
+        jnp.asarray([[-a_bin / 2, 0, 0], [a_bin / 2, 0, 0]], jnp.float64),
+        cloud_pos,
+    ])
+    vel = jnp.concatenate([
+        jnp.asarray([[0, -v_bin / 2, 0], [0, v_bin / 2, 0]], jnp.float64),
+        cloud_vel,
+    ])
+    masses = jnp.concatenate([jnp.asarray([m, m], jnp.float64), cloud_m])
+    period = 2 * np.pi * np.sqrt(a_bin**3 / (G * 2 * m))
+    return ParticleState(pos, vel, masses), period
+
+
+def test_multirate_beats_single_rate_at_equal_full_evals(x64):
+    """Tight binary in a slow cloud: with dt ~ P/6, single-rate leapfrog
+    cannot resolve the binary (catastrophic energy error) while the
+    two-rung scheme sub-cycles just the binary and stays accurate —
+    at ONE full (N, N) eval per outer step either way."""
+    state, period = _binary_in_cloud(jax.random.PRNGKey(1))
+    dt = period / 6.0
+    steps = 24
+    masses = state.masses
+    e0 = float(total_energy(state))
+
+    accel = lambda p: _accel_vs(p, p, masses)  # noqa: E731
+    step_lf = make_step_fn("leapfrog", accel, dt)
+    st, acc = state, init_carry(accel, state)
+    for _ in range(steps):
+        st, acc = step_lf(st, acc)
+    e_single = abs((float(total_energy(st)) - e0) / e0)
+
+    step_mr = make_multirate_step_fn(_accel_vs, dt, k=2, n_sub=32)
+    st, acc = state, init_carry(accel, state)
+    for _ in range(steps):
+        st, acc = step_mr(st, acc)
+    e_multi = abs((float(total_energy(st)) - e0) / e0)
+
+    assert e_multi < 1e-3, e_multi
+    assert e_single > 20 * e_multi, (e_single, e_multi)
+
+
+def test_simulator_multirate_end_to_end(tmp_path, capsys):
+    import json
+
+    from gravity_tpu.cli import main
+
+    rc = main([
+        "run", "--model", "plummer", "--n", "64", "--steps", "20",
+        "--dt", "1e4", "--eps", "1e9", "--integrator", "multirate",
+        "--multirate-k", "8", "--multirate-sub", "4",
+        "--force-backend", "dense", "--log-dir", str(tmp_path / "logs"),
+    ])
+    assert rc == 0
+    stats = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert stats["steps"] == 20
+
+
+def test_invalid_params_fail_fast():
+    from gravity_tpu.config import SimulationConfig
+    from gravity_tpu.ops.multirate import make_multirate_step_fn
+    from gravity_tpu.simulation import Simulator
+
+    with pytest.raises(ValueError, match="n_sub"):
+        make_multirate_step_fn(_accel_vs, 1.0, k=2, n_sub=0)
+    with pytest.raises(ValueError, match="multirate_k"):
+        Simulator(SimulationConfig(
+            model="random", n=16, integrator="multirate",
+            multirate_k=-1, force_backend="dense",
+        ))
+    with pytest.raises(ValueError, match="multirate_sub"):
+        Simulator(SimulationConfig(
+            model="random", n=16, integrator="multirate",
+            multirate_sub=0, force_backend="dense",
+        ))
+
+
+def test_multirate_full_eval_uses_backend_path(x64):
+    """With the chunked backend, the once-per-step full eval must go
+    through the chunked path, not a dense (N, N) kernel; results match
+    the dense backend exactly."""
+    from gravity_tpu.config import SimulationConfig
+    from gravity_tpu.simulation import Simulator
+
+    base = dict(model="plummer", n=64, steps=10, dt=1e4, eps=1e9, seed=4,
+                integrator="multirate", multirate_k=8, multirate_sub=2,
+                dtype="float64")
+    s_chunked = Simulator(SimulationConfig(
+        force_backend="chunked", chunk=16, **base
+    ))
+    s_dense = Simulator(SimulationConfig(force_backend="dense", **base))
+    p1 = np.asarray(s_chunked.run()["final_state"].positions)
+    p2 = np.asarray(s_dense.run()["final_state"].positions)
+    np.testing.assert_allclose(p1, p2, rtol=1e-10)
+
+
+def test_simulator_multirate_rejects_sharding():
+    from gravity_tpu.config import SimulationConfig
+    from gravity_tpu.simulation import Simulator
+
+    with pytest.raises(ValueError, match="unsharded"):
+        Simulator(SimulationConfig(
+            model="plummer", n=64, integrator="multirate",
+            force_backend="dense", sharding="allgather",
+        ))
+
+
+def test_multirate_with_external_field(x64):
+    """External field reaches both the full eval and the fast kicks: a
+    two-particle 'binary' in a uniform field falls with the field while
+    sub-cycling."""
+    from gravity_tpu.config import SimulationConfig
+    from gravity_tpu.simulation import Simulator
+    from gravity_tpu.state import ParticleState
+
+    state = ParticleState(
+        jnp.asarray([[0.0, 0.0, 0.0], [1e9, 0.0, 0.0]], jnp.float64),
+        jnp.zeros((2, 3), jnp.float64),
+        jnp.asarray([1e20, 1e20], jnp.float64),
+    )
+    dt, steps = 100.0, 10
+    config = SimulationConfig(
+        n=2, steps=steps, dt=dt, integrator="multirate",
+        multirate_k=1, multirate_sub=2, force_backend="dense",
+        external="uniform:gz=-10.0", dtype="float64",
+    )
+    sim = Simulator(config, state=state)
+    final = sim.run()["final_state"]
+    t = dt * steps
+    # Free fall: z = -g t^2 / 2 for both, fast and slow alike.
+    np.testing.assert_allclose(
+        np.asarray(final.positions[:, 2]), -10.0 * t * t / 2,
+        rtol=1e-6,
+    )
+
+
+def test_zero_mass_padding_is_transparent(x64):
+    """Zero-mass padding changes nothing for the real particles: padded
+    and unpadded two-rung steps agree on the real rows, and padding is
+    never selected into the fast rung (it drifts as a massless tracer,
+    like everywhere else in the framework)."""
+    state, _ = _binary_in_cloud(jax.random.PRNGKey(2), n_cloud=6)
+    acc0 = _accel_vs(state.positions, state.positions, state.masses)
+    plain, _ = two_rung_step(
+        state, acc0, 1.0e3, accel_vs=_accel_vs, k=4, n_sub=2
+    )
+
+    padded, _ = state.pad_to(16)
+    acc0p = _accel_vs(padded.positions, padded.positions, padded.masses)
+    fast = set(np.asarray(
+        select_fast(acc0p, padded.masses, k=4)
+    ).tolist())
+    assert fast.isdisjoint(set(range(8, 16)))
+    padded_out, _ = two_rung_step(
+        padded, acc0p, 1.0e3, accel_vs=_accel_vs, k=4, n_sub=2
+    )
+    np.testing.assert_allclose(
+        np.asarray(padded_out.positions[:8]),
+        np.asarray(plain.positions), rtol=1e-12,
+    )
+    np.testing.assert_allclose(
+        np.asarray(padded_out.velocities[:8]),
+        np.asarray(plain.velocities), rtol=1e-12,
+    )
