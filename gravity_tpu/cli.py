@@ -544,6 +544,20 @@ def cmd_analyze(args: argparse.Namespace) -> int:
             "virial_ratio covers self-gravity only; total_energy includes "
             "the external field"
         )
+    if args.spectrum:
+        from .ops.spectra import density_power_spectrum
+
+        k, p, shot = density_power_spectrum(
+            state.positions, state.masses, grid=args.spectrum_grid
+        )
+        # Empty radial bins are NaN by design; emit null so the report
+        # stays strict JSON.
+        report["power_spectrum"] = {
+            "k": np.asarray(k).tolist(),
+            "P": [None if not np.isfinite(v) else float(v)
+                  for v in np.asarray(p)],
+            "shot_noise": float(shot),
+        }
     print(json.dumps(report, indent=2))
     return 0
 
@@ -631,6 +645,11 @@ def main(argv=None) -> int:
                       help="analyze the latest (or --step) checkpoint "
                            "instead of a fresh model realization")
     p_an.add_argument("--step", type=int, default=None)
+    p_an.add_argument("--spectrum", action="store_true",
+                      help="add the radially-binned density power "
+                           "spectrum P(k) to the report")
+    p_an.add_argument("--spectrum-grid", dest="spectrum_grid", type=int,
+                      default=64)
     p_an.set_defaults(fn=cmd_analyze)
 
     p_traj = sub.add_parser(

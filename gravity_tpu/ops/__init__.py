@@ -35,6 +35,7 @@ from .integrators import (
     yoshida4,
 )
 from .p3m import p3m_accelerations
+from .spectra import density_power_spectrum
 
 __all__ = [
     "FORCE_EVALS_PER_STEP",
@@ -42,6 +43,7 @@ __all__ = [
     "acceleration_timestep",
     "accelerations_vs",
     "adaptive_run",
+    "density_power_spectrum",
     "center_of_mass",
     "energy_drift",
     "half_mass_radius",

@@ -37,8 +37,15 @@ def _cic_weights(fx):
     return 1.0 - fx, fx
 
 
-def cic_deposit(positions, masses, grid, origin, h):
-    """Scatter masses to an (M, M, M) grid with cloud-in-cell weights."""
+def cic_deposit(positions, masses, grid, origin, h, *, wrap: bool = False):
+    """Scatter masses to an (M, M, M) grid with cloud-in-cell weights.
+
+    ``wrap=False`` clamps out-of-range cells to the boundary (isolated
+    BCs — the PM/P3M solvers' convention, whose padded Green's function
+    treats the grid as isolated). ``wrap=True`` wraps indices mod M for
+    genuinely periodic fields (the power-spectrum estimator): a particle
+    in the last cell spreads its weight across the face into cell 0.
+    """
     m = grid
     # Continuous grid coordinates of each particle.
     u = (positions - origin[None, :]) / h  # (N, 3)
@@ -54,9 +61,14 @@ def cic_deposit(positions, masses, grid, origin, h):
                     * (f[:, 1] if dy else 1.0 - f[:, 1])
                     * (f[:, 2] if dz else 1.0 - f[:, 2])
                 )
-                ix = jnp.clip(i0[:, 0] + dx, 0, m - 1)
-                iy = jnp.clip(i0[:, 1] + dy, 0, m - 1)
-                iz = jnp.clip(i0[:, 2] + dz, 0, m - 1)
+                if wrap:
+                    ix = (i0[:, 0] + dx) % m
+                    iy = (i0[:, 1] + dy) % m
+                    iz = (i0[:, 2] + dz) % m
+                else:
+                    ix = jnp.clip(i0[:, 0] + dx, 0, m - 1)
+                    iy = jnp.clip(i0[:, 1] + dy, 0, m - 1)
+                    iz = jnp.clip(i0[:, 2] + dz, 0, m - 1)
                 rho = rho.at[ix, iy, iz].add(masses * w)
     return rho
 
