@@ -8,6 +8,7 @@ families.
 
 from .cold_collapse import create_cold_collapse
 from .disk import create_disk
+from .grf import create_grf
 from .hernquist import create_hernquist
 from .merger import create_merger
 from .plummer import create_plummer
@@ -31,6 +32,7 @@ MODELS = {
         key, n, dtype=dtype
     ),
     "disk": lambda key, n, dtype: create_disk(key, n, dtype=dtype),
+    "grf": lambda key, n, dtype: create_grf(key, n, dtype=dtype),
     "hernquist": lambda key, n, dtype: create_hernquist(key, n, dtype=dtype),
     "merger": lambda key, n, dtype: create_merger(key, n, dtype=dtype),
 }
@@ -46,6 +48,7 @@ __all__ = [
     "create_model",
     "create_cold_collapse",
     "create_disk",
+    "create_grf",
     "create_hernquist",
     "create_merger",
     "create_plummer",
