@@ -67,6 +67,10 @@ def _add_config_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--p3m-cap", dest="p3m_cap", type=int, default=None)
     p.add_argument("--fast-chunk", dest="fast_chunk", type=int, default=None,
                    help="target-chunk size for tree/p3m evaluation")
+    p.add_argument("--periodic-box", dest="periodic_box", type=float,
+                   default=None,
+                   help="periodic unit-cell side (0 = isolated BCs); "
+                        "needs --force-backend pm")
     p.add_argument("--external", default=None,
                    help="analytic background field spec, e.g. "
                         "'nfw:gm=1e13,rs=2e20' or "
@@ -240,7 +244,13 @@ def cmd_run(args: argparse.Namespace) -> int:
                           "message": str(e)}), file=sys.stderr)
         return 2
 
-    if config.debug_check:
+    if config.debug_check and config.periodic_box > 0.0:
+        logger.log_print(
+            "debug-check skipped: the jnp direct-sum oracle is isolated-"
+            "BC and cannot audit the periodic solver (use "
+            "tests/test_periodic.py's Ewald parity instead)"
+        )
+    elif config.debug_check:
         from .simulation import make_local_kernel
         from .utils.profiling import debug_check_forces
 

@@ -64,6 +64,11 @@ class SimulationConfig:
     timestep_criterion: str = "auto"
     adaptive_max_steps: int = 1_000_000  # runaway-subdivision bound
 
+    # Periodic-box gravity (capability add): side length of the periodic
+    # unit cell, 0 = isolated boundaries. Requires force_backend "pm"
+    # (the periodic FFT solver, ops.periodic); positions wrap mod box.
+    periodic_box: float = 0.0
+
     # Analytic background field added to self-gravity (capability add).
     # Spec string, e.g. "nfw:gm=1e13,rs=2e20" or
     # "pointmass:gm=1.3e20 + uniform:gz=-9.8"; "" = none.

@@ -27,20 +27,29 @@ import jax.numpy as jnp
 from ..state import ParticleState
 
 
-@partial(jax.jit, static_argnames=("k", "chunk"))
+def _min_image(diff, box):
+    """Wrap per-axis separations into [-box/2, box/2)."""
+    b = jnp.asarray(box, diff.dtype)
+    return jnp.mod(diff + 0.5 * b, b) - 0.5 * b
+
+
+@partial(jax.jit, static_argnames=("k", "chunk", "box"))
 def closest_pairs(
     positions: jax.Array,
     masses: jax.Array,
     *,
     k: int = 16,
     chunk: int = 1024,
+    box: float = 0.0,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """The k globally closest (distance, i, j) pairs, ascending.
 
     Zero-mass particles are ignored; each unordered pair appears once
     (j > i). Returns (dists (k,), is (k,), js (k,)); slots beyond the
     number of valid pairs hold inf / -1. O(N * chunk) memory via an
-    i-chunked running top-k.
+    i-chunked running top-k. ``box > 0`` switches to minimum-image
+    distances (periodic runs): a pair facing each other across a
+    boundary is as close as it physically is.
     """
     n = positions.shape[0]
     dtype = positions.dtype
@@ -58,6 +67,8 @@ def closest_pairs(
         mask_i = jax.lax.dynamic_slice_in_dim(mask_p, i0, chunk)
         rows = (i0 + jnp.arange(chunk)).astype(jnp.int32)
         diff = positions[None, :, :] - pos_i[:, None, :]
+        if box > 0.0:
+            diff = _min_image(diff, box)
         r2 = jnp.sum(diff * diff, axis=-1)  # (chunk, n)
         keep = (
             (cols[None, :] > rows[:, None])
@@ -92,9 +103,10 @@ def closest_pairs(
     )
 
 
-def min_separation(positions, masses, *, chunk: int = 1024):
+def min_separation(positions, masses, *, chunk: int = 1024,
+                   box: float = 0.0):
     """Smallest distance between any two massive particles."""
-    d, _, _ = closest_pairs(positions, masses, k=1, chunk=chunk)
+    d, _, _ = closest_pairs(positions, masses, k=1, chunk=chunk, box=box)
     return d[0]
 
 
@@ -103,13 +115,14 @@ class MergeResult(NamedTuple):
     n_merged: jax.Array  # number of merges applied this pass
 
 
-@partial(jax.jit, static_argnames=("k", "chunk"))
+@partial(jax.jit, static_argnames=("k", "chunk", "box"))
 def merge_close_pairs(
     state: ParticleState,
     radius: float,
     *,
     k: int = 16,
     chunk: int = 1024,
+    box: float = 0.0,
 ) -> MergeResult:
     """One merge pass: greedily merge pairs with r < radius.
 
@@ -119,9 +132,12 @@ def merge_close_pairs(
     merged body (lower index) carries total mass, the mass-weighted COM
     position, and the momentum-conserving velocity; the donor (higher
     index) becomes a massless tracer at the same phase-space point.
+    ``box > 0`` (periodic runs) detects AND merges with minimum-image
+    separations: a pair across a face merges at the face, not at the
+    box-spanning midpoint.
     """
     dists, is_, js = closest_pairs(
-        state.positions, state.masses, k=k, chunk=chunk
+        state.positions, state.masses, k=k, chunk=chunk, box=box
     )
     i_safe = jnp.maximum(is_, 0)
     j_safe = jnp.maximum(js, 0)
@@ -142,7 +158,15 @@ def merge_close_pairs(
         # and any slot zeroed earlier in this pass has used[j] set, so a
         # 0/0 can only occur under ok == False and is discarded.
         mt = jnp.maximum(mi + mj, jnp.asarray(1e-38, dtype))
-        new_pos = (mi * pos[i] + mj * pos[j]) / mt
+        if box > 0.0:
+            # COM via the minimum image of j relative to i, wrapped back
+            # into the box afterwards.
+            xj_eff = pos[i] + _min_image(pos[j] - pos[i], box)
+            new_pos = jnp.mod(
+                (mi * pos[i] + mj * xj_eff) / mt, jnp.asarray(box, dtype)
+            )
+        else:
+            new_pos = (mi * pos[i] + mj * pos[j]) / mt
         new_vel = (mi * vel[i] + mj * vel[j]) / mt
         pos = jnp.where(ok, pos.at[i].set(new_pos).at[j].set(new_pos), pos)
         vel = jnp.where(ok, vel.at[i].set(new_vel).at[j].set(new_vel), vel)

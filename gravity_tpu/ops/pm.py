@@ -73,8 +73,12 @@ def cic_deposit(positions, masses, grid, origin, h, *, wrap: bool = False):
     return rho
 
 
-def cic_gather(field, positions, origin, h):
-    """Interpolate a per-axis grid field (M, M, M, 3) to particle positions."""
+def cic_gather(field, positions, origin, h, *, wrap: bool = False):
+    """Interpolate a per-axis grid field (M, M, M, 3) to particle positions.
+
+    ``wrap`` selects periodic index wrapping, matching
+    :func:`cic_deposit`'s convention.
+    """
     m = field.shape[0]
     u = (positions - origin[None, :]) / h
     i0 = jnp.floor(u).astype(jnp.int32)
@@ -89,9 +93,14 @@ def cic_gather(field, positions, origin, h):
                     * (f[:, 1] if dy else 1.0 - f[:, 1])
                     * (f[:, 2] if dz else 1.0 - f[:, 2])
                 )
-                ix = jnp.clip(i0[:, 0] + dx, 0, m - 1)
-                iy = jnp.clip(i0[:, 1] + dy, 0, m - 1)
-                iz = jnp.clip(i0[:, 2] + dz, 0, m - 1)
+                if wrap:
+                    ix = (i0[:, 0] + dx) % m
+                    iy = (i0[:, 1] + dy) % m
+                    iz = (i0[:, 2] + dz) % m
+                else:
+                    ix = jnp.clip(i0[:, 0] + dx, 0, m - 1)
+                    iy = jnp.clip(i0[:, 1] + dy, 0, m - 1)
+                    iz = jnp.clip(i0[:, 2] + dz, 0, m - 1)
                 out = out + w[:, None] * field[ix, iy, iz]
     return out
 

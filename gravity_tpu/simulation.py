@@ -45,6 +45,8 @@ def resolve_dtype(name: str):
 
 def _resolve_backend(config: SimulationConfig) -> str:
     backend = config.force_backend
+    if backend == "auto" and config.periodic_box > 0.0:
+        return "pm"  # the only periodic-capable solver
     if backend != "auto":
         return backend
     on_tpu = jax.devices()[0].platform == "tpu"
@@ -107,6 +109,13 @@ def make_local_kernel(config: SimulationConfig, backend: str):
             far=config.tree_far, chunk=config.fast_chunk, **common,
         )
     if backend == "pm":
+        if config.periodic_box > 0.0:
+            from .ops.periodic import pm_periodic_accelerations_vs
+
+            return partial(
+                pm_periodic_accelerations_vs, box=config.periodic_box,
+                grid=config.pm_grid, g=config.g, eps=config.eps,
+            )
         from .ops.pm import pm_accelerations_vs
 
         return partial(
@@ -196,6 +205,12 @@ class Simulator:
         the same compiled block instead of retracing.
         """
         config = self.config
+        if config.periodic_box > 0.0 and self.backend != "pm":
+            raise ValueError(
+                "periodic_box > 0 needs the periodic FFT solver "
+                f"(force_backend 'pm' or 'auto'); got {self.backend!r} — "
+                "tree/p3m/direct backends are isolated-BC"
+            )
         if self.mesh is not None:
             from .parallel import make_sharded_accel2
 
@@ -283,6 +298,13 @@ class Simulator:
                 chunk=config.fast_chunk, **common,
             )
         if self.backend == "pm":
+            if config.periodic_box > 0.0:
+                from .ops.periodic import pm_periodic_accelerations
+
+                return lambda pos, m: pm_periodic_accelerations(
+                    pos, m, box=config.periodic_box, grid=config.pm_grid,
+                    g=config.g, eps=config.eps,
+                )
             from .ops.pm import pm_accelerations
 
             return lambda pos, m: pm_accelerations(
@@ -338,11 +360,21 @@ class Simulator:
             st, a = step(st, a)
             return (st, a), None
 
+        def wrap(st: ParticleState) -> ParticleState:
+            # Periodic runs: re-wrap once per block (forces are wrap-
+            # invariant, so this only protects fp precision over long
+            # drifts, not correctness within a block).
+            if self.config.periodic_box <= 0.0:
+                return st
+            box = jnp.asarray(self.config.periodic_box,
+                              st.positions.dtype)
+            return st.replace(positions=jnp.mod(st.positions, box))
+
         if not record:
             (state, acc), _ = jax.lax.scan(
                 body, (state, acc), None, length=n_steps
             )
-            return state, acc, None
+            return wrap(state), acc, None
 
         # Recording: emit one (N, 3) frame per `record_every` steps, so the
         # scan output (and its D2H transfer) is 1/record_every the size of
@@ -356,7 +388,7 @@ class Simulator:
         (state, acc), traj = jax.lax.scan(
             stride, (state, acc), None, length=n_steps // record_every
         )
-        return state, acc, traj
+        return wrap(state), acc, traj
 
     def run(
         self,
@@ -447,7 +479,7 @@ class Simulator:
                 merge_chunk = max(1, min(1024, (1 << 24) // max(state.n, 1)))
                 res = merge_close_pairs(
                     state, config.merge_radius, k=config.merge_k,
-                    chunk=merge_chunk,
+                    chunk=merge_chunk, box=config.periodic_box,
                 )
                 if int(res.n_merged) > 0:
                     state = res.state
@@ -622,6 +654,16 @@ class Simulator:
         jax.block_until_ready(res.state.positions)
         timer.mark()
 
+        if config.periodic_box > 0.0:
+            # Same fp-health re-wrap the block loop applies (forces are
+            # wrap-invariant; mid-run coordinates may exceed the box).
+            box = jnp.asarray(config.periodic_box,
+                              res.state.positions.dtype)
+            res = res._replace(
+                state=res.state.replace(
+                    positions=jnp.mod(res.state.positions, box)
+                )
+            )
         self.state = res.state
         steps_taken = int(res.steps)
         if config.nan_check and not self._state_finite(res.state):
@@ -669,10 +711,22 @@ class Simulator:
         drift metric keeps measuring integrator health under
         --external."""
         state = self.final_state()
-        e = diagnostics.total_energy(
-            state, g=self.config.g, cutoff=self.config.cutoff,
-            eps=self.config.eps,
-        )
+        config = self.config
+        if config.periodic_box > 0.0:
+            # The isolated pairwise potential is not conserved in a
+            # periodic box (and jumps at re-wraps); use the mesh
+            # potential the solver actually integrates.
+            from .ops.diagnostics import kinetic_energy
+            from .ops.periodic import pm_periodic_potential_energy
+
+            e = kinetic_energy(state) + pm_periodic_potential_energy(
+                state.positions, state.masses, box=config.periodic_box,
+                grid=config.pm_grid, g=config.g, eps=config.eps,
+            )
+        else:
+            e = diagnostics.total_energy(
+                state, g=config.g, cutoff=config.cutoff, eps=config.eps,
+            )
         if self._ext_phi is not None:
             e = e + jnp.sum(state.masses * self._ext_phi(state.positions))
         return e

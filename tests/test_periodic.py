@@ -1,0 +1,273 @@
+"""Periodic-box PM gravity: Ewald oracle parity, boundary wrap, Jeans
+swindle, Simulator integration."""
+
+from math import erfc, exp, pi, sin, sqrt
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gravity_tpu.constants import G
+from gravity_tpu.ops.periodic import (
+    pm_periodic_accelerations,
+    pm_periodic_accelerations_vs,
+)
+
+
+def _ewald_pair_ax(d, box, m, eps):
+    """x-acceleration on particle 0 from particle 1 (+ all images) via
+    Ewald summation, with the solver's arctan-core softening applied as
+    a nearest-image correction (softening is negligible for images)."""
+    alpha = 3.0 / box
+    d = np.asarray(d, float)
+    ar = np.zeros(3)
+    for ix in range(-3, 4):
+        for iy in range(-3, 4):
+            for iz in range(-3, 4):
+                rn = d + np.array([ix, iy, iz]) * box
+                r = np.linalg.norm(rn)
+                ar += (
+                    G * m * rn / r**3
+                    * (erfc(alpha * r)
+                       + 2 * alpha * r / sqrt(pi) * exp(-(alpha * r) ** 2))
+                )
+    ak = np.zeros(3)
+    for mx in range(-10, 11):
+        for my in range(-10, 11):
+            for mz in range(-10, 11):
+                if mx == my == mz == 0:
+                    continue
+                k = 2 * pi / box * np.array([mx, my, mz])
+                k2 = k @ k
+                ak += (
+                    4 * pi * G * m / box**3 * k / k2
+                    * exp(-k2 / (4 * alpha**2)) * sin(k @ d)
+                )
+    a_point = (ar + ak)[0]
+    # Nearest-image softening correction: swap the 1/r^2 point force for
+    # the arctan-core force d/dr[(2/pi) arctan(r/eps)/r].
+    r = np.linalg.norm(d)
+    f_point = G * m / r**2
+    f_soft = (
+        (2 / pi) * G * m
+        * (np.arctan(r / eps) / r**2 - eps / (r * (r**2 + eps**2)))
+    )
+    return a_point + (f_soft - f_point) * d[0] / r
+
+
+def test_pair_force_matches_ewald(x64):
+    box = 1.0e12
+    eps = 5.0e10
+    pos = jnp.asarray(
+        [[0.4e12, 0.5e12, 0.5e12], [0.6e12, 0.5e12, 0.5e12]], jnp.float64
+    )
+    masses = jnp.asarray([1e30, 1e30], jnp.float64)
+    acc = pm_periodic_accelerations(
+        pos, masses, box=box, grid=128, eps=eps
+    )
+    want = _ewald_pair_ax([0.2e12, 0.0, 0.0], box, 1e30, eps)
+    np.testing.assert_allclose(float(acc[0, 0]), want, rtol=0.02)
+    # Antisymmetry for the equal-mass pair (momentum conservation); y/z
+    # components are pure roundoff (~1e-27), so tolerance is absolute,
+    # scaled to the physical x-component.
+    np.testing.assert_allclose(
+        np.asarray(acc[0]), -np.asarray(acc[1]),
+        atol=1e-10 * abs(float(acc[0, 0])),
+    )
+
+
+def test_attraction_through_the_face(x64):
+    """Particles at 0.05 and 0.95 of the box are 0.1 apart through the
+    boundary: the periodic force pulls them THROUGH the face (outward),
+    opposite to the isolated-solver direction."""
+    box = 1.0e12
+    pos = jnp.asarray(
+        [[0.05e12, 0.5e12, 0.5e12], [0.95e12, 0.5e12, 0.5e12]], jnp.float64
+    )
+    masses = jnp.asarray([1e30, 1e30], jnp.float64)
+    acc = pm_periodic_accelerations(
+        pos, masses, box=box, grid=64, eps=2e10
+    )
+    assert float(acc[0, 0]) < 0  # pulled toward x=0 face (the image)
+    assert float(acc[1, 0]) > 0
+
+
+def test_wrap_invariance(x64):
+    """Shifting positions by whole box periods changes nothing."""
+    box = 1.0e12
+    key = jax.random.PRNGKey(0)
+    pos = jax.random.uniform(key, (32, 3), jnp.float64, maxval=box)
+    masses = jnp.ones((32,), jnp.float64) * 1e28
+    a1 = pm_periodic_accelerations(pos, masses, box=box, grid=32, eps=4e10)
+    shift = jnp.asarray([box, -2 * box, 3 * box], jnp.float64)
+    a2 = pm_periodic_accelerations(
+        pos + shift, masses, box=box, grid=32, eps=4e10
+    )
+    np.testing.assert_allclose(np.asarray(a1), np.asarray(a2), atol=1e-18)
+
+
+def test_uniform_lattice_feels_no_force(x64):
+    """A uniform lattice is an equilibrium of the k=0-subtracted solver
+    (Jeans swindle): forces vanish to grid precision."""
+    box = 1.0
+    side = 8
+    h = box / side
+    lattice = (
+        jnp.stack(
+            jnp.meshgrid(*([jnp.arange(side)] * 3), indexing="ij"), axis=-1
+        ).reshape(-1, 3)
+        + 0.5
+    ) * h
+    masses = jnp.ones((side**3,), jnp.float64)
+    acc = pm_periodic_accelerations(
+        lattice.astype(jnp.float64), masses, box=box, grid=16, eps=0.1
+    )
+    # Scale: a single unbalanced neighbor at distance h would pull with
+    # G/h^2 ~ 4e-9; lattice cancellation must be many orders below that.
+    assert float(jnp.abs(acc).max()) < 1e-6 * G / h**2
+
+
+def test_momentum_conserved_random(key, x64):
+    box = 1.0e12
+    pos = jax.random.uniform(key, (128, 3), jnp.float64, maxval=box)
+    masses = jax.random.uniform(
+        jax.random.fold_in(key, 1), (128,), jnp.float64, minval=1e27,
+        maxval=1e29,
+    )
+    acc = pm_periodic_accelerations(pos, masses, box=box, grid=32, eps=3e10)
+    ptot = np.asarray(jnp.sum(masses[:, None] * acc, axis=0))
+    scale = float(jnp.sum(masses * jnp.linalg.norm(acc, axis=1)))
+    assert np.abs(ptot).max() < 1e-10 * scale
+
+
+def test_simulator_periodic_run(tmp_path, capsys):
+    """grf ICs + periodic PM through the CLI; positions stay in-box."""
+    import json
+
+    from gravity_tpu.cli import main
+
+    rc = main([
+        "run", "--model", "grf", "--n", str(8**3), "--steps", "10",
+        "--dt", "1e3", "--integrator", "leapfrog",
+        "--force-backend", "pm", "--pm-grid", "16",
+        "--periodic-box", "1e13",
+        "--log-dir", str(tmp_path / "logs"),
+    ])
+    assert rc == 0
+    stats = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert stats["steps"] == 10
+
+
+def test_periodic_rejects_isolated_backends():
+    from gravity_tpu.config import SimulationConfig
+    from gravity_tpu.simulation import Simulator
+
+    with pytest.raises(ValueError, match="periodic"):
+        Simulator(SimulationConfig(
+            model="random", n=64, periodic_box=1e12,
+            force_backend="tree",
+        ))
+
+
+def test_gravitational_growth_of_structure(x64):
+    """The cosmology loop: grf ICs in a periodic box collapse under the
+    periodic solver — the low-k density power grows."""
+    from gravity_tpu.config import SimulationConfig
+    from gravity_tpu.models import create_grf
+    from gravity_tpu.ops.spectra import density_power_spectrum
+    from gravity_tpu.simulation import Simulator
+
+    box = 1.0e13
+    n = 16**3
+    state = create_grf(
+        jax.random.PRNGKey(5), n, box=box, spectral_index=-2.0,
+        sigma_psi=0.02, total_mass=1e36, dtype=jnp.float64,
+    )
+
+    def low_k_power(st):
+        _, p, _ = density_power_spectrum(
+            st.positions, st.masses, grid=16,
+            box=((0.0, 0.0, 0.0), box), n_bins=4,
+        )
+        return float(p[0])
+
+    p_before = low_k_power(state)
+    config = SimulationConfig(
+        n=n, steps=60, dt=2e4, integrator="leapfrog",
+        force_backend="pm", pm_grid=32, periodic_box=box,
+        eps=2e11, dtype="float64",
+    )
+    sim = Simulator(config, state=state)
+    final = sim.run()["final_state"]
+    assert bool(jnp.all(final.positions >= 0))
+    assert bool(jnp.all(final.positions < box))
+    p_after = low_k_power(final)
+    assert p_after > 1.5 * p_before, (p_before, p_after)
+
+
+def test_min_image_merge_across_face(x64):
+    """Pairs across a periodic face merge at their true (minimum-image)
+    separation, with the merged body at the face, not mid-box."""
+    from gravity_tpu.ops.encounters import merge_close_pairs
+    from gravity_tpu.state import ParticleState
+
+    box = 1.0e12
+    pos = jnp.asarray(
+        [[0.005e12, 0.5e12, 0.5e12], [0.995e12, 0.5e12, 0.5e12],
+         [0.5e12, 0.2e12, 0.5e12]], jnp.float64
+    )
+    vel = jnp.zeros_like(pos)
+    masses = jnp.asarray([1e30, 1e30, 1e30], jnp.float64)
+    state = ParticleState(pos, vel, masses)
+    # Isolated view: separation 0.99e12 >> radius -> no merge.
+    res_iso = merge_close_pairs(state, 2e10, k=4, chunk=4)
+    assert int(res_iso.n_merged) == 0
+    # Periodic view: true separation 1e10 < radius -> merge at the face.
+    res = merge_close_pairs(state, 2e10, k=4, chunk=4, box=box)
+    assert int(res.n_merged) == 1
+    assert float(res.state.masses[0]) == 2e30
+    x_merged = float(res.state.positions[0, 0])
+    # COM of the minimum-image pair is the face itself (x = 0 == box).
+    assert min(x_merged, box - x_merged) < 1e9
+
+
+def test_periodic_energy_conserved_through_wrap(x64):
+    """Simulator.energy() for a periodic run uses the mesh potential:
+    drift stays small even as particles cross faces and re-wrap (the
+    isolated pairwise energy would jump at every crossing)."""
+    from gravity_tpu.config import SimulationConfig
+    from gravity_tpu.models import create_grf
+    from gravity_tpu.simulation import Simulator
+
+    box = 1.0e13
+    n = 8**3
+    state = create_grf(
+        jax.random.PRNGKey(3), n, box=box, spectral_index=-2.0,
+        sigma_psi=0.02, vel_factor=1e-3, total_mass=1e36,
+        dtype=jnp.float64,
+    )
+    config = SimulationConfig(
+        n=n, steps=100, dt=5e4, integrator="leapfrog",
+        force_backend="pm", pm_grid=32, periodic_box=box, eps=3e11,
+        dtype="float64", progress_every=25,
+    )
+    sim = Simulator(config, state=state)
+    e0 = float(sim.energy())
+    sim.run()
+    e1 = float(sim.energy())
+    assert abs((e1 - e0) / e0) < 5e-3, (e0, e1)
+
+
+def test_vs_form_targets_subset(x64):
+    box = 1.0e12
+    key = jax.random.PRNGKey(2)
+    pos = jax.random.uniform(key, (64, 3), jnp.float64, maxval=box)
+    masses = jnp.ones((64,), jnp.float64) * 1e28
+    full = pm_periodic_accelerations(pos, masses, box=box, grid=32, eps=3e10)
+    some = pm_periodic_accelerations_vs(
+        pos[:10], pos, masses, box=box, grid=32, eps=3e10
+    )
+    np.testing.assert_allclose(
+        np.asarray(some), np.asarray(full[:10]), rtol=1e-12
+    )
