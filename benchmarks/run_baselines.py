@@ -15,8 +15,13 @@ Usage:
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
+
+# Runnable as `python benchmarks/run_baselines.py` from the repo root:
+# the script dir (not the cwd) lands on sys.path, so add the repo root.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 CONFIGS = {
     # tag -> (description, SimulationConfig kwargs, bench kwargs)
@@ -61,6 +66,14 @@ CONFIGS = {
              p3m_cap=64),
         dict(bench_steps=3),
     ),
+    # Bonus (beyond BASELINE.json): the cosmology path.
+    "cosmo-262k": (
+        "262,144-body Zel'dovich ICs, periodic-box PM (grid=128)",
+        dict(model="grf", n=64**3, dt=2.0e4, eps=2.0e11,
+             integrator="leapfrog", force_backend="pm", pm_grid=128,
+             periodic_box=1.0e13),
+        dict(bench_steps=5),
+    ),
 }
 
 
@@ -82,6 +95,9 @@ def run_one(tag: str) -> dict:
 
 
 def main(argv) -> int:
+    from gravity_tpu.utils.platform import ensure_live_backend
+
+    ensure_live_backend()  # wedged-tunnel guard (CPU fallback)
     tags = argv or list(CONFIGS)
     results = []
     for tag in tags:

@@ -514,19 +514,33 @@ def cmd_analyze(args: argparse.Namespace) -> int:
     lr = np.asarray(
         diag.lagrangian_radii(state, (0.1, 0.25, 0.5, 0.75, 0.9))
     )
+    if config.periodic_box > 0.0:
+        # Periodic runs: the conserved potential is the mesh potential
+        # (matching Simulator.energy()); the isolated pairwise sum and
+        # the virial ratio built on it are not meaningful here.
+        from .ops.periodic import pm_periodic_potential_energy
+
+        pot = float(pm_periodic_potential_energy(
+            state.positions, state.masses, box=config.periodic_box,
+            grid=config.pm_grid, g=config.g, eps=config.eps,
+        ))
+        virial = None
+    else:
+        pot = float(
+            diag.total_energy(state, g=config.g, cutoff=config.cutoff,
+                              eps=config.eps)
+            - diag.kinetic_energy(state)
+        )
+        virial = float(
+            diag.virial_ratio(state, g=config.g, cutoff=config.cutoff,
+                              eps=config.eps)
+        )
     report = {
         "step": int(step),
         "n": int(state.n),
         "kinetic_energy": float(diag.kinetic_energy(state)),
-        "potential_energy": float(
-            diag.total_energy(state, g=config.g, cutoff=config.cutoff,
-                              eps=config.eps)
-            - diag.kinetic_energy(state)
-        ),
-        "virial_ratio": float(
-            diag.virial_ratio(state, g=config.g, cutoff=config.cutoff,
-                              eps=config.eps)
-        ),
+        "potential_energy": pot,
+        "virial_ratio": virial,
         "center_of_mass": np.asarray(diag.center_of_mass(state)).tolist(),
         "total_momentum": np.asarray(diag.total_momentum(state)).tolist(),
         "velocity_dispersion": float(diag.velocity_dispersion(state)),
@@ -536,6 +550,12 @@ def cmd_analyze(args: argparse.Namespace) -> int:
             "0.90": float(lr[4]),
         },
     }
+    if config.periodic_box > 0.0:
+        report["periodic_note"] = (
+            "periodic run: potential_energy is the mesh potential "
+            "(matches Simulator.energy); virial_ratio is null "
+            "(isolated-only diagnostic)"
+        )
     if config.external:
         # Keep analyze consistent with run/metrics, whose total_energy
         # includes the background field. virial_ratio above remains the

@@ -15,7 +15,7 @@ from .plummer import create_plummer
 from .random_cube import create_random_cube, generate_random_particles
 from .solar import create_solar_system
 
-def _solar(key, n, dtype):
+def _solar(key, n, dtype, **kw):
     if n != 3:
         raise ValueError(
             f"model 'solar' has exactly 3 bodies; got n={n}. "
@@ -24,24 +24,39 @@ def _solar(key, n, dtype):
     return create_solar_system(dtype=dtype)
 
 
+def _grf(key, n, dtype, periodic_box: float = 0.0, **kw):
+    """grf honors the run's periodic box so the lattice period and the
+    solver period can never disagree (0.0 = the factory default box)."""
+    extra = {"box": periodic_box} if periodic_box > 0.0 else {}
+    return create_grf(key, n, dtype=dtype, **extra)
+
+
 MODELS = {
     "solar": _solar,
-    "random": lambda key, n, dtype: create_random_cube(key, n, dtype=dtype),
-    "plummer": lambda key, n, dtype: create_plummer(key, n, dtype=dtype),
-    "cold_collapse": lambda key, n, dtype: create_cold_collapse(
+    "random": lambda key, n, dtype, **kw: create_random_cube(
         key, n, dtype=dtype
     ),
-    "disk": lambda key, n, dtype: create_disk(key, n, dtype=dtype),
-    "grf": lambda key, n, dtype: create_grf(key, n, dtype=dtype),
-    "hernquist": lambda key, n, dtype: create_hernquist(key, n, dtype=dtype),
-    "merger": lambda key, n, dtype: create_merger(key, n, dtype=dtype),
+    "plummer": lambda key, n, dtype, **kw: create_plummer(
+        key, n, dtype=dtype
+    ),
+    "cold_collapse": lambda key, n, dtype, **kw: create_cold_collapse(
+        key, n, dtype=dtype
+    ),
+    "disk": lambda key, n, dtype, **kw: create_disk(key, n, dtype=dtype),
+    "grf": _grf,
+    "hernquist": lambda key, n, dtype, **kw: create_hernquist(
+        key, n, dtype=dtype
+    ),
+    "merger": lambda key, n, dtype, **kw: create_merger(key, n, dtype=dtype),
 }
 
 
-def create_model(name: str, key, n: int, dtype):
+def create_model(name: str, key, n: int, dtype, **kwargs):
+    """``kwargs`` carries run-level context the factories may honor
+    (currently: ``periodic_box`` for the grf lattice period)."""
     if name not in MODELS:
         raise ValueError(f"unknown model {name!r}; choose from {sorted(MODELS)}")
-    return MODELS[name](key, n, dtype)
+    return MODELS[name](key, n, dtype, **kwargs)
 
 __all__ = [
     "MODELS",

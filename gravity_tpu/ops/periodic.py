@@ -31,6 +31,53 @@ from ..constants import G
 from .pm import cic_deposit, cic_gather
 
 
+def _mode_grids(grid, box, dtype):
+    """(mx, my, mz) integer mode numbers on the rfft half-grid and the
+    fundamental wavenumber kf = 2 pi / box."""
+    idx = jnp.fft.fftfreq(grid) * grid
+    idz = jnp.fft.rfftfreq(grid) * grid
+    mx, my, mz = jnp.meshgrid(idx, idx, idz, indexing="ij")
+    kf = 2.0 * jnp.pi / jnp.asarray(box, dtype)
+    return (mx, my, mz), kf
+
+
+def _phi_k(rho_k, modes, *, h, kf, g, eps, grid, dtype):
+    """Softened periodic potential in k-space from the mass-per-cell
+    transform — the ONE place the kernel (deconvolution, softening,
+    Jeans swindle, normalization) is defined, shared by the force and
+    energy paths so they can never drift apart.
+
+    fp32-critical structure: the physical kernel 4 pi G / (k^2 h^3)
+    naively combines G ~ 1e-10 with h^3 ~ 1e35 and k^2 ~ 1e-25, and XLA
+    is free to reassociate division chains — one association order
+    constant-folds G/h^3 ~ 1e-45, which flushes to zero and silently
+    kills every force. Writing it as (4 pi G / h) / (k^2 h^2) with the
+    DIMENSIONLESS k^2 h^2 = m^2 (2 pi / grid)^2 ~ O(1) keeps every
+    factor and every possible reassociation inside fp32 normal range.
+    """
+    mx, my, mz = modes
+    m2 = mx * mx + my * my + mz * mz
+    # k^2 h^2, dimensionless O(0.1 .. 40): (m * 2 pi / grid)^2.
+    k2h2 = (m2 * (2.0 * jnp.pi / grid) ** 2).astype(dtype)
+    k2h2_safe = jnp.where(m2 > 0, k2h2, 1.0)
+    # CIC window, deconvolved once per CIC pass (deposit + gather).
+    w = (
+        jnp.sinc(mx / grid) * jnp.sinc(my / grid) * jnp.sinc(mz / grid)
+    ) ** 2
+    w2 = jnp.maximum(
+        w * w, jnp.asarray(1e-12, rho_k.real.dtype)
+    ).astype(rho_k.real.dtype)
+    # Arctan-core softening: k * eps = sqrt(m2) * kf * eps.
+    soft = jnp.exp(
+        -jnp.sqrt(m2).astype(dtype) * (kf * jnp.asarray(eps, dtype))
+    )
+    # 4 pi G / h ~ 1e-21 at astro scales, ~1e-9 at unit scales: normal.
+    kernel = ((4.0 * jnp.pi * g) / h) / k2h2_safe
+    phi_k = -rho_k * kernel * soft / w2
+    # Jeans swindle: drop the k=0 mean-density mode.
+    return jnp.where(m2 > 0, phi_k, 0.0)
+
+
 @partial(jax.jit, static_argnames=("grid", "g", "eps"))
 def pm_periodic_accelerations_vs(
     targets: jax.Array,
@@ -57,36 +104,10 @@ def pm_periodic_accelerations_vs(
     rho = cic_deposit(positions, masses, grid, origin, h, wrap=True)
     rho_k = jnp.fft.rfftn(rho)  # mass per cell, k-space
 
-    # Integer mode numbers on the rfft half-grid; k = 2 pi m / box.
-    idx = jnp.fft.fftfreq(grid) * grid
-    idz = jnp.fft.rfftfreq(grid) * grid
-    mx, my, mz = jnp.meshgrid(idx, idx, idz, indexing="ij")
-    kf = 2.0 * jnp.pi / jnp.asarray(box, dtype)
-    kx, ky, kz = mx * kf, my * kf, mz * kf
-    k2 = kx**2 + ky**2 + kz**2
-    k2_safe = jnp.where(k2 > 0, k2, 1.0)
-    k_mag = jnp.sqrt(k2)
-
-    # CIC window, deconvolved once per CIC pass (deposit + gather).
-    w = (
-        jnp.sinc(mx / grid) * jnp.sinc(my / grid) * jnp.sinc(mz / grid)
-    ) ** 2
-    w2 = jnp.maximum(
-        w * w, jnp.asarray(1e-12, rho_k.real.dtype)
-    ).astype(rho_k.real.dtype)
-
-    # rho_k is mass-per-cell; dividing by h^3 makes it a density. The
-    # arctan-core softened kernel transforms to 4 pi e^{-k eps} / k^2.
-    soft = jnp.exp(-k_mag * jnp.asarray(eps, dtype))
-    phi_k = (
-        -(4.0 * jnp.pi * g)
-        * rho_k
-        / (h * h * h)
-        * soft
-        / k2_safe
-        / w2
-    )
-    phi_k = jnp.where(k2 > 0, phi_k, 0.0)  # Jeans swindle: drop the mean
+    modes, kf = _mode_grids(grid, box, dtype)
+    kx, ky, kz = (m * kf for m in modes)
+    phi_k = _phi_k(rho_k, modes, h=h, kf=kf, g=g, eps=eps, grid=grid,
+                   dtype=dtype)
 
     # Spectral gradient: a = -grad(phi) -> a_k = -i k phi_k.
     # Normalization: a(x_c) = (1/V) sum_k a_k e^{ikx} = (M^3/V) IDFT[a_k]
@@ -122,6 +143,23 @@ def pm_periodic_accelerations(
 
 
 @partial(jax.jit, static_argnames=("grid", "g", "eps"))
+def _potential_core(positions, mw, origin, box, *, grid, g, eps):
+    """0.5 * sum_i mw_i * phi_w(x_i) with unit-scale weights mw — stays
+    comfortably inside fp32 range; the caller restores the m_mean^2
+    scale in host float64."""
+    dtype = positions.dtype
+    origin = jnp.asarray(origin, dtype)
+    h = jnp.asarray(box, dtype) / grid
+    rho = cic_deposit(positions, mw, grid, origin, h, wrap=True)
+    rho_k = jnp.fft.rfftn(rho)
+    modes, kf = _mode_grids(grid, box, dtype)
+    phi_k = _phi_k(rho_k, modes, h=h, kf=kf, g=g, eps=eps, grid=grid,
+                   dtype=dtype)
+    phi_grid = jnp.fft.irfftn(phi_k, s=(grid, grid, grid))[..., None]
+    phi = cic_gather(phi_grid, positions, origin, h, wrap=True)[:, 0]
+    return 0.5 * jnp.sum(mw * phi)
+
+
 def pm_periodic_potential_energy(
     positions: jax.Array,
     masses: jax.Array,
@@ -131,39 +169,22 @@ def pm_periodic_potential_energy(
     grid: int = 128,
     g: float = G,
     eps: float = 0.0,
-) -> jax.Array:
+) -> float:
     """Mesh potential energy E = 0.5 * sum_i m_i phi(x_i) for periodic
     runs — the potential that IS conserved by the periodic solver (the
     isolated pairwise sum is not, and jumps when positions re-wrap).
 
     Includes each particle's CIC-cloud self-energy; that term is nearly
     constant in time (it depends only weakly on sub-cell offsets), so
-    energy *drift* remains a meaningful integrator diagnostic.
+    energy *drift* remains a meaningful integrator diagnostic. Computed
+    with unit-normalized mass weights on device and rescaled by
+    m_mean^2 in host float64 (m * phi overflows fp32 at astro scales).
     """
-    dtype = positions.dtype
-    origin = jnp.asarray(origin, dtype)
-    h = jnp.asarray(box, dtype) / grid
-    rho = cic_deposit(positions, masses, grid, origin, h, wrap=True)
-    rho_k = jnp.fft.rfftn(rho)
+    import numpy as np
 
-    idx = jnp.fft.fftfreq(grid) * grid
-    idz = jnp.fft.rfftfreq(grid) * grid
-    mx, my, mz = jnp.meshgrid(idx, idx, idz, indexing="ij")
-    kf = 2.0 * jnp.pi / jnp.asarray(box, dtype)
-    k2 = (mx**2 + my**2 + mz**2) * kf * kf
-    k2_safe = jnp.where(k2 > 0, k2, 1.0)
-    k_mag = jnp.sqrt(k2)
-    w = (
-        jnp.sinc(mx / grid) * jnp.sinc(my / grid) * jnp.sinc(mz / grid)
-    ) ** 2
-    w2 = jnp.maximum(
-        w * w, jnp.asarray(1e-12, rho_k.real.dtype)
-    ).astype(rho_k.real.dtype)
-    soft = jnp.exp(-k_mag * jnp.asarray(eps, dtype))
-    phi_k = (
-        -(4.0 * jnp.pi * g) * rho_k / (h * h * h) * soft / k2_safe / w2
-    )
-    phi_k = jnp.where(k2 > 0, phi_k, 0.0)
-    phi_grid = jnp.fft.irfftn(phi_k, s=(grid, grid, grid))[..., None]
-    phi = cic_gather(phi_grid, positions, origin, h, wrap=True)[:, 0]
-    return 0.5 * jnp.sum(masses * phi)
+    dtype = positions.dtype
+    m_mean = jnp.mean(masses)
+    mw = masses / jnp.maximum(m_mean, jnp.finfo(dtype).tiny)
+    s = _potential_core(positions, mw, origin, box, grid=grid, g=g,
+                        eps=eps)
+    return float(np.float64(m_mean) ** 2 * np.float64(s))

@@ -166,7 +166,10 @@ class Simulator:
 
         if state is None:
             key = jax.random.PRNGKey(config.seed)
-            state = create_model(config.model, key, config.n, self.dtype)
+            state = create_model(
+                config.model, key, config.n, self.dtype,
+                periodic_box=config.periodic_box,
+            )
         else:
             state = state.astype(self.dtype)
         self.n_real = state.n

@@ -141,6 +141,73 @@ def test_momentum_conserved_random(key, x64):
     assert np.abs(ptot).max() < 1e-10 * scale
 
 
+def test_fp32_astro_scale_forces_nonzero():
+    """fp32 regression: the periodic kernel must be built from
+    dimensionless k^2 h^2 — XLA reassociates division chains, and one
+    association order constant-folds G/h^3 ~ 1e-45 (flushed to zero),
+    silently zeroing every force at astro scales under jit."""
+    from gravity_tpu.models import create_grf
+
+    st = create_grf(jax.random.PRNGKey(0), 512, box=1e13,
+                    dtype=jnp.float32)
+    acc = jax.jit(
+        lambda p, m: pm_periodic_accelerations(p, m, box=1e13, grid=16)
+    )(st.positions, st.masses)
+    amax = float(jnp.abs(acc).max())
+    assert amax > 1e-5, amax  # ~3.6e-3 expected; 0.0 = the regression
+    # fp64 agreement within mesh fp noise (x64 enabled just for the
+    # oracle so the fp32 path above stays genuinely fp32).
+    jax.config.update("jax_enable_x64", True)
+    try:
+        acc64 = pm_periodic_accelerations(
+            st.positions.astype(jnp.float64),
+            st.masses.astype(jnp.float64), box=1e13, grid=16,
+        )
+        assert acc64.dtype == jnp.float64
+    finally:
+        jax.config.update("jax_enable_x64", False)
+    np.testing.assert_allclose(
+        np.asarray(acc), np.asarray(acc64), rtol=2e-3,
+        atol=amax * 1e-3,
+    )
+
+
+def test_grf_lattice_matches_solver_period(x64):
+    """The grf model must build its lattice with the run's periodic box
+    (regression: a fixed default box folded multiple lattice layers onto
+    each other under a different --periodic-box)."""
+    from gravity_tpu.config import SimulationConfig
+    from gravity_tpu.simulation import Simulator
+
+    box = 3.0e12  # NOT the grf default of 1e13
+    config = SimulationConfig(
+        model="grf", n=8**3, steps=1, dt=1e3, integrator="leapfrog",
+        force_backend="pm", pm_grid=16, periodic_box=box,
+        dtype="float64",
+    )
+    sim = Simulator(config)
+    pos = np.asarray(sim.state.positions)
+    assert pos.max() < box  # lattice spans the solver's box, not 1e13
+    assert pos.max() > 0.8 * box  # ...and actually fills it
+
+
+def test_analyze_periodic_uses_mesh_potential(capsys):
+    import json
+
+    from gravity_tpu.cli import main
+
+    rc = main([
+        "analyze", "--model", "grf", "--n", str(8**3),
+        "--periodic-box", "1e13", "--force-backend", "pm",
+        "--pm-grid", "16",
+    ])
+    assert rc == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["virial_ratio"] is None
+    assert report["potential_energy"] < 0
+    assert "periodic_note" in report
+
+
 def test_simulator_periodic_run(tmp_path, capsys):
     """grf ICs + periodic PM through the CLI; positions stay in-box."""
     import json
