@@ -24,6 +24,12 @@ from .adaptive import (
     adaptive_run,
     velocity_timestep,
 )
+from .cosmo import (
+    comoving_kdk_run,
+    eds_drift_factor,
+    eds_kick_factor,
+    zeldovich_momenta,
+)
 from .external import parse_external
 from .integrators import (
     FORCE_EVALS_PER_STEP,
@@ -45,6 +51,9 @@ __all__ = [
     "adaptive_run",
     "density_power_spectrum",
     "center_of_mass",
+    "comoving_kdk_run",
+    "eds_drift_factor",
+    "eds_kick_factor",
     "energy_drift",
     "half_mass_radius",
     "kinetic_energy",
@@ -66,4 +75,5 @@ __all__ = [
     "velocity_verlet",
     "virial_ratio",
     "yoshida4",
+    "zeldovich_momenta",
 ]
