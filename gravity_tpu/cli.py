@@ -592,6 +592,79 @@ def cmd_analyze(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_cosmo(args: argparse.Namespace) -> int:
+    """Comoving cosmological run (EdS or flat LCDM): Zel'dovich ICs in a
+    periodic box, comoving KDK with the periodic FFT solver, and a
+    measured-vs-linear-theory growth report — the full cosmology stack
+    (grf -> ops.periodic -> ops.cosmo -> ops.spectra) in one command."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from .models import create_grf, grf_lattice, grf_side
+    from .ops.cosmo import (
+        comoving_kdk_run,
+        growing_mode_momenta,
+        linear_growth_ratio,
+    )
+    from .ops.periodic import pm_periodic_accelerations_vs
+
+    try:
+        side = grf_side(args.n)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    grid = args.grid or side
+    box, h0, a1, a2 = args.box, args.h0, args.a_start, args.a_end
+
+    st = create_grf(
+        jax.random.PRNGKey(args.seed), args.n, box=box,
+        spectral_index=args.spectral_index, sigma_psi=args.sigma_psi,
+        total_mass=1.0e36,
+    )
+    lat = np.asarray(grf_lattice(side, box, dtype=st.positions.dtype))
+    disp = (np.asarray(st.positions) - lat + box / 2) % box - box / 2
+    st = st.replace(
+        velocities=growing_mode_momenta(
+            jnp.asarray(disp), a1, h0, args.omega_m
+        )
+    )
+    # EdS/LCDM closure: Om * rho_crit0 = mean density -> G fixed.
+    m_tot = float(jnp.sum(st.masses))
+    g_eff = 3.0 * args.omega_m * h0**2 * box**3 / (8.0 * np.pi * m_tot)
+    masses = st.masses
+
+    def accel(x):
+        return pm_periodic_accelerations_vs(
+            x, x, masses, box=box, grid=grid, g=g_eff, eps=0.0
+        )
+
+    t0 = time.perf_counter()
+    out = comoving_kdk_run(
+        st, accel, a_start=a1, a_end=a2, n_steps=args.steps, h0=h0,
+        omega_m=args.omega_m,
+    )
+    jax.block_until_ready(out.positions)
+    elapsed = time.perf_counter() - t0
+
+    disp2 = (np.asarray(out.positions) - lat + box / 2) % box - box / 2
+    measured = float((disp2 * disp).sum() / (disp * disp).sum())
+    linear = linear_growth_ratio(a1, a2, args.omega_m)
+    print(json.dumps({
+        "n": args.n, "box": box, "grid": grid,
+        "a_start": a1, "a_end": a2, "steps": args.steps,
+        "omega_m": args.omega_m,
+        "growth_measured": measured,
+        "growth_linear": linear,
+        "rel_err": abs(measured - linear) / linear,
+        "total_time_s": elapsed,
+        "platform": jax.devices()[0].platform,
+    }))
+    return 0
+
+
 def cmd_traj(args: argparse.Namespace) -> int:
     """Inspect a native GTRJ trajectory file via the C++ tool (info /
     stats / dump) — durable-artifact tooling the reference's in-RAM
@@ -693,6 +766,36 @@ def main(argv=None) -> int:
     p_traj.add_argument("--count", type=int, default=10,
                         help="particles to dump")
     p_traj.set_defaults(fn=cmd_traj)
+
+    p_cosmo = sub.add_parser(
+        "cosmo",
+        help="comoving cosmological run: Zel'dovich ICs -> periodic PM "
+             "-> growth report",
+    )
+    p_cosmo.add_argument("--n", type=int, default=32**3,
+                         help="particle count (perfect cube)")
+    p_cosmo.add_argument("--box", type=float, default=1.0e13)
+    p_cosmo.add_argument("--grid", type=int, default=0,
+                         help="PM grid (0 = lattice side, the PM-safe "
+                              "choice)")
+    p_cosmo.add_argument("--a-start", dest="a_start", type=float,
+                         default=0.02)
+    p_cosmo.add_argument("--a-end", dest="a_end", type=float, default=0.08)
+    p_cosmo.add_argument("--steps", type=int, default=60)
+    p_cosmo.add_argument("--h0", type=float, default=0.05,
+                         help="Hubble constant in code units (1/s scale "
+                              "set by --box units)")
+    p_cosmo.add_argument("--omega-m", dest="omega_m", type=float,
+                         default=1.0,
+                         help="matter density (1.0 = EdS; <1 = flat LCDM)")
+    p_cosmo.add_argument("--sigma-psi", dest="sigma_psi", type=float,
+                         default=0.004,
+                         help="RMS Zel'dovich displacement at a_start, "
+                              "as a box fraction")
+    p_cosmo.add_argument("--spectral-index", dest="spectral_index",
+                         type=float, default=-2.0)
+    p_cosmo.add_argument("--seed", type=int, default=0)
+    p_cosmo.set_defaults(fn=cmd_cosmo)
 
     p_bench = sub.add_parser("bench", help="throughput benchmark")
     _add_config_args(p_bench)

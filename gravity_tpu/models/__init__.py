@@ -8,7 +8,7 @@ families.
 
 from .cold_collapse import create_cold_collapse
 from .disk import create_disk
-from .grf import create_grf
+from .grf import create_grf, grf_lattice, grf_side
 from .hernquist import create_hernquist
 from .merger import create_merger
 from .plummer import create_plummer
@@ -64,6 +64,8 @@ __all__ = [
     "create_cold_collapse",
     "create_disk",
     "create_grf",
+    "grf_lattice",
+    "grf_side",
     "create_hernquist",
     "create_merger",
     "create_plummer",

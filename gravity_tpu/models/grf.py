@@ -23,6 +23,30 @@ import jax.numpy as jnp
 from ..state import ParticleState
 
 
+def grf_side(n: int) -> int:
+    """Lattice side for n particles; raises unless n is a perfect cube."""
+    side = round(n ** (1.0 / 3.0))
+    if side**3 != n:
+        raise ValueError(
+            f"model 'grf' needs a perfect-cube n (8, 27, 64, ..., 4096, "
+            f"32768, 262144, ...); got n={n}"
+        )
+    return side
+
+
+def grf_lattice(side: int, box: float, dtype=jnp.float32):
+    """The (side^3, 3) cell-centered lattice create_grf displaces — the
+    SINGLE definition of the IC lattice convention, shared with callers
+    that reconstruct displacement fields (the cosmo CLI)."""
+    h = box / side
+    return (
+        jnp.stack(
+            jnp.meshgrid(*([jnp.arange(side)] * 3), indexing="ij"), axis=-1
+        ).reshape(-1, 3)
+        + 0.5
+    ).astype(dtype) * h
+
+
 def create_grf(
     key: jax.Array,
     n: int,
@@ -42,12 +66,7 @@ def create_grf(
     t_unit with t_unit = 1 s (pure Zel'dovich growth would set this from
     the cosmology — here it is an explicit knob, default cold).
     """
-    side = round(n ** (1.0 / 3.0))
-    if side**3 != n:
-        raise ValueError(
-            f"model 'grf' needs a perfect-cube n (8, 27, 64, ..., 4096, "
-            f"32768, 262144, ...); got n={n}"
-        )
+    side = grf_side(n)
     h = box / side
 
     # Mode grid on the rfft half-spectrum (integer wavenumbers): the
@@ -84,12 +103,7 @@ def create_grf(
     psi = psi / jnp.maximum(rms, jnp.finfo(psi.dtype).tiny)
     psi = (sigma_psi * box) * psi
 
-    lattice = (
-        jnp.stack(
-            jnp.meshgrid(*([jnp.arange(side)] * 3), indexing="ij"), axis=-1
-        ).reshape(-1, 3)
-        + 0.5
-    ) * h
+    lattice = grf_lattice(side, box, dtype=psi.dtype)
 
     positions = ((lattice + psi) % box).astype(dtype)
     velocities = (vel_factor * psi).astype(dtype)

@@ -28,6 +28,10 @@ from .cosmo import (
     comoving_kdk_run,
     eds_drift_factor,
     eds_kick_factor,
+    growing_mode_momenta,
+    growth_rate,
+    lcdm_factors,
+    linear_growth_ratio,
     zeldovich_momenta,
 )
 from .external import parse_external
@@ -55,10 +59,14 @@ __all__ = [
     "eds_drift_factor",
     "eds_kick_factor",
     "energy_drift",
+    "growing_mode_momenta",
+    "growth_rate",
     "half_mass_radius",
     "kinetic_energy",
     "lagrangian_radii",
+    "lcdm_factors",
     "leapfrog_kdk",
+    "linear_growth_ratio",
     "make_step_fn",
     "p3m_accelerations",
     "pairwise_accelerations_chunked",

@@ -46,9 +46,50 @@ def eds_drift_factor(a1, a2, h0):
     return (2.0 / h0) * (1.0 / jnp.sqrt(a1) - 1.0 / jnp.sqrt(a2))
 
 
+def lcdm_factors(a1, a2, h0, omega_m, *, n_quad: int = 512):
+    """(kick, drift) = (int dt/a, int dt/a^2) over [a1, a2] for flat
+    LambdaCDM: H(a) = H0 sqrt(Om/a^3 + (1 - Om)), dt = da / (a H).
+
+    Host-side float64 quadrature (the factors are trace-time constants);
+    reduces to the EdS closed forms at omega_m = 1 (tested).
+    """
+    import numpy as np
+
+    trap = getattr(np, "trapezoid", None) or np.trapz  # numpy<2 compat
+    a = np.linspace(float(a1), float(a2), n_quad + 1)
+    h = h0 * np.sqrt(omega_m / a**3 + (1.0 - omega_m))
+    dt_da = 1.0 / (a * h)
+    kick = trap(dt_da / a, a)
+    drift = trap(dt_da / a**2, a)
+    return kick, drift
+
+
+def linear_growth_ratio(a1: float, a2: float, omega_m: float = 1.0,
+                        *, n_quad: int = 4096) -> float:
+    """D(a2)/D(a1) for flat LambdaCDM: D(a) ∝ H(a) int_0^a da'/(a'H)^3.
+
+    Host-side float64 quadrature; exactly a2/a1 at omega_m = 1 (EdS).
+    """
+    import numpy as np
+
+    trap = getattr(np, "trapezoid", None) or np.trapz  # numpy<2 compat
+
+    def d_of(a):
+        aa = np.linspace(1e-8, a, n_quad + 1)
+        e = np.sqrt(omega_m / aa**3 + (1.0 - omega_m))  # H/H0
+        integ = trap(1.0 / (aa * e) ** 3, aa)
+        return np.sqrt(omega_m / a**3 + (1.0 - omega_m)) * integ
+
+    return float(d_of(a2) / d_of(a1))
+
+
 def zeldovich_momenta(displacements, a, h0, dtype=None):
     """Growing-mode momenta matching x = q + D(a) psi with D = a (EdS):
-    p = a^2 dx/dt = a^2 (dD/dt) psi = H0 a^(3/2) psi."""
+    p = a^2 dx/dt = a^2 (dD/dt) psi = H0 a^(3/2) psi.
+
+    EdS-only convention (``displacements`` is the D = 1 field); for
+    general omega_m use :func:`growing_mode_momenta` on the CURRENT
+    displacement field."""
     dtype = dtype or displacements.dtype
     return (
         jnp.asarray(h0, dtype)
@@ -57,9 +98,37 @@ def zeldovich_momenta(displacements, a, h0, dtype=None):
     )
 
 
+def growth_rate(a: float, omega_m: float = 1.0) -> float:
+    """f = dlnD/dlna for flat LambdaCDM (1.0 exactly at omega_m = 1),
+    via central difference of the quadrature growth factor."""
+    if omega_m == 1.0:
+        return 1.0
+    import numpy as np
+
+    da = 1e-4 * a
+    r = linear_growth_ratio(a - da, a + da, omega_m)
+    return float(np.log(r) / (np.log(a + da) - np.log(a - da)))
+
+
+def growing_mode_momenta(disp_now, a, h0, omega_m: float = 1.0,
+                         dtype=None):
+    """Momenta from the CURRENT displacement field: the growing mode has
+    dx/dt = (Ddot/D) * disp = f(a) H(a) disp, so
+    p = a^2 f(a) H(a) disp_now — valid for any flat LambdaCDM
+    (reduces to zeldovich_momenta's EdS form at omega_m = 1)."""
+    import numpy as np
+
+    dtype = dtype or disp_now.dtype
+    h = h0 * np.sqrt(omega_m / a**3 + (1.0 - omega_m))
+    scale = a * a * growth_rate(a, omega_m) * h
+    return jnp.asarray(scale, dtype) * disp_now
+
+
 @partial(
     jax.jit,
-    static_argnames=("accel_fn", "n_steps", "a_start", "a_end", "h0"),
+    static_argnames=(
+        "accel_fn", "n_steps", "a_start", "a_end", "h0", "omega_m",
+    ),
 )
 def comoving_kdk_run(
     state: ParticleState,
@@ -69,6 +138,7 @@ def comoving_kdk_run(
     a_end: float,
     n_steps: int,
     h0: float,
+    omega_m: float = 1.0,
 ) -> ParticleState:
     """Integrate from a_start to a_end in n_steps comoving KDK steps.
 
@@ -76,7 +146,11 @@ def comoving_kdk_run(
     acceleration (the periodic solver on comoving coordinates with the
     COMOVING particle masses); ``state.velocities`` carries p = a^2 dx/dt
     on input and output. Steps are uniform in log(a) — the natural
-    spacing when D grows as a power of a.
+    spacing when D grows as a power of a. ``omega_m = 1`` is EdS
+    (analytic factors); other values use flat-LambdaCDM quadrature.
+    The comoving Poisson source is Om * rho_crit0 * delta / a — the
+    caller's G/mass normalization fixes Om implicitly via the mean
+    density, and dark energy enters only through H(a) in the factors.
     """
     import numpy as np
 
@@ -91,15 +165,30 @@ def comoving_kdk_run(
     # over [a1, a_mid], full drift over [a1, a2], half-kick over
     # [a_mid, a2]. The comoving Poisson 1/a is the integrand of the kick
     # factor itself (int dt / a) — nothing extra to divide by.
-    k1s = jnp.asarray(
-        eds_kick_factor(a_edges_np[:-1], a_mids_np, h0), dtype
-    )
-    drs = jnp.asarray(
-        eds_drift_factor(a_edges_np[:-1], a_edges_np[1:], h0), dtype
-    )
-    k2s = jnp.asarray(
-        eds_kick_factor(a_mids_np, a_edges_np[1:], h0), dtype
-    )
+    if omega_m == 1.0:
+        k1s = jnp.asarray(
+            eds_kick_factor(a_edges_np[:-1], a_mids_np, h0), dtype
+        )
+        drs = jnp.asarray(
+            eds_drift_factor(a_edges_np[:-1], a_edges_np[1:], h0), dtype
+        )
+        k2s = jnp.asarray(
+            eds_kick_factor(a_mids_np, a_edges_np[1:], h0), dtype
+        )
+    else:
+        pairs1 = [
+            lcdm_factors(a1, am, h0, omega_m)
+            for a1, am in zip(a_edges_np[:-1], a_mids_np)
+        ]
+        pairs2 = [
+            lcdm_factors(am, a2, h0, omega_m)
+            for am, a2 in zip(a_mids_np, a_edges_np[1:])
+        ]
+        k1s = jnp.asarray([p[0] for p in pairs1], dtype)
+        k2s = jnp.asarray([p[0] for p in pairs2], dtype)
+        drs = jnp.asarray(
+            [p1[1] + p2[1] for p1, p2 in zip(pairs1, pairs2)], dtype
+        )
 
     def step(carry, factors):
         x, p, acc = carry

@@ -28,6 +28,52 @@ def test_factors_match_numerical_integrals(x64):
                                rtol=1e-7)
 
 
+def test_lcdm_reduces_to_eds(x64):
+    from gravity_tpu.ops.cosmo import (
+        growth_rate,
+        lcdm_factors,
+        linear_growth_ratio,
+    )
+
+    h0, a1, a2 = 0.05, 0.02, 0.31
+    kick, drift = lcdm_factors(a1, a2, h0, 1.0, n_quad=20_000)
+    np.testing.assert_allclose(kick, float(eds_kick_factor(a1, a2, h0)),
+                               rtol=1e-6)
+    np.testing.assert_allclose(drift, float(eds_drift_factor(a1, a2, h0)),
+                               rtol=1e-6)
+    assert growth_rate(0.5, 1.0) == 1.0
+    np.testing.assert_allclose(linear_growth_ratio(a1, a2, 1.0), a2 / a1,
+                               rtol=1e-4)
+
+
+def test_growth_rate_matches_omega_m_power(x64):
+    """f(a=1) ~ Omega_m^0.55 (the standard approximation) for LCDM."""
+    from gravity_tpu.ops.cosmo import growth_rate
+
+    for om in (0.3, 0.7):
+        np.testing.assert_allclose(
+            growth_rate(1.0, om), om**0.55, rtol=0.03
+        )
+
+
+@pytest.mark.parametrize("omega_m,a1,a2", [(1.0, 0.02, 0.08),
+                                           (0.3, 0.2, 0.5)])
+def test_cli_cosmo_growth(omega_m, a1, a2, capsys):
+    """The cosmo CLI reproduces linear growth for EdS and flat LCDM."""
+    import json
+
+    from gravity_tpu.cli import main
+
+    rc = main([
+        "cosmo", "--n", str(16**3), "--steps", "40",
+        "--omega-m", str(omega_m), "--a-start", str(a1),
+        "--a-end", str(a2),
+    ])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["rel_err"] < 0.06, out
+
+
 def _lattice(side, box):
     return (
         np.stack(
