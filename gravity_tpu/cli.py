@@ -67,6 +67,10 @@ def _add_config_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--p3m-cap", dest="p3m_cap", type=int, default=None)
     p.add_argument("--fast-chunk", dest="fast_chunk", type=int, default=None,
                    help="target-chunk size for tree/p3m evaluation")
+    p.add_argument("--pm-assignment", dest="pm_assignment",
+                   choices=["cic", "tsc"], default=None,
+                   help="periodic-solver mass assignment (tsc = smoother, "
+                        "27-point)")
     p.add_argument("--periodic-box", dest="periodic_box", type=float,
                    default=None,
                    help="periodic unit-cell side (0 = isolated BCs); "
@@ -523,6 +527,7 @@ def cmd_analyze(args: argparse.Namespace) -> int:
         pot = float(pm_periodic_potential_energy(
             state.positions, state.masses, box=config.periodic_box,
             grid=config.pm_grid, g=config.g, eps=config.eps,
+            assignment=config.pm_assignment,
         ))
         virial = None
     else:
@@ -638,7 +643,8 @@ def cmd_cosmo(args: argparse.Namespace) -> int:
 
     def accel(x):
         return pm_periodic_accelerations_vs(
-            x, x, masses, box=box, grid=grid, g=g_eff, eps=0.0
+            x, x, masses, box=box, grid=grid, g=g_eff, eps=0.0,
+            assignment=args.pm_assignment,
         )
 
     t0 = time.perf_counter()
@@ -656,6 +662,7 @@ def cmd_cosmo(args: argparse.Namespace) -> int:
         "n": args.n, "box": box, "grid": grid,
         "a_start": a1, "a_end": a2, "steps": args.steps,
         "omega_m": args.omega_m,
+        "assignment": args.pm_assignment,
         "growth_measured": measured,
         "growth_linear": linear,
         "rel_err": abs(measured - linear) / linear,
@@ -794,6 +801,8 @@ def main(argv=None) -> int:
                               "as a box fraction")
     p_cosmo.add_argument("--spectral-index", dest="spectral_index",
                          type=float, default=-2.0)
+    p_cosmo.add_argument("--pm-assignment", dest="pm_assignment",
+                         choices=["cic", "tsc"], default="cic")
     p_cosmo.add_argument("--seed", type=int, default=0)
     p_cosmo.set_defaults(fn=cmd_cosmo)
 

@@ -28,7 +28,18 @@ import jax
 import jax.numpy as jnp
 
 from ..constants import G
-from .pm import cic_deposit, cic_gather
+from .pm import cic_deposit, cic_gather, tsc_deposit, tsc_gather
+
+
+def _assignment_fns(assignment: str):
+    """(deposit, gather, window exponent) for a mass-assignment scheme."""
+    if assignment == "cic":
+        return cic_deposit, cic_gather, 2
+    if assignment == "tsc":
+        return tsc_deposit, tsc_gather, 3
+    raise ValueError(
+        f"unknown assignment {assignment!r}; choose 'cic' or 'tsc'"
+    )
 
 
 def _mode_grids(grid, box, dtype):
@@ -41,7 +52,7 @@ def _mode_grids(grid, box, dtype):
     return (mx, my, mz), kf
 
 
-def _phi_k(rho_k, modes, *, h, kf, g, eps, grid, dtype):
+def _phi_k(rho_k, modes, *, h, kf, g, eps, grid, dtype, p_assign=2):
     """Softened periodic potential in k-space from the mass-per-cell
     transform — the ONE place the kernel (deconvolution, softening,
     Jeans swindle, normalization) is defined, shared by the force and
@@ -60,10 +71,11 @@ def _phi_k(rho_k, modes, *, h, kf, g, eps, grid, dtype):
     # k^2 h^2, dimensionless O(0.1 .. 40): (m * 2 pi / grid)^2.
     k2h2 = (m2 * (2.0 * jnp.pi / grid) ** 2).astype(dtype)
     k2h2_safe = jnp.where(m2 > 0, k2h2, 1.0)
-    # CIC window, deconvolved once per CIC pass (deposit + gather).
+    # Assignment window (sinc^p per axis: p=2 CIC, p=3 TSC), deconvolved
+    # once per assignment pass (deposit + gather).
     w = (
         jnp.sinc(mx / grid) * jnp.sinc(my / grid) * jnp.sinc(mz / grid)
-    ) ** 2
+    ) ** p_assign
     w2 = jnp.maximum(
         w * w, jnp.asarray(1e-12, rho_k.real.dtype)
     ).astype(rho_k.real.dtype)
@@ -78,7 +90,7 @@ def _phi_k(rho_k, modes, *, h, kf, g, eps, grid, dtype):
     return jnp.where(m2 > 0, phi_k, 0.0)
 
 
-@partial(jax.jit, static_argnames=("grid", "g", "eps"))
+@partial(jax.jit, static_argnames=("grid", "g", "eps", "assignment"))
 def pm_periodic_accelerations_vs(
     targets: jax.Array,
     positions: jax.Array,
@@ -89,6 +101,7 @@ def pm_periodic_accelerations_vs(
     grid: int = 128,
     g: float = G,
     eps: float = 0.0,
+    assignment: str = "cic",
 ) -> jax.Array:
     """Accelerations at ``targets`` from a periodic box of sources.
 
@@ -98,16 +111,17 @@ def pm_periodic_accelerations_vs(
     module docstring — NOT exactly Plummer, though equivalent in role);
     scales below the mesh resolution are smoothed by the grid itself.
     """
+    deposit, gather, p_assign = _assignment_fns(assignment)
     dtype = positions.dtype
     origin = jnp.asarray(origin, dtype)
     h = jnp.asarray(box, dtype) / grid
-    rho = cic_deposit(positions, masses, grid, origin, h, wrap=True)
+    rho = deposit(positions, masses, grid, origin, h, wrap=True)
     rho_k = jnp.fft.rfftn(rho)  # mass per cell, k-space
 
     modes, kf = _mode_grids(grid, box, dtype)
     kx, ky, kz = (m * kf for m in modes)
     phi_k = _phi_k(rho_k, modes, h=h, kf=kf, g=g, eps=eps, grid=grid,
-                   dtype=dtype)
+                   dtype=dtype, p_assign=p_assign)
 
     # Spectral gradient: a = -grad(phi) -> a_k = -i k phi_k.
     # Normalization: a(x_c) = (1/V) sum_k a_k e^{ikx} = (M^3/V) IDFT[a_k]
@@ -122,7 +136,7 @@ def pm_periodic_accelerations_vs(
         ],
         axis=-1,
     )
-    return cic_gather(acc_grids, targets, origin, h, wrap=True).astype(dtype)
+    return gather(acc_grids, targets, origin, h, wrap=True).astype(dtype)
 
 
 def pm_periodic_accelerations(
@@ -134,29 +148,33 @@ def pm_periodic_accelerations(
     grid: int = 128,
     g: float = G,
     eps: float = 0.0,
+    assignment: str = "cic",
 ) -> jax.Array:
     """All-particles form (targets == sources)."""
     return pm_periodic_accelerations_vs(
         positions, positions, masses,
         box=box, origin=origin, grid=grid, g=g, eps=eps,
+        assignment=assignment,
     )
 
 
-@partial(jax.jit, static_argnames=("grid", "g", "eps"))
-def _potential_core(positions, mw, origin, box, *, grid, g, eps):
+@partial(jax.jit, static_argnames=("grid", "g", "eps", "assignment"))
+def _potential_core(positions, mw, origin, box, *, grid, g, eps,
+                    assignment="cic"):
     """0.5 * sum_i mw_i * phi_w(x_i) with unit-scale weights mw — stays
     comfortably inside fp32 range; the caller restores the m_mean^2
     scale in host float64."""
+    deposit, gather, p_assign = _assignment_fns(assignment)
     dtype = positions.dtype
     origin = jnp.asarray(origin, dtype)
     h = jnp.asarray(box, dtype) / grid
-    rho = cic_deposit(positions, mw, grid, origin, h, wrap=True)
+    rho = deposit(positions, mw, grid, origin, h, wrap=True)
     rho_k = jnp.fft.rfftn(rho)
     modes, kf = _mode_grids(grid, box, dtype)
     phi_k = _phi_k(rho_k, modes, h=h, kf=kf, g=g, eps=eps, grid=grid,
-                   dtype=dtype)
+                   dtype=dtype, p_assign=p_assign)
     phi_grid = jnp.fft.irfftn(phi_k, s=(grid, grid, grid))[..., None]
-    phi = cic_gather(phi_grid, positions, origin, h, wrap=True)[:, 0]
+    phi = gather(phi_grid, positions, origin, h, wrap=True)[:, 0]
     return 0.5 * jnp.sum(mw * phi)
 
 
@@ -169,6 +187,7 @@ def pm_periodic_potential_energy(
     grid: int = 128,
     g: float = G,
     eps: float = 0.0,
+    assignment: str = "cic",
 ) -> float:
     """Mesh potential energy E = 0.5 * sum_i m_i phi(x_i) for periodic
     runs — the potential that IS conserved by the periodic solver (the
@@ -186,5 +205,5 @@ def pm_periodic_potential_energy(
     m_mean = jnp.mean(masses)
     mw = masses / jnp.maximum(m_mean, jnp.finfo(dtype).tiny)
     s = _potential_core(positions, mw, origin, box, grid=grid, g=g,
-                        eps=eps)
+                        eps=eps, assignment=assignment)
     return float(np.float64(m_mean) ** 2 * np.float64(s))

@@ -32,6 +32,23 @@ import jax.numpy as jnp
 from ..constants import G
 
 
+def _stencil_indices(base, dx, dy, dz, m, wrap):
+    """Neighbor cell indices for a (dx, dy, dz) stencil offset — the ONE
+    definition of the boundary convention (periodic wrap vs isolated
+    clip) shared by every deposit/gather pair."""
+    if wrap:
+        return (
+            (base[:, 0] + dx) % m,
+            (base[:, 1] + dy) % m,
+            (base[:, 2] + dz) % m,
+        )
+    return (
+        jnp.clip(base[:, 0] + dx, 0, m - 1),
+        jnp.clip(base[:, 1] + dy, 0, m - 1),
+        jnp.clip(base[:, 2] + dz, 0, m - 1),
+    )
+
+
 def _cic_weights(fx):
     """1D CIC weights for fractional coordinate fx in [0, 1): (w0, w1)."""
     return 1.0 - fx, fx
@@ -61,14 +78,7 @@ def cic_deposit(positions, masses, grid, origin, h, *, wrap: bool = False):
                     * (f[:, 1] if dy else 1.0 - f[:, 1])
                     * (f[:, 2] if dz else 1.0 - f[:, 2])
                 )
-                if wrap:
-                    ix = (i0[:, 0] + dx) % m
-                    iy = (i0[:, 1] + dy) % m
-                    iz = (i0[:, 2] + dz) % m
-                else:
-                    ix = jnp.clip(i0[:, 0] + dx, 0, m - 1)
-                    iy = jnp.clip(i0[:, 1] + dy, 0, m - 1)
-                    iz = jnp.clip(i0[:, 2] + dz, 0, m - 1)
+                ix, iy, iz = _stencil_indices(i0, dx, dy, dz, m, wrap)
                 rho = rho.at[ix, iy, iz].add(masses * w)
     return rho
 
@@ -93,14 +103,65 @@ def cic_gather(field, positions, origin, h, *, wrap: bool = False):
                     * (f[:, 1] if dy else 1.0 - f[:, 1])
                     * (f[:, 2] if dz else 1.0 - f[:, 2])
                 )
-                if wrap:
-                    ix = (i0[:, 0] + dx) % m
-                    iy = (i0[:, 1] + dy) % m
-                    iz = (i0[:, 2] + dz) % m
-                else:
-                    ix = jnp.clip(i0[:, 0] + dx, 0, m - 1)
-                    iy = jnp.clip(i0[:, 1] + dy, 0, m - 1)
-                    iz = jnp.clip(i0[:, 2] + dz, 0, m - 1)
+                ix, iy, iz = _stencil_indices(i0, dx, dy, dz, m, wrap)
+                out = out + w[:, None] * field[ix, iy, iz]
+    return out
+
+
+def _tsc_axis_weights(f):
+    """TSC weights for offsets (-1, 0, +1) around the NEAREST cell, given
+    d = u - round-to-nearest-center in [-1/2, 1/2)."""
+    return (
+        0.5 * (0.5 - f) ** 2,
+        0.75 - f * f,
+        0.5 * (0.5 + f) ** 2,
+    )
+
+
+def tsc_deposit(positions, masses, grid, origin, h, *, wrap: bool = False):
+    """Scatter masses with triangular-shaped-cloud (second-order) weights.
+
+    27-point stencil; one order smoother than CIC, so mesh forces carry
+    less anisotropic assignment noise (k-space window sinc^3 per axis).
+    Same boundary conventions as :func:`cic_deposit`.
+    """
+    m = grid
+    u = (positions - origin[None, :]) / h
+    c = jnp.floor(u + 0.5).astype(jnp.int32)  # nearest cell center
+    d = u - c.astype(u.dtype)  # in [-1/2, 1/2)
+
+    wx = _tsc_axis_weights(d[:, 0])
+    wy = _tsc_axis_weights(d[:, 1])
+    wz = _tsc_axis_weights(d[:, 2])
+
+    rho = jnp.zeros((m, m, m), positions.dtype)
+    for dx in (-1, 0, 1):
+        for dy in (-1, 0, 1):
+            for dz in (-1, 0, 1):
+                w = wx[dx + 1] * wy[dy + 1] * wz[dz + 1]
+                ix, iy, iz = _stencil_indices(c, dx, dy, dz, m, wrap)
+                rho = rho.at[ix, iy, iz].add(masses * w)
+    return rho
+
+
+def tsc_gather(field, positions, origin, h, *, wrap: bool = False):
+    """TSC interpolation of a grid field to particle positions (the
+    gather twin of :func:`tsc_deposit`)."""
+    m = field.shape[0]
+    u = (positions - origin[None, :]) / h
+    c = jnp.floor(u + 0.5).astype(jnp.int32)
+    d = u - c.astype(u.dtype)
+
+    wx = _tsc_axis_weights(d[:, 0])
+    wy = _tsc_axis_weights(d[:, 1])
+    wz = _tsc_axis_weights(d[:, 2])
+
+    out = jnp.zeros((positions.shape[0], field.shape[-1]), field.dtype)
+    for dx in (-1, 0, 1):
+        for dy in (-1, 0, 1):
+            for dz in (-1, 0, 1):
+                w = wx[dx + 1] * wy[dy + 1] * wz[dz + 1]
+                ix, iy, iz = _stencil_indices(c, dx, dy, dz, m, wrap)
                 out = out + w[:, None] * field[ix, iy, iz]
     return out
 

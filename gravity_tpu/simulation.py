@@ -115,6 +115,7 @@ def make_local_kernel(config: SimulationConfig, backend: str):
             return partial(
                 pm_periodic_accelerations_vs, box=config.periodic_box,
                 grid=config.pm_grid, g=config.g, eps=config.eps,
+                assignment=config.pm_assignment,
             )
         from .ops.pm import pm_accelerations_vs
 
@@ -307,6 +308,7 @@ class Simulator:
                 return lambda pos, m: pm_periodic_accelerations(
                     pos, m, box=config.periodic_box, grid=config.pm_grid,
                     g=config.g, eps=config.eps,
+                    assignment=config.pm_assignment,
                 )
             from .ops.pm import pm_accelerations
 
@@ -725,6 +727,7 @@ class Simulator:
             e = kinetic_energy(state) + pm_periodic_potential_energy(
                 state.positions, state.masses, box=config.periodic_box,
                 grid=config.pm_grid, g=config.g, eps=config.eps,
+                assignment=config.pm_assignment,
             )
         else:
             e = diagnostics.total_energy(
