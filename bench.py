@@ -18,7 +18,6 @@ NORTH_STAR = 1.0e11  # pair-interactions/sec/chip (BASELINE.json)
 
 
 def main() -> int:
-    n = int(os.environ.get("BENCH_N", 65536))
     steps = int(os.environ.get("BENCH_STEPS", 20))
 
     import jax
@@ -31,6 +30,11 @@ def main() -> int:
     from gravity_tpu.config import SimulationConfig
 
     on_tpu = jax.devices()[0].platform == "tpu"
+    # CPU fallback (wedged tunnel): the TPU-sized workload would take
+    # ~10 min of O(N^2) on host cores; shrink so the fallback line is
+    # recorded quickly. BENCH_N overrides either way.
+    default_n = 65536 if on_tpu else 8192
+    n = int(os.environ.get("BENCH_N", default_n))
     config = SimulationConfig(
         model="plummer",
         n=n,
