@@ -15,8 +15,18 @@ if "xla_force_host_platform_device_count" not in xla_flags:
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+import pathlib
+
 import jax  # noqa: E402
 import pytest  # noqa: E402
+
+REPO_ROOT = str(pathlib.Path(__file__).resolve().parents[1])
+
+
+def subprocess_env():
+    """Env for running repo entry points in a subprocess on CPU."""
+    return {"JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO_ROOT,
+            "PATH": "/usr/bin:/bin:/usr/local/bin"}
 
 # The axon sitecustomize registers the tunneled TPU backend in every Python
 # process and force-overrides jax_platforms to "axon,cpu" — the env var

@@ -3,14 +3,13 @@
 import subprocess
 import sys
 
+from conftest import REPO_ROOT, subprocess_env
+
 
 def _run(args):
     return subprocess.run(
         [sys.executable] + args, capture_output=True, text=True,
-        timeout=600,
-        env={"JAX_PLATFORMS": "cpu", "PYTHONPATH": "/root/repo",
-             "PATH": "/usr/bin:/bin:/usr/local/bin"},
-        cwd="/root/repo",
+        timeout=600, env=subprocess_env(), cwd=REPO_ROOT,
     )
 
 

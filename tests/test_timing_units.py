@@ -1,0 +1,88 @@
+"""Direct tests for the metric accounting (utils/timing — the source of
+the judge-facing pairs/s numbers), the unit system, the numeric floors,
+and the `python -m gravity_tpu` entry point."""
+
+import math
+import subprocess
+import sys
+
+import pytest
+
+from gravity_tpu.utils.timing import StepTimer, pairs_per_step, throughput
+
+
+def test_pairs_per_step_directed_count():
+    # N*(N-1) directed interactions — matches what dense/Pallas evaluate.
+    assert pairs_per_step(1) == 0
+    assert pairs_per_step(2) == 2
+    assert pairs_per_step(1000) == 999_000
+
+
+def test_throughput_accounting():
+    out = throughput(100, 50, 2.0, num_devices=4, force_evals_per_step=3)
+    pairs = 100 * 99 * 50 * 3
+    assert out["pair_interactions"] == pairs
+    assert out["pairs_per_sec"] == pytest.approx(pairs / 2.0)
+    assert out["pairs_per_sec_per_chip"] == pytest.approx(pairs / 8.0)
+    assert out["avg_step_s"] == pytest.approx(0.04)
+
+
+def test_throughput_zero_time_and_steps():
+    out = throughput(10, 0, 0.0)
+    assert out["pairs_per_sec"] == float("inf")
+    assert out["avg_step_s"] == 0.0  # max(steps, 1) guard
+
+
+def test_step_timer_marks():
+    t = StepTimer()
+    t.start()
+    first = t.mark()
+    second = t.mark()
+    assert 0 <= first <= second
+    assert t.total == pytest.approx(second)
+    assert t.avg_step(4) == pytest.approx(t.total / 4)
+
+
+def test_galactic_units_roundtrip_and_g_is_one():
+    from gravity_tpu.utils import units as u
+
+    # The natural-unit system is defined so G == 1: one mass unit at one
+    # length unit orbits at one velocity unit.
+    v = math.sqrt(u.G_SI * u.MASS_UNIT_KG / u.LENGTH_UNIT_M)
+    assert v == pytest.approx(u.VELOCITY_UNIT_MS)
+    for to, back, val in [
+        (u.si_to_galactic_length, u.galactic_to_si_length, 3.1e20),
+        (u.si_to_galactic_mass, u.galactic_to_si_mass, 4.2e40),
+        (u.si_to_galactic_velocity, u.galactic_to_si_velocity, 2.2e5),
+        (u.si_to_galactic_time, u.galactic_to_si_time, 1.0e15),
+    ]:
+        assert back(to(val)) == pytest.approx(val, rel=1e-12)
+
+
+def test_numeric_floor_is_fp32_normal(x64):
+    """ops/numerics.tiny must stay in the NORMAL range (XLA flushes fp32
+    subnormals to zero, which turns guarded divisions into 0/0). The
+    float64 floor only exists under x64 (hence the fixture)."""
+    import numpy as np
+
+    from gravity_tpu.ops.numerics import tiny
+
+    f32 = float(tiny(np.float32))
+    assert f32 >= np.finfo(np.float32).tiny  # smallest NORMAL fp32
+    f64 = float(tiny(np.float64))
+    assert f64 >= np.finfo(np.float64).tiny and f64 > 0
+
+
+def test_module_entry_point():
+    """`python -m gravity_tpu --help` works (the __main__ shim)."""
+    from conftest import REPO_ROOT, subprocess_env
+
+    out = subprocess.run(
+        [sys.executable, "-m", "gravity_tpu", "--help"],
+        capture_output=True, text=True, timeout=120,
+        env=subprocess_env(), cwd=REPO_ROOT,
+    )
+    assert out.returncode == 0
+    for cmd in ("run", "sweep", "resume", "validate", "analyze", "cosmo",
+                "traj", "bench"):
+        assert cmd in out.stdout
