@@ -583,7 +583,8 @@ def cmd_analyze(args: argparse.Namespace) -> int:
         from .ops.spectra import density_power_spectrum
 
         k, p, shot = density_power_spectrum(
-            state.positions, state.masses, grid=args.spectrum_grid
+            state.positions, state.masses, grid=args.spectrum_grid,
+            interlace=args.spectrum_interlace,
         )
         # Empty radial bins are NaN by design; emit null so the report
         # stays strict JSON.
@@ -760,6 +761,9 @@ def main(argv=None) -> int:
                            "spectrum P(k) to the report")
     p_an.add_argument("--spectrum-grid", dest="spectrum_grid", type=int,
                       default=64)
+    p_an.add_argument("--spectrum-interlace", dest="spectrum_interlace",
+                      action="store_true",
+                      help="interlaced deposits (alias suppression)")
     p_an.set_defaults(fn=cmd_analyze)
 
     p_traj = sub.add_parser(
