@@ -69,6 +69,28 @@ def test_sharded_with_padding(key, strategy):
     )
 
 
+def test_ring_with_pallas_local_kernel(key):
+    """The flagship TPU composition — ppermute ring over shards with the
+    Pallas tile kernel as the local force — matches the dense reference
+    (Pallas interpreter on the CPU mesh)."""
+    from gravity_tpu.ops.pallas_forces import make_pallas_local_kernel
+
+    n = 128
+    state = _random_state(key, n)
+    expected = pairwise_accelerations_dense(state.positions, state.masses)
+
+    mesh = make_particle_mesh()
+    state_sharded = shard_state(state, mesh)
+    accel_fn = make_sharded_accel_fn(
+        mesh, state_sharded.masses, strategy="ring",
+        local_kernel=make_pallas_local_kernel(interpret=True),
+    )
+    got = accel_fn(state_sharded.positions)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(expected), rtol=1e-5, atol=1e-10
+    )
+
+
 def test_multislice_hierarchical_ring(key):
     """2x4 ("dcn", "shard") mesh — the multi-slice layout — matches dense."""
     n = 256
