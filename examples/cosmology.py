@@ -1,0 +1,86 @@
+"""Cosmology demo: measure the linear growth curve D(a) against theory.
+
+Zel'dovich ICs in a periodic box, evolved with the comoving KDK
+integrator and the periodic FFT solver, checkpointing the displacement
+amplitude at several scale factors — the Python-API version of
+`python -m gravity_tpu cosmo`, showing the pieces composed by hand.
+
+    python examples/cosmology.py [--omega-m 0.3] [--side 16]
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--omega-m", dest="omega_m", type=float, default=1.0)
+    ap.add_argument("--side", type=int, default=16,
+                    help="lattice side (n = side^3)")
+    ap.add_argument("--steps", type=int, default=25,
+                    help="KDK steps per checkpoint interval")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    jax.config.update("jax_enable_x64", True)
+
+    from gravity_tpu.models import create_grf, grf_lattice
+    from gravity_tpu.ops.cosmo import (
+        comoving_kdk_run,
+        growing_mode_momenta,
+        linear_growth_ratio,
+    )
+    from gravity_tpu.ops.periodic import pm_periodic_accelerations_vs
+
+    box, h0 = 1.0e13, 0.05
+    side = args.side
+    n = side**3
+    checkpoints = [0.02, 0.04, 0.08, 0.16]
+
+    st = create_grf(
+        jax.random.PRNGKey(0), n, box=box, spectral_index=-2.0,
+        sigma_psi=0.002, total_mass=1.0e36, dtype=jnp.float64,
+    )
+    lat = np.asarray(grf_lattice(side, box, dtype=st.positions.dtype))
+    disp0 = (np.asarray(st.positions) - lat + box / 2) % box - box / 2
+    st = st.replace(
+        velocities=growing_mode_momenta(
+            jnp.asarray(disp0), checkpoints[0], h0, args.omega_m
+        )
+    )
+    m_tot = float(jnp.sum(st.masses))
+    g_eff = 3.0 * args.omega_m * h0**2 * box**3 / (8.0 * np.pi * m_tot)
+    masses = st.masses
+
+    def accel(x):
+        return pm_periodic_accelerations_vs(
+            x, x, masses, box=box, grid=side, g=g_eff, eps=0.0
+        )
+
+    print(f"omega_m={args.omega_m}  n={n}  box={box:g}")
+    print(f"{'a':>6} {'D measured':>12} {'D linear':>10} {'rel err':>9}")
+    print(f"{checkpoints[0]:6.3f} {1.0:12.4f} {1.0:10.4f} {'—':>9}")
+    worst = 0.0
+    for a1, a2 in zip(checkpoints[:-1], checkpoints[1:]):
+        st = comoving_kdk_run(
+            st, accel, a_start=a1, a_end=a2, n_steps=args.steps, h0=h0,
+            omega_m=args.omega_m,
+        )
+        disp = (np.asarray(st.positions) - lat + box / 2) % box - box / 2
+        measured = float((disp * disp0).sum() / (disp0 * disp0).sum())
+        linear = linear_growth_ratio(checkpoints[0], a2, args.omega_m)
+        rel = abs(measured - linear) / linear
+        worst = max(worst, rel)
+        print(f"{a2:6.3f} {measured:12.4f} {linear:10.4f} {rel:9.2%}")
+
+    ok = worst < 0.10  # quasi-linear corrections grow with D
+    print("GROWTH OK" if ok else "GROWTH DEVIATES FROM LINEAR THEORY")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -26,6 +26,12 @@ def test_galaxy_merger_example():
     assert "energy drift" in out.stdout
 
 
+def test_cosmology_example():
+    out = _run(["examples/cosmology.py", "--steps", "20"])
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "GROWTH OK" in out.stdout
+
+
 def test_gradient_orbit_fit_example():
     out = _run(["examples/gradient_orbit_fit.py", "--iters", "120",
                 "--steps", "30"])
