@@ -582,8 +582,16 @@ def cmd_analyze(args: argparse.Namespace) -> int:
     if args.spectrum:
         from .ops.spectra import density_power_spectrum
 
+        # Periodic runs: P(k)'s volume/k_f normalization and wrap seam
+        # must use the SIMULATION box, not the data bounding cube.
+        spectrum_box = (
+            ((0.0, 0.0, 0.0), config.periodic_box)
+            if config.periodic_box > 0.0
+            else None
+        )
         k, p, shot = density_power_spectrum(
             state.positions, state.masses, grid=args.spectrum_grid,
+            box=spectrum_box,
             interlace=args.spectrum_interlace,
         )
         # Empty radial bins are NaN by design; emit null so the report

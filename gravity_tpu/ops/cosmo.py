@@ -37,13 +37,20 @@ from ..state import ParticleState
 
 
 def eds_kick_factor(a1, a2, h0):
-    """int_{t(a1)}^{t(a2)} dt / a for EdS."""
-    return (2.0 / h0) * (jnp.sqrt(a2) - jnp.sqrt(a1))
+    """int_{t(a1)}^{t(a2)} dt / a for EdS.
+
+    Dtype follows the inputs (``**0.5`` instead of ``jnp.sqrt``): the
+    KDK factor tables are built host-side from numpy float64 edges, and
+    the sqrt(a2)-sqrt(a1) cancellation must happen in float64 even when
+    jax x64 is off — a jnp sqrt would silently round to float32 first.
+    """
+    return (2.0 / h0) * (a2**0.5 - a1**0.5)
 
 
 def eds_drift_factor(a1, a2, h0):
-    """int_{t(a1)}^{t(a2)} dt / a^2 for EdS."""
-    return (2.0 / h0) * (1.0 / jnp.sqrt(a1) - 1.0 / jnp.sqrt(a2))
+    """int_{t(a1)}^{t(a2)} dt / a^2 for EdS (dtype follows inputs, as
+    :func:`eds_kick_factor`)."""
+    return (2.0 / h0) * (1.0 / a1**0.5 - 1.0 / a2**0.5)
 
 
 def lcdm_factors(a1, a2, h0, omega_m, *, n_quad: int = 512):

@@ -25,6 +25,7 @@ import jax
 import jax.numpy as jnp
 
 from ..state import ParticleState
+from .numerics import tiny
 
 
 def _min_image(diff, box):
@@ -156,8 +157,10 @@ def merge_close_pairs(
         mi, mj = m[i], m[j]
         # Division is safe: candidates have mass > 0 at detection time,
         # and any slot zeroed earlier in this pass has used[j] set, so a
-        # 0/0 can only occur under ok == False and is discarded.
-        mt = jnp.maximum(mi + mj, jnp.asarray(1e-38, dtype))
+        # 0/0 can only occur under ok == False and is discarded. The
+        # floor must survive FTZ (1e-38 is subnormal in fp32 and would
+        # flush to an inert 0.0), hence numerics.tiny.
+        mt = jnp.maximum(mi + mj, tiny(dtype))
         if box > 0.0:
             # COM via the minimum image of j relative to i, wrapped back
             # into the box afterwards.

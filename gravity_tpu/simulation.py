@@ -432,6 +432,11 @@ class Simulator:
         block_prev = 0.0
         step = start_step
         merged_total = 0
+        # Merge cadence is a physics knob independent of the logging
+        # block size: blocks may be smaller (progress_every < merge_every),
+        # so count steps since the last check instead of checking every
+        # block boundary.
+        steps_since_merge_check = 0
         # self.state/self._last_step stay current per block so the
         # KeyboardInterrupt handler below can checkpoint mid-run.
         try:
@@ -475,7 +480,18 @@ class Simulator:
             self.state, self._last_step = state, step
             if logger is not None:
                 logger.progress(step, total_steps)
-            if config.merge_radius > 0.0:
+            steps_since_merge_check += n_steps
+            # The final block always checks: the returned state must not
+            # contain never-examined colliding pairs just because the
+            # run length is not a multiple of merge_every.
+            if (
+                config.merge_radius > 0.0
+                and (
+                    steps_since_merge_check >= config.merge_every
+                    or step >= total_steps
+                )
+            ):
+                steps_since_merge_check = 0
                 from .ops.encounters import merge_close_pairs
 
                 # Cap the (chunk, N) detection buffers at ~2^24 elements

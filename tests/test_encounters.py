@@ -146,7 +146,7 @@ def test_simulator_merge_conserves_mass(x64):
     config = SimulationConfig(
         n=2, steps=100, dt=1000.0, integrator="leapfrog",
         force_backend="dense", merge_radius=5e7, dtype="float64",
-        progress_every=10,
+        progress_every=10, merge_every=10,
     )
     sim = Simulator(config, state=state)
     stats = sim.run()
@@ -177,3 +177,34 @@ def test_forces_finite_after_merge(key, x64):
         res.state.positions, res.state.masses
     )
     assert np.isfinite(np.asarray(acc)).all()
+
+
+def test_merge_check_cadence_honors_merge_every(monkeypatch, x64):
+    """merge_every is the check cadence even when the logging block is
+    smaller: progress_every=5, merge_every=20, 100 steps -> exactly 5
+    detection passes, not 20 (the round-1 behavior was
+    min(progress_every, merge_every))."""
+    from gravity_tpu.config import SimulationConfig
+    from gravity_tpu.ops import encounters
+    from gravity_tpu.simulation import Simulator
+
+    calls = {"n": 0}
+    real = encounters.merge_close_pairs
+
+    def counting(*args, **kwargs):
+        calls["n"] += 1
+        return real(*args, **kwargs)
+
+    monkeypatch.setattr(encounters, "merge_close_pairs", counting)
+
+    pos = jnp.asarray([[-1e11, 0.0, 0.0], [1e11, 0.0, 0.0]], jnp.float64)
+    vel = jnp.zeros_like(pos)
+    masses = jnp.asarray([1e20, 1e20], jnp.float64)  # far apart, no merge
+    config = SimulationConfig(
+        n=2, steps=100, dt=1.0, integrator="leapfrog",
+        force_backend="dense", merge_radius=1.0, dtype="float64",
+        progress_every=5, merge_every=20,
+    )
+    sim = Simulator(config, state=ParticleState(pos, vel, masses))
+    sim.run()
+    assert calls["n"] == 5
