@@ -38,9 +38,11 @@ class SimulationConfig:
     multirate_k: int = 0  # fast-rung capacity; 0 = auto (n // 8)
     multirate_sub: int = 4  # substeps per outer step for the fast rung
     dtype: str = "float32"
-    # auto | dense | chunked | pallas (direct sum) | cpp (native XLA FFI
-    # host kernel, CPU platform) | tree (octree) | pm (FFT mesh) |
-    # p3m (FFT mesh + cell-list pair correction)
+    # auto (scale-aware, may pick an approximate fast solver) | direct
+    # (scale-aware among EXACT O(N^2) backends only) | dense | chunked |
+    # pallas (direct sum) | cpp (native XLA FFI host kernel, CPU
+    # platform) | tree (octree) | pm (FFT mesh) | p3m (FFT mesh +
+    # cell-list pair correction)
     force_backend: str = "auto"
     chunk: int = 1024
     tree_depth: int = 0  # 0 = auto (recommended_depth)
@@ -125,7 +127,12 @@ class SimulationConfig:
 # the rest are the BASELINE.json benchmark configs.
 PRESETS = {
     "reference-mpi": SimulationConfig(model="random", n=8, integrator="euler"),
-    "reference-cuda": SimulationConfig(model="random", n=50_000, integrator="euler"),
+    # Pinned to the exact direct sum: reference parity means pairwise
+    # forces (/root/reference/cuda.cu:53-60), and at n=50k the CPU-side
+    # auto router would otherwise pick the approximate tree.
+    "reference-cuda": SimulationConfig(
+        model="random", n=50_000, integrator="euler", force_backend="direct"
+    ),
     "reference-spark": SimulationConfig(
         model="random", n=1000, integrator="euler", record_trajectories=True
     ),

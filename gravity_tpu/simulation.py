@@ -43,18 +43,62 @@ def resolve_dtype(name: str):
     return _DTYPES[name]
 
 
-def _resolve_backend(config: SimulationConfig) -> str:
-    backend = config.force_backend
-    if backend == "auto" and config.periodic_box > 0.0:
-        return "pm"  # the only periodic-capable solver
-    if backend != "auto":
-        return backend
-    on_tpu = jax.devices()[0].platform == "tpu"
+# Direct-sum/tree crossover for backend='auto' (see docs/scaling.md).
+# TPU: the Pallas O(N^2) kernel runs ~1.6e11 pairs/s/chip (BASELINE.md),
+# so 256k bodies is ~0.43 s/step while the O(N log N) tree step stays
+# sub-second well past 1M — beyond ~256k direct sum only loses. CPU: the
+# chunked jnp kernel is ~2e8 pairs/s, pushing the crossover down to ~32k.
+TREE_CROSSOVER_TPU = 262_144
+TREE_CROSSOVER_CPU = 32_768
+# Forcing O(N^2) here means >=2.7e11 pairs/step — minutes/step on CPU,
+# multiple seconds/step on one chip. Probably a mistake; warn.
+DIRECT_SUM_WARN_N = 524_288
+
+
+def _resolve_direct(config: SimulationConfig, on_tpu: bool) -> str:
+    """Scale-aware choice among the EXACT direct-sum backends."""
     if on_tpu and config.n >= 1024:
         return "pallas"
     if config.n <= 4096:
         return "dense"
     return "chunked"
+
+
+def _resolve_backend(config: SimulationConfig) -> str:
+    backend = config.force_backend
+    if backend == "auto" and config.periodic_box > 0.0:
+        return "pm"  # the only periodic-capable solver
+    if backend not in ("auto", "direct"):
+        if (
+            backend in ("dense", "chunked", "pallas", "cpp")
+            and config.n >= DIRECT_SUM_WARN_N
+            # A ring shard streams sources and can never assemble the
+            # full set a global tree build needs, so there is no faster
+            # alternative to suggest — don't nag the merger preset.
+            and config.sharding != "ring"
+        ):
+            import warnings
+
+            warnings.warn(
+                f"force_backend={backend!r} is a direct O(N^2) sum; at "
+                f"n={config.n} that is {config.n * (config.n - 1) // 2:.3g} "
+                "pair interactions per force evaluation. The 'tree' (or "
+                "periodic 'pm'/'p3m') solver is orders of magnitude faster "
+                "at this scale; pass force_backend='auto' to select it.",
+                stacklevel=2,
+            )
+        return backend
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if backend == "direct":
+        # Exactness guarantee without hardware knowledge: never routes
+        # to an approximate solver regardless of scale.
+        return _resolve_direct(config, on_tpu)
+    # auto: above the measured crossover the O(N log N) octree wins over
+    # any direct sum — unless the ring strategy is requested (see above).
+    crossover = TREE_CROSSOVER_TPU if on_tpu else TREE_CROSSOVER_CPU
+    if config.n >= crossover and config.sharding != "ring":
+        return "tree"
+    return _resolve_direct(config, on_tpu)
 
 
 def make_local_kernel(config: SimulationConfig, backend: str):

@@ -259,3 +259,101 @@ def test_bfloat16_run():
     final = stats["final_state"]
     assert final.positions.dtype == jnp.bfloat16
     assert bool(jnp.all(jnp.isfinite(final.positions.astype(jnp.float32))))
+
+
+def test_auto_backend_scale_routing():
+    """`auto` routes by scale (VERDICT r1 item 3): tree above the
+    crossover, direct below, pm when periodic, and never tree under the
+    ring strategy (which cannot build a global tree)."""
+    from gravity_tpu.config import SimulationConfig
+    from gravity_tpu.simulation import (
+        TREE_CROSSOVER_CPU,
+        TREE_CROSSOVER_TPU,
+        _resolve_backend,
+    )
+    import jax
+
+    crossover = (
+        TREE_CROSSOVER_TPU
+        if jax.devices()[0].platform == "tpu"
+        else TREE_CROSSOVER_CPU
+    )
+    assert _resolve_backend(SimulationConfig(n=1_000_000)) == "tree"
+    assert _resolve_backend(SimulationConfig(n=crossover)) == "tree"
+    assert _resolve_backend(SimulationConfig(n=crossover - 1)) != "tree"
+    assert (
+        _resolve_backend(SimulationConfig(n=1_000_000, periodic_box=1.0))
+        == "pm"
+    )
+    assert (
+        _resolve_backend(SimulationConfig(n=1_000_000, sharding="ring"))
+        != "tree"
+    )
+
+
+def test_forced_direct_sum_at_scale_warns():
+    import warnings
+
+    from gravity_tpu.config import SimulationConfig
+    from gravity_tpu.simulation import _resolve_backend
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        assert (
+            _resolve_backend(
+                SimulationConfig(n=524_288, force_backend="chunked")
+            )
+            == "chunked"
+        )
+    assert any("O(N^2)" in str(x.message) for x in w)
+    # Below the threshold: silent.
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        _resolve_backend(SimulationConfig(n=4096, force_backend="dense"))
+    assert not w
+
+
+def test_direct_backend_never_approximate():
+    """force_backend='direct' is the exactness-guaranteed auto: scale
+    routing among O(N^2) backends only."""
+    import warnings
+
+    import jax
+
+    from gravity_tpu.config import PRESETS, SimulationConfig
+    from gravity_tpu.simulation import _resolve_backend
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    want_big = "pallas" if on_tpu else "chunked"
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        assert (
+            _resolve_backend(
+                SimulationConfig(n=1_000_000, force_backend="direct")
+            )
+            == want_big
+        )
+        assert (
+            _resolve_backend(SimulationConfig(n=64, force_backend="direct"))
+            == "dense"
+        )
+    assert not w  # 'direct' is a deliberate choice; no O(N^2) nag
+    # The reference-parity preset resolves to an exact backend.
+    assert _resolve_backend(PRESETS["reference-cuda"]) in (
+        "dense", "chunked", "pallas",
+    )
+
+
+def test_ring_merger_preset_resolves_quietly():
+    """The flagship ring-sharded merger preset must not warn: under the
+    ring strategy there is no faster alternative to suggest."""
+    import warnings
+
+    from gravity_tpu.config import PRESETS
+    from gravity_tpu.simulation import _resolve_backend
+
+    cfg = PRESETS["baseline-2m-merger"]
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        _resolve_backend(cfg)
+    assert not w
