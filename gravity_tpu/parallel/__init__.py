@@ -8,6 +8,7 @@ from .mesh import (
     num_shards,
     particle_sharding,
     particle_spec,
+    replicate_state,
     shard_state,
 )
 from .multislice import hierarchical_ring_accel
@@ -24,5 +25,6 @@ __all__ = [
     "num_shards",
     "particle_sharding",
     "particle_spec",
+    "replicate_state",
     "shard_state",
 ]

@@ -79,5 +79,16 @@ def shard_state(state, mesh: Mesh):
     return jax.tree.map(lambda x: jax.device_put(x, sharding), state)
 
 
+def replicate_state(state, mesh: Mesh):
+    """Gather a particle-sharded ParticleState to full replication.
+
+    For host-driven global passes (e.g. collision merging) whose O(N^2)
+    pair scans are illegal on particle-sharded operands; the inverse of
+    :func:`shard_state`.
+    """
+    sharding = NamedSharding(mesh, P())
+    return jax.tree.map(lambda x: jax.device_put(x, sharding), state)
+
+
 def num_shards(mesh: Mesh) -> int:
     return mesh.size

@@ -542,12 +542,23 @@ class Simulator:
                 # so the pass neither OOMs nor crosses int32 indexing at
                 # million-body N.
                 merge_chunk = max(1, min(1024, (1 << 24) // max(state.n, 1)))
+                # The pair scan is a global O(N^2) pass — illegal on
+                # particle-sharded operands (an (N@shard, N@shard)
+                # distance matrix has no legal sharding). Gather to
+                # replicated for the check, reshard only if merged.
+                merge_state = state
+                if self.mesh is not None:
+                    from .parallel import replicate_state, shard_state
+
+                    merge_state = replicate_state(state, self.mesh)
                 res = merge_close_pairs(
-                    state, config.merge_radius, k=config.merge_k,
+                    merge_state, config.merge_radius, k=config.merge_k,
                     chunk=merge_chunk, box=config.periodic_box,
                 )
                 if int(res.n_merged) > 0:
                     state = res.state
+                    if self.mesh is not None:
+                        state = shard_state(state, self.mesh)
                     self.state = state
                     merged_total += int(res.n_merged)
                     if logger is not None:

@@ -138,3 +138,33 @@ def test_sharded_output_sharding(key):
     accel_fn = make_sharded_accel_fn(mesh, state.masses, strategy="allgather")
     acc = jax.jit(accel_fn)(state.positions)
     assert not acc.sharding.is_fully_replicated
+
+
+def test_sharded_merge_conserves_mass():
+    """Collision merging through the sharded block loop: the global pair
+    scan gathers to replicated, merges, and reshards (the O(N^2) scan is
+    illegal on particle-sharded operands)."""
+    from gravity_tpu.config import SimulationConfig
+    from gravity_tpu.simulation import Simulator
+
+    n = 16
+    rng = np.random.default_rng(7)
+    pos = rng.uniform(-1e11, 1e11, (n, 3)).astype(np.float32)
+    pos[9] = pos[2] + 1e6  # a pair inside merge_radius, across shards
+    vel = rng.uniform(-1e3, 1e3, (n, 3)).astype(np.float32)
+    masses = rng.uniform(1e23, 1e25, n).astype(np.float32)
+    state = ParticleState(
+        jnp.asarray(pos), jnp.asarray(vel), jnp.asarray(masses)
+    )
+    config = SimulationConfig(
+        n=n, steps=4, dt=10.0, integrator="leapfrog",
+        force_backend="dense", sharding="allgather",
+        merge_radius=1e8, merge_every=2, progress_every=2,
+    )
+    sim = Simulator(config, state=state)
+    stats = sim.run()
+    assert stats["merged_pairs"] >= 1
+    final = stats["final_state"]
+    np.testing.assert_allclose(
+        float(jnp.sum(final.masses)), float(masses.sum()), rtol=1e-6
+    )
