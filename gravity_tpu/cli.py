@@ -79,6 +79,10 @@ def _add_config_args(p: argparse.ArgumentParser) -> None:
                    help="analytic background field spec, e.g. "
                         "'nfw:gm=1e13,rs=2e20' or "
                         "'pointmass:gm=1.3e20 + uniform:gz=-9.8'")
+    p.add_argument("--progress-every", dest="progress_every", type=int,
+                   default=None,
+                   help="steps per progress print / streaming block "
+                        "(the reference prints every 100: mpi.c:192-194)")
     p.add_argument("--merge-radius", dest="merge_radius", type=float,
                    default=None,
                    help="merge pairs closer than this radius (inelastic "
@@ -167,14 +171,10 @@ def cmd_run(args: argparse.Namespace) -> int:
     logger = RunLogger(config.log_dir)
     sim = Simulator(config)
 
-    if config.adaptive and (
-        config.record_trajectories or config.checkpoint_every
-        or config.metrics or config.merge_radius > 0.0
-    ):
+    if config.adaptive and config.merge_radius > 0.0:
         print(
-            "error: --adaptive runs one data-dependent while_loop on "
-            "device; per-step trajectory/checkpoint/metrics streaming "
-            "and --merge-radius are unavailable in this mode",
+            "error: --adaptive does not support --merge-radius "
+            "(collision merging needs the fixed-dt block loop)",
             file=sys.stderr,
         )
         return 1
@@ -222,7 +222,9 @@ def cmd_run(args: argparse.Namespace) -> int:
 
     def _go():
         if config.adaptive:
-            return sim.run_adaptive(logger)
+            return sim.run_adaptive(logger, trajectory_writer=writer,
+                                    checkpoint_manager=ckpt_mgr,
+                                    metrics_logger=metrics_logger)
         return sim.run(logger, trajectory_writer=writer,
                        checkpoint_manager=ckpt_mgr,
                        metrics_logger=metrics_logger)
@@ -331,13 +333,44 @@ def cmd_resume(args: argparse.Namespace) -> int:
     from .simulation import Simulator
     from .utils.checkpoint import (
         make_checkpoint_manager,
-        restore_checkpoint,
+        restore_checkpoint_with_extra,
     )
     from .utils.logging import RunLogger
 
     config = build_config(args)
     mgr = make_checkpoint_manager(config.checkpoint_dir)
-    state, step = restore_checkpoint(mgr, args.step)
+    state, step, extra = restore_checkpoint_with_extra(mgr, args.step)
+    if config.adaptive:
+        # Adaptive checkpoints carry simulated time; the target is
+        # t_end = steps * dt, not a step count.
+        if "t" not in extra:
+            print(
+                "error: checkpoint has no simulated-time metadata — it "
+                "was written by a fixed-dt run; resume it without "
+                "--adaptive",
+                file=sys.stderr,
+            )
+            return 1
+        t0 = extra["t"]
+        t_end = config.steps * config.dt
+        if t0 >= t_end:
+            print(json.dumps({"resumed_at": step, "t": t0, "t_end": t_end,
+                              "note": "checkpoint already at/past t_end"}))
+            return 0
+        logger = RunLogger(config.log_dir)
+        logger.log_print(
+            f"Resuming adaptive run from checkpoint at step {step} "
+            f"(t={t0:.6g})"
+        )
+        sim = Simulator(config, state=state)
+        stats = sim.run_adaptive(
+            logger, checkpoint_manager=mgr, start_t=t0,
+            start_comp=extra.get("comp", 0.0), start_steps=step,
+        )
+        stats.pop("final_state", None)
+        stats["resumed_at"] = step
+        print(json.dumps(stats))
+        return 0
     if step >= config.steps:
         print(json.dumps({"resumed_at": step, "steps": config.steps,
                           "note": "checkpoint already at/past target"}))

@@ -71,9 +71,10 @@ class AdaptiveResult(NamedTuple):
     state: ParticleState
     acc: jax.Array
     t: jax.Array  # simulated time reached (== t_end unless max_steps hit)
-    steps: jax.Array  # number of KDK steps taken
-    dt_min: jax.Array  # smallest dt used
-    dt_max_used: jax.Array  # largest dt used
+    steps: jax.Array  # number of KDK steps taken THIS call
+    dt_min: jax.Array  # smallest dt used this call
+    dt_max_used: jax.Array  # largest dt used this call
+    comp: jax.Array  # Kahan compensation for t (pass back as comp0)
 
 
 def make_timestep_fn(
@@ -112,22 +113,32 @@ def adaptive_run(
     criterion: str = "accel",
     max_steps: int = 1_000_000,
     dt_min_frac: float = 1e-6,
+    t0=0.0,
+    comp0=0.0,
+    acc0: jax.Array | None = None,
 ) -> AdaptiveResult:
     """Integrate to ``t_end`` with per-step adaptive dt, fully jitted.
 
     One ``lax.while_loop`` of carried-acc KDK steps; the final step is
-    truncated to land exactly on ``t_end``. ``max_steps`` bounds runaway
-    subdivision (check ``result.t`` against ``t_end`` on return).
+    truncated to land exactly on ``t_end``. ``max_steps`` bounds the
+    steps taken in THIS call (check ``result.t`` against ``t_end`` on
+    return) — which makes the function restartable: pass the returned
+    ``(state, t, comp, acc)`` back as ``(state, t0, comp0, acc0)`` to
+    continue, giving a bounded-work building block the Simulator drives
+    in an outer host loop so trajectory/checkpoint/metrics streaming
+    works in adaptive mode too.
 
     ``dt_min_frac * dt_max`` floors the step: the criteria can return 0
     (e.g. the velocity criterion with a massive particle momentarily at
     rest), which would otherwise spin the loop without advancing time.
     Time is accumulated with Kahan compensation so sub-ulp steps still
-    make progress in float32 state dtypes.
+    make progress in float32 state dtypes (``comp0`` carries the
+    compensation across restarts).
     """
     dt_fn = make_timestep_fn(criterion, eta=eta, eps=eps, dt_max=dt_max)
     dtype = state.positions.dtype
-    acc0 = accel_fn(state.positions)
+    if acc0 is None:
+        acc0 = accel_fn(state.positions)
     t_end_c = jnp.asarray(t_end, dtype)
     dt_floor = jnp.asarray(dt_min_frac * dt_max, dtype)
 
@@ -153,10 +164,10 @@ def adaptive_run(
 
     zero = jnp.asarray(0.0, dtype)
     init = (
-        state, acc0, zero, zero, jnp.asarray(0, jnp.int32),
-        jnp.asarray(jnp.inf, dtype), zero,
+        state, acc0, jnp.asarray(t0, dtype), jnp.asarray(comp0, dtype),
+        jnp.asarray(0, jnp.int32), jnp.asarray(jnp.inf, dtype), zero,
     )
-    st, acc, t, _comp, steps, dmin, dmax = jax.lax.while_loop(
+    st, acc, t, comp, steps, dmin, dmax = jax.lax.while_loop(
         cond, body, init
     )
-    return AdaptiveResult(st, acc, t, steps, dmin, dmax)
+    return AdaptiveResult(st, acc, t, steps, dmin, dmax, comp)

@@ -684,16 +684,38 @@ class Simulator:
         stats["final_state"] = self.final_state()
         return stats
 
-    def run_adaptive(self, logger: Optional[RunLogger] = None) -> dict:
+    def run_adaptive(
+        self,
+        logger: Optional[RunLogger] = None,
+        *,
+        trajectory_writer: Optional[TrajectoryWriter] = None,
+        checkpoint_manager=None,
+        metrics_logger=None,
+        start_t: float = 0.0,
+        start_comp: float = 0.0,
+        start_steps: int = 0,
+    ) -> dict:
         """Adaptive-dt run to t_end = steps * dt (see ops.adaptive).
 
-        One jitted ``lax.while_loop`` — the step count is data-dependent,
-        so per-step trajectory/checkpoint/metrics streaming is not
-        available in this mode (use fixed-dt runs for those).
+        Block-wise: an outer host loop drives bounded jitted
+        ``lax.while_loop`` blocks (capped at ~progress_every steps), so
+        trajectory/checkpoint/metrics stream at block boundaries exactly
+        like fixed-dt runs — a long adaptive run is crash-resumable.
+        Trajectory frames land at block boundaries (irregular simulated
+        times; the metrics JSONL records t per block). Checkpoints store
+        (t, kahan comp) as extras; ``resume`` passes them back via
+        ``start_t``/``start_steps``.
         """
         from .ops.adaptive import adaptive_run
 
         config = self.config
+        if config.merge_radius > 0.0:
+            # Mirrors the CLI guard for Python-API callers: silently
+            # dropping collision merging would change the physics.
+            raise ValueError(
+                "adaptive mode does not support collision merging "
+                "(merge_radius > 0); use fixed-dt runs for merging"
+            )
         t_end = config.steps * config.dt
         criterion = config.timestep_criterion
         if criterion == "auto":
@@ -712,64 +734,174 @@ class Simulator:
             f"adaptive-kdk ({criterion}, eta={config.eta})",
         )
 
-        run_fn = jax.jit(
-            partial(
-                adaptive_run,
-                accel_fn=self.accel_fn,
-                t_end=t_end,
-                dt_max=config.dt,
-                eta=config.eta,
-                eps=config.eps,
-                criterion=criterion,
-                max_steps=config.adaptive_max_steps,
-            )
-        )
+        block_cap = max(1, min(config.progress_every,
+                               config.adaptive_max_steps))
+        # max_steps is a static (trace-time) bound, so a shrunken final
+        # block (to honor adaptive_max_steps exactly) compiles a second
+        # while_loop — cache per distinct budget; at most two occur.
+        _block_fns: dict = {}
+
+        def run_block(st, *, budget, t0, comp0, acc0):
+            if budget not in _block_fns:
+                _block_fns[budget] = jax.jit(
+                    partial(
+                        adaptive_run,
+                        accel_fn=self.accel_fn,
+                        t_end=t_end,
+                        dt_max=config.dt,
+                        eta=config.eta,
+                        eps=config.eps,
+                        criterion=criterion,
+                        max_steps=budget,
+                    )
+                )
+            return _block_fns[budget](st, t0=t0, comp0=comp0, acc0=acc0)
+
+        dtype = self.state.positions.dtype
+        t_end_cast = float(jnp.asarray(t_end, dtype))
+
         timer = StepTimer()
         timer.start()
-        res = run_fn(self.state)
-        jax.block_until_ready(res.state.positions)
+        block_prev = 0.0
+        state = self.state
+        t = start_t
+        comp = start_comp
+        # Seed the carried acceleration eagerly: passing acc0=None into
+        # the jitted block would retrace it once acc becomes an array.
+        acc = self.accel_fn(state.positions)
+        steps_taken = start_steps
+        dt_min = float("inf")
+        dt_max_used = 0.0
+        # One consistent (state, steps, t, comp) snapshot, updated in a
+        # single assignment once a block is known finite — the ONLY
+        # source for checkpoints, so an interrupt or divergence can
+        # never pair a stale state with a newer simulated time.
+        snap = (state, steps_taken, t, comp)
+        try:
+          while (
+              t < t_end_cast
+              and steps_taken < config.adaptive_max_steps
+          ):
+            prev_steps = steps_taken
+            budget = min(block_cap,
+                         config.adaptive_max_steps - steps_taken)
+            res = run_block(state, budget=budget, t0=t, comp0=comp,
+                            acc0=acc)
+            jax.block_until_ready(res.state.positions)
+            state, acc = res.state, res.acc
+            t, comp = float(res.t), float(res.comp)
+            block_steps = int(res.steps)
+            if block_steps > 0:
+                dt_min = min(dt_min, float(res.dt_min))
+                dt_max_used = max(dt_max_used, float(res.dt_max_used))
+            if config.nan_check and not self._state_finite(state):
+                if checkpoint_manager is not None and snap[1] > 0:
+                    from .utils.checkpoint import save_checkpoint
+
+                    save_checkpoint(
+                        checkpoint_manager, snap[1], snap[0],
+                        extra={"t": snap[2], "comp": snap[3]},
+                    )
+                if logger is not None:
+                    logger.log_print(
+                        f"DIVERGED during adaptive run (after "
+                        f"{steps_taken} steps)"
+                    )
+                raise SimulationDiverged(steps_taken)
+            now = timer.mark()
+            block_elapsed = now - block_prev
+            block_prev = now
+            steps_taken += block_steps
+            snap = (state, steps_taken, t, comp)
+            self.state, self._last_step = state, steps_taken
+            if logger is not None:
+                logger.log_print(
+                    f"t={t:.6g}/{t_end:.6g} ({steps_taken} adaptive "
+                    f"steps, dt in [{float(res.dt_min):.3g}, "
+                    f"{float(res.dt_max_used):.3g}])"
+                )
+            if metrics_logger is not None:
+                from .utils.timing import pairs_per_step
+
+                metrics_logger.log(
+                    step=steps_taken,
+                    block_steps=block_steps,
+                    block_s=block_elapsed,
+                    t=t,
+                    dt_min=float(res.dt_min) if block_steps else None,
+                    dt_max=float(res.dt_max_used) if block_steps else None,
+                    pairs_per_sec=(
+                        pairs_per_step(self.n_real) * block_steps
+                        / block_elapsed
+                        if block_elapsed > 0 else None
+                    ),
+                )
+            if trajectory_writer is not None and block_steps > 0:
+                frame = np.asarray(
+                    jax.device_get(state.positions)
+                )[: self.n_real]
+                trajectory_writer.record(steps_taken, frame)
+            if (
+                checkpoint_manager is not None
+                and config.checkpoint_every
+                and (steps_taken // config.checkpoint_every)
+                > (prev_steps // config.checkpoint_every)
+            ):
+                from .utils.checkpoint import save_checkpoint
+
+                save_checkpoint(
+                    checkpoint_manager, steps_taken, state,
+                    extra={"t": t, "comp": comp},
+                )
+            if block_steps == 0:
+                break  # t >= t_end in state dtype; nothing advanced
+        except KeyboardInterrupt:
+            if checkpoint_manager is not None and snap[1] > start_steps:
+                from .utils.checkpoint import save_checkpoint
+
+                save_checkpoint(
+                    checkpoint_manager, snap[1], snap[0],
+                    extra={"t": snap[2], "comp": snap[3]},
+                )
+                if logger is not None:
+                    logger.log_print(
+                        f"Interrupted at adaptive step {snap[1]} "
+                        f"(t={snap[2]:.6g}); checkpoint saved"
+                    )
+            raise
         timer.mark()
 
         if config.periodic_box > 0.0:
             # Same fp-health re-wrap the block loop applies (forces are
             # wrap-invariant; mid-run coordinates may exceed the box).
-            box = jnp.asarray(config.periodic_box,
-                              res.state.positions.dtype)
-            res = res._replace(
-                state=res.state.replace(
-                    positions=jnp.mod(res.state.positions, box)
-                )
-            )
-        self.state = res.state
-        steps_taken = int(res.steps)
-        if config.nan_check and not self._state_finite(res.state):
-            if logger is not None:
-                logger.log_print(
-                    f"DIVERGED during adaptive run (after {steps_taken} "
-                    "steps)"
-                )
-            raise SimulationDiverged(steps_taken)
+            box = jnp.asarray(config.periodic_box, state.positions.dtype)
+            state = state.replace(positions=jnp.mod(state.positions, box))
+            self.state = state
 
+        if trajectory_writer is not None:
+            trajectory_writer.close()
+
+        run_steps = steps_taken - start_steps
         stats = throughput(
             self.n_real,
-            max(steps_taken, 1),
+            max(run_steps, 1),
             timer.total,
             num_devices=self.mesh.size if self.mesh else 1,
         )
         stats.update(
             t_end=t_end,
-            t_reached=float(res.t),
+            t_reached=t,
             adaptive_steps=steps_taken,
-            dt_min=float(res.dt_min),
-            dt_max_used=float(res.dt_max_used),
+            dt_min=dt_min if dt_min != float("inf") else None,
+            dt_max_used=dt_max_used,
             criterion=criterion,
         )
         if steps_taken >= config.adaptive_max_steps and logger is not None:
             logger.log_print(
                 f"WARNING: max_steps={config.adaptive_max_steps} hit at "
-                f"t={float(res.t):.6g} of {t_end:.6g}"
+                f"t={t:.6g} of {t_end:.6g}"
             )
-        return self._finish(logger, timer.total, steps_taken, stats)
+        return self._finish(logger, timer.total, run_steps, stats)
 
     def final_state(self) -> ParticleState:
         """State restricted to the real (unpadded) particles, on host-default

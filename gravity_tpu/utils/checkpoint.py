@@ -29,13 +29,28 @@ def make_checkpoint_manager(
 
 
 def save_checkpoint(
-    manager: ocp.CheckpointManager, step: int, state: ParticleState
+    manager: ocp.CheckpointManager,
+    step: int,
+    state: ParticleState,
+    *,
+    extra: Optional[dict] = None,
 ) -> None:
+    """Snapshot (positions, velocities, masses) at ``step``.
+
+    ``extra`` holds scalar run metadata beyond the step counter — e.g.
+    adaptive runs store the simulated time ``t`` (float64, since fp32
+    cannot address individual steps near large t) and the Kahan
+    compensation so a resume continues the exact time accumulation.
+    Keys are namespaced ``extra_*`` in the payload, so old checkpoints
+    (without extras) restore unchanged.
+    """
     payload = {
         "positions": state.positions,
         "velocities": state.velocities,
         "masses": state.masses,
     }
+    for k, v in (extra or {}).items():
+        payload[f"extra_{k}"] = np.asarray(v, np.float64)
     manager.save(step, args=ocp.args.StandardSave(payload))
     manager.wait_until_finished()
 
@@ -43,6 +58,15 @@ def save_checkpoint(
 def restore_checkpoint(
     manager: ocp.CheckpointManager, step: Optional[int] = None
 ) -> tuple[ParticleState, int]:
+    state, step, _ = restore_checkpoint_with_extra(manager, step)
+    return state, step
+
+
+def restore_checkpoint_with_extra(
+    manager: ocp.CheckpointManager, step: Optional[int] = None
+) -> tuple[ParticleState, int, dict]:
+    """Like :func:`restore_checkpoint` but also returns the ``extra``
+    scalar metadata dict ({} for checkpoints saved without extras)."""
     if step is None:
         step = manager.latest_step()
         if step is None:
@@ -53,4 +77,9 @@ def restore_checkpoint(
         velocities=jax.numpy.asarray(np.asarray(restored["velocities"])),
         masses=jax.numpy.asarray(np.asarray(restored["masses"])),
     )
-    return state, step
+    extra = {
+        k[len("extra_"):]: float(np.asarray(v))
+        for k, v in restored.items()
+        if k.startswith("extra_")
+    }
+    return state, step, extra

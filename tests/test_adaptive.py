@@ -137,15 +137,113 @@ def test_cli_adaptive_run(tmp_path, capsys):
     assert stats["criterion"] == "accel"
 
 
-def test_cli_adaptive_rejects_streaming(tmp_path, capsys):
+def test_cli_adaptive_rejects_merge(tmp_path, capsys):
+    """Collision merging needs the fixed-dt block loop; --adaptive with
+    --merge-radius is a config error (trajectory/checkpoint/metrics
+    streaming, by contrast, now works in adaptive mode)."""
     from gravity_tpu.cli import main
 
     rc = main([
         "run", "--model", "plummer", "--n", "32", "--steps", "5",
-        "--adaptive", "--trajectories", "--force-backend", "dense",
+        "--adaptive", "--merge-radius", "1e9", "--force-backend", "dense",
         "--log-dir", str(tmp_path / "logs"),
     ])
     assert rc == 1
+
+
+def test_cli_adaptive_streams_trajectories_and_metrics(tmp_path, capsys):
+    """Block-wise adaptive runs stream trajectory frames and metrics
+    (VERDICT r1 item 5 — round 1 hard-errored on this combination)."""
+    import json
+    import os
+
+    from gravity_tpu.cli import main
+
+    log_dir = tmp_path / "logs"
+    rc = main([
+        "run", "--model", "plummer", "--n", "32", "--steps", "5",
+        "--adaptive", "--trajectories", "--metrics",
+        "--eps", "1e9", "--progress-every", "2",
+        "--force-backend", "dense", "--log-dir", str(log_dir),
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    stats = json.loads(out.strip().splitlines()[-1])
+    assert stats["adaptive_steps"] > 0
+    names = os.listdir(log_dir)
+    traj_dirs = [x for x in names if x.startswith("trajectories_")]
+    assert traj_dirs, names
+    metrics = [x for x in names if x.startswith("metrics_")]
+    assert metrics, names
+    lines = [
+        json.loads(line)
+        for line in (log_dir / metrics[0]).read_text().splitlines()
+    ]
+    assert lines and all("t" in rec for rec in lines)
+
+
+def test_adaptive_checkpoint_resume_matches_uninterrupted(tmp_path):
+    """An adaptive run interrupted mid-way (max_steps cap) resumes from
+    its checkpoint and lands on the same final state as one
+    uninterrupted run — the crash-recovery story VERDICT r1 flagged as
+    missing in adaptive mode."""
+    from gravity_tpu.config import SimulationConfig
+    from gravity_tpu.simulation import Simulator
+    from gravity_tpu.utils.checkpoint import (
+        make_checkpoint_manager,
+        restore_checkpoint_with_extra,
+    )
+
+    base = dict(
+        model="plummer", n=24, steps=40, dt=2.0e4, eps=1.0e9,
+        integrator="leapfrog", force_backend="dense", adaptive=True,
+        eta=0.05, progress_every=4, checkpoint_every=4, seed=3,
+    )
+
+    # Uninterrupted reference run.
+    full = Simulator(SimulationConfig(**base)).run_adaptive()
+    assert full["t_reached"] == pytest.approx(
+        base["steps"] * base["dt"], rel=1e-6
+    )
+
+    # Interrupted run: cap total adaptive steps below what t_end needs.
+    cfg1 = SimulationConfig(**{**base, "adaptive_max_steps": 12})
+    sim1 = Simulator(cfg1)
+    mgr = make_checkpoint_manager(str(tmp_path / "ckpt"))
+    part = sim1.run_adaptive(checkpoint_manager=mgr)
+    assert part["t_reached"] < base["steps"] * base["dt"]
+
+    # Resume from the persisted checkpoint to completion.
+    state, step, extra = restore_checkpoint_with_extra(mgr)
+    assert step == 12 and "t" in extra
+    sim2 = Simulator(SimulationConfig(**base), state=state)
+    done = sim2.run_adaptive(
+        checkpoint_manager=mgr, start_t=extra["t"],
+        start_comp=extra.get("comp", 0.0), start_steps=step,
+    )
+    assert done["t_reached"] == pytest.approx(
+        base["steps"] * base["dt"], rel=1e-6
+    )
+    assert done["adaptive_steps"] == full["adaptive_steps"]
+    np.testing.assert_allclose(
+        np.asarray(done["final_state"].positions),
+        np.asarray(full["final_state"].positions),
+        rtol=1e-5,
+    )
+
+
+def test_run_adaptive_rejects_merge_radius():
+    """Python-API callers get the same guard as the CLI (advisor r1):
+    merging must not be silently dropped."""
+    from gravity_tpu.config import SimulationConfig
+    from gravity_tpu.simulation import Simulator
+
+    cfg = SimulationConfig(
+        model="plummer", n=16, steps=2, adaptive=True, eps=1e9,
+        merge_radius=1e9, force_backend="dense",
+    )
+    with pytest.raises(ValueError, match="merge"):
+        Simulator(cfg).run_adaptive()
 
 
 def test_dt_floor_prevents_stall_with_at_rest_particle(x64):
@@ -220,3 +318,19 @@ def test_accel_criterion_runs(key, x64):
     )
     assert float(res.t) == pytest.approx(3.0e4, rel=1e-12)
     assert np.isfinite(np.asarray(res.state.positions)).all()
+
+
+def test_adaptive_max_steps_is_exact_bound():
+    """adaptive_max_steps is honored exactly even when it does not
+    divide the block size (the final block shrinks its budget)."""
+    from gravity_tpu.config import SimulationConfig
+    from gravity_tpu.simulation import Simulator
+
+    cfg = SimulationConfig(
+        model="plummer", n=16, steps=1000, dt=1.0e5, eps=1.0e9,
+        integrator="leapfrog", force_backend="dense", adaptive=True,
+        eta=0.001, progress_every=4, adaptive_max_steps=10,
+    )
+    stats = Simulator(cfg).run_adaptive()
+    assert stats["adaptive_steps"] == 10
+    assert stats["t_reached"] < cfg.steps * cfg.dt
