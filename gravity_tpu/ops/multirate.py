@@ -121,6 +121,139 @@ def two_rung_step(
     return state.replace(positions=x, velocities=v), new_acc
 
 
+def two_rung_step_sharded(
+    state: ParticleState,
+    acc: jax.Array,
+    dt: float,
+    *,
+    mesh,
+    rect_accel: AccelVs,
+    fast_fast: AccelVs,
+    accel_full: Callable,
+    k: int,
+    n_sub: int = 4,
+) -> tuple[ParticleState, jax.Array]:
+    """Sharding-friendly two-rung step (same scheme as
+    :func:`two_rung_step`; algebraically identical, different data
+    layout).
+
+    The fast rung lives in small REPLICATED (K, ·) arrays during the
+    substep loop, so per-substep work is K-sized gathers/kicks plus one
+    rectangular ``rect_accel(x_f, x, masses_slow)`` against the SHARDED
+    slow sources (fast masses zeroed — their sharded rows go stale while
+    sub-cycling) and a dense replicated ``fast_fast(x_f, x_f, m_f)``
+    for the fast-fast pairs. The sum equals the original (K, N)
+    evaluation because forces are mass-linear. Sharded scatters touch
+    the state exactly twice per outer step (fast write-back), not per
+    substep.
+    """
+    if n_sub < 1:
+        raise ValueError(f"n_sub must be >= 1, got {n_sub}")
+    dtype = state.positions.dtype
+    masses = state.masses
+    dt = jnp.asarray(dt, dtype)
+    dt_s = dt / n_sub
+    half = 0.5 * dt
+    half_s = 0.5 * dt_s
+
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    rep = NamedSharding(mesh, PartitionSpec())
+
+    # Fast-rung selection happens on replicated copies: top_k's (K,)
+    # output cannot keep a particle partition (K < shard count is the
+    # common case) and GSPMD refuses the layout. jax.sharding.reshard
+    # is the explicit-sharding-mode API (with_sharding_constraint does
+    # not relayout explicit-axis operands). The replicated copies are
+    # reused for the fast-rung gathers below — one all-gather each per
+    # outer step.
+    acc_rep = jax.sharding.reshard(acc, rep)
+    masses_rep = jax.sharding.reshard(masses, rep)
+    fast_idx = select_fast(acc_rep, masses_rep, k=k)
+
+    part = PartitionSpec(mesh.axis_names)
+    fast_mask_rep = jnp.zeros((state.n,), bool).at[fast_idx].set(
+        True, out_sharding=rep
+    )
+    fast_mask = jax.sharding.reshard(
+        fast_mask_rep, NamedSharding(mesh, part)
+    )
+    slow_w = jnp.where(fast_mask, 0.0, 1.0).astype(dtype)[:, None]
+    masses_slow = jnp.where(fast_mask, jnp.asarray(0.0, dtype), masses)
+    x, v = state.positions, state.velocities
+
+    # Pull the fast rung into replicated K-sized arrays.
+    x_rep = jax.sharding.reshard(x, rep)
+    v_rep = jax.sharding.reshard(v, rep)
+    x_f = x_rep[fast_idx]
+    v_f = v_rep[fast_idx]
+    a_f = acc_rep[fast_idx]
+    m_f = masses_rep[fast_idx]
+
+    # Opening slow kick (fast rows untouched: slow_w is 0 there).
+    v = v + slow_w * acc * half
+
+    def substep(carry, _):
+        x, x_f, v_f, a_f = carry
+        v_f = v_f + a_f * half_s
+        # Slow rows drift at their constant (post-kick) velocity; fast
+        # rows of the sharded x are left stale — they are zero-mass
+        # sources and get overwritten after the loop.
+        x = x + slow_w * v * dt_s
+        x_f = x_f + v_f * dt_s
+        a_f = rect_accel(x_f, x, masses_slow) + fast_fast(x_f, x_f, m_f)
+        v_f = v_f + a_f * half_s
+        return (x, x_f, v_f, a_f), None
+
+    (x, x_f, v_f, _), _ = jax.lax.scan(
+        substep, (x, x_f, v_f, a_f), None, length=n_sub
+    )
+
+    # Write the sub-cycled fast rung back into the sharded state: the
+    # scatter goes through a replicated copy (explicit-mode scatter
+    # into a particle-sharded operand with replicated indices has no
+    # unambiguous layout), then reshards to the particle partition.
+    x = jax.sharding.reshard(
+        jax.sharding.reshard(x, rep).at[fast_idx].set(
+            x_f, out_sharding=rep
+        ),
+        NamedSharding(mesh, part),
+    )
+    v = jax.sharding.reshard(
+        jax.sharding.reshard(v, rep).at[fast_idx].set(
+            v_f, out_sharding=rep
+        ),
+        NamedSharding(mesh, part),
+    )
+
+    new_acc = accel_full(x, masses)
+    v = v + slow_w * new_acc * half
+    return state.replace(positions=x, velocities=v), new_acc
+
+
+def make_multirate_sharded_step_fn(
+    mesh,
+    rect_accel: AccelVs,
+    fast_fast: AccelVs,
+    accel_full: Callable,
+    dt: float,
+    *,
+    k: int,
+    n_sub: int = 4,
+):
+    """(state, acc) -> (state, acc), sharded-layout multirate step."""
+    if n_sub < 1:
+        raise ValueError(f"n_sub must be >= 1, got {n_sub}")
+
+    def step(state, acc):
+        return two_rung_step_sharded(
+            state, acc, dt, mesh=mesh, rect_accel=rect_accel,
+            fast_fast=fast_fast, accel_full=accel_full, k=k, n_sub=n_sub,
+        )
+
+    return step
+
+
 def make_multirate_step_fn(
     accel_vs: AccelVs, dt: float, *, k: int, n_sub: int = 4,
     accel_full: Callable | None = None,

@@ -12,7 +12,11 @@ from .mesh import (
     shard_state,
 )
 from .multislice import hierarchical_ring_accel
-from .sharded import make_sharded_accel2, make_sharded_accel_fn
+from .sharded import (
+    make_sharded_accel2,
+    make_sharded_accel_fn,
+    make_sharded_rect_accel,
+)
 
 __all__ = [
     "DCN_AXIS",
@@ -22,6 +26,7 @@ __all__ = [
     "make_particle_mesh",
     "make_sharded_accel2",
     "make_sharded_accel_fn",
+    "make_sharded_rect_accel",
     "num_shards",
     "particle_sharding",
     "particle_spec",

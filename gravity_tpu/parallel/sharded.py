@@ -111,6 +111,37 @@ def make_sharded_accel2(
     )
 
 
+def make_sharded_rect_accel(
+    mesh: Mesh,
+    local_kernel: LocalKernel,
+) -> Callable[[jax.Array, jax.Array, jax.Array], jax.Array]:
+    """``(targets (K,3) replicated, positions sharded, masses sharded)
+    -> (K,3) replicated`` rectangular force evaluation.
+
+    The multirate fast rung's kick: a small replicated target set
+    against the full sharded source set. Each chip evaluates its source
+    shard against all K targets, then one ``psum`` over every mesh axis
+    reduces the partial forces — no source gather at all, so the per-
+    substep cost is O(K·N/P) compute + one K-sized all-reduce (the
+    collective rides ICI; compare the reference's full-state
+    Allgatherv per step, `/root/reference/mpi.c:227-231`).
+    """
+    axes = mesh.axis_names
+    spec = P(axes)
+
+    def body(targets, pos_l, m_l):
+        partial_acc = local_kernel(targets, pos_l, m_l)
+        return jax.lax.psum(partial_acc, axes)
+
+    return jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(), spec, spec),
+        out_specs=P(),
+        check_vma=False,
+    )
+
+
 def make_sharded_accel_fn(
     mesh: Mesh,
     masses: jax.Array,

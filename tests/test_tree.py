@@ -197,3 +197,46 @@ def test_quadrupole_improves_accuracy(key):
     )
     assert np.median(rel_q) < 0.005, np.median(rel_q)
     assert np.median(rel_q) < 0.5 * np.median(rel_m)
+
+
+def test_recommended_depth_data_beats_count_only(key):
+    """Data-driven depth selection resolves lower-dimensional
+    distributions the count-only heuristic under-resolves: a thin disk
+    occupies ~side^2 of the side^3 leaves, so recommended_depth(n) is
+    ~2 levels too shallow there (~30% median force error vs <2%)."""
+    from gravity_tpu.models import create_disk
+    from gravity_tpu.ops.tree import (
+        recommended_depth,
+        recommended_depth_data,
+    )
+
+    n = 2048
+    state = create_disk(key, n)
+    d_count = recommended_depth(n)
+    d_data = recommended_depth_data(state.positions)
+    assert d_data > d_count  # the disk needs more resolution
+
+    exact = pairwise_accelerations_dense(
+        state.positions, state.masses, g=1.0, eps=0.05
+    )
+    approx = tree_accelerations(
+        state.positions, state.masses, depth=d_data, g=1.0, eps=0.05
+    )
+    rel = _rel_err(approx, exact)
+    assert np.median(rel) < 0.02, f"median {np.median(rel):.4f}"
+
+
+def test_recommended_depth_data_uniform_matches_count(key):
+    """On genuinely uniform 3D data the two heuristics agree to within a
+    level, and the memory-capped max depth is respected."""
+    from gravity_tpu.ops.tree import (
+        recommended_depth,
+        recommended_depth_data,
+    )
+
+    n = 4096
+    pos = jax.random.uniform(key, (n, 3), jnp.float32) * 1e12
+    d_count = recommended_depth(n)
+    d_data = recommended_depth_data(pos)
+    assert abs(d_data - d_count) <= 1
+    assert recommended_depth_data(pos, max_depth=3) <= 3
