@@ -11,11 +11,19 @@ Usage:
 
 from __future__ import annotations
 
+import os
 import sys
 import time
 
-import jax
-import jax.numpy as jnp
+# Runnable as `python benchmarks/profile_tree.py` from the repo root.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from gravity_tpu.utils.platform import ensure_live_backend  # noqa: E402
+
+ensure_live_backend()
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
 
 
 def timed(fn, *args, iters=3, label=""):
@@ -39,14 +47,14 @@ def main(argv) -> int:
     from gravity_tpu.models import create_disk
     from gravity_tpu.ops.tree import (
         build_octree,
-        recommended_depth,
+        recommended_depth_data,
         tree_accelerations,
     )
 
     platform = jax.devices()[0].platform
     state = create_disk(jax.random.PRNGKey(0), n)
     pos, masses = state.positions, state.masses
-    depth = recommended_depth(n)
+    depth = recommended_depth_data(pos)
     side = 1 << depth
     print(f"platform={platform} n={n} depth={depth} side={side}")
 

@@ -42,6 +42,10 @@ def _add_config_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--multirate-k", dest="multirate_k", type=int,
                    default=None,
                    help="fast-rung capacity (0 = auto: n/8)")
+    p.add_argument("--multirate-rungs", dest="multirate_rungs", type=int,
+                   default=None,
+                   help="timestep rungs (2 = classic two-rung; >2 = "
+                        "power-of-two ladder, rung r at dt/2^r)")
     p.add_argument("--multirate-sub", dest="multirate_sub", type=int,
                    default=None, help="substeps per outer step")
     p.add_argument("--dtype",

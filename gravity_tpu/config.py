@@ -37,6 +37,10 @@ class SimulationConfig:
     integrator: str = "euler"
     multirate_k: int = 0  # fast-rung capacity; 0 = auto (n // 8)
     multirate_sub: int = 4  # substeps per outer step for the fast rung
+    # >2 switches to the power-of-two rung ladder (GADGET-style): rung r
+    # steps at dt/2^r with static capacity k // 8^(r-1); multirate_sub
+    # is ignored there (each level sub-cycles 2x the one above).
+    multirate_rungs: int = 2
     dtype: str = "float32"
     # auto (scale-aware, may pick an approximate fast solver) | direct
     # (scale-aware among EXACT O(N^2) backends only) | dense | chunked |

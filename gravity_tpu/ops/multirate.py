@@ -269,3 +269,250 @@ def make_multirate_step_fn(
         )
 
     return step
+
+
+def rung_segments(capacities):
+    """Static (start, cap) slices of the |a|-ranked union index,
+    fastest rung first — the ONE encoding of the rung layout shared by
+    the sharded and unsharded ladders (``capacities`` is ordered
+    slowest-extra first; the fastest rung takes the highest-|a| block).
+    """
+    seg = []
+    start = 0
+    for cap in reversed(capacities):
+        seg.append((start, cap))
+        start += cap
+    return seg
+
+
+def assign_rungs(acc, masses, *, capacities):
+    """(union_idx, per-rung index arrays) from |a| ranking with STATIC
+    capacities.
+
+    ``capacities[r]`` is the static size of rung r+1 (rung 0 is "the
+    rest"); the |a|-ranked top sum(capacities) particles fill the
+    fastest rung first (GADGET assigns by a per-particle dt criterion —
+    `select_fast`'s |a| ranking is the same ordering for the
+    acceleration criterion at fixed eps). Per-rung arrays come fastest
+    first. Zero-mass particles (padding/tracers) never leave rung 0.
+    """
+    union_idx = select_fast(acc, masses, k=sum(capacities))
+    return union_idx, [
+        union_idx[s:s + cap] for s, cap in rung_segments(capacities)
+    ]
+
+
+def rung_ladder_step(
+    state: ParticleState,
+    acc: jax.Array,
+    dt: float,
+    *,
+    accel_vs: AccelVs,
+    capacities: tuple,
+    accel_full: Callable | None = None,
+) -> tuple[ParticleState, jax.Array]:
+    """One outer KDK step of an R-rung power-of-two block-timestep
+    ladder (GADGET-style; the static-capacity TPU formulation).
+
+    Rung 0 (every particle not in a faster rung) steps at dt; rung r
+    steps at dt / 2^r. ``capacities[r-1]`` is rung r's static size, so
+    R = len(capacities) + 1 and the fastest rung sub-cycles 2^(R-1)
+    times. All rungs drift together on the finest grid (positions are
+    always current); rung r's force is re-evaluated 2^r times per outer
+    step as a (K_r, N) rectangular kernel against ALL sources — the
+    same cost model as :func:`two_rung_step`, one level per scale
+    octave instead of a single fast set.
+
+    Cost per outer step: 1 full eval + sum_r 2^r * K_r * N rectangular
+    pair evals. Reduces to ``two_rung_step(k=K, n_sub=2)`` at R=2.
+
+    The micro-step schedule is unrolled at trace time (2^(R-1) steps;
+    keep R <= ~5). Kicks chain KDK-style within each rung: a rung's
+    closing half-kick and next opening half-kick merge into one full
+    kick at its boundaries, using the force at the current (drifted)
+    positions — so each rung sees a time-centred force at its own
+    cadence.
+    """
+    n_rungs = len(capacities) + 1
+    if n_rungs < 2:
+        raise ValueError("need at least one fast-rung capacity")
+    if any(c < 1 for c in capacities):
+        raise ValueError(f"capacities must be >= 1, got {capacities}")
+    if accel_full is None:
+        accel_full = lambda pos, m: accel_vs(pos, pos, m)  # noqa: E731
+    dtype = state.positions.dtype
+    masses = state.masses
+    dt = jnp.asarray(dt, dtype)
+    n_micro = 1 << (n_rungs - 1)
+    dt_min = dt / n_micro
+
+    # fastest first: rung_idx[0] is the fastest (smallest dt) set.
+    union_idx, rung_idx = assign_rungs(acc, masses, capacities=capacities)
+    # A particle in any fast rung must NOT also be kicked as rung 0
+    # (the slow remainder): one union scatter builds the slow weight.
+    fast_mask = jnp.zeros((state.n,), bool).at[union_idx].set(True)
+    slow_w = jnp.where(fast_mask, 0.0, 1.0).astype(dtype)[:, None]
+
+    x, v = state.positions, state.velocities
+
+    # Opening half-kicks, every rung (slow rung via mask, fast rungs via
+    # their index sets; rung r's half step is dt / 2^r / 2).
+    v = v + slow_w * acc * (0.5 * dt)
+    for f, idx in enumerate(rung_idx):
+        r = n_rungs - 1 - f  # rung number (fastest f=0 -> r=R-1)
+        half_r = 0.5 * dt / (1 << r)
+        v = v.at[idx].add(acc[idx] * half_r)
+
+    # Micro-step schedule, unrolled: drift on the finest grid; at each
+    # rung-r boundary re-evaluate that rung's force and kick (full kick
+    # mid-stream = closing half + next opening half; half kick at the
+    # outer-step end).
+    for i in range(n_micro):
+        x = x + v * dt_min
+        for f, idx in enumerate(rung_idx):
+            r = n_rungs - 1 - f
+            period = 1 << (n_rungs - 1 - r)  # micro-steps per rung-r step
+            if (i + 1) % period == 0:
+                a_r = accel_vs(x[idx], x, masses)
+                last = (i + 1) == n_micro
+                factor = (0.5 if last else 1.0) * dt / (1 << r)
+                v = v.at[idx].add(a_r * factor)
+
+    # Closing slow half-kick at the final positions; full force becomes
+    # the next carry.
+    new_acc = accel_full(x, masses)
+    v = v + slow_w * new_acc * (0.5 * dt)
+    return state.replace(positions=x, velocities=v), new_acc
+
+
+def make_rung_ladder_step_fn(
+    accel_vs: AccelVs, dt: float, *, capacities: tuple,
+    accel_full: Callable | None = None,
+):
+    """(state, acc) -> (state, acc), drop-in for make_step_fn's shape."""
+
+    def step(state, acc):
+        return rung_ladder_step(
+            state, acc, dt, accel_vs=accel_vs, capacities=tuple(capacities),
+            accel_full=accel_full,
+        )
+
+    return step
+
+
+def rung_ladder_step_sharded(
+    state: ParticleState,
+    acc: jax.Array,
+    dt: float,
+    *,
+    mesh,
+    rect_accel: AccelVs,
+    fast_fast: AccelVs,
+    accel_full: Callable,
+    capacities: tuple,
+) -> tuple[ParticleState, jax.Array]:
+    """Sharded R-rung ladder: the union of all fast rungs lives in
+    replicated (F, .) arrays (F = sum(capacities), small by
+    construction) exactly like :func:`two_rung_step_sharded`'s single
+    fast set; each rung boundary evaluates one psum-reduced rectangular
+    kick against the sharded slow sources plus a dense replicated
+    fast-fast block over the union (fast-fast pairs at CURRENT
+    positions regardless of rung — same algebra as the unsharded
+    ladder, which evaluates against all drifted sources).
+    """
+    n_rungs = len(capacities) + 1
+    if n_rungs < 2:
+        raise ValueError("need at least one fast-rung capacity")
+    if any(c < 1 for c in capacities):
+        raise ValueError(f"capacities must be >= 1, got {capacities}")
+    dtype = state.positions.dtype
+    masses = state.masses
+    dt = jnp.asarray(dt, dtype)
+    n_micro = 1 << (n_rungs - 1)
+    dt_min = dt / n_micro
+
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    rep = NamedSharding(mesh, PartitionSpec())
+    part = PartitionSpec(mesh.axis_names)
+
+    acc_rep = jax.sharding.reshard(acc, rep)
+    masses_rep = jax.sharding.reshard(masses, rep)
+    # Union fast set, fastest block first (the assign_rungs layout).
+    union_idx = select_fast(acc_rep, masses_rep, k=sum(capacities))
+
+    fast_mask_rep = jnp.zeros((state.n,), bool).at[union_idx].set(
+        True, out_sharding=rep
+    )
+    fast_mask = jax.sharding.reshard(
+        fast_mask_rep, NamedSharding(mesh, part)
+    )
+    slow_w = jnp.where(fast_mask, 0.0, 1.0).astype(dtype)[:, None]
+    masses_slow = jnp.where(fast_mask, jnp.asarray(0.0, dtype), masses)
+    x, v = state.positions, state.velocities
+
+    x_rep = jax.sharding.reshard(x, rep)
+    v_rep = jax.sharding.reshard(v, rep)
+    x_f = x_rep[union_idx]
+    v_f = v_rep[union_idx]
+    a_f = acc_rep[union_idx]
+    m_f = masses_rep[union_idx]
+
+    # Per-rung slices of the union (fastest first: rung r = R-1-f);
+    # all starts/sizes are trace-time constants, so plain slicing works.
+    seg = rung_segments(capacities)
+
+    # Opening half-kicks.
+    v = v + slow_w * acc * (0.5 * dt)
+    for f, (s, cap) in enumerate(seg):
+        r = n_rungs - 1 - f
+        half_r = 0.5 * dt / (1 << r)
+        v_f = v_f.at[s:s + cap].add(a_f[s:s + cap] * half_r)
+
+    for i in range(n_micro):
+        x = x + slow_w * v * dt_min  # slow rows drift; fast rows stale
+        x_f = x_f + v_f * dt_min
+        for f, (s, cap) in enumerate(seg):
+            r = n_rungs - 1 - f
+            period = 1 << (n_rungs - 1 - r)
+            if (i + 1) % period == 0:
+                x_r = x_f[s:s + cap]
+                a_r = rect_accel(x_r, x, masses_slow) + fast_fast(
+                    x_r, x_f, m_f
+                )
+                last = (i + 1) == n_micro
+                factor = (0.5 if last else 1.0) * dt / (1 << r)
+                v_f = v_f.at[s:s + cap].add(a_r * factor)
+
+    # Write the union back, then the closing slow half-kick.
+    x = jax.sharding.reshard(
+        jax.sharding.reshard(x, rep).at[union_idx].set(
+            x_f, out_sharding=rep
+        ),
+        NamedSharding(mesh, part),
+    )
+    v = jax.sharding.reshard(
+        jax.sharding.reshard(v, rep).at[union_idx].set(
+            v_f, out_sharding=rep
+        ),
+        NamedSharding(mesh, part),
+    )
+    new_acc = accel_full(x, masses)
+    v = v + slow_w * new_acc * (0.5 * dt)
+    return state.replace(positions=x, velocities=v), new_acc
+
+
+def make_rung_ladder_sharded_step_fn(
+    mesh, rect_accel: AccelVs, fast_fast: AccelVs, accel_full: Callable,
+    dt: float, *, capacities: tuple,
+):
+    """(state, acc) -> (state, acc), sharded-layout rung ladder."""
+
+    def step(state, acc):
+        return rung_ladder_step_sharded(
+            state, acc, dt, mesh=mesh, rect_accel=rect_accel,
+            fast_fast=fast_fast, accel_full=accel_full,
+            capacities=tuple(capacities),
+        )
+
+    return step

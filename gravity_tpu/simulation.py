@@ -310,6 +310,13 @@ class Simulator:
                     "multirate_sub >= 1; got "
                     f"k={config.multirate_k}, sub={config.multirate_sub}"
                 )
+            if not (2 <= config.multirate_rungs <= 6):
+                # 6 rungs = 32 unrolled micro-steps; beyond that the
+                # trace blows up and the capacities hit the floor anyway.
+                raise ValueError(
+                    "multirate_rungs must be in [2, 6]; got "
+                    f"{config.multirate_rungs}"
+                )
             base_kernel = make_local_kernel(
                 config, self.backend, positions=self.state.positions
             )
@@ -419,19 +426,46 @@ class Simulator:
             from .ops.multirate import (
                 make_multirate_sharded_step_fn,
                 make_multirate_step_fn,
+                make_rung_ladder_sharded_step_fn,
+                make_rung_ladder_step_fn,
             )
 
-            k = self.config.multirate_k or max(1, state.n // 8)
-            if self.mesh is not None:
+            k = min(self.config.multirate_k or max(1, state.n // 8),
+                    state.n)
+            rungs = self.config.multirate_rungs
+            if rungs > 2:
+                # Power-of-two ladder: rung r capacity k // 8^(r-1),
+                # floored at 1 (GADGET-style geometric occupancy).
+                capacities = tuple(
+                    max(1, k // (8 ** (r - 1))) for r in range(1, rungs)
+                )
+                if sum(capacities) > state.n:
+                    raise ValueError(
+                        f"rung capacities {capacities} (from "
+                        f"multirate_k={k}, rungs={rungs}) exceed "
+                        f"n={state.n}; lower multirate_k"
+                    )
+                if self.mesh is not None:
+                    step = make_rung_ladder_sharded_step_fn(
+                        self.mesh, self._rect_accel,
+                        self._fast_fast_kernel, self._accel2,
+                        self.config.dt, capacities=capacities,
+                    )
+                else:
+                    step = make_rung_ladder_step_fn(
+                        self._local_vs_kernel, self.config.dt,
+                        capacities=capacities, accel_full=self._accel2,
+                    )
+            elif self.mesh is not None:
                 step = make_multirate_sharded_step_fn(
                     self.mesh, self._rect_accel, self._fast_fast_kernel,
                     self._accel2, self.config.dt,
-                    k=min(k, state.n), n_sub=self.config.multirate_sub,
+                    k=k, n_sub=self.config.multirate_sub,
                 )
             else:
                 step = make_multirate_step_fn(
                     self._local_vs_kernel, self.config.dt,
-                    k=min(k, state.n), n_sub=self.config.multirate_sub,
+                    k=k, n_sub=self.config.multirate_sub,
                     # The once-per-step full eval goes through the
                     # backend's memory-bounded path (chunked/tree/...),
                     # not the dense rectangular kernel used for the

@@ -283,3 +283,93 @@ def test_zero_mass_padding_is_transparent(x64):
         np.asarray(padded_out.velocities[:8]),
         np.asarray(plain.velocities), rtol=1e-12,
     )
+
+
+def test_rung_ladder_r2_equals_two_rung(key, x64):
+    """The R=2 ladder is exactly the two-rung scheme at n_sub=2 (the
+    ladder's KDK chaining collapses to the same kick sequence)."""
+    from gravity_tpu.ops.multirate import rung_ladder_step, two_rung_step
+
+    state, _ = _binary_in_cloud(key, n_cloud=14)
+    acc0 = _accel_vs(state.positions, state.positions, state.masses)
+    a, acc_a = rung_ladder_step(
+        state, acc0, 1.0e3, accel_vs=_accel_vs, capacities=(4,)
+    )
+    b, acc_b = two_rung_step(
+        state, acc0, 1.0e3, accel_vs=_accel_vs, k=4, n_sub=2
+    )
+    np.testing.assert_allclose(
+        np.asarray(a.positions), np.asarray(b.positions), rtol=1e-12
+    )
+    np.testing.assert_allclose(
+        np.asarray(a.velocities), np.asarray(b.velocities), rtol=1e-12
+    )
+    np.testing.assert_allclose(
+        np.asarray(acc_a), np.asarray(acc_b), rtol=1e-12
+    )
+
+
+def test_rung_ladder_three_rungs_conserves_energy(key, x64):
+    """R=3 ladder on a binary-in-cloud system: runs, stays finite, and
+    keeps energy drift within the two-rung scheme's ballpark (the
+    ladder adds resolution octaves, not error)."""
+    from gravity_tpu.ops.diagnostics import total_energy
+    from gravity_tpu.ops.multirate import (
+        make_rung_ladder_step_fn,
+        make_multirate_step_fn,
+    )
+
+    state, _ = _binary_in_cloud(key, n_cloud=30)
+    e0 = float(total_energy(state))
+    acc0 = _accel_vs(state.positions, state.positions, state.masses)
+
+    def run(step_fn, steps=20):
+        st, acc = state, acc0
+        for _ in range(steps):
+            st, acc = step_fn(st, acc)
+        return st
+
+    ladder = run(make_rung_ladder_step_fn(
+        _accel_vs, 1.0e3, capacities=(8, 2)
+    ))
+    two = run(make_multirate_step_fn(_accel_vs, 1.0e3, k=8, n_sub=4))
+    drift_ladder = abs((float(total_energy(ladder)) - e0) / e0)
+    drift_two = abs((float(total_energy(two)) - e0) / e0)
+    assert np.isfinite(np.asarray(ladder.positions)).all()
+    assert drift_ladder < max(3 * drift_two, 1e-3), (
+        drift_ladder, drift_two,
+    )
+
+
+def test_rung_ladder_sharded_matches_unsharded():
+    """R=3 ladder over the 8-device mesh: replicated fast-union layout
+    must match the unsharded ladder."""
+    from gravity_tpu.config import SimulationConfig
+    from gravity_tpu.simulation import Simulator
+
+    base = dict(
+        model="plummer", n=61, steps=8, dt=5.0e3, eps=1e9, seed=13,
+        integrator="multirate", multirate_k=8, multirate_rungs=3,
+        force_backend="dense", dtype="float64",
+    )
+    jax.config.update("jax_enable_x64", True)
+    try:
+        rs = Simulator(SimulationConfig(sharding="allgather", **base)).run()
+        rl = Simulator(SimulationConfig(**base)).run()
+        np.testing.assert_allclose(
+            np.asarray(rs["final_state"].positions),
+            np.asarray(rl["final_state"].positions), rtol=1e-9,
+        )
+    finally:
+        jax.config.update("jax_enable_x64", False)
+
+
+def test_rung_count_validation():
+    from gravity_tpu.config import SimulationConfig
+    from gravity_tpu.simulation import Simulator
+
+    with pytest.raises(ValueError, match="multirate_rungs"):
+        Simulator(SimulationConfig(
+            model="plummer", n=32, integrator="multirate",
+            multirate_rungs=7, force_backend="dense",
+        ))
