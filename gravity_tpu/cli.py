@@ -73,8 +73,8 @@ def _add_config_args(p: argparse.ArgumentParser) -> None:
                    help="target-chunk size for tree/p3m evaluation")
     p.add_argument("--pm-assignment", dest="pm_assignment",
                    choices=["cic", "tsc"], default=None,
-                   help="periodic-solver mass assignment (tsc = smoother, "
-                        "27-point)")
+                   help="pm-solver mass assignment, periodic or isolated "
+                        "(tsc = smoother, 27-point)")
     p.add_argument("--periodic-box", dest="periodic_box", type=float,
                    default=None,
                    help="periodic unit-cell side (0 = isolated BCs); "

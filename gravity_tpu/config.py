@@ -74,7 +74,7 @@ class SimulationConfig:
     # unit cell, 0 = isolated boundaries. Requires force_backend "pm"
     # (the periodic FFT solver, ops.periodic); positions wrap mod box.
     periodic_box: float = 0.0
-    pm_assignment: str = "cic"  # cic | tsc (periodic solver mass assignment)
+    pm_assignment: str = "cic"  # cic | tsc (pm mass assignment, both BCs)
 
     # Analytic background field added to self-gravity (capability add).
     # Spec string, e.g. "nfw:gm=1e13,rs=2e20" or

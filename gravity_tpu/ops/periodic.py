@@ -28,18 +28,7 @@ import jax
 import jax.numpy as jnp
 
 from ..constants import G
-from .pm import cic_deposit, cic_gather, tsc_deposit, tsc_gather
-
-
-def _assignment_fns(assignment: str):
-    """(deposit, gather, window exponent) for a mass-assignment scheme."""
-    if assignment == "cic":
-        return cic_deposit, cic_gather, 2
-    if assignment == "tsc":
-        return tsc_deposit, tsc_gather, 3
-    raise ValueError(
-        f"unknown assignment {assignment!r}; choose 'cic' or 'tsc'"
-    )
+from .pm import assignment_fns as _assignment_fns
 
 
 def _mode_grids(grid, box, dtype):

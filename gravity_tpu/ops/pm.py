@@ -166,6 +166,20 @@ def tsc_gather(field, positions, origin, h, *, wrap: bool = False):
     return out
 
 
+def assignment_fns(assignment: str):
+    """(deposit, gather, k-space window exponent) for a mass-assignment
+    scheme — the ONE scheme registry shared by the isolated and
+    periodic solvers (the exponent only matters where a window
+    deconvolution is applied, i.e. the periodic k-space path)."""
+    if assignment == "cic":
+        return cic_deposit, cic_gather, 2
+    if assignment == "tsc":
+        return tsc_deposit, tsc_gather, 3
+    raise ValueError(
+        f"unknown assignment {assignment!r}; choose 'cic' or 'tsc'"
+    )
+
+
 def _greens_function(m2, h, eps, dtype):
     """Softened -1/r kernel on the padded (2M)^3 grid, wrapped so that
     negative separations index from the top (circular convolution sees the
@@ -187,7 +201,7 @@ def _greens_function(m2, h, eps, dtype):
 
 @partial(
     jax.jit,
-    static_argnames=("grid", "g", "eps"),
+    static_argnames=("grid", "g", "eps", "assignment"),
 )
 def pm_accelerations(
     positions: jax.Array,
@@ -196,18 +210,21 @@ def pm_accelerations(
     grid: int = 128,
     g: float = G,
     eps: float = 0.0,
+    assignment: str = "cic",
 ) -> jax.Array:
     """PM accelerations for all particles (isolated boundary conditions).
 
     The bounding cube is derived from the positions each call (the grid
     tracks the system as it evolves). ``eps`` is the Plummer softening;
     values below half a cell are clamped to the grid resolution floor.
+    ``assignment`` picks the deposit/interpolation scheme ('cic' or
+    'tsc' — TSC trades a 27-point stencil for smoother forces).
     """
     return pm_accelerations_vs(positions, positions, masses, grid=grid,
-                               g=g, eps=eps)
+                               g=g, eps=eps, assignment=assignment)
 
 
-@partial(jax.jit, static_argnames=("grid", "g", "eps"))
+@partial(jax.jit, static_argnames=("grid", "g", "eps", "assignment"))
 def pm_accelerations_vs(
     targets: jax.Array,
     positions: jax.Array,
@@ -216,13 +233,14 @@ def pm_accelerations_vs(
     grid: int = 128,
     g: float = G,
     eps: float = 0.0,
+    assignment: str = "cic",
 ) -> jax.Array:
     """PM accelerations at ``targets`` from sources (positions, masses) —
     the mesh solve is over the sources, the field gather at the targets
     (under sharded evaluation: replicated solve, sharded gather)."""
     origin, span = bounding_cube(positions)
     return pm_solve(targets, positions, masses, origin, span, grid=grid,
-                    g=g, eps=eps)
+                    g=g, eps=eps, assignment=assignment)
 
 
 def bounding_cube(positions):
@@ -236,7 +254,7 @@ def bounding_cube(positions):
     return origin, span
 
 
-@partial(jax.jit, static_argnames=("grid", "g", "eps"))
+@partial(jax.jit, static_argnames=("grid", "g", "eps", "assignment"))
 def pm_solve(
     targets,
     positions,
@@ -247,15 +265,20 @@ def pm_solve(
     grid: int,
     g: float,
     eps: float,
+    assignment: str = "cic",
 ):
     """PM solve (softened -1/r kernel) over an explicit bounding cube:
-    deposit the sources, gather the field at the targets."""
+    deposit the sources, gather the field at the targets. The real-space
+    Green's function applies no window deconvolution, so 'tsc' here
+    smooths slightly MORE than 'cic' (and is correspondingly less noisy
+    near the grid scale)."""
+    deposit, gather, _ = assignment_fns(assignment)
     dtype = positions.dtype
     m = grid
     m2 = 2 * m  # zero-padded transform size (isolated BCs)
     h = span / (m - 1)
 
-    rho = cic_deposit(positions, masses, m, origin, h)
+    rho = deposit(positions, masses, m, origin, h)
 
     # Convolve with the Green's function on the padded grid.
     rho_p = jnp.zeros((m2, m2, m2), dtype).at[:m, :m, :m].set(rho)
@@ -281,4 +304,4 @@ def pm_solve(
     acc_field = jnp.stack(
         [-grad_axis(phi, a) for a in range(3)], axis=-1
     )  # (M, M, M, 3)
-    return cic_gather(acc_field, targets, origin, h)
+    return gather(acc_field, targets, origin, h)

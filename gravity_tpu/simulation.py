@@ -177,7 +177,7 @@ def make_local_kernel(config: SimulationConfig, backend: str,
 
         return partial(
             pm_accelerations_vs, grid=config.pm_grid, g=config.g,
-            eps=config.eps,
+            eps=config.eps, assignment=config.pm_assignment,
         )
     if backend == "p3m":
         import warnings
@@ -394,7 +394,8 @@ class Simulator:
             from .ops.pm import pm_accelerations
 
             return lambda pos, m: pm_accelerations(
-                pos, m, grid=config.pm_grid, g=config.g, eps=config.eps
+                pos, m, grid=config.pm_grid, g=config.g, eps=config.eps,
+                assignment=config.pm_assignment,
             )
         if self.backend == "p3m":
             import warnings
