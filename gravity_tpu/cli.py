@@ -754,9 +754,10 @@ def cmd_analyze(args: argparse.Namespace) -> int:
 
 
 def cmd_cosmo(args: argparse.Namespace) -> int:
-    """Comoving cosmological run (EdS or flat LCDM): Zel'dovich ICs in a
-    periodic box, comoving KDK with the periodic FFT solver, and a
-    measured-vs-linear-theory growth report — the full cosmology stack
+    """Comoving cosmological run (EdS, LCDM, open/closed curvature, or
+    CPL evolving-w dark energy): Zel'dovich ICs in a periodic box,
+    comoving KDK with the periodic FFT solver, and a measured-vs-
+    linear-theory growth report — the full cosmology stack
     (grf -> ops.periodic -> ops.cosmo -> ops.spectra) in one command."""
     import time
 
@@ -787,9 +788,10 @@ def cmd_cosmo(args: argparse.Namespace) -> int:
     )
     lat = np.asarray(grf_lattice(side, box, dtype=st.positions.dtype))
     disp = (np.asarray(st.positions) - lat + box / 2) % box - box / 2
+    cosmo = dict(omega_k=args.omega_k, w0=args.w0, wa=args.wa)
     st = st.replace(
         velocities=growing_mode_momenta(
-            jnp.asarray(disp), a1, h0, args.omega_m
+            jnp.asarray(disp), a1, h0, args.omega_m, **cosmo
         )
     )
     # EdS/LCDM closure: Om * rho_crit0 = mean density -> G fixed.
@@ -806,18 +808,19 @@ def cmd_cosmo(args: argparse.Namespace) -> int:
     t0 = time.perf_counter()
     out = comoving_kdk_run(
         st, accel, a_start=a1, a_end=a2, n_steps=args.steps, h0=h0,
-        omega_m=args.omega_m,
+        omega_m=args.omega_m, **cosmo,
     )
     jax.block_until_ready(out.positions)
     elapsed = time.perf_counter() - t0
 
     disp2 = (np.asarray(out.positions) - lat + box / 2) % box - box / 2
     measured = float((disp2 * disp).sum() / (disp * disp).sum())
-    linear = linear_growth_ratio(a1, a2, args.omega_m)
+    linear = linear_growth_ratio(a1, a2, args.omega_m, **cosmo)
     print(json.dumps({
         "n": args.n, "box": box, "grid": grid,
         "a_start": a1, "a_end": a2, "steps": args.steps,
         "omega_m": args.omega_m,
+        "omega_k": args.omega_k, "w0": args.w0, "wa": args.wa,
         "assignment": args.pm_assignment,
         "growth_measured": measured,
         "growth_linear": linear,
@@ -966,6 +969,14 @@ def main(argv=None) -> int:
                               "as a box fraction")
     p_cosmo.add_argument("--spectral-index", dest="spectral_index",
                          type=float, default=-2.0)
+    p_cosmo.add_argument("--omega-k", dest="omega_k", type=float,
+                         default=0.0,
+                         help="curvature density (0 = flat)")
+    p_cosmo.add_argument("--w0", type=float, default=-1.0,
+                         help="dark-energy equation of state today "
+                              "(CPL w(a) = w0 + wa (1 - a))")
+    p_cosmo.add_argument("--wa", type=float, default=0.0,
+                         help="dark-energy EoS evolution (CPL)")
     p_cosmo.add_argument("--pm-assignment", dest="pm_assignment",
                          choices=["cic", "tsc"], default="cic")
     p_cosmo.add_argument("--seed", type=int, default=0)

@@ -26,6 +26,7 @@ from .adaptive import (
 )
 from .cosmo import (
     comoving_kdk_run,
+    e_of_a,
     eds_drift_factor,
     eds_kick_factor,
     growing_mode_momenta,
@@ -56,6 +57,7 @@ __all__ = [
     "density_power_spectrum",
     "center_of_mass",
     "comoving_kdk_run",
+    "e_of_a",
     "eds_drift_factor",
     "eds_kick_factor",
     "energy_drift",

@@ -53,9 +53,57 @@ def eds_drift_factor(a1, a2, h0):
     return (2.0 / h0) * (1.0 / a1**0.5 - 1.0 / a2**0.5)
 
 
-def lcdm_factors(a1, a2, h0, omega_m, *, n_quad: int = 512):
-    """(kick, drift) = (int dt/a, int dt/a^2) over [a1, a2] for flat
-    LambdaCDM: H(a) = H0 sqrt(Om/a^3 + (1 - Om)), dt = da / (a H).
+def _is_eds(omega_m, omega_k, w0, wa) -> bool:
+    """True when the parameters are exactly the EdS fast-path case —
+    the ONE gate for every analytic-EdS shortcut in this module."""
+    return omega_m == 1.0 and omega_k == 0.0 and w0 == -1.0 and wa == 0.0
+
+
+def _e2_terms(a, omega_m, omega_k, w0, wa):
+    """(E^2, dE^2/dlna) — both analytic for matter + curvature + CPL."""
+    import numpy as np
+
+    omega_de = 1.0 - omega_m - omega_k
+    q = -3.0 * (1.0 + w0 + wa)
+    de = omega_de * a**q * np.exp(-3.0 * wa * (1.0 - a))
+    mat = omega_m / a**3
+    cur = omega_k / a**2
+    e2 = mat + cur + de
+    # dln(de)/dlna = q + 3 wa a (the exponent's a-derivative times a).
+    de2 = -3.0 * mat - 2.0 * cur + de * (q + 3.0 * wa * a)
+    return e2, de2
+
+
+def e_of_a(a, omega_m, omega_k=0.0, w0=-1.0, wa=0.0):
+    """E(a) = H(a)/H0 for matter + curvature + CPL dark energy.
+
+    CPL equation of state w(a) = w0 + wa (1 - a) (Chevallier-Polarski-
+    Linder); the dark-energy density evolves as
+    a^(-3 (1 + w0 + wa)) * exp(-3 wa (1 - a)). Defaults reduce to flat
+    LambdaCDM, and omega_m = 1 (with flat, w=-1 defaults) to EdS. The
+    ONE H(a) definition shared by the KDK factors, growth solver, and
+    momentum setup — numpy in, numpy out (host-side float64).
+
+    Raises ValueError where E^2 <= 0 (a strongly closed universe that
+    recollapses inside the requested range) rather than returning NaN.
+    """
+    import numpy as np
+
+    e2, _ = _e2_terms(np.asarray(a, np.float64), omega_m, omega_k, w0, wa)
+    if np.any(e2 <= 0.0):
+        raise ValueError(
+            f"E^2(a) <= 0 for omega_m={omega_m}, omega_k={omega_k}, "
+            f"w0={w0}, wa={wa} at some requested a — this closed "
+            "universe recollapses inside the range; no expansion "
+            "history exists there"
+        )
+    return np.sqrt(e2)
+
+
+def lcdm_factors(a1, a2, h0, omega_m, *, omega_k=0.0, w0=-1.0, wa=0.0,
+                 n_quad: int = 512):
+    """(kick, drift) = (int dt/a, int dt/a^2) over [a1, a2] for
+    matter + curvature + CPL dark energy: H = H0 E(a), dt = da / (a H).
 
     Host-side float64 quadrature (the factors are trace-time constants);
     reduces to the EdS closed forms at omega_m = 1 (tested).
@@ -64,30 +112,74 @@ def lcdm_factors(a1, a2, h0, omega_m, *, n_quad: int = 512):
 
     trap = getattr(np, "trapezoid", None) or np.trapz  # numpy<2 compat
     a = np.linspace(float(a1), float(a2), n_quad + 1)
-    h = h0 * np.sqrt(omega_m / a**3 + (1.0 - omega_m))
+    h = h0 * e_of_a(a, omega_m, omega_k, w0, wa)
     dt_da = 1.0 / (a * h)
     kick = trap(dt_da / a, a)
     drift = trap(dt_da / a**2, a)
     return kick, drift
 
 
-def linear_growth_ratio(a1: float, a2: float, omega_m: float = 1.0,
-                        *, n_quad: int = 4096) -> float:
-    """D(a2)/D(a1) for flat LambdaCDM: D(a) ∝ H(a) int_0^a da'/(a'H)^3.
+def _growth_solve(a_targets, omega_m, omega_k=0.0, w0=-1.0, wa=0.0,
+                  *, a_init: float = 1e-4, n_steps: int = 4096):
+    """[(D(a), f(a) = dlnD/dlna) for a in a_targets] by ONE pass of the
+    linear growth ODE in u = ln a (host-side float64 RK4):
 
-    Host-side float64 quadrature; exactly a2/a1 at omega_m = 1 (EdS).
+        D'' + (2 + dlnE/dlna) D' = (3/2) Omega_m(a) D,
+        Omega_m(a) = omega_m a^-3 / E^2.
+
+    Valid for any (omega_m, omega_k, CPL w) with unclustered dark
+    energy — unlike the Heath integral E(a) int da/(aE)^3, which is
+    exact only for matter + Lambda + curvature. Seeded deep in matter
+    domination with the growing mode D = a, f = 1. ``a_targets`` must
+    be ascending; dlnE/dlna is analytic (no numeric differentiation).
     """
     import numpy as np
 
-    trap = getattr(np, "trapezoid", None) or np.trapz  # numpy<2 compat
+    def rhs(u, y):
+        d, dp = y  # D, dD/dlna
+        a = np.exp(u)
+        e2, de2 = _e2_terms(a, omega_m, omega_k, w0, wa)
+        om_a = omega_m / a**3 / e2
+        dln_e = 0.5 * de2 / e2
+        return np.array([dp, 1.5 * om_a * d - (2.0 + dln_e) * dp])
 
-    def d_of(a):
-        aa = np.linspace(1e-8, a, n_quad + 1)
-        e = np.sqrt(omega_m / aa**3 + (1.0 - omega_m))  # H/H0
-        integ = trap(1.0 / (aa * e) ** 3, aa)
-        return np.sqrt(omega_m / a**3 + (1.0 - omega_m)) * integ
+    def rk4_to(u, y, u_end, steps):
+        du = (u_end - u) / steps
+        for _ in range(steps):
+            k1 = rhs(u, y)
+            k2 = rhs(u + du / 2, y + du / 2 * k1)
+            k3 = rhs(u + du / 2, y + du / 2 * k2)
+            k4 = rhs(u + du, y + du * k3)
+            y = y + du / 6 * (k1 + 2 * k2 + 2 * k3 + k4)
+            u += du
+        return u, y
 
-    return float(d_of(a2) / d_of(a1))
+    y = np.array([a_init, a_init])  # growing mode deep in matter era
+    u = np.log(a_init)
+    u_span = np.log(float(a_targets[-1])) - u
+    out = []
+    for a_t in a_targets:
+        u_t = np.log(float(a_t))
+        seg = max(1, int(round(n_steps * (u_t - u) / u_span)))
+        u, y = rk4_to(u, y, u_t, seg)
+        out.append((float(y[0]), float(y[1] / y[0])))
+    return out
+
+
+def linear_growth_ratio(a1: float, a2: float, omega_m: float = 1.0,
+                        *, omega_k: float = 0.0, w0: float = -1.0,
+                        wa: float = 0.0, n_quad: int = 4096) -> float:
+    """D(a2)/D(a1) for matter + curvature + CPL dark energy (growth-ODE
+    solve; exactly a2/a1 at omega_m = 1)."""
+    if _is_eds(omega_m, omega_k, w0, wa):
+        return float(a2) / float(a1)
+    (d1, _), (d2, _) = _growth_solve(
+        sorted((float(a1), float(a2))), omega_m, omega_k, w0, wa,
+        n_steps=n_quad,
+    )
+    if a2 < a1:
+        d1, d2 = d2, d1
+    return d2 / d1
 
 
 def zeldovich_momenta(displacements, a, h0, dtype=None):
@@ -105,29 +197,28 @@ def zeldovich_momenta(displacements, a, h0, dtype=None):
     )
 
 
-def growth_rate(a: float, omega_m: float = 1.0) -> float:
-    """f = dlnD/dlna for flat LambdaCDM (1.0 exactly at omega_m = 1),
-    via central difference of the quadrature growth factor."""
-    if omega_m == 1.0:
+def growth_rate(a: float, omega_m: float = 1.0, *, omega_k: float = 0.0,
+                w0: float = -1.0, wa: float = 0.0) -> float:
+    """f = dlnD/dlna (1.0 exactly at EdS), from the growth-ODE solve."""
+    if _is_eds(omega_m, omega_k, w0, wa):
         return 1.0
-    import numpy as np
-
-    da = 1e-4 * a
-    r = linear_growth_ratio(a - da, a + da, omega_m)
-    return float(np.log(r) / (np.log(a + da) - np.log(a - da)))
+    [(_, f)] = _growth_solve([a], omega_m, omega_k, w0, wa)
+    return f
 
 
 def growing_mode_momenta(disp_now, a, h0, omega_m: float = 1.0,
-                         dtype=None):
+                         dtype=None, *, omega_k: float = 0.0,
+                         w0: float = -1.0, wa: float = 0.0):
     """Momenta from the CURRENT displacement field: the growing mode has
     dx/dt = (Ddot/D) * disp = f(a) H(a) disp, so
-    p = a^2 f(a) H(a) disp_now — valid for any flat LambdaCDM
-    (reduces to zeldovich_momenta's EdS form at omega_m = 1)."""
-    import numpy as np
-
+    p = a^2 f(a) H(a) disp_now — valid for any matter + curvature + CPL
+    cosmology (reduces to zeldovich_momenta's EdS form at omega_m = 1).
+    """
     dtype = dtype or disp_now.dtype
-    h = h0 * np.sqrt(omega_m / a**3 + (1.0 - omega_m))
-    scale = a * a * growth_rate(a, omega_m) * h
+    h = h0 * e_of_a(a, omega_m, omega_k, w0, wa)
+    scale = a * a * growth_rate(
+        a, omega_m, omega_k=omega_k, w0=w0, wa=wa
+    ) * h
     return jnp.asarray(scale, dtype) * disp_now
 
 
@@ -135,6 +226,7 @@ def growing_mode_momenta(disp_now, a, h0, omega_m: float = 1.0,
     jax.jit,
     static_argnames=(
         "accel_fn", "n_steps", "a_start", "a_end", "h0", "omega_m",
+        "omega_k", "w0", "wa",
     ),
 )
 def comoving_kdk_run(
@@ -146,6 +238,9 @@ def comoving_kdk_run(
     n_steps: int,
     h0: float,
     omega_m: float = 1.0,
+    omega_k: float = 0.0,
+    w0: float = -1.0,
+    wa: float = 0.0,
 ) -> ParticleState:
     """Integrate from a_start to a_end in n_steps comoving KDK steps.
 
@@ -153,11 +248,13 @@ def comoving_kdk_run(
     acceleration (the periodic solver on comoving coordinates with the
     COMOVING particle masses); ``state.velocities`` carries p = a^2 dx/dt
     on input and output. Steps are uniform in log(a) — the natural
-    spacing when D grows as a power of a. ``omega_m = 1`` is EdS
-    (analytic factors); other values use flat-LambdaCDM quadrature.
-    The comoving Poisson source is Om * rho_crit0 * delta / a — the
-    caller's G/mass normalization fixes Om implicitly via the mean
-    density, and dark energy enters only through H(a) in the factors.
+    spacing when D grows as a power of a. ``omega_m = 1`` (flat, w=-1)
+    is EdS (analytic factors); anything else — open/closed curvature
+    via ``omega_k``, CPL dark energy via ``(w0, wa)`` — uses float64
+    quadrature of E(a). The comoving Poisson source is
+    Om * rho_crit0 * delta / a — the caller's G/mass normalization
+    fixes Om implicitly via the mean density, and curvature/dark energy
+    enter only through H(a) in the factors (unclustered dark energy).
     """
     import numpy as np
 
@@ -172,7 +269,7 @@ def comoving_kdk_run(
     # over [a1, a_mid], full drift over [a1, a2], half-kick over
     # [a_mid, a2]. The comoving Poisson 1/a is the integrand of the kick
     # factor itself (int dt / a) — nothing extra to divide by.
-    if omega_m == 1.0:
+    if _is_eds(omega_m, omega_k, w0, wa):
         k1s = jnp.asarray(
             eds_kick_factor(a_edges_np[:-1], a_mids_np, h0), dtype
         )
@@ -183,12 +280,13 @@ def comoving_kdk_run(
             eds_kick_factor(a_mids_np, a_edges_np[1:], h0), dtype
         )
     else:
+        cosmo = dict(omega_k=omega_k, w0=w0, wa=wa)
         pairs1 = [
-            lcdm_factors(a1, am, h0, omega_m)
+            lcdm_factors(a1, am, h0, omega_m, **cosmo)
             for a1, am in zip(a_edges_np[:-1], a_mids_np)
         ]
         pairs2 = [
-            lcdm_factors(am, a2, h0, omega_m)
+            lcdm_factors(am, a2, h0, omega_m, **cosmo)
             for am, a2 in zip(a_mids_np, a_edges_np[1:])
         ]
         k1s = jnp.asarray([p[0] for p in pairs1], dtype)

@@ -161,3 +161,86 @@ def test_from_rest_grows_slower(x64):
     growth = (disp2 * disp).sum() / (disp * disp).sum()
     want = 0.6 * 2.0 + 0.4 * 2.0 ** (-1.5)
     assert growth == pytest.approx(want, rel=0.08), (growth, want)
+
+
+def test_e_of_a_reductions(x64):
+    """E(a) reduces to the closed forms: EdS a^-3/2; flat LCDM
+    sqrt(Om/a^3 + 1-Om); w0/wa defaults recover LCDM."""
+    from gravity_tpu.ops.cosmo import e_of_a
+
+    a = np.linspace(0.1, 1.0, 7)
+    np.testing.assert_allclose(e_of_a(a, 1.0), a**-1.5, rtol=1e-12)
+    np.testing.assert_allclose(
+        e_of_a(a, 0.3), np.sqrt(0.3 / a**3 + 0.7), rtol=1e-12
+    )
+    # Cosmological-constant limit of CPL is exact.
+    np.testing.assert_allclose(
+        e_of_a(a, 0.3, 0.0, -1.0, 0.0), e_of_a(a, 0.3), rtol=1e-12
+    )
+    # Open universe: curvature term a^-2.
+    np.testing.assert_allclose(
+        e_of_a(a, 0.3, 0.1),
+        np.sqrt(0.3 / a**3 + 0.1 / a**2 + 0.6), rtol=1e-12,
+    )
+
+
+def test_growth_ode_matches_heath_integral_for_lcdm(x64):
+    """For matter + Lambda (+ curvature) the Heath integral
+    D ∝ E(a) int da/(aE)^3 is exact; the growth ODE must agree."""
+    from gravity_tpu.ops.cosmo import e_of_a, linear_growth_ratio
+
+    def heath_ratio(a1, a2, om, ok=0.0):
+        def d_of(a):
+            aa = np.linspace(1e-8, a, 200_001)
+            e = e_of_a(aa, om, ok)
+            return e_of_a(a, om, ok) * np.trapezoid(
+                1.0 / (aa * e) ** 3, aa
+            )
+        return d_of(a2) / d_of(a1)
+
+    for om, ok in ((0.3, 0.0), (0.3, 0.1), (0.8, -0.05)):
+        np.testing.assert_allclose(
+            linear_growth_ratio(0.2, 0.8, om, omega_k=ok),
+            heath_ratio(0.2, 0.8, om, ok),
+            rtol=2e-3,
+        )
+
+
+def test_growth_rate_w_dependence(x64):
+    """f(a=1) follows the w-generalized approximation
+    Omega_m^gamma with gamma ~ 0.55 + 0.05 (1 + w(z=1)) (Linder 2005)
+    for evolving-w dark energy."""
+    from gravity_tpu.ops.cosmo import growth_rate
+
+    for w0 in (-0.8, -1.2):
+        gamma = 0.55 + 0.05 * (1 + w0)
+        np.testing.assert_allclose(
+            growth_rate(1.0, 0.3, w0=w0), 0.3**gamma, rtol=0.03
+        )
+
+
+def test_cli_cosmo_growth_evolving_w(capsys):
+    """End-to-end comoving run in an open, evolving-w cosmology matches
+    the growth-ODE linear prediction."""
+    import json
+
+    from gravity_tpu.cli import main
+
+    rc = main([
+        "cosmo", "--n", str(16**3), "--steps", "40",
+        "--omega-m", "0.3", "--omega-k", "0.05",
+        "--w0", "-0.9", "--wa", "0.2",
+        "--a-start", "0.2", "--a-end", "0.5",
+    ])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["rel_err"] < 0.06, out
+
+
+def test_recollapsing_universe_raises(x64):
+    """A strongly closed universe with E^2 < 0 in range raises a clear
+    error instead of propagating NaN through the KDK factors."""
+    from gravity_tpu.ops.cosmo import e_of_a
+
+    with pytest.raises(ValueError, match="E\\^2"):
+        e_of_a(0.5, 0.3, -2.0)
