@@ -765,13 +765,17 @@ def cmd_cosmo(args: argparse.Namespace) -> int:
     import jax.numpy as jnp
     import numpy as np
 
+    import os
+
     from .models import create_grf, grf_lattice, grf_side
     from .ops.cosmo import (
-        comoving_kdk_run,
+        comoving_kdk_factors,
+        comoving_kdk_scan,
         growing_mode_momenta,
         linear_growth_ratio,
     )
     from .ops.periodic import pm_periodic_accelerations_vs
+    from .utils.checkpoint import crossed_cadence
 
     try:
         side = grf_side(args.n)
@@ -789,11 +793,33 @@ def cmd_cosmo(args: argparse.Namespace) -> int:
     lat = np.asarray(grf_lattice(side, box, dtype=st.positions.dtype))
     disp = (np.asarray(st.positions) - lat + box / 2) % box - box / 2
     cosmo = dict(omega_k=args.omega_k, w0=args.w0, wa=args.wa)
-    st = st.replace(
-        velocities=growing_mode_momenta(
-            jnp.asarray(disp), a1, h0, args.omega_m, **cosmo
+
+    start_step = 0
+    ckpt_mgr = None
+    if args.checkpoint_every or args.resume:
+        from .utils.checkpoint import make_checkpoint_manager
+
+        ckpt_mgr = make_checkpoint_manager(args.checkpoint_dir)
+    if args.resume:
+        from .utils.checkpoint import restore_checkpoint_with_extra
+
+        st, start_step, extra = restore_checkpoint_with_extra(ckpt_mgr)
+        if "a" not in extra:
+            print(
+                "error: checkpoint has no scale-factor metadata (not a "
+                "cosmo checkpoint)", file=sys.stderr,
+            )
+            return 1
+        if start_step >= args.steps:
+            print(json.dumps({"resumed_at": start_step,
+                              "note": "checkpoint already at/past a_end"}))
+            return 0
+    else:
+        st = st.replace(
+            velocities=growing_mode_momenta(
+                jnp.asarray(disp), a1, h0, args.omega_m, **cosmo
+            )
         )
-    )
     # EdS/LCDM closure: Om * rho_crit0 = mean density -> G fixed.
     m_tot = float(jnp.sum(st.masses))
     g_eff = 3.0 * args.omega_m * h0**2 * box**3 / (8.0 * np.pi * m_tot)
@@ -805,18 +831,72 @@ def cmd_cosmo(args: argparse.Namespace) -> int:
             assignment=args.pm_assignment,
         )
 
-    t0 = time.perf_counter()
-    out = comoving_kdk_run(
-        st, accel, a_start=a1, a_end=a2, n_steps=args.steps, h0=h0,
-        omega_m=args.omega_m, **cosmo,
-    )
-    jax.block_until_ready(out.positions)
-    elapsed = time.perf_counter() - t0
+    writer = None
+    if args.trajectories:
+        from .utils.trajectory import TrajectoryWriter
 
-    disp2 = (np.asarray(out.positions) - lat + box / 2) % box - box / 2
+        stamp = time.strftime("%Y%m%d_%H%M%S")
+        writer = TrajectoryWriter(
+            os.path.join(args.out_dir, f"trajectories_cosmo_{stamp}"),
+            args.n, every=1,
+        )
+
+    # One global log-a edge grid: block boundaries land on the same
+    # edges a single-shot run uses, so streaming/resume is exact.
+    edges = np.exp(np.linspace(np.log(a1), np.log(a2), args.steps + 1))
+    if args.resume:
+        # The stored scale factor exists precisely to catch a resume
+        # onto a different (a_start, a_end, steps) grid, where the step
+        # counter would silently mean a different epoch.
+        a_ckpt = extra["a"]
+        a_grid = float(edges[start_step])
+        if abs(a_ckpt - a_grid) > 1e-9 * max(a_ckpt, a_grid):
+            print(
+                f"error: checkpoint step {start_step} was taken at "
+                f"a={a_ckpt:.9g} but the current --a-start/--a-end/"
+                f"--steps grid puts that step at a={a_grid:.9g}; resume "
+                "with the original grid", file=sys.stderr,
+            )
+            return 1
+    # Checkpoint cadence bounds the block size too: --checkpoint-every
+    # without --progress-every must still checkpoint mid-run.
+    block = max(1, min(
+        args.progress_every or args.steps,
+        args.checkpoint_every or args.steps,
+        args.steps,
+    ))
+
+    t0 = time.perf_counter()
+    step_i = start_step
+    while step_i < args.steps:
+        hi = min(step_i + block, args.steps)
+        k1s, drs, k2s = comoving_kdk_factors(
+            edges[step_i:hi + 1], h0, args.omega_m, **cosmo,
+            dtype=st.positions.dtype,
+        )
+        st = comoving_kdk_scan(st, k1s, drs, k2s, accel_fn=accel)
+        jax.block_until_ready(st.positions)
+        prev_i, step_i = step_i, hi
+        a_now = float(edges[step_i])
+        if args.progress_every and step_i < args.steps:
+            print(f"Step {step_i}/{args.steps} (a={a_now:.6g})",
+                  file=sys.stderr)
+        if writer is not None:
+            writer.record(step_i, np.asarray(st.positions))
+        if ckpt_mgr is not None and crossed_cadence(
+            prev_i, step_i, args.checkpoint_every
+        ):
+            from .utils.checkpoint import save_checkpoint
+
+            save_checkpoint(ckpt_mgr, step_i, st, extra={"a": a_now})
+    elapsed = time.perf_counter() - t0
+    if writer is not None:
+        writer.close()
+
+    disp2 = (np.asarray(st.positions) - lat + box / 2) % box - box / 2
     measured = float((disp2 * disp).sum() / (disp * disp).sum())
     linear = linear_growth_ratio(a1, a2, args.omega_m, **cosmo)
-    print(json.dumps({
+    report = {
         "n": args.n, "box": box, "grid": grid,
         "a_start": a1, "a_end": a2, "steps": args.steps,
         "omega_m": args.omega_m,
@@ -827,7 +907,10 @@ def cmd_cosmo(args: argparse.Namespace) -> int:
         "rel_err": abs(measured - linear) / linear,
         "total_time_s": elapsed,
         "platform": jax.devices()[0].platform,
-    }))
+    }
+    if start_step:
+        report["resumed_at"] = start_step
+    print(json.dumps(report))
     return 0
 
 
@@ -980,6 +1063,23 @@ def main(argv=None) -> int:
     p_cosmo.add_argument("--pm-assignment", dest="pm_assignment",
                          choices=["cic", "tsc"], default="cic")
     p_cosmo.add_argument("--seed", type=int, default=0)
+    p_cosmo.add_argument("--progress-every", dest="progress_every",
+                         type=int, default=0,
+                         help="steps per streaming block (0 = one shot)")
+    p_cosmo.add_argument("--checkpoint-every", dest="checkpoint_every",
+                         type=int, default=0,
+                         help="checkpoint cadence in steps (stores the "
+                              "scale factor for exact resume)")
+    p_cosmo.add_argument("--checkpoint-dir", dest="checkpoint_dir",
+                         default="gravity_ckpt_cosmo")
+    p_cosmo.add_argument("--resume", action="store_true",
+                         help="continue from the latest cosmo checkpoint "
+                              "(same seed/cosmology/step grid)")
+    p_cosmo.add_argument("--trajectories", action="store_true",
+                         help="record comoving positions at each block "
+                              "boundary")
+    p_cosmo.add_argument("--out-dir", dest="out_dir",
+                         default="gravity_logs_cosmo")
     p_cosmo.set_defaults(fn=cmd_cosmo)
 
     p_bench = sub.add_parser("bench", help="throughput benchmark")

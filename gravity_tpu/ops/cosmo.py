@@ -258,27 +258,35 @@ def comoving_kdk_run(
     """
     import numpy as np
 
-    dtype = state.positions.dtype
-    # Step edges are static (a_start/a_end/n_steps are trace constants):
-    # build them host-side in genuine float64 regardless of x64 mode.
-    a_edges_np = np.exp(
-        np.linspace(np.log(a_start), np.log(a_end), n_steps + 1)
+    edges = np.exp(np.linspace(np.log(a_start), np.log(a_end), n_steps + 1))
+    k1s, drs, k2s = comoving_kdk_factors(
+        edges, h0, omega_m, omega_k=omega_k, w0=w0, wa=wa,
+        dtype=state.positions.dtype,
     )
+    return comoving_kdk_scan(state, k1s, drs, k2s, accel_fn=accel_fn)
+
+
+def comoving_kdk_factors(a_edges, h0, omega_m=1.0, *, omega_k=0.0,
+                         w0=-1.0, wa=0.0, dtype=jnp.float32):
+    """(k1s, drs, k2s) KDK factor arrays for explicit step edges.
+
+    Host-side float64 (the sqrt(a2)-sqrt(a1) cancellations must not
+    round through fp32), cast to ``dtype`` at the end. Per-step KDK
+    factors: half-kick over [a1, a_mid], full drift over [a1, a2],
+    half-kick over [a_mid, a2] — the comoving Poisson 1/a is the
+    integrand of the kick factor itself (int dt / a), nothing extra to
+    divide by. Exposing explicit edges makes block-wise (checkpointed /
+    streamed) comoving runs exact: a resume computes factors for the
+    SAME global edge grid, so block boundaries change nothing.
+    """
+    import numpy as np
+
+    a_edges_np = np.asarray(a_edges, np.float64)
     a_mids_np = np.sqrt(a_edges_np[:-1] * a_edges_np[1:])  # log-midpoints
-    # Per-step KDK factors, precomputed in float64 then cast: half-kick
-    # over [a1, a_mid], full drift over [a1, a2], half-kick over
-    # [a_mid, a2]. The comoving Poisson 1/a is the integrand of the kick
-    # factor itself (int dt / a) — nothing extra to divide by.
     if _is_eds(omega_m, omega_k, w0, wa):
-        k1s = jnp.asarray(
-            eds_kick_factor(a_edges_np[:-1], a_mids_np, h0), dtype
-        )
-        drs = jnp.asarray(
-            eds_drift_factor(a_edges_np[:-1], a_edges_np[1:], h0), dtype
-        )
-        k2s = jnp.asarray(
-            eds_kick_factor(a_mids_np, a_edges_np[1:], h0), dtype
-        )
+        k1s = eds_kick_factor(a_edges_np[:-1], a_mids_np, h0)
+        drs = eds_drift_factor(a_edges_np[:-1], a_edges_np[1:], h0)
+        k2s = eds_kick_factor(a_mids_np, a_edges_np[1:], h0)
     else:
         cosmo = dict(omega_k=omega_k, w0=w0, wa=wa)
         pairs1 = [
@@ -289,11 +297,27 @@ def comoving_kdk_run(
             lcdm_factors(am, a2, h0, omega_m, **cosmo)
             for am, a2 in zip(a_mids_np, a_edges_np[1:])
         ]
-        k1s = jnp.asarray([p[0] for p in pairs1], dtype)
-        k2s = jnp.asarray([p[0] for p in pairs2], dtype)
-        drs = jnp.asarray(
-            [p1[1] + p2[1] for p1, p2 in zip(pairs1, pairs2)], dtype
+        k1s = np.asarray([p[0] for p in pairs1])
+        k2s = np.asarray([p[0] for p in pairs2])
+        drs = np.asarray(
+            [p1[1] + p2[1] for p1, p2 in zip(pairs1, pairs2)]
         )
+    return (
+        jnp.asarray(k1s, dtype),
+        jnp.asarray(drs, dtype),
+        jnp.asarray(k2s, dtype),
+    )
+
+
+@partial(jax.jit, static_argnames=("accel_fn",))
+def comoving_kdk_scan(
+    state: ParticleState, k1s, drs, k2s, *, accel_fn
+) -> ParticleState:
+    """The jitted comoving KDK scan over traced factor arrays.
+
+    Factors are OPERANDS (not trace constants), so block-wise drivers
+    reuse one compiled program for every equal-length block.
+    """
 
     def step(carry, factors):
         x, p, acc = carry

@@ -683,18 +683,16 @@ class Simulator:
                     trajectory_writer.record(
                         step - n_steps + (k + 1) * every, traj_np[k]
                     )
-            if (
-                checkpoint_manager is not None
-                and config.checkpoint_every
-                # Fires whenever the block crossed a checkpoint boundary —
-                # block granularity must not silently skip cadences that
-                # don't divide the block size.
-                and (step // config.checkpoint_every)
-                > ((step - n_steps) // config.checkpoint_every)
-            ):
-                from .utils.checkpoint import save_checkpoint
+            if checkpoint_manager is not None:
+                from .utils.checkpoint import (
+                    crossed_cadence,
+                    save_checkpoint,
+                )
 
-                save_checkpoint(checkpoint_manager, step, state)
+                if crossed_cadence(
+                    step - n_steps, step, config.checkpoint_every
+                ):
+                    save_checkpoint(checkpoint_manager, step, state)
         except KeyboardInterrupt:
             # Graceful interrupt: persist what we have so `resume` works
             # (the reference loses everything on any interruption).
@@ -917,14 +915,14 @@ class Simulator:
                     jax.device_get(state.positions)
                 )[: self.n_real]
                 trajectory_writer.record(steps_taken, frame)
-            if (
-                checkpoint_manager is not None
-                and config.checkpoint_every
-                and (steps_taken // config.checkpoint_every)
-                > (prev_steps // config.checkpoint_every)
+            if checkpoint_manager is not None:
+                from .utils.checkpoint import (
+                    crossed_cadence,
+                    save_checkpoint,
+                )
+            if checkpoint_manager is not None and crossed_cadence(
+                prev_steps, steps_taken, config.checkpoint_every
             ):
-                from .utils.checkpoint import save_checkpoint
-
                 save_checkpoint(
                     checkpoint_manager, steps_taken, state,
                     extra={"t": t, "comp": comp},

@@ -28,6 +28,13 @@ def make_checkpoint_manager(
     return ocp.CheckpointManager(directory, options=options)
 
 
+def crossed_cadence(prev_step: int, step: int, every: int) -> bool:
+    """True when [prev_step, step] crossed a multiple of ``every`` —
+    the block-loop checkpoint predicate (block granularity must not
+    skip cadences that don't divide the block size)."""
+    return every > 0 and (step // every) > (prev_step // every)
+
+
 def save_checkpoint(
     manager: ocp.CheckpointManager,
     step: int,
