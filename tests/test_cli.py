@@ -162,21 +162,16 @@ def test_analyze_checkpoint(tmp_path, capsys):
     assert out["kinetic_energy"] > 0
 
 
-def test_validate_command(capsys):
-    rc = main(["validate"])
+def test_validate_command_with_tpu_battery(capsys):
+    """One pass of `validate --tpu` covers the base physics battery AND
+    the on-chip smoke gate (CPU-shrunk sizes) — a regression in either
+    is caught before the next TPU session. (Combined test: the base
+    battery alone costs ~60s and would otherwise run twice.)"""
+    rc = main(["validate", "--tpu"])
     out = json.loads(capsys.readouterr().out)
     assert rc == 0
     assert out["ok"] is True
     assert out["checks"]["earth_year_closure"]["ok"]
-
-
-def test_validate_tpu_battery(capsys):
-    """The on-chip smoke gate runs end-to-end with CPU-shrunk sizes, so
-    a regression in its imports/thresholds/stat keys is caught before
-    the next TPU session."""
-    rc = main(["validate", "--tpu"])
-    out = json.loads(capsys.readouterr().out)
-    assert rc == 0
     for name in ("tpu_pallas_parity", "tpu_tree_parity",
                  "tpu_sharded_mesh1", "tpu_bench_5step"):
         assert out["checks"][name]["ok"], out["checks"][name]

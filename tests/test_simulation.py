@@ -323,8 +323,16 @@ def test_direct_backend_never_approximate():
     from gravity_tpu.config import PRESETS, SimulationConfig
     from gravity_tpu.simulation import _resolve_backend
 
-    on_tpu = jax.devices()[0].platform == "tpu"
-    want_big = "pallas" if on_tpu else "chunked"
+    from gravity_tpu.ops.ffi_forces import ffi_forces_available
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform == "tpu"
+    if on_tpu:
+        want_big = "pallas"
+    elif platform == "cpu" and ffi_forces_available():
+        want_big = "cpp"  # native FFI kernel beats chunked jnp ~2x
+    else:
+        want_big = "chunked"
     with warnings.catch_warnings(record=True) as w:
         warnings.simplefilter("always")
         assert (
@@ -340,7 +348,7 @@ def test_direct_backend_never_approximate():
     assert not w  # 'direct' is a deliberate choice; no O(N^2) nag
     # The reference-parity preset resolves to an exact backend.
     assert _resolve_backend(PRESETS["reference-cuda"]) in (
-        "dense", "chunked", "pallas",
+        "dense", "chunked", "pallas", "cpp",
     )
 
 
