@@ -1,0 +1,89 @@
+"""Measure the direct-sum / tree crossover on the current platform.
+
+Times one carried-acc leapfrog force evaluation per backend over a
+range of N on the disk model (the 1m-tree baseline family), printing
+one JSON line per (n, backend) and a suggested crossover — the number
+that calibrates ``simulation.TREE_CROSSOVER_TPU`` / ``_CPU``
+(docs/scaling.md "Automatic backend selection").
+
+Usage:
+    python benchmarks/crossover.py              # default N ladder
+    python benchmarks/crossover.py 65536 262144 # explicit N values
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from gravity_tpu.utils.platform import ensure_live_backend  # noqa: E402
+
+ensure_live_backend()
+
+import jax  # noqa: E402
+
+
+def timed_eval(fn, pos, masses, iters):
+    out = fn(pos, masses)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(pos, masses)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def main(argv) -> int:
+    from gravity_tpu.config import SimulationConfig
+    from gravity_tpu.simulation import Simulator
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform == "tpu"
+    if argv:
+        ns = [int(a) for a in argv]
+    elif on_tpu:
+        ns = [65_536, 131_072, 262_144, 524_288, 1_048_576]
+    else:
+        # CPU: direct sums above ~64k take minutes; stay small.
+        ns = [8_192, 16_384, 32_768, 65_536]
+
+    results = []
+    for n in ns:
+        iters = max(1, min(10, (262_144 // n) or 1))
+        row = {"n": n, "platform": platform}
+        for backend in ("direct", "tree"):
+            cfg = SimulationConfig(
+                model="disk", n=n, g=1.0, dt=2.0e-3, eps=0.05,
+                integrator="leapfrog", force_backend=backend,
+                tree_leaf_cap=32,
+            )
+            sim = Simulator(cfg)
+            dt_s = timed_eval(
+                jax.jit(sim._accel2), sim.state.positions,
+                sim.state.masses, iters,
+            )
+            row[f"{backend}_s"] = dt_s
+            row[f"{backend}_resolved"] = sim.backend
+        row["tree_speedup"] = row["direct_s"] / row["tree_s"]
+        results.append(row)
+        print(json.dumps(row))
+
+    # Crossover = first n where the tree wins; refine with the ratio
+    # trend (direct scales ~n^2, tree ~n log n).
+    winners = [r for r in results if r["tree_speedup"] > 1.0]
+    suggestion = winners[0]["n"] if winners else None
+    print(json.dumps({
+        "suggested_crossover": suggestion,
+        "note": "first measured n where the tree force eval beats the "
+                "direct sum on this platform; update "
+                "simulation.TREE_CROSSOVER_* and docs/scaling.md",
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

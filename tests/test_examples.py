@@ -54,3 +54,16 @@ def test_plot_trajectory_example(tmp_path):
                 str(tmp_path / "p.png")])
     assert out.returncode == 0, out.stderr[-2000:]
     assert (tmp_path / "p.png").exists()
+
+
+def test_star_cluster_example():
+    import json
+
+    out = _run(["examples/star_cluster.py", "--n", "128",
+                "--steps", "10"])
+    assert out.returncode == 0, out.stderr[-2000:]
+    rep = json.loads(out.stdout.strip().splitlines()[-1])
+    # The block-timestep schemes must beat single-rate by a wide margin
+    # at one full force eval per outer step.
+    assert rep["drift_two_rung"] < rep["drift_single_rate"] / 10
+    assert rep["drift_ladder_r3"] < rep["drift_single_rate"] / 10
