@@ -749,6 +749,26 @@ def cmd_analyze(args: argparse.Namespace) -> int:
                   for v in np.asarray(p)],
             "shot_noise": float(shot),
         }
+    if args.fof > 0.0:
+        from .ops.halos import friends_of_friends
+
+        fof = friends_of_friends(
+            np.asarray(state.positions), np.asarray(state.masses),
+            linking_length=args.fof, box=config.periodic_box,
+            min_members=args.fof_min_members,
+        )
+        m_tot = float(np.asarray(state.masses).sum())
+        in_halos = float(fof.halo_masses.sum())
+        top = min(10, fof.n_halos)
+        report["fof"] = {
+            "linking_length": args.fof,
+            "min_members": args.fof_min_members,
+            "n_halos": fof.n_halos,
+            "mass_fraction_in_halos": in_halos / m_tot if m_tot else 0.0,
+            "top_halo_masses": fof.halo_masses[:top].tolist(),
+            "top_halo_sizes": fof.halo_sizes[:top].tolist(),
+            "top_halo_centers": fof.halo_centers[:top].tolist(),
+        }
     print(json.dumps(report, indent=2))
     return 0
 
@@ -1011,6 +1031,14 @@ def main(argv=None) -> int:
     p_an.add_argument("--spectrum-interlace", dest="spectrum_interlace",
                       action="store_true",
                       help="interlaced deposits (alias suppression)")
+    p_an.add_argument("--fof", type=float, default=0.0,
+                      help="friends-of-friends halo finding with this "
+                           "linking length (absolute; cosmological "
+                           "convention is ~0.2 x mean interparticle "
+                           "spacing). Periodic when --periodic-box is "
+                           "set.")
+    p_an.add_argument("--fof-min-members", dest="fof_min_members",
+                      type=int, default=20)
     p_an.set_defaults(fn=cmd_analyze)
 
     p_traj = sub.add_parser(

@@ -36,6 +36,7 @@ from .cosmo import (
     zeldovich_momenta,
 )
 from .external import parse_external
+from .halos import friends_of_friends
 from .integrators import (
     FORCE_EVALS_PER_STEP,
     INTEGRATORS,
@@ -59,6 +60,7 @@ __all__ = [
     "comoving_kdk_run",
     "e_of_a",
     "eds_drift_factor",
+    "friends_of_friends",
     "eds_kick_factor",
     "energy_drift",
     "growing_mode_momenta",
