@@ -879,12 +879,33 @@ def cmd_cosmo(args: argparse.Namespace) -> int:
             )
             return 1
     # Checkpoint cadence bounds the block size too: --checkpoint-every
-    # without --progress-every must still checkpoint mid-run.
+    # without --progress-every must still checkpoint mid-run; the LI
+    # quadrature needs enough samples for its trapezoid.
     block = max(1, min(
         args.progress_every or args.steps,
         args.checkpoint_every or args.steps,
+        (max(1, args.steps // 16) if args.li_check else args.steps),
         args.steps,
     ))
+
+    li_records = []
+
+    def li_sample(a_val, st_):
+        # Peculiar KE: v_pec = a dx/dt = p / a; proper potential energy
+        # of fluctuations: the comoving-solve potential scales as 1/a.
+        from .ops.periodic import pm_periodic_potential_energy
+
+        p = np.asarray(st_.velocities, np.float64)
+        m = np.asarray(st_.masses, np.float64)
+        t_kin = 0.5 * float(np.sum(m * np.sum((p / a_val) ** 2, axis=-1)))
+        w_c = pm_periodic_potential_energy(
+            st_.positions, st_.masses, box=box, grid=grid, g=g_eff,
+            eps=0.0, assignment=args.pm_assignment,
+        )
+        li_records.append((a_val, t_kin, w_c / a_val))
+
+    if args.li_check:
+        li_sample(float(edges[start_step]), st)
 
     t0 = time.perf_counter()
     step_i = start_step
@@ -898,10 +919,22 @@ def cmd_cosmo(args: argparse.Namespace) -> int:
         jax.block_until_ready(st.positions)
         prev_i, step_i = step_i, hi
         a_now = float(edges[step_i])
-        if args.progress_every and step_i < args.steps:
+        # Output cadences are gated independently of the block size:
+        # --li-check shrinks the blocks for its quadrature, and that
+        # must not densify the progress lines or trajectory frames the
+        # user asked for.
+        if (
+            args.progress_every
+            and crossed_cadence(prev_i, step_i, args.progress_every)
+            and step_i < args.steps
+        ):
             print(f"Step {step_i}/{args.steps} (a={a_now:.6g})",
                   file=sys.stderr)
-        if writer is not None:
+        if args.li_check:
+            li_sample(a_now, st)
+        if writer is not None and crossed_cadence(
+            prev_i, step_i, args.progress_every or args.steps
+        ):
             writer.record(step_i, np.asarray(st.positions))
         if ckpt_mgr is not None and crossed_cadence(
             prev_i, step_i, args.checkpoint_every
@@ -928,6 +961,15 @@ def cmd_cosmo(args: argparse.Namespace) -> int:
         "total_time_s": elapsed,
         "platform": jax.devices()[0].platform,
     }
+    if args.li_check:
+        from .ops.cosmo import layzer_irvine_residual
+
+        report["layzer_irvine"] = {
+            "residual": layzer_irvine_residual(li_records),
+            "n_samples": len(li_records),
+            "T_final": li_records[-1][1],
+            "W_final": li_records[-1][2],
+        }
     if start_step:
         report["resumed_at"] = start_step
     print(json.dumps(report))
@@ -1106,6 +1148,11 @@ def main(argv=None) -> int:
     p_cosmo.add_argument("--trajectories", action="store_true",
                          help="record comoving positions at each block "
                               "boundary")
+    p_cosmo.add_argument("--li-check", dest="li_check",
+                         action="store_true",
+                         help="track the Layzer-Irvine cosmic energy "
+                              "equation and report its normalized "
+                              "residual (global health check)")
     p_cosmo.add_argument("--out-dir", dest="out_dir",
                          default="gravity_logs_cosmo")
     p_cosmo.set_defaults(fn=cmd_cosmo)

@@ -337,3 +337,33 @@ def comoving_kdk_scan(
         (k1s, drs, k2s),
     )
     return state.replace(positions=x, velocities=p)
+
+
+def layzer_irvine_residual(records):
+    """Normalized Layzer-Irvine residual from (a, T, W) samples.
+
+    The cosmic energy equation for peculiar motion in an expanding
+    background: d(T + W)/da = -(2T + W)/a, with T the peculiar kinetic
+    energy and W the PROPER potential energy of density fluctuations
+    (the comoving-solve potential scales as W = W_comoving / a). A
+    consistent comoving integration drives the residual
+
+        [T + W](a2) - [T + W](a1) + int_a1^a2 (2T + W)/a da
+
+    toward zero; the returned value is that sum over the sampled
+    records (trapezoidal quadrature) normalized by max|W| — the
+    GADGET-style global health check for cosmological runs.
+    ``records`` is an iterable of (a, T, W) with ascending a.
+    """
+    import numpy as np
+
+    rec = np.asarray(list(records), np.float64)
+    if rec.shape[0] < 2:
+        raise ValueError("need >= 2 (a, T, W) records")
+    a, t, w = rec[:, 0], rec[:, 1], rec[:, 2]
+    e = t + w
+    integrand = (2.0 * t + w) / a
+    trap = getattr(np, "trapezoid", None) or np.trapz  # numpy<2 compat
+    residual = (e[-1] - e[0]) + trap(integrand, a)
+    scale = np.max(np.abs(w))
+    return float(residual / scale) if scale > 0 else float(residual)

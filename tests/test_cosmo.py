@@ -334,3 +334,38 @@ def test_cli_cosmo_streaming_and_resume(tmp_path, capsys):
     np.testing.assert_allclose(
         resumed["growth_measured"], full["growth_measured"], rtol=1e-5
     )
+
+
+def test_layzer_irvine_residual_helper(x64):
+    """Synthetic records obeying the LI equation give ~zero residual;
+    breaking them does not."""
+    from gravity_tpu.ops.cosmo import layzer_irvine_residual
+
+    # Linear-regime EdS scalings: T = (2/3)|W|, both growing as a.
+    a = np.linspace(0.02, 0.08, 200)
+    w = -3.0 * a
+    t = 2.0 * a  # T = -(2/3) W -> d(T+W)/da = -(2T+W)/a holds exactly
+    assert abs(layzer_irvine_residual(zip(a, t, w))) < 1e-4
+    assert abs(layzer_irvine_residual(zip(a, 2 * t, w))) > 0.1
+    with pytest.raises(ValueError, match="records"):
+        layzer_irvine_residual([(0.1, 1.0, -1.0)])
+
+
+def test_cli_cosmo_layzer_irvine(capsys):
+    """End-to-end cosmic-energy health check: with a resolved spectrum
+    the LI residual is sub-percent and the kinetic/potential ratio sits
+    on the linear-theory growing-mode value T = (2/3)|W|."""
+    import json
+
+    from gravity_tpu.cli import main
+
+    rc = main([
+        "cosmo", "--n", str(32**3), "--steps", "48",
+        "--a-start", "0.02", "--a-end", "0.08",
+        "--spectral-index", "-3.5", "--li-check",
+    ])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    li = out["layzer_irvine"]
+    assert abs(li["residual"]) < 0.02, li
+    assert li["T_final"] / li["W_final"] == pytest.approx(-2 / 3, rel=0.05)
