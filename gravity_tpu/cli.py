@@ -749,6 +749,29 @@ def cmd_analyze(args: argparse.Namespace) -> int:
                   for v in np.asarray(p)],
             "shot_noise": float(shot),
         }
+    if args.correlation:
+        from .ops.halos import correlation_function
+
+        if args.correlation_bins < 1:
+            print("error: --correlation-bins must be >= 1",
+                  file=sys.stderr)
+            return 1
+        if config.periodic_box <= 0.0:
+            print(
+                "error: --correlation needs --periodic-box (the natural "
+                "estimator's RR term is analytic only on the torus)",
+                file=sys.stderr,
+            )
+            return 1
+        r_c, xi, dd = correlation_function(
+            np.asarray(state.positions), box=config.periodic_box,
+            n_bins=args.correlation_bins,
+        )
+        report["correlation"] = {
+            "r": r_c.tolist(),
+            "xi": [None if not np.isfinite(v) else float(v) for v in xi],
+            "dd": dd.tolist(),
+        }
     if args.fof > 0.0:
         from .ops.halos import friends_of_friends
 
@@ -879,14 +902,19 @@ def cmd_cosmo(args: argparse.Namespace) -> int:
             )
             return 1
     # Checkpoint cadence bounds the block size too: --checkpoint-every
-    # without --progress-every must still checkpoint mid-run; the LI
-    # quadrature needs enough samples for its trapezoid.
-    block = max(1, min(
+    # without --progress-every must still checkpoint mid-run. The
+    # USER-facing block (trajectory-frame cadence, per the --trajectories
+    # help text) excludes the LI shrinkage below.
+    user_block = max(1, min(
         args.progress_every or args.steps,
         args.checkpoint_every or args.steps,
-        (max(1, args.steps // 16) if args.li_check else args.steps),
         args.steps,
     ))
+    # The LI quadrature needs enough samples for its trapezoid.
+    block = min(
+        user_block,
+        max(1, args.steps // 16) if args.li_check else args.steps,
+    )
 
     li_records = []
 
@@ -933,7 +961,7 @@ def cmd_cosmo(args: argparse.Namespace) -> int:
         if args.li_check:
             li_sample(a_now, st)
         if writer is not None and crossed_cadence(
-            prev_i, step_i, args.progress_every or args.steps
+            prev_i, step_i, user_block
         ):
             writer.record(step_i, np.asarray(st.positions))
         if ckpt_mgr is not None and crossed_cadence(
@@ -1081,6 +1109,11 @@ def main(argv=None) -> int:
                            "set.")
     p_an.add_argument("--fof-min-members", dest="fof_min_members",
                       type=int, default=20)
+    p_an.add_argument("--correlation", action="store_true",
+                      help="two-point correlation function xi(r) "
+                           "(periodic boxes; natural estimator)")
+    p_an.add_argument("--correlation-bins", dest="correlation_bins",
+                      type=int, default=16)
     p_an.set_defaults(fn=cmd_analyze)
 
     p_traj = sub.add_parser(

@@ -36,7 +36,7 @@ from .cosmo import (
     zeldovich_momenta,
 )
 from .external import parse_external
-from .halos import friends_of_friends
+from .halos import correlation_function, friends_of_friends
 from .integrators import (
     FORCE_EVALS_PER_STEP,
     INTEGRATORS,
@@ -58,6 +58,7 @@ __all__ = [
     "density_power_spectrum",
     "center_of_mass",
     "comoving_kdk_run",
+    "correlation_function",
     "e_of_a",
     "eds_drift_factor",
     "friends_of_friends",

@@ -96,3 +96,66 @@ def test_tiny_negative_coordinate_survives_periodic_wrap():
     res = friends_of_friends(pos, linking_length=0.2, box=10.0,
                              min_members=20)
     assert res.n_halos == 1
+
+
+def test_correlation_uniform_is_zero():
+    """A uniform random periodic field has xi(r) ~ 0 at all separations
+    (within Poisson noise)."""
+    from gravity_tpu.ops.halos import correlation_function
+
+    rng = np.random.default_rng(5)
+    box = 1.0
+    pos = rng.uniform(0, box, (4096, 3))
+    r, xi, dd = correlation_function(pos, box=box, n_bins=8)
+    good = np.isfinite(xi) & (dd > 50)  # enough pairs for the noise bound
+    assert good.any()
+    assert np.all(np.abs(xi[good]) < 0.5), xi
+
+
+def test_correlation_detects_clustering():
+    """Pairs planted at a fixed small separation produce strong xi > 0
+    in the matching bin and ~0 well away from it."""
+    from gravity_tpu.ops.halos import correlation_function
+
+    rng = np.random.default_rng(6)
+    box = 1.0
+    base = rng.uniform(0, box, (2048, 3))
+    partners = np.mod(
+        base + rng.normal(scale=0.003, size=base.shape), box
+    )
+    pos = np.concatenate([base, partners])
+    r, xi, dd = correlation_function(
+        pos, box=box, r_bins=np.geomspace(0.002, 0.2, 13)
+    )
+    small = r < 0.01
+    assert np.nanmax(xi[small]) > 10.0, xi
+    large = (r > 0.1) & np.isfinite(xi)
+    assert np.all(np.abs(xi[large]) < 1.0), xi
+
+
+def test_correlation_validation():
+    from gravity_tpu.ops.halos import correlation_function
+
+    with pytest.raises(ValueError, match="box"):
+        correlation_function(np.zeros((8, 3)), box=0.0)
+    with pytest.raises(ValueError, match="box/2"):
+        correlation_function(
+            np.random.default_rng(0).uniform(0, 1, (64, 3)),
+            box=1.0, r_bins=np.asarray([0.1, 0.6]),
+        )
+
+
+def test_cli_analyze_correlation(capsys):
+    import json
+
+    from gravity_tpu.cli import main
+
+    rc = main([
+        "analyze", "--model", "grf", "--n", str(16**3),
+        "--periodic-box", "1e13", "--eps", "1e11",
+        "--correlation", "--correlation-bins", "8",
+    ])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    corr = out["correlation"]
+    assert len(corr["r"]) == 8 and len(corr["xi"]) == 8
