@@ -828,11 +828,25 @@ def cmd_cosmo(args: argparse.Namespace) -> int:
     grid = args.grid or side
     box, h0, a1, a2 = args.box, args.h0, args.a_start, args.a_end
 
-    st = create_grf(
-        jax.random.PRNGKey(args.seed), args.n, box=box,
-        spectral_index=args.spectral_index, sigma_psi=args.sigma_psi,
-        total_mass=1.0e36,
-    )
+    p_table = None
+    if args.spectrum_file:
+        # Two-column (k, P) text table, e.g. CAMB/CLASS matter power
+        # output; shape-only (sigma_psi pins the amplitude).
+        try:
+            p_table = np.loadtxt(args.spectrum_file)
+        except (OSError, ValueError) as e:
+            print(f"error: cannot read --spectrum-file: {e}",
+                  file=sys.stderr)
+            return 1
+    try:
+        st = create_grf(
+            jax.random.PRNGKey(args.seed), args.n, box=box,
+            spectral_index=args.spectral_index, sigma_psi=args.sigma_psi,
+            total_mass=1.0e36, power_spectrum=p_table,
+        )
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
     lat = np.asarray(grf_lattice(side, box, dtype=st.positions.dtype))
     disp = (np.asarray(st.positions) - lat + box / 2) % box - box / 2
     cosmo = dict(omega_k=args.omega_k, w0=args.w0, wa=args.wa)
@@ -1181,6 +1195,12 @@ def main(argv=None) -> int:
     p_cosmo.add_argument("--trajectories", action="store_true",
                          help="record comoving positions at each block "
                               "boundary")
+    p_cosmo.add_argument("--spectrum-file", dest="spectrum_file",
+                         default="",
+                         help="two-column (k, P) text table for the IC "
+                              "power-spectrum shape (CAMB/CLASS output; "
+                              "log-log interpolated, sigma-psi sets the "
+                              "amplitude)")
     p_cosmo.add_argument("--li-check", dest="li_check",
                          action="store_true",
                          help="track the Layzer-Irvine cosmic energy "

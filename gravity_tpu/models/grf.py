@@ -57,6 +57,7 @@ def create_grf(
     vel_factor: float = 0.0,
     total_mass: float = 1.0e33,
     dtype=jnp.float32,
+    power_spectrum=None,
 ) -> ParticleState:
     """Lattice + Zel'dovich displacements with P(k) ∝ k^spectral_index.
 
@@ -65,6 +66,14 @@ def create_grf(
     box side; ``vel_factor`` scales velocities as v = vel_factor * psi /
     t_unit with t_unit = 1 s (pure Zel'dovich growth would set this from
     the cosmology — here it is an explicit knob, default cold).
+
+    ``power_spectrum`` replaces the power law with an arbitrary P(k)
+    SHAPE: either a callable ``P(k)`` over physical wavenumbers
+    k = 2*pi*m/box (m integer mode magnitude), or an (M, 2) table of
+    (k, P) rows interpolated log-log (clamped outside the tabulated
+    range) — e.g. a CAMB/CLASS transfer-function output. The overall
+    amplitude stays pinned by ``sigma_psi`` either way, so tables in
+    any normalization convention work unchanged.
     """
     side = grf_side(n)
     h = box / side
@@ -79,8 +88,50 @@ def create_grf(
     k2 = kx**2 + ky**2 + kz**2
     k_mag = jnp.sqrt(k2)
 
-    # Power-law amplitude; the k=0 mean mode is zeroed.
-    amp = jnp.where(k_mag > 0, k_mag**(spectral_index / 2.0), 0.0)
+    if power_spectrum is None:
+        # Power-law amplitude; the k=0 mean mode is zeroed.
+        amp = jnp.where(k_mag > 0, k_mag**(spectral_index / 2.0), 0.0)
+    else:
+        k_phys = k_mag * (2.0 * jnp.pi / box)
+        if callable(power_spectrum):
+            p_k = power_spectrum(k_phys)
+        else:
+            # Host-side float64 table prep (repo rule: range-sensitive
+            # spectral math never rounds through fp32 — dimensionful
+            # CAMB amplitudes overflow f32 and would log to inf/NaN;
+            # only NORMALIZED log-space values reach the device).
+            import numpy as np
+
+            tab = np.asarray(power_spectrum, np.float64)
+            if tab.ndim != 2 or tab.shape[1] != 2 or tab.shape[0] < 2:
+                raise ValueError(
+                    "power_spectrum table must be (M >= 2, 2) rows of "
+                    f"(k, P); got shape {tab.shape}"
+                )
+            if np.any(tab <= 0.0) or not np.all(np.isfinite(tab)):
+                raise ValueError(
+                    "power_spectrum table needs finite k > 0 and P > 0 "
+                    "in every row (drop zero-padding/negative entries)"
+                )
+            tab = tab[np.argsort(tab[:, 0])]  # interp needs ascending k
+            log_tab_k = np.log(tab[:, 0])
+            # Shape-only: subtract max(log P) so exp() stays in f32
+            # range regardless of the table's normalization convention
+            # (sigma_psi re-pins the amplitude below).
+            log_tab_p = np.log(tab[:, 1]) - np.log(tab[:, 1]).max()
+            # Log-log interpolation (spectra are power-law-ish across
+            # decades); k=0 is masked below, so the log is safe.
+            logk = jnp.log(jnp.where(k_phys > 0, k_phys, 1.0))
+            p_k = jnp.exp(
+                jnp.interp(
+                    logk,
+                    jnp.asarray(log_tab_k, logk.dtype),
+                    jnp.asarray(log_tab_p, logk.dtype),
+                )
+            )
+        amp = jnp.where(
+            k_mag > 0, jnp.sqrt(jnp.maximum(p_k, 0.0)), 0.0
+        ).astype(k_mag.dtype)
 
     kr, ki = jax.random.split(key)
     shape = kx.shape
