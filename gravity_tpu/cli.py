@@ -843,6 +843,7 @@ def cmd_cosmo(args: argparse.Namespace) -> int:
             jax.random.PRNGKey(args.seed), args.n, box=box,
             spectral_index=args.spectral_index, sigma_psi=args.sigma_psi,
             total_mass=1.0e36, power_spectrum=p_table,
+            lpt_order=args.lpt_order,
         )
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
@@ -871,6 +872,26 @@ def cmd_cosmo(args: argparse.Namespace) -> int:
             print(json.dumps({"resumed_at": start_step,
                               "note": "checkpoint already at/past a_end"}))
             return 0
+    elif args.lpt_order == 2:
+        # Second-order momenta: the psi2 piece grows as D2 ~ D^2, so
+        # its rate factor is f2 ~ 2 f1 (the standard 2LPTic EdS
+        # approximation) — the split fields come from the SAME
+        # realization create_grf collapsed into positions.
+        from .models import grf_displacement_fields
+
+        psi1, psi2 = grf_displacement_fields(
+            jax.random.PRNGKey(args.seed), args.n, box=box,
+            spectral_index=args.spectral_index, sigma_psi=args.sigma_psi,
+            power_spectrum=p_table,
+        )
+        st = st.replace(
+            velocities=growing_mode_momenta(
+                psi1, a1, h0, args.omega_m, **cosmo
+            )
+            + 2.0 * growing_mode_momenta(
+                psi2, a1, h0, args.omega_m, **cosmo
+            )
+        )
     else:
         st = st.replace(
             velocities=growing_mode_momenta(
@@ -1195,6 +1216,10 @@ def main(argv=None) -> int:
     p_cosmo.add_argument("--trajectories", action="store_true",
                          help="record comoving positions at each block "
                               "boundary")
+    p_cosmo.add_argument("--lpt-order", dest="lpt_order", type=int,
+                         choices=[1, 2], default=1,
+                         help="IC displacement order: 1 = Zel'dovich, "
+                              "2 = 2LPT (EdS D2 = -3/7 D^2 convention)")
     p_cosmo.add_argument("--spectrum-file", dest="spectrum_file",
                          default="",
                          help="two-column (k, P) text table for the IC "

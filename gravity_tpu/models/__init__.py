@@ -8,7 +8,14 @@ families.
 
 from .cold_collapse import create_cold_collapse
 from .disk import create_disk
-from .grf import create_grf, grf_lattice, grf_side
+from .grf import (
+    create_grf,
+    grf_displacement_fields,
+    grf_lattice,
+    grf_side,
+    second_order_displacements,
+    zeldovich_displacements,
+)
 from .hernquist import create_hernquist
 from .merger import create_merger
 from .plummer import create_plummer
@@ -64,8 +71,11 @@ __all__ = [
     "create_cold_collapse",
     "create_disk",
     "create_grf",
+    "grf_displacement_fields",
     "grf_lattice",
     "grf_side",
+    "second_order_displacements",
+    "zeldovich_displacements",
     "create_hernquist",
     "create_merger",
     "create_plummer",

@@ -47,6 +47,94 @@ def grf_lattice(side: int, box: float, dtype=jnp.float32):
     ).astype(dtype) * h
 
 
+def zeldovich_displacements(delta_k, kx, ky, kz, side: int, box: float):
+    """First-order (Zel'dovich) displacement field psi(1) (n, 3) from
+    the rfft half-spectrum ``delta_k``.
+
+    psi(1) = -grad(phi1) with del^2 phi1 = delta, i.e.
+    psi_k = i k delta_k / k^2 in PHYSICAL wavenumbers k = 2 pi m / box
+    (``kx/ky/kz`` are the integer mode grids) — physical units in the
+    output, so the second-order field composes without unit juggling.
+    """
+    kf = 2.0 * jnp.pi / box
+    k2 = (kx**2 + ky**2 + kz**2) * kf**2
+    k2_safe = jnp.where(k2 > 0, k2, 1.0)
+    psi = [
+        jnp.fft.irfftn(
+            1j * (kc * kf) / k2_safe * delta_k, s=(side, side, side)
+        )
+        for kc in (kx, ky, kz)
+    ]
+    return jnp.stack([p.reshape(-1) for p in psi], axis=1)
+
+
+def second_order_displacements(delta_k, kx, ky, kz, side: int,
+                               box: float):
+    """Second-order (2LPT) displacement field psi(2) (n, 3) for the
+    SAME ``delta_k`` normalization as :func:`zeldovich_displacements`.
+
+    Standard EdS-approximation 2LPT (the 2LPTic convention):
+
+        x = q - grad(phi1) D + grad(phi2) D2,   D2 = -(3/7) D^2
+        del^2 phi2 = sum_{i<j} [phi1,ii phi1,jj - (phi1,ij)^2]
+
+    so psi(2) = -(3/7) grad(phi2) at D = 1. Six second-derivative
+    fields (irfftn each), the quadratic source in real space, one
+    forward FFT, and a gradient — O(N log N) like the first order.
+    Vanishes identically for a single plane wave (where Zel'dovich is
+    exact); tested against the analytic two-crossed-waves solution.
+    """
+    kf = 2.0 * jnp.pi / box
+    k2 = (kx**2 + ky**2 + kz**2) * kf**2
+    k2_safe = jnp.where(k2 > 0, k2, 1.0)
+    s3 = (side, side, side)
+
+    # phi1,ij = irfftn(k_i k_j delta_k / k^2) (phi1_k = -delta_k/k^2;
+    # each derivative contributes i k; (i k_i)(i k_j)(-1/k^2) = k_i k_j/k^2).
+    def d2(ka, kb):
+        return jnp.fft.irfftn(
+            (ka * kf) * (kb * kf) / k2_safe * delta_k, s=s3
+        )
+
+    pxx, pyy, pzz = d2(kx, kx), d2(ky, ky), d2(kz, kz)
+    pxy, pxz, pyz = d2(kx, ky), d2(kx, kz), d2(ky, kz)
+    src = (
+        pxx * pyy + pxx * pzz + pyy * pzz
+        - pxy**2 - pxz**2 - pyz**2
+    )
+    src_k = jnp.fft.rfftn(src)
+    # phi2_k = -src_k / k^2; psi(2) = -(3/7) grad(phi2):
+    # component k-space factor = -(3/7) (i k_c)(-1/k^2) = (3/7) i k_c/k^2.
+    psi2 = [
+        jnp.fft.irfftn(
+            (3.0 / 7.0) * 1j * (kc * kf) / k2_safe * src_k, s=s3
+        )
+        for kc in (kx, ky, kz)
+    ]
+    return jnp.stack([p.reshape(-1) for p in psi2], axis=1)
+
+
+def grf_displacement_fields(
+    key: jax.Array,
+    n: int,
+    *,
+    box: float = 1.0e13,
+    spectral_index: float = -2.0,
+    sigma_psi: float = 0.02,
+    power_spectrum=None,
+):
+    """(psi1, psi2) scaled displacement fields for the create_grf
+    realization of ``key`` — the SAME construction create_grf collapses
+    into positions, kept split so callers can apply order-dependent
+    velocity factors (2LPT growing-mode momenta need f2 ~ 2 f1 on the
+    second-order piece; collapsing the sum would lose that).
+    """
+    return _grf_fields(
+        key, n, box=box, spectral_index=spectral_index,
+        sigma_psi=sigma_psi, power_spectrum=power_spectrum,
+    )
+
+
 def create_grf(
     key: jax.Array,
     n: int,
@@ -58,6 +146,7 @@ def create_grf(
     total_mass: float = 1.0e33,
     dtype=jnp.float32,
     power_spectrum=None,
+    lpt_order: int = 1,
 ) -> ParticleState:
     """Lattice + Zel'dovich displacements with P(k) ∝ k^spectral_index.
 
@@ -75,8 +164,37 @@ def create_grf(
     amplitude stays pinned by ``sigma_psi`` either way, so tables in
     any normalization convention work unchanged.
     """
+    if lpt_order not in (1, 2):
+        raise ValueError(f"lpt_order must be 1 or 2, got {lpt_order}")
     side = grf_side(n)
-    h = box / side
+    psi1, psi2 = _grf_fields(
+        key, n, box=box, spectral_index=spectral_index,
+        sigma_psi=sigma_psi, power_spectrum=power_spectrum,
+        with_second_order=lpt_order == 2,
+    )
+    psi = psi1 if psi2 is None else psi1 + psi2
+
+    lattice = grf_lattice(side, box, dtype=psi.dtype)
+    positions = ((lattice + psi) % box).astype(dtype)
+    velocities = (vel_factor * psi).astype(dtype)
+    masses = jnp.full((n,), total_mass / n, dtype=dtype)
+    return ParticleState(positions, velocities, masses)
+
+
+def _grf_fields(
+    key: jax.Array,
+    n: int,
+    *,
+    box: float,
+    spectral_index: float = -2.0,
+    sigma_psi: float = 0.02,
+    power_spectrum=None,
+    with_second_order: bool = True,
+):
+    """(psi1_scaled, psi2_scaled | None) for the create_grf realization
+    of ``key`` — one construction shared by create_grf and the split-
+    field callers (2LPT velocity factors)."""
+    side = grf_side(n)
 
     # Mode grid on the rfft half-spectrum (integer wavenumbers): the
     # inverse transform is irfftn, which enforces hermitian symmetry —
@@ -133,30 +251,29 @@ def create_grf(
             k_mag > 0, jnp.sqrt(jnp.maximum(p_k, 0.0)), 0.0
         ).astype(k_mag.dtype)
 
+    # Pre-normalize the amplitude: sigma_psi pins the final scale, and
+    # an arbitrary-normalization spectrum (dimensionful callable/table)
+    # would otherwise push the un-normalized field's mean-square past
+    # fp32 max, flushing the RMS division to 0/inf.
+    amp_max = jnp.max(amp)
+    amp = jnp.where(amp_max > 0, amp / amp_max, amp)
+
     kr, ki = jax.random.split(key)
     shape = kx.shape
     re = jax.random.normal(kr, shape)
     im = jax.random.normal(ki, shape)
     delta_k = amp * (re + 1j * im)
 
-    # Displacement field psi_k = i k / k^2 delta_k per axis. The overall
-    # amplitude is whatever it is — the explicit RMS renormalization
-    # below pins it to sigma_psi exactly.
-    k2_safe = jnp.where(k2 > 0, k2, 1.0)
-    psi = [
-        jnp.fft.irfftn(1j * kc / k2_safe * delta_k, s=(side, side, side))
-        for kc in (kx, ky, kz)
-    ]
-    psi = jnp.stack([p.reshape(-1) for p in psi], axis=1)  # (n, 3)
+    psi1 = zeldovich_displacements(delta_k, kx, ky, kz, side, box)
 
-    # Normalize to the requested RMS displacement per axis.
-    rms = jnp.sqrt(jnp.mean(psi**2))
-    psi = psi / jnp.maximum(rms, jnp.finfo(psi.dtype).tiny)
-    psi = (sigma_psi * box) * psi
-
-    lattice = grf_lattice(side, box, dtype=psi.dtype)
-
-    positions = ((lattice + psi) % box).astype(dtype)
-    velocities = (vel_factor * psi).astype(dtype)
-    masses = jnp.full((n,), total_mass / n, dtype=dtype)
-    return ParticleState(positions, velocities, masses)
+    # Normalize the FIRST-order field to the requested RMS per axis;
+    # the amplitude rescale s acts linearly on delta, so the quadratic
+    # second-order field scales as s^2.
+    rms = jnp.sqrt(jnp.mean(psi1**2))
+    s = (sigma_psi * box) / jnp.maximum(rms, jnp.finfo(psi1.dtype).tiny)
+    psi2 = None
+    if with_second_order:
+        psi2 = s**2 * second_order_displacements(
+            delta_k, kx, ky, kz, side, box
+        )
+    return s * psi1, psi2
