@@ -749,6 +749,14 @@ def cmd_analyze(args: argparse.Namespace) -> int:
                   for v in np.asarray(p)],
             "shot_noise": float(shot),
         }
+    if args.density_profile:
+        r_mid, rho = diag.radial_density_profile(
+            state, bins=args.density_profile
+        )
+        report["density_profile"] = {
+            "r": np.asarray(r_mid).tolist(),
+            "rho": np.asarray(rho).tolist(),
+        }
     if args.correlation:
         from .ops.halos import correlation_function
 
@@ -1144,6 +1152,10 @@ def main(argv=None) -> int:
                            "set.")
     p_an.add_argument("--fof-min-members", dest="fof_min_members",
                       type=int, default=20)
+    p_an.add_argument("--density-profile", dest="density_profile",
+                      type=int, default=0, metavar="BINS",
+                      help="add the COM-centric radial mass-density "
+                           "profile with this many log shells")
     p_an.add_argument("--correlation", action="store_true",
                       help="two-point correlation function xi(r) "
                            "(periodic boxes; natural estimator)")

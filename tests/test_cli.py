@@ -234,3 +234,24 @@ def test_traj_export(tmp_path, capsys):
     assert arr.shape == (4, 16, 3)
     steps = np.load(out["steps"])
     assert list(steps) == [1, 2, 3, 4]
+
+
+def test_analyze_density_profile(capsys):
+    """--density-profile wires ops.diagnostics.radial_density_profile
+    into the report; a Plummer sphere yields a decreasing outer
+    profile."""
+    import numpy as np
+
+    rc = main([
+        "analyze", "--model", "plummer", "--n", "2048", "--eps", "1e10",
+        "--density-profile", "16",
+    ])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    prof = out["density_profile"]
+    rho = np.asarray(prof["rho"])
+    assert len(prof["r"]) == 16
+    good = rho > 0
+    # Outer half falls with radius (Plummer rho ~ r^-5 far out).
+    outer = rho[good][-4:]
+    assert np.all(np.diff(outer) < 0), outer
