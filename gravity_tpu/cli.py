@@ -695,6 +695,9 @@ def cmd_analyze(args: argparse.Namespace) -> int:
         "virial_ratio": virial,
         "center_of_mass": np.asarray(diag.center_of_mass(state)).tolist(),
         "total_momentum": np.asarray(diag.total_momentum(state)).tolist(),
+        "total_angular_momentum": np.asarray(
+            diag.total_angular_momentum(state)
+        ).tolist(),
         "velocity_dispersion": float(diag.velocity_dispersion(state)),
         "lagrangian_radii": {
             "0.10": float(lr[0]), "0.25": float(lr[1]),

@@ -139,6 +139,7 @@ def test_analyze_fresh_model(capsys):
     assert out["n"] == 512
     assert 0.5 < out["virial_ratio"] < 1.5
     assert out["lagrangian_radii"]["0.10"] < out["lagrangian_radii"]["0.90"]
+    assert len(out["total_angular_momentum"]) == 3
 
 
 def test_analyze_checkpoint(tmp_path, capsys):
