@@ -36,12 +36,22 @@ def total_momentum(state: ParticleState) -> jnp.ndarray:
     return jnp.sum(state.masses[:, None] * state.velocities, axis=0)
 
 
-def total_angular_momentum(state: ParticleState) -> jnp.ndarray:
-    return jnp.sum(
-        state.masses[:, None]
-        * jnp.cross(state.positions, state.velocities),
-        axis=0,
+def total_angular_momentum(state: ParticleState):
+    """Total L = sum m (x cross v), as a host float64 (3,) array.
+
+    Normalized mass weights on device, mass-sum rescale in float64:
+    m * |x| * |v| reaches ~1e46 at astronomical scales (1e30 kg bodies,
+    1e12 m lever arms, 1e4 m/s) and overflows fp32 to inf - inf = NaN;
+    the weighted cross products stay ~1e16, well inside range.
+    """
+    import numpy as np
+
+    m_sum = jnp.sum(state.masses)
+    w = state.masses / jnp.maximum(m_sum, jnp.finfo(state.masses.dtype).tiny)
+    l_hat = jnp.sum(
+        w[:, None] * jnp.cross(state.positions, state.velocities), axis=0
     )
+    return np.float64(np.asarray(m_sum)) * np.asarray(l_hat, np.float64)
 
 
 def center_of_mass(state: ParticleState) -> jnp.ndarray:

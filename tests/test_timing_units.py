@@ -86,3 +86,25 @@ def test_module_entry_point():
     for cmd in ("run", "sweep", "resume", "validate", "analyze", "cosmo",
                 "traj", "bench"):
         assert cmd in out.stdout
+
+
+def test_total_angular_momentum_astro_scales_finite():
+    """m * |x| * |v| ~ 1e46 overflows fp32; the normalized-weight +
+    float64-rescale path must return finite values (regression: the
+    analyze report serialized NaN for a plain fp32 Plummer state)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from gravity_tpu.ops.diagnostics import total_angular_momentum
+    from gravity_tpu.state import ParticleState
+
+    key = jax.random.PRNGKey(0)
+    pos = jax.random.uniform(key, (64, 3), jnp.float32, minval=-1e12,
+                             maxval=1e12)
+    vel = jax.random.uniform(key, (64, 3), jnp.float32, minval=-1e4,
+                             maxval=1e4)
+    m = jnp.full((64,), 1e30, jnp.float32)
+    ll = total_angular_momentum(ParticleState(pos, vel, m))
+    assert np.isfinite(ll).all()
+    assert np.abs(ll).max() > 1e40  # genuinely astronomical, not zeroed
