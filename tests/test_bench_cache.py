@@ -1,0 +1,125 @@
+"""Provenance contract of the bench TPU-line cache (bench.py).
+
+A cached line replayed as a round headline must be auditable back to the
+real on-chip run that produced it: device kind, jax/jaxlib versions, the
+run's own timestamp, and the verbatim JSON line that run emitted — all
+written only by ``_save_tpu_line``. Hand-seeded or tampered entries are
+refused, so a replay can never launder an unverified number (the failure
+mode of the round-1/2 cache, which was seeded by commit from BASELINE.md).
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import sys
+
+import pytest
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def bench(tmp_path, monkeypatch):
+    spec = importlib.util.spec_from_file_location("bench", os.path.join(_REPO_ROOT, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules.pop("bench", None)
+    spec.loader.exec_module(mod)
+    monkeypatch.setattr(mod, "CACHE_PATH", str(tmp_path / "BENCH_LAST_TPU.json"))
+    return mod
+
+
+def _fake_result():
+    return {
+        "metric": "pair_interactions_per_sec_per_chip",
+        "value": 1.62e11,
+        "unit": "pairs/s/chip",
+        "vs_baseline": 1.62,
+        "n": 65536,
+        "steps": 20,
+        "avg_step_s": 0.0265,
+        "backend": "pallas",
+        "platform": "tpu",
+    }
+
+
+def test_missing_cache_refused(bench):
+    line, reason = bench._load_cached_tpu_line()
+    assert line is None
+    assert "no cache file" in reason
+
+
+def test_hand_seeded_entry_refused(bench):
+    # The exact shape of the round-1/2 hand-seeded cache: a plausible TPU
+    # line with a synthetic timestamp but no device/version provenance.
+    seeded = dict(_fake_result(), measured_at="2026-07-29T00:00:00Z",
+                  note="seeded from BASELINE.md")
+    with open(bench.CACHE_PATH, "w") as f:
+        json.dump(seeded, f)
+    line, reason = bench._load_cached_tpu_line()
+    assert line is None
+    assert "missing provenance" in reason
+
+
+def test_save_then_load_roundtrip(bench):
+    result = _fake_result()
+    result.update(bench._collect_provenance())
+    bench._save_tpu_line(result)
+    line, reason = bench._load_cached_tpu_line()
+    assert reason is None
+    assert line["value"] == result["value"]
+    for key in bench.REQUIRED_PROVENANCE:
+        assert line.get(key), key
+    assert line["saved_by"] == bench.SAVED_BY
+    # The stored emitted_json is the verbatim line the producing run printed.
+    assert json.loads(line["emitted_json"]) == result
+
+
+@pytest.mark.parametrize(
+    "field, forged",
+    [
+        ("value", 9.9e11),
+        ("vs_baseline", 9.9),
+        ("n", 1048576),
+        ("device_kind", "TPU v7"),
+    ],
+)
+def test_tampered_field_refused(bench, field, forged):
+    # A hand-edit to ANY field — not just the headline value — breaks the
+    # match against the verbatim emitted line and is refused.
+    result = _fake_result()
+    result.update(bench._collect_provenance())
+    bench._save_tpu_line(result)
+    with open(bench.CACHE_PATH) as f:
+        cached = json.load(f)
+    cached[field] = forged
+    with open(bench.CACHE_PATH, "w") as f:
+        json.dump(cached, f)
+    line, reason = bench._load_cached_tpu_line()
+    assert line is None
+    assert "does not match" in reason
+
+
+def test_wrong_saved_by_refused(bench):
+    result = _fake_result()
+    result.update(bench._collect_provenance())
+    result["saved_by"] = "somewhere-else"
+    cached = dict(result, emitted_json=json.dumps(result))
+    with open(bench.CACHE_PATH, "w") as f:
+        json.dump(cached, f)
+    line, reason = bench._load_cached_tpu_line()
+    assert line is None
+    assert "saved_by" in reason
+
+
+def test_collect_provenance_fields(bench):
+    prov = bench._collect_provenance()
+    assert prov["device_kind"]
+    assert prov["jax_version"]
+    assert prov["jaxlib_version"]
+    assert prov["saved_by"] == bench.SAVED_BY
+    # Real timestamp format, not a hand-written midnight placeholder.
+    import re
+
+    assert re.fullmatch(r"\d{4}-\d{2}-\d{2}T\d{2}:\d{2}:\d{2}Z", prov["measured_at"])
