@@ -1,0 +1,117 @@
+"""Worker for the genuine multi-process mesh test (one of P processes).
+
+The true TPU-native analog of one MPI rank under ``mpirun -np P``
+(`/root/reference/mpi.c:140-144`): each process owns a subset of devices,
+``jax.distributed.initialize`` (via the repo's ``initialize_distributed``)
+joins them into one cluster, and the collectives in
+:mod:`gravity_tpu.parallel.sharded` span the process boundary. Run by
+``tests/test_multiprocess.py`` as ``python multiprocess_worker.py
+<process_id> <num_processes> <coordinator_port>`` with 4 virtual CPU
+devices per process.
+
+Each process independently builds the same deterministic ICs, evaluates
+the allgather and ring sharded strategies over the process-spanning mesh,
+a semi-implicit Euler step on top of each, and checks its addressable
+output shards against the NumPy fp64 oracle — parity with the
+single-process truth, across a real process boundary.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+DEVICES_PER_PROC = 4
+N = 64
+DT = 3600.0
+
+
+def main() -> int:
+    proc_id = int(sys.argv[1])
+    num_procs = int(sys.argv[2])
+    port = sys.argv[3]
+
+    import jax
+
+    # The axon sitecustomize force-sets jax_platforms=axon,cpu in every
+    # process; override before any backend initialization.
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+
+    from gravity_tpu.parallel.mesh import (
+        initialize_distributed,
+        make_particle_mesh,
+        particle_sharding,
+    )
+
+    initialize_distributed(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=num_procs,
+        process_id=proc_id,
+    )
+    assert jax.process_count() == num_procs, jax.process_count()
+    assert len(jax.local_devices()) == DEVICES_PER_PROC
+    assert len(jax.devices()) == num_procs * DEVICES_PER_PROC
+
+    import numpy as np
+
+    import reference_oracle as oracle
+    from gravity_tpu.parallel.sharded import make_sharded_accel2
+
+    # Identical deterministic ICs in every process (the analog of the
+    # reference's rank-0 Bcast, /root/reference/mpi.c:160,182 — here each
+    # rank derives the same state instead of receiving it).
+    rng = np.random.default_rng(1234)
+    pos = rng.uniform(-3.0e11, 3.0e11, size=(N, 3))
+    vel = rng.uniform(-3.0e4, 3.0e4, size=(N, 3))
+    masses = rng.uniform(1.0e23, 1.0e25, size=N)
+
+    expected_acc = oracle.accelerations(pos, masses)
+    expected_pos, expected_vel = oracle.step_semi_implicit_euler(
+        pos.copy(), vel.copy(), masses, DT
+    )
+
+    mesh = make_particle_mesh()  # all devices, across both processes
+    sharding = particle_sharding(mesh)
+    pos_g = jax.make_array_from_callback((N, 3), sharding, lambda idx: pos[idx])
+    vel_g = jax.make_array_from_callback((N, 3), sharding, lambda idx: vel[idx])
+    m_g = jax.make_array_from_callback((N,), sharding, lambda idx: masses[idx])
+
+    for strategy in ("allgather", "ring"):
+        accel2 = jax.jit(make_sharded_accel2(mesh, strategy=strategy))
+
+        acc = accel2(pos_g, m_g)
+        for shard in acc.addressable_shards:
+            np.testing.assert_allclose(
+                np.asarray(shard.data),
+                expected_acc[shard.index],
+                rtol=1e-12,
+                err_msg=f"{strategy}: accel parity, proc {proc_id}",
+            )
+
+        # One semi-implicit Euler step on top of the sharded accel —
+        # the reference's per-step update (mpi.c:206-215) across processes.
+        @jax.jit
+        def euler_step(p, v, m, accel2=accel2):
+            v_new = v + accel2(p, m) * DT
+            return p + v_new * DT, v_new
+
+        p1, v1 = euler_step(pos_g, vel_g, m_g)
+        for arr, exp in ((p1, expected_pos), (v1, expected_vel)):
+            for shard in arr.addressable_shards:
+                np.testing.assert_allclose(
+                    np.asarray(shard.data),
+                    exp[shard.index],
+                    rtol=1e-12,
+                    err_msg=f"{strategy}: step parity, proc {proc_id}",
+                )
+
+    print(f"WORKER_OK {proc_id}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
