@@ -21,6 +21,28 @@ def grid_coords(points, origin, span, side: int):
     return jnp.clip((u * side).astype(jnp.int32), 0, side - 1)
 
 
+def _cell_slots(sorted_cell_ids, cell_start, n_cells: int, cap: int):
+    """Scatter slots for dense per-cell blocks: slot = cell * cap +
+    rank-within-cell. Ranks >= cap and ids >= n_cells (out-of-grid /
+    excluded particles) park on the trash row. Returns (slot, kept)."""
+    n = sorted_cell_ids.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    rank = idx - cell_start[sorted_cell_ids]
+    kept = (sorted_cell_ids < n_cells) & (rank < cap)
+    slot = jnp.where(kept, sorted_cell_ids * cap + rank, n_cells * cap)
+    return slot, kept
+
+
+def _scatter_cells(values, slot, n_cells: int, cap: int, fill=0):
+    """One O(N) scatter of ``values`` into (n_cells, cap[, ...]) blocks;
+    trash-row and out-of-bounds entries are dropped."""
+    tail = values.shape[1:]
+    out = jnp.full((n_cells * cap + 1, *tail), fill, values.dtype)
+    return out.at[slot].set(values, mode="drop")[: n_cells * cap].reshape(
+        n_cells, cap, *tail
+    )
+
+
 def build_padded_cells(
     sorted_pos, sorted_mass, sorted_cell_ids, cell_start, n_cells: int,
     cap: int,
@@ -37,45 +59,62 @@ def build_padded_cells(
     One O(N) scatter per build: slot = rank-within-cell (sorted index
     minus the cell's start); ranks >= cap are parked on a trash row.
     """
-    n = sorted_pos.shape[0]
-    dtype = sorted_pos.dtype
-    idx = jnp.arange(n, dtype=jnp.int32)
-    cell_of = sorted_cell_ids
-    rank = idx - cell_start[cell_of]
-    slot = cell_of * cap + rank
-    # Overflow ranks scatter to a dedicated trash row (dropped on reshape).
-    slot = jnp.where(rank < cap, slot, n_cells * cap)
-    cells_pos = (
-        jnp.zeros((n_cells * cap + 1, 3), dtype)
-        .at[slot].set(sorted_pos, mode="drop")[: n_cells * cap]
-        .reshape(n_cells, cap, 3)
-    )
-    cells_mass = (
-        jnp.zeros((n_cells * cap + 1,), dtype)
-        .at[slot].set(sorted_mass, mode="drop")[: n_cells * cap]
-        .reshape(n_cells, cap)
-    )
+    slot, _ = _cell_slots(sorted_cell_ids, cell_start, n_cells, cap)
+    cells_pos = _scatter_cells(sorted_pos, slot, n_cells, cap)
+    cells_mass = _scatter_cells(sorted_mass, slot, n_cells, cap)
     return cells_pos, cells_mass
+
+
+def build_padded_cells_indexed(
+    sorted_pos, sorted_mass, sorted_idx, sorted_cell_ids, cell_start,
+    n_cells: int, cap: int,
+):
+    """:func:`build_padded_cells` plus a per-slot global-index block
+    (fill -1) and the count of in-grid particles that overflowed their
+    cell's cap (callers needing exhaustive coverage, e.g. merge
+    detection, retry with a larger cap when nonzero). ``sorted_cell_ids``
+    may contain ids >= n_cells to exclude particles from the structure
+    entirely (``cell_start`` must then have n_cells + 1 entries)."""
+    slot, kept = _cell_slots(sorted_cell_ids, cell_start, n_cells, cap)
+    cells_pos = _scatter_cells(sorted_pos, slot, n_cells, cap)
+    cells_mass = _scatter_cells(sorted_mass, slot, n_cells, cap)
+    cells_idx = _scatter_cells(sorted_idx, slot, n_cells, cap, fill=-1)
+    n_dropped = jnp.sum((sorted_cell_ids < n_cells) & ~kept)
+    return cells_pos, cells_mass, cells_idx, n_dropped
+
+
+def map_chunked(fn, operands: tuple, chunk: int, *, pad_values=None):
+    """Apply ``fn(operand_chunks) -> outputs`` over leading-axis chunks.
+
+    ``operands`` is a tuple of arrays sharing leading dim n; outputs (a
+    single array or a pytree, leading dim = chunk) are concatenated and
+    sliced back to n. The tail chunk is padded (``pad_values``: one fill
+    per operand, default 0) — padded rows are computed and discarded, so
+    padding never touches source-side structures."""
+    n = operands[0].shape[0]
+    chunk = max(1, min(chunk, n))
+    n_padded = ((n + chunk - 1) // chunk) * chunk
+    pad = n_padded - n
+    if n_padded == chunk:
+        return fn(operands)
+    if pad_values is None:
+        pad_values = (0,) * len(operands)
+    padded = tuple(
+        jnp.pad(
+            x,
+            ((0, pad),) + ((0, 0),) * (x.ndim - 1),
+            constant_values=pv,
+        ).reshape(n_padded // chunk, chunk, *x.shape[1:])
+        for x, pv in zip(operands, pad_values)
+    )
+    out = jax.lax.map(fn, padded)
+    return jax.tree.map(
+        lambda y: y.reshape(n_padded, *y.shape[2:])[:n], out
+    )
 
 
 def map_target_chunks(fn, targets, t_coords, chunk: int):
     """Apply ``fn((pos_chunk (C,3), coord_chunk (C,3))) -> (C, 3)`` over
-    targets in chunks of ``chunk``, padding the tail chunk (padded rows
-    are computed and sliced off — padding targets never touches the
-    source-side structures)."""
-    n_t = targets.shape[0]
-    chunk = max(1, min(chunk, n_t))
-    n_padded = ((n_t + chunk - 1) // chunk) * chunk
-    pad = n_padded - n_t
-    if n_padded == chunk:
-        return fn((targets, t_coords))
-    pos_p = jnp.pad(targets, ((0, pad), (0, 0)))
-    coords_p = jnp.pad(t_coords, ((0, pad), (0, 0)))
-    out = jax.lax.map(
-        fn,
-        (
-            pos_p.reshape(n_padded // chunk, chunk, 3),
-            coords_p.reshape(n_padded // chunk, chunk, 3),
-        ),
-    )
-    return out.reshape(n_padded, 3)[:n_t]
+    targets in chunks of ``chunk`` — :func:`map_chunked` for the fast
+    solvers' (position, cell-coord) target streams."""
+    return map_chunked(fn, (targets, t_coords), chunk)

@@ -25,6 +25,7 @@ import jax
 import jax.numpy as jnp
 
 from ..state import ParticleState
+from .cells import build_padded_cells_indexed, grid_coords, map_chunked
 from .numerics import tiny
 
 
@@ -116,33 +117,31 @@ class MergeResult(NamedTuple):
     n_merged: jax.Array  # number of merges applied this pass
 
 
-@partial(jax.jit, static_argnames=("k", "chunk", "box"))
-def merge_close_pairs(
-    state: ParticleState,
-    radius: float,
-    *,
-    k: int = 16,
-    chunk: int = 1024,
-    box: float = 0.0,
-) -> MergeResult:
-    """One merge pass: greedily merge pairs with r < radius.
+# Max side^3 * cap slots for the merge grid (~16M slots: a few hundred
+# MB across the three cell blocks at fp64) — the planner coarsens the
+# grid, then falls back to the brute pass, rather than exceed it.
+_SLOT_LIMIT = 1 << 24
 
-    Candidates are the k closest pairs, processed in ascending distance;
-    each particle participates in at most one merge per pass (call again
-    for cascades — a pass with ``n_merged == 0`` is a fixed point). The
-    merged body (lower index) carries total mass, the mass-weighted COM
-    position, and the momentum-conserving velocity; the donor (higher
-    index) becomes a massless tracer at the same phase-space point.
-    ``box > 0`` (periodic runs) detects AND merges with minimum-image
-    separations: a pair across a face merges at the face, not at the
-    box-spanning midpoint.
+
+def _greedy_merge(
+    state: ParticleState,
+    dists: jax.Array,
+    is_: jax.Array,
+    js: jax.Array,
+    radius: float,
+    box: float,
+) -> MergeResult:
+    """Greedy at-most-one-merge-per-particle scan over candidate pairs.
+
+    Candidates are processed in the given (ascending-distance) order;
+    duplicates such as (i, j) and (j, i) are harmless — the second is
+    blocked by the used flags. Shared by the brute-force and cell-grid
+    detection paths so the merge physics cannot drift between them.
     """
-    dists, is_, js = closest_pairs(
-        state.positions, state.masses, k=k, chunk=chunk, box=box
-    )
     i_safe = jnp.maximum(is_, 0)
     j_safe = jnp.maximum(js, 0)
     dtype = state.positions.dtype
+    k = dists.shape[0]
 
     def body(carry, t):
         pos, vel, m, used, count = carry
@@ -151,6 +150,7 @@ def merge_close_pairs(
             jnp.isfinite(d)
             & (d < jnp.asarray(radius, dtype))
             & (is_[t] >= 0)
+            & (js[t] >= 0)
             & ~used[i]
             & ~used[j]
         )
@@ -185,3 +185,269 @@ def merge_close_pairs(
     return MergeResult(
         state.replace(positions=pos, velocities=vel, masses=m), count
     )
+
+
+@partial(jax.jit, static_argnames=("k", "chunk", "box"))
+def merge_close_pairs(
+    state: ParticleState,
+    radius: float,
+    *,
+    k: int = 16,
+    chunk: int = 1024,
+    box: float = 0.0,
+) -> MergeResult:
+    """One merge pass: greedily merge pairs with r < radius.
+
+    Candidates are the k closest pairs, processed in ascending distance;
+    each particle participates in at most one merge per pass (call again
+    for cascades — a pass with ``n_merged == 0`` is a fixed point). The
+    merged body (lower index) carries total mass, the mass-weighted COM
+    position, and the momentum-conserving velocity; the donor (higher
+    index) becomes a massless tracer at the same phase-space point.
+    ``box > 0`` (periodic runs) detects AND merges with minimum-image
+    separations: a pair across a face merges at the face, not at the
+    box-spanning midpoint.
+
+    Detection is a global O(N^2) chunked scan — exact at any radius, but
+    at million-body N use :func:`merge_close_pairs_grid`, which is O(N)
+    for radii small relative to the system size.
+    """
+    dists, is_, js = closest_pairs(
+        state.positions, state.masses, k=k, chunk=chunk, box=box
+    )
+    return _greedy_merge(state, dists, is_, js, radius, box)
+
+
+@partial(jax.jit, static_argnames=("side", "cap", "chunk", "box"))
+def nearest_within_radius_grid(
+    positions: jax.Array,
+    masses: jax.Array,
+    radius: float,
+    *,
+    side: int,
+    cap: int,
+    chunk: int = 2048,
+    box: float = 0.0,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Per-particle nearest massive neighbor within ``radius``, via a
+    side^3 cell grid whose cells are at least ``radius`` wide.
+
+    Returns ``(d (N,), j (N,), n_dropped ())``: the distance and global
+    index of each massive particle's nearest in-radius neighbor (inf / -1
+    when none), plus the number of massive particles that overflowed
+    their cell's ``cap`` slots and were dropped from the *source* side
+    (callers retry with a larger cap when nonzero — see
+    :func:`merge_close_pairs_grid`). O(N * 27 * cap) work and O(side^3 *
+    cap) memory, vs the O(N^2) of :func:`closest_pairs`: the cell width
+    >= radius guarantees every in-radius pair falls in the same or an
+    adjacent cell, so the 3x3x3 neighborhood scan is exhaustive.
+    ``box > 0`` wraps both the grid and the separations (minimum image).
+    """
+    n = positions.shape[0]
+    dtype = positions.dtype
+    valid = masses > 0
+    n_cells = side**3
+    if box > 0.0:
+        origin = jnp.zeros((3,), dtype)
+        span = jnp.asarray(box, dtype)
+        pos_w = jnp.mod(positions, span)
+    else:
+        big = jnp.asarray(jnp.inf, dtype)
+        pmin = jnp.min(jnp.where(valid[:, None], positions, big), axis=0)
+        pmax = jnp.max(jnp.where(valid[:, None], positions, -big), axis=0)
+        origin = pmin
+        span = jnp.maximum(jnp.max(pmax - pmin), tiny(dtype))
+        pos_w = positions
+    coords = grid_coords(pos_w, origin, span, side)
+    cell_id = (coords[:, 0] * side + coords[:, 1]) * side + coords[:, 2]
+    # Massless particles (padding, merge donors) are excluded from the
+    # source structure entirely — they must not consume cap slots.
+    cell_id = jnp.where(valid, cell_id, n_cells).astype(jnp.int32)
+
+    order = jnp.argsort(cell_id)
+    # cell_start has n_cells + 1 entries so the trash id (n_cells, the
+    # massless particles) has a valid start too.
+    s_id = cell_id[order]
+    cell_start = jnp.searchsorted(
+        s_id, jnp.arange(n_cells + 1, dtype=jnp.int32), side="left"
+    ).astype(jnp.int32)
+    cells_pos, cells_mass, cells_idx, n_dropped = build_padded_cells_indexed(
+        pos_w[order], masses[order], order.astype(jnp.int32),
+        s_id, cell_start, n_cells, cap,
+    )
+
+    offs = jnp.stack(
+        jnp.meshgrid(*([jnp.arange(-1, 2, dtype=jnp.int32)] * 3),
+                     indexing="ij"),
+        axis=-1,
+    ).reshape(27, 3)
+    r2_max = jnp.asarray(radius, dtype) ** 2
+
+    def chunk_fn(args):
+        pos_c, coord_c, idx_c = args  # (C,3), (C,3), (C,)
+        nbr = coord_c[:, None, :] + offs[None, :, :]  # (C, 27, 3)
+        if box > 0.0:
+            nbr = jnp.mod(nbr, side)
+            ok_cell = jnp.ones(nbr.shape[:2], bool)
+        else:
+            ok_cell = jnp.all((nbr >= 0) & (nbr < side), axis=-1)
+            nbr = jnp.clip(nbr, 0, side - 1)
+        nbr_id = (nbr[..., 0] * side + nbr[..., 1]) * side + nbr[..., 2]
+        npos = cells_pos[nbr_id]  # (C, 27, cap, 3)
+        nmass = cells_mass[nbr_id]  # (C, 27, cap)
+        nidx = cells_idx[nbr_id]
+        diff = npos - pos_c[:, None, None, :]
+        if box > 0.0:
+            diff = _min_image(diff, box)
+        r2 = jnp.sum(diff * diff, axis=-1)
+        ok = (
+            ok_cell[..., None]
+            & (nmass > 0)
+            & (nidx != idx_c[:, None, None])
+            & (r2 < r2_max)
+        )
+        r2 = jnp.where(ok, r2, jnp.asarray(jnp.inf, dtype))
+        r2f = r2.reshape(r2.shape[0], 27 * cap)
+        nidxf = nidx.reshape(r2.shape[0], 27 * cap)
+        a = jnp.argmin(r2f, axis=1)
+        best_r2 = jnp.take_along_axis(r2f, a[:, None], axis=1)[:, 0]
+        best_j = jnp.take_along_axis(nidxf, a[:, None], axis=1)[:, 0]
+        return jnp.sqrt(best_r2), jnp.where(
+            jnp.isfinite(best_r2), best_j, -1
+        )
+
+    # Padding targets get index -1 (< every real index), so they can
+    # never self-exclude a real source slot.
+    idx = jnp.arange(n, dtype=jnp.int32)
+    d, j = map_chunked(
+        chunk_fn, (pos_w, coords, idx), chunk, pad_values=(0, 0, -1)
+    )
+    # Massless targets produce no candidates.
+    d = jnp.where(valid, d, jnp.asarray(jnp.inf, dtype))
+    j = jnp.where(valid, j, -1)
+    return d, j, n_dropped
+
+
+@partial(jax.jit, static_argnames=("k", "side", "cap", "chunk", "box"))
+def _merge_pass_grid(state, radius, *, k, side, cap, chunk, box):
+    d, j, n_dropped = nearest_within_radius_grid(
+        state.positions, state.masses, radius,
+        side=side, cap=cap, chunk=chunk, box=box,
+    )
+    # A mutual nearest pair appears twice — as (i, j) and (j, i). Drop
+    # the higher-index orientation so each pair costs one top-k slot,
+    # not two (otherwise k candidates cover only k/2 merges).
+    i_arr = jnp.arange(d.shape[0], dtype=jnp.int32)
+    mutual = (j >= 0) & (jnp.take(j, jnp.maximum(j, 0)) == i_arr)
+    dup = mutual & (j < i_arr)
+    d = jnp.where(dup, jnp.asarray(jnp.inf, d.dtype), d)
+    k_eff = min(k, d.shape[0])
+    neg_top, sel = jax.lax.top_k(-d, k_eff)
+    dists = -neg_top
+    found = jnp.isfinite(dists)
+    is_ = jnp.where(found, sel.astype(jnp.int32), -1)
+    js = jnp.where(found, j[sel], -1)
+    # Canonicalize to (lo, hi) so the lower index always survives the
+    # merge — the documented contract shared with merge_close_pairs.
+    lo = jnp.minimum(is_, js)
+    hi = jnp.maximum(is_, js)
+    is_ = jnp.where(found, lo, -1)
+    js = jnp.where(found, hi, -1)
+    return _greedy_merge(state, dists, is_, js, radius, box), n_dropped
+
+
+def merge_close_pairs_grid(
+    state: ParticleState,
+    radius: float,
+    *,
+    k: int = 16,
+    chunk: int = 2048,
+    box: float = 0.0,
+    max_side: int = 64,
+    cap_limit: int = 2048,
+) -> MergeResult:
+    """One merge pass with cell-grid candidate generation — O(N) where
+    :func:`merge_close_pairs` is O(N^2).
+
+    Candidates are each particle's nearest in-radius neighbor (both
+    orientations of the closest pair appear, so the greedy scan applies
+    the same merges the brute-force pass would for well-separated pairs;
+    chained configurations may take an extra cadence to cascade — the
+    at-most-once-per-pass contract is unchanged). Like the brute pass,
+    the lower index survives a merge and the higher index becomes the
+    massless tracer. Host-side planning picks the grid resolution
+    (largest power-of-two ``side`` with cell width >= radius, <=
+    ``max_side``, shrunk while the side^3 * cap slot total exceeds
+    ``_SLOT_LIMIT``) and the per-cell capacity (from measured occupancy,
+    doubled on overflow), then falls back to the exact brute-force pass
+    when the grid degenerates (radius comparable to the system size, or
+    a clustered core denser than ``cap_limit`` / the slot budget).
+    """
+    import numpy as np
+
+    def brute():
+        # The exact pass, with its (chunk, N) buffers capped at ~2^24
+        # elements so million-body fallbacks neither OOM nor cross
+        # int32 indexing.
+        return merge_close_pairs(
+            state, radius, k=k,
+            chunk=max(1, min(1024, (1 << 24) // max(state.n, 1))),
+            box=box,
+        )
+
+    pos = np.asarray(state.positions, dtype=np.float64)
+    m = np.asarray(state.masses, dtype=np.float64)
+    valid = m > 0
+    if not valid.any():
+        return MergeResult(state, jnp.asarray(0, jnp.int32))
+    if box > 0.0:
+        origin = np.zeros(3)
+        span = float(box)
+        pos_w = np.mod(pos, span)
+    else:
+        origin = pos[valid].min(axis=0)
+        span = max(float((pos[valid].max(axis=0) - origin).max()), 1e-300)
+        pos_w = pos
+    # Largest power-of-two side with cell width >= radius (and <= max_side).
+    side = 1
+    while side * 2 <= max_side and span / (side * 2) >= radius:
+        side *= 2
+
+    def cap_for(side_):
+        coords = np.clip(
+            ((pos_w[valid] - origin) / span * side_).astype(np.int64),
+            0, side_ - 1,
+        )
+        ids = (coords[:, 0] * side_ + coords[:, 1]) * side_ + coords[:, 2]
+        occupancy = int(np.bincount(ids).max())
+        cap_ = 8
+        while cap_ < occupancy + 4:
+            cap_ *= 2
+        return cap_
+
+    cap = cap_for(side)
+    # Bound total grid memory, not cap alone: a clustered core can force
+    # a large cap while most of a fine grid sits empty — coarsening the
+    # grid (fewer, fatter cells) keeps side^3 * cap ~ O(N) instead of
+    # letting the empty cells multiply the dense cell's cap.
+    while side > 4 and side**3 * cap > _SLOT_LIMIT:
+        side //= 2
+        cap = cap_for(side)
+    if side < 4 or cap > cap_limit or side**3 * cap > _SLOT_LIMIT:
+        # Radius within ~4x of the system size, or a core so dense the
+        # grid cannot be sized sanely: the exact pass is the safe answer.
+        return brute()
+    while True:
+        # Bound the (chunk, 27, cap, 3) gather buffer alongside the grid.
+        chunk_eff = max(64, min(chunk, (1 << 22) // (27 * cap)))
+        res, n_dropped = _merge_pass_grid(
+            state, radius, k=k, side=side, cap=cap, chunk=chunk_eff,
+            box=box,
+        )
+        if int(n_dropped) == 0:
+            return res
+        # fp binning differences between the numpy plan and the traced
+        # grid overflowed a cell — retry with more headroom.
+        cap *= 2
+        if cap > cap_limit or side**3 * cap > _SLOT_LIMIT:
+            return brute()

@@ -53,6 +53,10 @@ TREE_CROSSOVER_CPU = 32_768
 # Forcing O(N^2) here means >=2.7e11 pairs/step — minutes/step on CPU,
 # multiple seconds/step on one chip. Probably a mistake; warn.
 DIRECT_SUM_WARN_N = 524_288
+# Above this N the collision-merge pass detects candidates with the O(N)
+# cell grid instead of the exact O(N^2) scan (ops/encounters.py); below
+# it the brute pass is already sub-second and exact at any radius.
+MERGE_GRID_THRESHOLD = 32_768
 
 
 def _resolve_direct(config: SimulationConfig, on_tpu: bool) -> str:
@@ -625,13 +629,12 @@ class Simulator:
                 )
             ):
                 steps_since_merge_check = 0
-                from .ops.encounters import merge_close_pairs
+                from .ops.encounters import (
+                    merge_close_pairs,
+                    merge_close_pairs_grid,
+                )
 
-                # Cap the (chunk, N) detection buffers at ~2^24 elements
-                # so the pass neither OOMs nor crosses int32 indexing at
-                # million-body N.
-                merge_chunk = max(1, min(1024, (1 << 24) // max(state.n, 1)))
-                # The pair scan is a global O(N^2) pass — illegal on
+                # The pair scan needs every particle visible — illegal on
                 # particle-sharded operands (an (N@shard, N@shard)
                 # distance matrix has no legal sharding). Gather to
                 # replicated for the check, reshard only if merged.
@@ -640,10 +643,25 @@ class Simulator:
                     from .parallel import replicate_state, shard_state
 
                     merge_state = replicate_state(state, self.mesh)
-                res = merge_close_pairs(
-                    merge_state, config.merge_radius, k=config.merge_k,
-                    chunk=merge_chunk, box=config.periodic_box,
-                )
+                if state.n >= MERGE_GRID_THRESHOLD:
+                    # Cell-grid candidate generation: O(N) detection —
+                    # at the 2M merger the brute scan is ~2.2e12 pair
+                    # checks per cadence; the grid is ~27*cap*N.
+                    res = merge_close_pairs_grid(
+                        merge_state, config.merge_radius,
+                        k=config.merge_k, box=config.periodic_box,
+                    )
+                else:
+                    # Exact O(N^2) chunked scan; cap the (chunk, N)
+                    # buffers at ~2^24 elements.
+                    merge_chunk = max(
+                        1, min(1024, (1 << 24) // max(state.n, 1))
+                    )
+                    res = merge_close_pairs(
+                        merge_state, config.merge_radius,
+                        k=config.merge_k, chunk=merge_chunk,
+                        box=config.periodic_box,
+                    )
                 if int(res.n_merged) > 0:
                     state = res.state
                     if self.mesh is not None:

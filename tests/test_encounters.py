@@ -8,7 +8,9 @@ import pytest
 from gravity_tpu.ops.encounters import (
     closest_pairs,
     merge_close_pairs,
+    merge_close_pairs_grid,
     min_separation,
+    nearest_within_radius_grid,
 )
 from gravity_tpu.state import ParticleState
 
@@ -177,6 +179,152 @@ def test_forces_finite_after_merge(key, x64):
         res.state.positions, res.state.masses
     )
     assert np.isfinite(np.asarray(acc)).all()
+
+
+def test_grid_nearest_matches_brute(key, x64):
+    """Cell-grid nearest-in-radius equals the O(N^2) answer exactly."""
+    n = 300
+    radius = 0.08
+    pos = jax.random.uniform(key, (n, 3), jnp.float64)
+    masses = jnp.ones((n,), jnp.float64).at[7].set(0.0)  # one tracer
+    d, j, dropped = nearest_within_radius_grid(
+        pos, masses, radius, side=8, cap=32, chunk=64
+    )
+    assert int(dropped) == 0
+    p = np.asarray(pos)
+    m = np.asarray(masses)
+    diff = p[None, :, :] - p[:, None, :]
+    r = np.sqrt((diff * diff).sum(-1))
+    np.fill_diagonal(r, np.inf)
+    r[:, m <= 0] = np.inf  # massless sources invisible
+    want_j = r.argmin(axis=1)
+    want_d = r.min(axis=1)
+    for i in range(n):
+        if m[i] <= 0 or want_d[i] >= radius:
+            assert int(j[i]) == -1, i
+            assert not np.isfinite(float(d[i])), i
+        else:
+            assert int(j[i]) == want_j[i], i
+            np.testing.assert_allclose(float(d[i]), want_d[i], rtol=1e-12)
+
+
+def test_grid_merge_parity_with_brute(key, x64):
+    """Well-separated close pairs: grid and brute passes produce the
+    identical merged state."""
+    rng = np.random.default_rng(7)
+    centers = rng.uniform(0.0, 1.0, (12, 3))
+    offsets = rng.normal(0.0, 1e-4, (12, 3))
+    pos = np.concatenate([centers, centers + offsets])  # 12 close pairs
+    vel = rng.normal(0.0, 1.0, pos.shape)
+    masses = rng.uniform(1.0, 2.0, len(pos))
+    state = ParticleState(
+        jnp.asarray(pos), jnp.asarray(vel), jnp.asarray(masses)
+    )
+    radius = 5e-3
+    brute = merge_close_pairs(state, radius, k=16, chunk=8)
+    grid = merge_close_pairs_grid(state, radius, k=16)
+    assert int(brute.n_merged) == 12
+    assert int(grid.n_merged) == 12
+    for a, b in zip(jax.tree.leaves(brute.state), jax.tree.leaves(grid.state)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_grid_merge_periodic_wrap(x64):
+    """A pair straddling the periodic boundary merges at the min-image
+    midpoint (the face), not the box-spanning midpoint."""
+    pos = jnp.asarray(
+        [[0.001, 0.5, 0.5], [0.999, 0.5, 0.5], [0.5, 0.5, 0.5]],
+        jnp.float64,
+    )
+    vel = jnp.zeros_like(pos)
+    masses = jnp.ones((3,), jnp.float64)
+    state = ParticleState(pos, vel, masses)
+    res = merge_close_pairs_grid(state, 0.01, k=4, box=1.0)
+    assert int(res.n_merged) == 1
+    merged_x = float(res.state.positions[0, 0])
+    assert min(merged_x, 1.0 - merged_x) < 1e-9  # at the face
+    assert float(res.state.masses[0]) == 2.0
+
+
+def test_grid_merge_cascade_reaches_separation_fixed_point(key, x64):
+    """Iterated grid passes terminate with every massive pair separated
+    by >= radius, conserving mass and momentum throughout."""
+    n = 1024
+    radius = 0.04
+    kp, kv = jax.random.split(key)
+    pos = jax.random.normal(kp, (n, 3), jnp.float64) * 0.3
+    vel = jax.random.normal(kv, (n, 3), jnp.float64)
+    masses = jnp.ones((n,), jnp.float64)
+    state = ParticleState(pos, vel, masses)
+    total = 0
+    for _ in range(200):
+        res = merge_close_pairs_grid(state, radius, k=64)
+        state = res.state
+        if int(res.n_merged) == 0:
+            break
+        total += int(res.n_merged)
+    assert int(res.n_merged) == 0, "did not reach a fixed point"
+    assert total > 0
+    np.testing.assert_allclose(
+        float(jnp.sum(state.masses)), n * 1.0, rtol=1e-13
+    )
+    np.testing.assert_allclose(
+        np.asarray(jnp.sum(state.masses[:, None] * state.velocities, axis=0)),
+        np.asarray(jnp.sum(masses[:, None] * vel, axis=0)),
+        rtol=1e-11,
+    )
+    assert float(min_separation(state.positions, state.masses)) >= radius
+
+
+def test_grid_merge_degenerate_radius_falls_back(key, x64):
+    """Radius comparable to the system size: the grid degenerates and the
+    wrapper must hand off to the exact brute pass."""
+    n = 50
+    pos = jax.random.uniform(key, (n, 3), jnp.float64)
+    vel = jnp.zeros_like(pos)
+    masses = jnp.ones((n,), jnp.float64)
+    state = ParticleState(pos, vel, masses)
+    radius = 0.5  # span ~1 -> side < 4 -> brute fallback
+    grid = merge_close_pairs_grid(state, radius, k=8)
+    brute = merge_close_pairs(state, radius, k=8, chunk=16)
+    assert int(grid.n_merged) == int(brute.n_merged)
+    for a, b in zip(jax.tree.leaves(grid.state), jax.tree.leaves(brute.state)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_simulator_routes_merge_through_grid(monkeypatch, x64):
+    """Above MERGE_GRID_THRESHOLD the Simulator merge cadence uses the
+    cell-grid pass; physics outcome matches the brute-force scenario."""
+    from gravity_tpu.config import SimulationConfig
+    from gravity_tpu.ops import encounters
+    from gravity_tpu import simulation
+    from gravity_tpu.simulation import Simulator
+
+    monkeypatch.setattr(simulation, "MERGE_GRID_THRESHOLD", 1)
+    calls = {"n": 0}
+    real = encounters.merge_close_pairs_grid
+
+    def counting(*args, **kwargs):
+        calls["n"] += 1
+        return real(*args, **kwargs)
+
+    monkeypatch.setattr(encounters, "merge_close_pairs_grid", counting)
+
+    pos = jnp.asarray([[-1e8, 0.0, 0.0], [1e8, 0.0, 0.0]], jnp.float64)
+    vel = jnp.asarray([[1e4, 0.0, 0.0], [-1e4, 0.0, 0.0]], jnp.float64)
+    masses = jnp.asarray([1e26, 2e26], jnp.float64)
+    config = SimulationConfig(
+        n=2, steps=100, dt=1000.0, integrator="leapfrog",
+        force_backend="dense", merge_radius=5e7, dtype="float64",
+        progress_every=10, merge_every=10,
+    )
+    sim = Simulator(config, state=ParticleState(pos, vel, masses))
+    stats = sim.run()
+    assert calls["n"] > 0
+    assert stats["merged_pairs"] == 1
+    np.testing.assert_allclose(
+        float(jnp.sum(stats["final_state"].masses)), 3e26, rtol=1e-12
+    )
 
 
 def test_merge_check_cadence_honors_merge_every(monkeypatch, x64):
