@@ -28,12 +28,14 @@ import jax  # noqa: E402
 
 
 def timed_eval(fn, pos, masses, iters):
+    from gravity_tpu.utils.timing import sync
+
     out = fn(pos, masses)
-    jax.block_until_ready(out)
+    sync(out)
     t0 = time.perf_counter()
     for _ in range(iters):
         out = fn(pos, masses)
-    jax.block_until_ready(out)
+    sync(out)
     return (time.perf_counter() - t0) / iters
 
 

@@ -26,13 +26,17 @@ import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
 
 
+from gravity_tpu.utils.timing import sync  # noqa: E402
+
+
 def timed(fn, *args, iters=3, label=""):
+
     out = fn(*args)
-    jax.block_until_ready(out)
+    sync(out)
     t0 = time.perf_counter()
     for _ in range(iters):
         out = fn(*args)
-    jax.block_until_ready(out)
+    sync(out)
     dt = (time.perf_counter() - t0) / iters
     print(f"{label:32s} {dt * 1e3:10.2f} ms")
     return dt
@@ -95,7 +99,7 @@ def main(argv) -> int:
 
     if trace_dir:
         with jax.profiler.trace(trace_dir):
-            jax.block_until_ready(jax.jit(full)(pos))
+            sync(jax.jit(full)(pos))
         print(f"trace written to {trace_dir}")
     return 0
 

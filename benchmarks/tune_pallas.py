@@ -11,10 +11,19 @@ Usage:
 
 from __future__ import annotations
 
+import os
 import sys
 import time
 
-import jax
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from gravity_tpu.utils.platform import ensure_live_backend  # noqa: E402
+
+ensure_live_backend()
+
+import jax  # noqa: E402
+
+from gravity_tpu.utils.timing import sync  # noqa: E402
 
 
 def main(argv) -> int:
@@ -41,12 +50,12 @@ def main(argv) -> int:
                     interpret=interpret,
                 )
                 out = f(pos)
-                jax.block_until_ready(out)
+                sync(out)
                 t0 = time.perf_counter()
                 iters = 5
                 for _ in range(iters):
                     out = f(pos)
-                jax.block_until_ready(out)
+                sync(out)
                 dt = (time.perf_counter() - t0) / iters
                 pairs = n * (n - 1) / dt
                 results.append((pairs, tile_i, tile_j))

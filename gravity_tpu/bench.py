@@ -3,7 +3,17 @@
 The reference only measures wall-clock around its step loop
 (`/root/reference/mpi.c:189,239`, `/root/reference/cuda.cu:154,169-171`);
 this harness compiles the step once, warms up, then times a fixed number of
-steps with ``block_until_ready`` fencing — the BASELINE.json metric.
+steps with a scalar value fetch as the fence — the BASELINE.json metric.
+
+Why a value fetch, not ``block_until_ready``: under the tunneled axon
+platform the remote client pipelines dispatches, and ``block_until_ready``
+called immediately after a prior sync can return on the dispatch ack —
+before the computation has executed — yielding microsecond "step times"
+that are pure fiction. Reading an actual scalar out of the result cannot
+lie: the producing computation must have finished for the bytes to exist.
+The reduction is jit-compiled and warmed outside the timed region, and
+transfers 4 bytes, so the fence costs one tunnel round-trip (~70 ms),
+amortized over the timed block of steps.
 """
 
 from __future__ import annotations
@@ -21,6 +31,7 @@ def run_benchmark(
     config: SimulationConfig, *, warmup_steps: int = 3, bench_steps: int = 20
 ) -> dict:
     from .ops.integrators import init_carry
+    from .utils.timing import sync
 
     sim = Simulator(config)
     state = sim.state
@@ -29,13 +40,14 @@ def run_benchmark(
     # Compile + warm up with the SAME static n_steps as the timed block:
     # _run_block retraces per distinct n_steps, so a different warmup shape
     # would leave the timed call paying compilation inside the timer.
+    # sync() is the true value-fetch fence (see utils/timing.sync).
     del warmup_steps
     state, acc, _ = sim._run_block(state, acc, n_steps=bench_steps, record=False)
-    jax.block_until_ready(state.positions)
+    sync(state.positions)
 
     start = time.perf_counter()
     state, acc, _ = sim._run_block(state, acc, n_steps=bench_steps, record=False)
-    jax.block_until_ready(state.positions)
+    sync(state.positions)
     elapsed = time.perf_counter() - start
 
     from .ops.integrators import FORCE_EVALS_PER_STEP

@@ -817,6 +817,8 @@ def cmd_cosmo(args: argparse.Namespace) -> int:
 
     import jax
     import jax.numpy as jnp
+
+    from .utils.timing import sync
     import numpy as np
 
     import os
@@ -990,7 +992,7 @@ def cmd_cosmo(args: argparse.Namespace) -> int:
             dtype=st.positions.dtype,
         )
         st = comoving_kdk_scan(st, k1s, drs, k2s, accel_fn=accel)
-        jax.block_until_ready(st.positions)
+        sync(st.positions)
         prev_i, step_i = step_i, hi
         a_now = float(edges[step_i])
         # Output cadences are gated independently of the block size:

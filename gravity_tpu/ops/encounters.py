@@ -123,6 +123,13 @@ class MergeResult(NamedTuple):
 _SLOT_LIMIT = 1 << 24
 
 
+def merge_scan_chunk(n: int) -> int:
+    """Chunk size for the exact O(N^2) merge scan: caps the (chunk, N)
+    distance buffers at ~2^24 elements so million-body scans neither OOM
+    nor cross int32 indexing."""
+    return max(1, min(1024, (1 << 24) // max(n, 1)))
+
+
 def _greedy_merge(
     state: ParticleState,
     dists: jax.Array,
@@ -386,13 +393,8 @@ def merge_close_pairs_grid(
     import numpy as np
 
     def brute():
-        # The exact pass, with its (chunk, N) buffers capped at ~2^24
-        # elements so million-body fallbacks neither OOM nor cross
-        # int32 indexing.
         return merge_close_pairs(
-            state, radius, k=k,
-            chunk=max(1, min(1024, (1 << 24) // max(state.n, 1))),
-            box=box,
+            state, radius, k=k, chunk=merge_scan_chunk(state.n), box=box,
         )
 
     pos = np.asarray(state.positions, dtype=np.float64)

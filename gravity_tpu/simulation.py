@@ -27,7 +27,7 @@ from .ops.integrators import FORCE_EVALS_PER_STEP, init_carry, make_step_fn
 from .ops import diagnostics
 from .state import ParticleState
 from .utils.logging import RunLogger
-from .utils.timing import StepTimer, throughput
+from .utils.timing import StepTimer, sync, throughput
 from .utils.trajectory import TrajectoryWriter
 
 _DTYPES = {
@@ -591,7 +591,7 @@ class Simulator:
                 state, acc, n_steps=n_steps, record=do_record,
                 record_every=every if do_record else 1,
             )
-            jax.block_until_ready(state.positions)
+            sync(state.positions)
             if config.nan_check and not self._state_finite(state):
                 # Divergence watchdog: abort with the last finite state
                 # persisted rather than integrating garbage to the end.
@@ -632,6 +632,7 @@ class Simulator:
                 from .ops.encounters import (
                     merge_close_pairs,
                     merge_close_pairs_grid,
+                    merge_scan_chunk,
                 )
 
                 # The pair scan needs every particle visible — illegal on
@@ -652,14 +653,11 @@ class Simulator:
                         k=config.merge_k, box=config.periodic_box,
                     )
                 else:
-                    # Exact O(N^2) chunked scan; cap the (chunk, N)
-                    # buffers at ~2^24 elements.
-                    merge_chunk = max(
-                        1, min(1024, (1 << 24) // max(state.n, 1))
-                    )
+                    # Exact O(N^2) chunked scan.
                     res = merge_close_pairs(
                         merge_state, config.merge_radius,
-                        k=config.merge_k, chunk=merge_chunk,
+                        k=config.merge_k,
+                        chunk=merge_scan_chunk(state.n),
                         box=config.periodic_box,
                     )
                 if int(res.n_merged) > 0:
@@ -892,7 +890,7 @@ class Simulator:
                          config.adaptive_max_steps - steps_taken)
             res = run_block(state, budget=budget, t0=t, comp0=comp,
                             acc0=acc)
-            jax.block_until_ready(res.state.positions)
+            sync(res.state.positions)
             state, acc = res.state, res.acc
             t, comp = float(res.t), float(res.comp)
             block_steps = int(res.steps)

@@ -12,6 +12,44 @@ from __future__ import annotations
 import time
 from dataclasses import dataclass, field
 
+_SYNC_PICK = None
+
+
+def sync(x) -> None:
+    """Genuine completion fence for wall-clock timing.
+
+    ``jax.block_until_ready`` is NOT a reliable fence on the tunneled axon
+    platform: the remote client pipelines dispatches, and a block call made
+    immediately after a prior sync can return on the dispatch ack — before
+    the computation has executed — producing microsecond "step times" that
+    are fiction. Fetching an actual value cannot lie: for the bytes to
+    arrive, the producing computation must have finished.
+
+    Transfers one scalar per array leaf, so the cost is one host
+    round-trip, not a full-buffer copy. The reduction is ``jnp.sum`` —
+    valid under any sharding (XLA inserts the cross-device reduce and
+    replicates the scalar), unlike a slice, which fails on sharded dims.
+    Its per-shape compilation is cached by jax; warm it outside any timed
+    region (the first call per shape compiles). Overflow in the summed
+    value is irrelevant — the value is discarded; only its arrival matters.
+    """
+    import jax
+
+    global _SYNC_PICK
+    if _SYNC_PICK is None:
+        import jax.numpy as jnp
+
+        _SYNC_PICK = jax.jit(jnp.sum)
+    # Dispatch every leaf's reduction first, then fetch the scalars in one
+    # device_get, so a multi-leaf tree costs one round-trip, not one per leaf.
+    scalars = []
+    for leaf in jax.tree_util.tree_leaves(x):
+        try:
+            scalars.append(_SYNC_PICK(leaf))
+        except TypeError:
+            scalars.append(leaf)  # non-numeric leaf (e.g. PRNG key): fetch it
+    jax.device_get(scalars)
+
 
 def pairs_per_step(n: int, *, direct_sum: bool = True) -> int:
     """Pair interactions evaluated per force evaluation.

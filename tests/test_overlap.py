@@ -34,12 +34,14 @@ requires_pod = pytest.mark.skipif(
 
 
 def _timed(fn, *args, iters=5):
+    from gravity_tpu.utils.timing import sync
+
     out = fn(*args)
-    jax.block_until_ready(out)
+    sync(out)
     t0 = time.perf_counter()
     for _ in range(iters):
         out = fn(*args)
-    jax.block_until_ready(out)
+    sync(out)
     return (time.perf_counter() - t0) / iters
 
 
