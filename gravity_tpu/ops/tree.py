@@ -326,6 +326,78 @@ def _monopole_acc(pos, cell_mass, cell_com, mask, g, eps, dtype,
     return acc
 
 
+def _interaction_ids(coords_c, d, depth, offsets, parity_masks):
+    """Level-d interaction-list cell ids and validity mask for targets in
+    leaf cells ``coords_c`` — the shared traversal scaffolding of the
+    force and potential paths (one source of truth for the parity-mask
+    geometry)."""
+    sd = 1 << d
+    cd = coords_c >> (depth - d)  # (C, 3) level-d coords
+    parity = ((cd[:, 0] & 1) << 2) | ((cd[:, 1] & 1) << 1) | (cd[:, 2] & 1)
+    pmask = parity_masks[parity]  # (C, L)
+    cell = cd[:, None, :] + offsets[None, :, :]  # (C, L, 3)
+    in_bounds = jnp.all(jnp.logical_and(cell >= 0, cell < sd), axis=-1)
+    cell_cl = jnp.clip(cell, 0, sd - 1)
+    ids = (cell_cl[..., 0] * sd + cell_cl[..., 1]) * sd + cell_cl[..., 2]
+    return ids, jnp.logical_and(pmask, in_bounds)
+
+
+def _near_gather(
+    coords_c, near, side, leaf_count, cells_pos, cells_mass, leaf_cap
+):
+    """Neighbor-leaf source gather for the exact near field: whole-block
+    gathers from the padded per-leaf arrays — (C, |near|) indices pulling
+    contiguous (cap, 3) slices, ~cap x fewer gather indices than
+    per-particle element gathers (TPU gathers want few, large slices).
+
+    Returns (nids (C, |near|), counts (C, |near|), src_pos (C, |near|*K, 3),
+    src_mass (C, |near|*K), valid (C, |near|, K))."""
+    ncell = coords_c[:, None, :] + near[None, :, :]  # (C, 27, 3)
+    in_bounds = jnp.all(
+        jnp.logical_and(ncell >= 0, ncell < side), axis=-1
+    )
+    ncell_cl = jnp.clip(ncell, 0, side - 1)
+    nids = (
+        ncell_cl[..., 0] * side + ncell_cl[..., 1]
+    ) * side + ncell_cl[..., 2]
+    counts = jnp.where(in_bounds, leaf_count[nids], 0)
+    c = coords_c.shape[0]
+    src_pos = cells_pos[nids].reshape(c, -1, 3)  # (C, 27K, 3)
+    src_mass = cells_mass[nids].reshape(c, -1)
+    k_idx = jnp.arange(leaf_cap, dtype=jnp.int32)  # (K,)
+    valid = k_idx[None, None, :] < counts[..., None]
+    return nids, counts, src_pos, src_mass, valid
+
+
+def _overflow_remainder(
+    src_pos, src_mass, valid, nids, cmass_l, ccom_l, over, m_scale, dtype
+):
+    """Remaining mass/COM of capped-out leaf cells: cell total minus the
+    gathered prefix, in normalized-mass arithmetic throughout (m * x
+    overflows fp32 for heavy bodies — see build_octree). The shared core
+    of the force and potential overflow fallbacks.
+
+    Returns (rem_mhat (C, |near|), rem_com (C, |near|, 3))."""
+    src_mhat = (src_mass / m_scale).reshape(valid.shape)
+    pref_mhat = jnp.sum(jnp.where(valid, src_mhat, 0.0), axis=-1)
+    pref_mw = jnp.sum(
+        jnp.where(
+            valid[..., None],
+            src_mhat[..., None] * src_pos.reshape(valid.shape + (3,)),
+            0.0,
+        ),
+        axis=-2,
+    )  # (C, 27, 3)
+    rem_mhat = jnp.maximum(
+        jnp.where(over, cmass_l[nids] / m_scale - pref_mhat, 0.0), 0.0
+    )
+    tot_mw = ccom_l[nids] * (cmass_l[nids] / m_scale)[..., None]
+    rem_com = (tot_mw - pref_mw) / jnp.maximum(
+        rem_mhat, jnp.asarray(1e-37, dtype)
+    )[..., None]
+    return rem_mhat, rem_com
+
+
 def _pair_acc(pos, src_pos, src_mass, mask, g, cutoff, eps, dtype):
     """Masked direct-sum kernel: pos (C, 3); sources (C, L[, 3])."""
     diff = src_pos - pos[:, None, :]
@@ -452,48 +524,23 @@ def tree_accelerations_vs(
         # (every level for "direct"; only the finest level — whose p=1
         # expansion ratio would be too large — for "expansion").
         for d in far_levels:
-            sd = 1 << d
             cmass, ccom = levels[d][0], levels[d][1]
-            cd = coords_c >> (depth - d)  # (C, 3) level-d coords
-            parity = ((cd[:, 0] & 1) << 2) | ((cd[:, 1] & 1) << 1) | (
-                cd[:, 2] & 1
+            ids, mask = _interaction_ids(
+                coords_c, d, depth, offsets, parity_masks
             )
-            pmask = parity_masks[parity]  # (C, 343)
-            cell = cd[:, None, :] + offsets[None, :, :]  # (C, 343, 3)
-            in_bounds = jnp.all(
-                jnp.logical_and(cell >= 0, cell < sd), axis=-1
-            )
-            cell_cl = jnp.clip(cell, 0, sd - 1)
-            ids = (
-                cell_cl[..., 0] * sd + cell_cl[..., 1]
-            ) * sd + cell_cl[..., 2]
-            mask = jnp.logical_and(pmask, in_bounds)
             acc = acc + _monopole_acc(
                 pos_c, cmass[ids], ccom[ids], mask, g, eps, dtype,
                 cell_quad=levels[d][2][ids] if use_quad else None,
-                h_d=span / sd, m_scale=m_scale,
+                h_d=span / (1 << d), m_scale=m_scale,
             )
 
         # Near field: exact pairs from the neighbor leaves (capped),
         # plus a monopole correction for capped-out overflow.
-        cd = coords_c  # leaf coords
-        ncell = cd[:, None, :] + near[None, :, :]  # (C, 27, 3)
-        in_bounds = jnp.all(
-            jnp.logical_and(ncell >= 0, ncell < side), axis=-1
-        )
-        ncell_cl = jnp.clip(ncell, 0, side - 1)
-        nids = (ncell_cl[..., 0] * side + ncell_cl[..., 1]) * side + ncell_cl[..., 2]
-        counts = jnp.where(in_bounds, leaf_count[nids], 0)
-
-        # Whole-block gathers from the padded per-leaf arrays: (C, |near|)
-        # indices pulling contiguous (cap, 3) slices — ~cap x fewer gather
-        # indices than per-particle element gathers (TPU gathers want
-        # few, large slices).
         c = pos_c.shape[0]
-        src_pos = cells_pos[nids].reshape(c, -1, 3)  # (C, 27K, 3)
-        src_mass = cells_mass[nids].reshape(c, -1)
-        k_idx = jnp.arange(leaf_cap, dtype=jnp.int32)  # (K,)
-        valid = k_idx[None, None, :] < counts[..., None]
+        nids, counts, src_pos, src_mass, valid = _near_gather(
+            coords_c, near, side, leaf_count, cells_pos, cells_mass,
+            leaf_cap,
+        )
         acc = acc + _pair_acc(
             pos_c, src_pos, src_mass,
             valid.reshape(c, -1), g, cutoff, eps, dtype,
@@ -507,27 +554,10 @@ def tree_accelerations_vs(
         over_any = jnp.any(over)
 
         def add_overflow(acc):
-            # Remaining mass/COM = cell total minus the gathered prefix.
-            # Normalized-mass arithmetic throughout: m * x overflows fp32
-            # for heavy bodies (see build_octree).
-            src_mhat = (src_mass / m_scale).reshape(valid.shape)
-            pref_mhat = jnp.sum(jnp.where(valid, src_mhat, 0.0), axis=-1)
-            pref_mw = jnp.sum(
-                jnp.where(
-                    valid[..., None],
-                    src_mhat[..., None]
-                    * src_pos.reshape(valid.shape + (3,)),
-                    0.0,
-                ),
-                axis=-2,
-            )  # (C, 27, 3)
-            rem_mhat = jnp.maximum(
-                jnp.where(over, cmass_l[nids] / m_scale - pref_mhat, 0.0), 0.0
+            rem_mhat, rem_com = _overflow_remainder(
+                src_pos, src_mass, valid, nids, cmass_l, ccom_l, over,
+                m_scale, dtype,
             )
-            tot_mw = ccom_l[nids] * (cmass_l[nids] / m_scale)[..., None]
-            rem_com = (tot_mw - pref_mw) / jnp.maximum(
-                rem_mhat, jnp.asarray(1e-37, dtype)
-            )[..., None]
             # Soften the overflow monopole by the leaf size: a target can
             # sit arbitrarily close to (even inside) an overflowing cell,
             # and an unsoftened point-monopole at its COM would produce
@@ -552,6 +582,203 @@ def tree_accelerations(
 ) -> jax.Array:
     """Octree accelerations for all particles (targets = sources)."""
     return tree_accelerations_vs(positions, positions, masses, **kwargs)
+
+
+def tree_potential_energy(
+    positions: jax.Array,
+    masses: jax.Array,
+    *,
+    depth: int = 6,
+    leaf_cap: int = 32,
+    chunk: int = 1024,
+    ws: int = 1,
+    g: float = G,
+    cutoff: float = CUTOFF_RADIUS,
+    eps: float = 0.0,
+    quad: bool = True,
+):
+    """Total potential energy via the octree: -0.5 sum_i G m_i phi_i.
+
+    The scalable counterpart of :func:`..forces.potential_energy` (whose
+    dense pair scan costs ~5.5e11 pair evaluations at 1M bodies — more
+    than the force step it monitors). Same traversal decomposition as
+    :func:`tree_accelerations_vs` in "direct" far mode: per-level
+    interaction-list cell sums of m_c / r (plus, with ``quad``, on by
+    default to match the force path, the quadrupole potential term
+    (1/2) Q:uu / r^5), an exact capped near field, and the cell-size-
+    softened overflow monopole.
+
+    Conventions match the dense diagnostic exactly: r is Plummer-softened
+    by ``eps``, sub-``cutoff`` softened pairs contribute zero, and the
+    softened self term (r = eps) is INCLUDED — a constant offset at fixed
+    masses, so drift metrics are unaffected and tree-vs-dense parity
+    holds term by term.
+
+    Returns a host ``np.float64``: the device computes the dimensionless
+    double sum in normalized masses (m_hat = m / max(m), fp32-safe), and
+    the -0.5 G m_scale^2 rescale happens in host float64 — the raw value
+    reaches ~1e42 at astronomical masses, beyond fp32 range (and TPU has
+    no f64).
+    """
+    s_hat, m_scale = _tree_pe_scaled(
+        positions, masses, depth=depth, leaf_cap=leaf_cap, chunk=chunk,
+        ws=ws, cutoff=cutoff, eps=eps, quad=quad,
+    )
+    return (
+        np.float64(-0.5 * g)
+        * np.float64(jax.device_get(m_scale)) ** 2
+        * np.float64(jax.device_get(s_hat))
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "depth", "leaf_cap", "chunk", "ws", "cutoff", "eps", "quad",
+    ),
+)
+def _tree_pe_scaled(
+    positions: jax.Array,
+    masses: jax.Array,
+    *,
+    depth: int,
+    leaf_cap: int,
+    chunk: int,
+    ws: int,
+    cutoff: float,
+    eps: float,
+    quad: bool,
+):
+    """Dimensionless sum_i m_hat_i sum_j m_hat_j / r_ij and the mass
+    scale, all in fp32 range (see tree_potential_energy)."""
+    n = positions.shape[0]
+    dtype = positions.dtype
+    levels, origin, span, coords = build_octree(
+        positions, masses, depth, quad=quad
+    )
+    side = 1 << depth
+    m_scale = jnp.maximum(jnp.max(masses), jnp.asarray(1e-37, dtype))
+
+    leaf_ids = (coords[:, 0] * side + coords[:, 1]) * side + coords[:, 2]
+    order = jnp.argsort(leaf_ids)
+    sorted_pos = positions[order]
+    sorted_mass = masses[order]
+    n_leaves = side**3
+    leaf_count = jax.ops.segment_sum(
+        jnp.ones((n,), jnp.int32), leaf_ids, num_segments=n_leaves
+    )
+    leaf_start = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(leaf_count)[:-1]]
+    )
+    cells_pos, cells_mass = build_padded_cells(
+        sorted_pos, sorted_mass, leaf_ids[order], leaf_start, n_leaves,
+        leaf_cap,
+    )
+
+    offsets = jnp.asarray(_offsets(ws))
+    parity_masks = jnp.asarray(_parity_mask_table(ws))
+    near = jnp.asarray(_near_offsets(ws))
+
+    def masked_inv_r_sum(pos_c, src_m, src_pos_or_com, ok, eps_,
+                         cell_quad=None, h_d=None):
+        # sum over sources of m / sqrt(r^2 + eps^2), masked; with
+        # ``cell_quad`` adds the quadrupole potential term
+        # (1/2) Q:uu / r^5 (Q = m_scale h_d^2 Q_hat), fp32-ordered so
+        # every factor is O(m_scale / r) or O(1).
+        diff = src_pos_or_com - pos_c[:, None, :]
+        diff = jnp.where(ok[..., None], diff, jnp.asarray(0.0, dtype))
+        r2 = jnp.sum(diff * diff, axis=-1) + jnp.asarray(eps_ * eps_, dtype)
+        safe = jnp.where(ok, r2, jnp.asarray(1.0, dtype))
+        inv_r = jnp.where(ok, jax.lax.rsqrt(safe), jnp.asarray(0.0, dtype))
+        rows_c = jnp.sum(src_m * inv_r, axis=-1)
+        if cell_quad is None:
+            return rows_c
+        q = jnp.where(ok[..., None], cell_quad, jnp.asarray(0.0, dtype))
+        qd_x = q[..., 0] * diff[..., 0] + q[..., 3] * diff[..., 1] \
+            + q[..., 4] * diff[..., 2]
+        qd_y = q[..., 3] * diff[..., 0] + q[..., 1] * diff[..., 1] \
+            + q[..., 5] * diff[..., 2]
+        qd_z = q[..., 4] * diff[..., 0] + q[..., 5] * diff[..., 1] \
+            + q[..., 2] * diff[..., 2]
+        qq = (
+            qd_x * diff[..., 0] + qd_y * diff[..., 1] + qd_z * diff[..., 2]
+        )
+        hq = h_d * inv_r
+        inv_r2 = inv_r * inv_r
+        return rows_c + jnp.sum(
+            0.5 * (m_scale * inv_r) * hq * hq * (qq * inv_r2), axis=-1
+        )
+
+    def chunk_rows(args):
+        pos_c, coords_c = args
+        rows = jnp.zeros((pos_c.shape[0],), dtype)
+
+        # Far field: per-level interaction-list monopole cells (no cutoff
+        # on cells — matching the acceleration path, where the cutoff only
+        # guards near-field point pairs).
+        for d in range(2, depth + 1):
+            cmass, ccom = levels[d][0], levels[d][1]
+            ids, mask = _interaction_ids(
+                coords_c, d, depth, offsets, parity_masks
+            )
+            ok = jnp.logical_and(mask, cmass[ids] > 0)
+            rows = rows + masked_inv_r_sum(
+                pos_c, cmass[ids], ccom[ids], ok, eps,
+                cell_quad=levels[d][2][ids] if quad else None,
+                h_d=span / (1 << d),
+            )
+
+        # Near field: exact capped pairs from the neighbor leaves, with
+        # the dense diagnostic's cutoff convention.
+        c = pos_c.shape[0]
+        nids, counts, src_pos, src_mass, valid_3d = _near_gather(
+            coords_c, near, side, leaf_count, cells_pos, cells_mass,
+            leaf_cap,
+        )
+        valid = valid_3d.reshape(c, -1)
+        diff = src_pos - pos_c[:, None, :]
+        r2s = jnp.sum(diff * diff, axis=-1) + jnp.asarray(eps * eps, dtype)
+        ok = jnp.logical_and(
+            valid, r2s > jnp.asarray(cutoff * cutoff, dtype)
+        )
+        safe = jnp.where(ok, r2s, jnp.asarray(1.0, dtype))
+        inv_r = jnp.where(ok, jax.lax.rsqrt(safe), jnp.asarray(0.0, dtype))
+        rows = rows + jnp.sum(src_mass * inv_r, axis=-1)
+
+        # Overflow: remaining mass of capped-out cells as a cell-size-
+        # softened monopole (same graceful fallback as the force path).
+        cmass_l, ccom_l = levels[depth][0], levels[depth][1]
+        over = counts > leaf_cap
+        over_any = jnp.any(over)
+
+        def add_overflow(rows):
+            rem_mhat, rem_com = _overflow_remainder(
+                src_pos, src_mass, valid_3d, nids, cmass_l, ccom_l, over,
+                m_scale, dtype,
+            )
+            cell_h = span / side
+            eps_arr = jnp.maximum(jnp.asarray(eps, dtype), 0.5 * cell_h)
+            diff_o = rem_com - pos_c[:, None, :]
+            diff_o = jnp.where(
+                over[..., None], diff_o, jnp.asarray(0.0, dtype)
+            )
+            r2o = jnp.sum(diff_o * diff_o, axis=-1) + eps_arr * eps_arr
+            safe_o = jnp.where(over, r2o, jnp.asarray(1.0, dtype))
+            inv_ro = jnp.where(
+                over, jax.lax.rsqrt(safe_o), jnp.asarray(0.0, dtype)
+            )
+            return rows + jnp.sum((rem_mhat * m_scale) * inv_ro, axis=-1)
+
+        rows = jax.lax.cond(over_any, add_overflow, lambda r: r, rows)
+        return rows
+
+    t_coords = grid_coords(positions, origin, span, side)
+    rows = map_target_chunks(chunk_rows, positions, t_coords, chunk)
+    # Normalized contraction: rows (~m n / r) stays in fp32 range, but
+    # g * m * rows does not at astronomical masses — sum m_hat * rows_hat
+    # instead and let the host rescale in f64.
+    s_hat = jnp.sum((masses / m_scale) * (rows / m_scale))
+    return s_hat, m_scale
 
 
 def recommended_depth(n: int, leaf_cap: int = 32) -> int:
@@ -593,6 +820,7 @@ def recommended_depth_data(
         # multi-host users who need the data-driven depth should pass
         # tree_depth explicitly.
         return recommended_depth(positions.shape[0], leaf_cap)
+    occupied = 1  # the rail warning below reads it when the loop is empty
     pos = np.asarray(positions, np.float64)
     origin = pos.min(axis=0)
     span = float((pos.max(axis=0) - origin).max())
@@ -607,4 +835,20 @@ def recommended_depth_data(
         occupied = np.unique(ids).size
         if pos.shape[0] / occupied <= leaf_cap / 2:
             return d
+    # The criterion is still unmet at max_depth: the padded leaf arrays
+    # (8^depth * leaf_cap floats, ~400 MB fp32 at depth 7 / cap 32) are
+    # the HBM bound that stops refinement. Surface it — the unresolved
+    # mass flows through overflow monopoles (cell-size-softened), so
+    # force accuracy degrades toward the PM-like resolution limit.
+    import warnings
+
+    mean_load = pos.shape[0] / max(occupied, 1)
+    warnings.warn(
+        f"octree depth railed at max_depth={max_depth}: mean occupied-leaf "
+        f"load {mean_load:.0f} > leaf_cap/2 = {leaf_cap // 2} "
+        f"(n={pos.shape[0]}). Unresolved cells degrade to softened "
+        f"overflow monopoles; consider raising tree_leaf_cap, or p3m for "
+        f"strongly clustered states.",
+        stacklevel=2,
+    )
     return max_depth

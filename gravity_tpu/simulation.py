@@ -57,6 +57,10 @@ DIRECT_SUM_WARN_N = 524_288
 # cell grid instead of the exact O(N^2) scan (ops/encounters.py); below
 # it the brute pass is already sub-second and exact at any radius.
 MERGE_GRID_THRESHOLD = 32_768
+# Above this N a tree/p3m run prices its --metrics-energy sample with the
+# O(N log N) tree potential instead of the dense O(N^2) pair scan (which
+# would cost more than the force step it monitors; ops/tree.py).
+ENERGY_TREE_THRESHOLD = 16_384
 
 
 def _resolve_direct(config: SimulationConfig, on_tpu: bool) -> str:
@@ -1034,6 +1038,33 @@ class Simulator:
                 state.positions, state.masses, box=config.periodic_box,
                 grid=config.pm_grid, g=config.g, eps=config.eps,
                 assignment=config.pm_assignment,
+            )
+        elif (
+            self.backend in ("tree", "p3m")
+            and self.n_real > ENERGY_TREE_THRESHOLD
+        ):
+            # Scale-aware diagnostic: the dense pair scan costs ~5.5e11
+            # pair evaluations at 1M bodies — more than the force step it
+            # monitors. Above small N, a fast-solver run prices its energy
+            # sample with the same O(N log N) machinery (tree monopole
+            # potential; P3M runs use it too — same isolated-BC physics).
+            from .ops.diagnostics import kinetic_energy
+            from .ops.tree import recommended_depth_data, tree_potential_energy
+
+            # Resolve the depth once per run (host np.unique passes over
+            # N ids are not free at 1M, and a depth change mid-run would
+            # recompile the PE kernel inside the metrics path).
+            depth = getattr(self, "_energy_tree_depth", None)
+            if depth is None:
+                depth = config.tree_depth or recommended_depth_data(
+                    state.positions, config.tree_leaf_cap
+                )
+                self._energy_tree_depth = depth
+            e = kinetic_energy(state) + tree_potential_energy(
+                state.positions, state.masses, depth=depth,
+                leaf_cap=config.tree_leaf_cap, ws=config.tree_ws,
+                chunk=config.fast_chunk, g=config.g,
+                cutoff=config.cutoff, eps=config.eps,
             )
         else:
             e = diagnostics.total_energy(

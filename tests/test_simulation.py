@@ -365,3 +365,40 @@ def test_ring_merger_preset_resolves_quietly():
         warnings.simplefilter("always")
         _resolve_backend(cfg)
     assert not w
+
+
+def test_energy_routes_through_tree_above_threshold(monkeypatch):
+    """Above ENERGY_TREE_THRESHOLD a tree-backend run prices its energy
+    diagnostic with the O(N log N) tree potential; the value must agree
+    with the dense diagnostic it replaces."""
+    from gravity_tpu.ops import tree as tree_mod
+    from gravity_tpu import simulation as sim_mod
+
+    monkeypatch.setattr(sim_mod, "ENERGY_TREE_THRESHOLD", 512)
+    calls = {"n": 0}
+    real_pe = tree_mod.tree_potential_energy
+
+    def counting_pe(*a, **k):
+        calls["n"] += 1
+        return real_pe(*a, **k)
+
+    monkeypatch.setattr(tree_mod, "tree_potential_energy", counting_pe)
+
+    config = SimulationConfig(
+        model="disk", n=2048, g=1.0, dt=2e-3, eps=0.05, steps=1,
+        force_backend="tree",
+    )
+    sim = Simulator(config)
+    e_tree = float(sim.energy())
+    assert calls["n"] == 1, "energy() did not route through the tree"
+
+    from gravity_tpu.ops.diagnostics import total_energy
+
+    e_dense = float(
+        total_energy(
+            sim.final_state(), g=config.g, cutoff=config.cutoff,
+            eps=config.eps,
+        )
+    )
+    assert e_dense != 0.0
+    assert abs(e_tree - e_dense) / abs(e_dense) < 0.02
