@@ -139,8 +139,10 @@ def main() -> int:
     on_tpu = jax.devices()[0].platform == "tpu"
     # CPU fallback (wedged tunnel): the TPU-sized workload would take
     # ~10 min of O(N^2) on host cores; shrink so the fallback line is
-    # recorded quickly. BENCH_N overrides either way.
-    default_n = 65536 if on_tpu else 8192
+    # recorded quickly. BENCH_N overrides either way. 262144 is the
+    # throughput sweet spot measured on the v5e (1.79e11 pairs/s vs
+    # 1.61e11 at 65536: bigger i-tiles amortize the j-stream better).
+    default_n = 262_144 if on_tpu else 8192
     n = int(os.environ.get("BENCH_N", default_n))
     config = SimulationConfig(
         model="plummer",

@@ -17,6 +17,8 @@ measured P(k) of the generated particles recovers the input slope.
 
 from __future__ import annotations
 
+from functools import partial
+
 import jax
 import jax.numpy as jnp
 
@@ -259,10 +261,28 @@ def _grf_fields(
     amp = jnp.where(amp_max > 0, amp / amp_max, amp)
 
     kr, ki = jax.random.split(key)
-    shape = kx.shape
-    re = jax.random.normal(kr, shape)
-    im = jax.random.normal(ki, shape)
-    delta_k = amp * (re + 1j * im)
+    return _grf_fields_core(
+        kr, ki, amp, kx, ky, kz, side=side, box=box, sigma_psi=sigma_psi,
+        with_second_order=with_second_order,
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=("side", "box", "sigma_psi", "with_second_order"),
+)
+def _grf_fields_core(
+    kr, ki, amp, kx, ky, kz, *, side, box, sigma_psi, with_second_order
+):
+    """The spectral construction, as ONE compiled program with real
+    inputs and real outputs: the axon TPU runtime cannot materialize
+    complex buffers at program boundaries, so delta_k and every other
+    complex intermediate must never escape a jit (eagerly, each op's
+    complex result would become a device buffer and fail UNIMPLEMENTED).
+    """
+    re = jax.random.normal(kr, kx.shape)
+    im = jax.random.normal(ki, kx.shape)
+    delta_k = amp * jax.lax.complex(re, im)
 
     psi1 = zeldovich_displacements(delta_k, kx, ky, kz, side, box)
 

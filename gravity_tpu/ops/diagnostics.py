@@ -20,6 +20,29 @@ def kinetic_energy(state: ParticleState) -> jnp.ndarray:
     return 0.5 * jnp.sum(state.masses * v2)
 
 
+def kinetic_energy_f64(state: ParticleState):
+    """Kinetic energy as a host ``np.float64``.
+
+    The fp32 device sum overflows at astronomical scales (m ~ 1e30 kg,
+    v ~ 3e4 m/s, N ~ 1e6 -> KE ~ 1e45 > fp32 max): accumulate with
+    normalized masses on device (m_hat * v^2 stays ~1e9 per particle)
+    and rescale by m_scale in host float64 — the partner of
+    tree_potential_energy's f64 contract, so their sum keeps it.
+    """
+    import numpy as np
+
+    m_scale = jnp.maximum(
+        jnp.max(state.masses), jnp.finfo(state.masses.dtype).tiny
+    )
+    v2 = jnp.sum(state.velocities * state.velocities, axis=-1)
+    s = jnp.sum((state.masses / m_scale) * v2)
+    return (
+        0.5
+        * np.float64(jax.device_get(m_scale))
+        * np.float64(jax.device_get(s))
+    )
+
+
 def total_energy(
     state: ParticleState,
     *,

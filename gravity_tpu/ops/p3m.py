@@ -83,27 +83,36 @@ def binning_side(grid: int, sigma_cells: float, rcut_sigmas: float) -> int:
     return max(2, int((grid - 1) / (sigma_cells * rcut_sigmas)))
 
 
-@lru_cache(maxsize=8)
-def _force_kernel_hat(m2: int, sigma_cells: float, dtype_str: str):
-    """rfftn of the smoothed vector force kernel on the padded (2M)^3
-    separation grid, in grid units (h = 1) — one-time per (grid, sigma).
+def _force_kernel_hat(m2: int, sigma_cells: float, dtype):
+    """Platform dispatcher for the Ewald force-kernel transform.
 
-    K_i(x) = -k(r) x_i with k(r) = erf(a r)/r^3 - (2a/sqrt(pi)) e^{-a^2
-    r^2}/r^2, a = 1/(sqrt(2) sigma): the analytic acceleration field of a
-    unit mass under the Ewald long-range kernel. Convolving the density
-    with K directly (rather than differentiating a potential grid) removes
-    the finite-difference error term entirely — k(r) is smooth, k(0) =
-    (4 a^3)/(3 sqrt(pi)), so the sampled kernel is exact at every
-    separation. Physical units: multiply the convolved field by g / h^2.
-
-    Computed in numpy so it stays eager (and cached) even when first hit
-    inside a jit trace; the returned numpy arrays become hoisted jit
-    constants.
+    CPU: the precomputed numpy kernel (lru-cached, inlined into the
+    compiled program as literal constants — local compiles tolerate the
+    size, and nothing is ever rebuilt per step on ANY path: scan,
+    adaptive, multirate, sharded). TPU/axon: the in-graph jnp build —
+    literal constants of this size break the axon remote-compile
+    transport, and complex buffers cannot cross the program boundary at
+    all; step loops hoist it per block via the Simulator's accel-setup
+    hook (adaptive/multirate/sharded p3m runs on TPU pay the per-step
+    rebuild — a documented cost until those paths grow the same hook).
     """
+    if jax.default_backend() == "cpu":
+        re_im = _force_kernel_hat_np(m2, sigma_cells, jnp.dtype(dtype).name)
+        return tuple(
+            jax.lax.complex(jnp.asarray(re), jnp.asarray(im))
+            for re, im in re_im
+        )
+    return _force_kernel_hat_graph(m2, sigma_cells, dtype)
+
+
+@lru_cache(maxsize=8)
+def _force_kernel_hat_np(m2: int, sigma_cells: float, dtype_str: str):
+    """Numpy kernel transform as (real, imag) float pairs (complex split
+    so even accidental TPU use never creates a complex constant)."""
     import numpy as np
     from scipy.special import erf as np_erf
 
-    cdtype = np.complex128 if dtype_str == "float64" else np.complex64
+    rdtype = np.float64 if dtype_str == "float64" else np.float32
     idx = np.arange(m2)
     sep = np.where(idx < m2 // 2, idx, idx - m2).astype(np.float64)
     sx = sep[:, None, None]
@@ -120,28 +129,78 @@ def _force_kernel_hat(m2: int, sigma_cells: float, dtype_str: str):
         * np.exp(-u * u) / (safe_r * safe_r)
     )
     k[0, 0, 0] = 4.0 * a**3 / (3.0 * math.sqrt(math.pi))
+    fx = np.fft.fftfreq(m2)
+    fz = np.fft.rfftfreq(m2)
+    wx = np.sinc(fx) ** 2
+    wz = np.sinc(fz) ** 2
+    w = (wx[:, None, None] * wx[None, :, None] * wz[None, None, :]) ** 2
+
+    def real_imag(s):
+        kh = np.fft.rfftn(-k * s) / w
+        return kh.real.astype(rdtype), kh.imag.astype(rdtype)
+
+    return tuple(real_imag(s) for s in (sx, sy, sz))
+
+
+def _force_kernel_hat_graph(m2: int, sigma_cells: float, dtype):
+    """rfftn of the smoothed vector force kernel on the padded (2M)^3
+    separation grid, in grid units (h = 1).
+
+    K_i(x) = -k(r) x_i with k(r) = erf(a r)/r^3 - (2a/sqrt(pi)) e^{-a^2
+    r^2}/r^2, a = 1/(sqrt(2) sigma): the analytic acceleration field of a
+    unit mass under the Ewald long-range kernel. Convolving the density
+    with K directly (rather than differentiating a potential grid) removes
+    the finite-difference error term entirely — k(r) is smooth, k(0) =
+    (4 a^3)/(3 sqrt(pi)), so the sampled kernel is exact at every
+    separation. Physical units: multiply the convolved field by g / h^2.
+
+    Built IN-GRAPH with jnp (same pattern as pm._greens_function): a
+    precomputed numpy kernel would be inlined into the lowered program
+    as literal constants — 6 x 67M floats at grid 256, which breaks the
+    axon remote-compile transport; and complex buffers cannot cross the
+    program boundary on that runtime at all. In-graph, the program text
+    stays small, every complex value is internal, and XLA's loop-
+    invariant code motion can hoist the build out of step loops (the
+    kernel depends only on static shapes).
+    """
+    idx = jnp.arange(m2)
+    sep = jnp.where(idx < m2 // 2, idx, idx - m2).astype(dtype)
+    sx = sep[:, None, None]
+    sy = sep[None, :, None]
+    sz = sep[None, None, :]
+    r2 = sx * sx + sy * sy + sz * sz
+    r = jnp.sqrt(r2)
+    a = 1.0 / (math.sqrt(2.0) * sigma_cells)
+    u = a * r
+    safe_r = jnp.maximum(r, jnp.asarray(1e-20, dtype))
+    k = (
+        erf(u) / (safe_r * safe_r * safe_r)
+        - (2.0 * a / math.sqrt(math.pi))
+        * jnp.exp(-u * u) / (safe_r * safe_r)
+    )
+    k = k.at[0, 0, 0].set(4.0 * a**3 / (3.0 * math.sqrt(math.pi)))
     # Deconvolve the CIC assignment window (applied twice: deposit and
     # gather). Per axis the CIC window is sinc^2; the Gaussian damping of
     # the long-range kernel (e^{-k^2 sigma^2/2}, sigma >= h) bounds the
     # high-k amplification, so this is the standard Hockney & Eastwood
     # sharpening, not a noise amplifier.
-    fx = np.fft.fftfreq(m2)
-    fz = np.fft.rfftfreq(m2)
-    wx = np.sinc(fx) ** 2
-    wz = np.sinc(fz) ** 2
+    fx = jnp.fft.fftfreq(m2).astype(dtype)
+    fz = jnp.fft.rfftfreq(m2).astype(dtype)
+    wx = jnp.sinc(fx) ** 2
+    wz = jnp.sinc(fz) ** 2
     w = (
         wx[:, None, None] * wx[None, :, None] * wz[None, None, :]
     ) ** 2
-    return tuple(
-        (np.fft.rfftn(-k * s) / w).astype(cdtype) for s in (sx, sy, sz)
-    )
+    return tuple(jnp.fft.rfftn(-k * s) / w for s in (sx, sy, sz))
 
 
 def _mesh_accelerations(targets, positions, masses, origin, span, *, grid,
-                        g, sigma_cells):
+                        g, sigma_cells, khat=None):
     """Long-range accelerations at ``targets``: CIC deposit of the sources,
     three kernel convolutions (isolated BCs via zero padding), CIC gather
-    at the targets."""
+    at the targets. ``khat`` lets a step loop pass the kernel transform
+    built once outside its scan (XLA does not hoist the in-graph build
+    out of while bodies — measured; see Simulator._block_fn)."""
     dtype = positions.dtype
     m = grid
     m2 = 2 * m
@@ -149,7 +208,8 @@ def _mesh_accelerations(targets, positions, masses, origin, span, *, grid,
     rho = cic_deposit(positions, masses, m, origin, h)
     rho_p = jnp.zeros((m2, m2, m2), dtype).at[:m, :m, :m].set(rho)
     rho_hat = jnp.fft.rfftn(rho_p)
-    khat = _force_kernel_hat(m2, sigma_cells, str(dtype))
+    if khat is None:
+        khat = _force_kernel_hat(m2, sigma_cells, dtype)
     acc_field = jnp.stack(
         [
             jnp.fft.irfftn(rho_hat * kh, s=(m2, m2, m2))[:m, :m, :m]
@@ -205,6 +265,7 @@ def p3m_accelerations_vs(
     g: float = G,
     cutoff: float = CUTOFF_RADIUS,
     eps: float = 0.0,
+    khat=None,
 ) -> jax.Array:
     """P3M accelerations at ``targets`` from sources (positions, masses),
     isolated boundary conditions.
@@ -229,7 +290,7 @@ def p3m_accelerations_vs(
     # ---- Long-range: smoothed vector-kernel FFT solve on the mesh. ----
     acc = _mesh_accelerations(
         targets, positions, masses, origin, span,
-        grid=grid, g=g, sigma_cells=sigma_cells,
+        grid=grid, g=g, sigma_cells=sigma_cells, khat=khat,
     )
 
     # ---- Short-range: cell-list pair sum of the erfc remainder. ----

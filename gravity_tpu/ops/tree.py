@@ -311,19 +311,26 @@ def _monopole_acc(pos, cell_mass, cell_com, mask, g, eps, dtype,
     hq = h_d * inv_r
     c5 = jnp.where(ok, s1 * hq * hq * inv_r2, jnp.asarray(0.0, dtype))
     q = jnp.where(ok[..., None], cell_quad, jnp.asarray(0.0, dtype))
-    qd_x = q[..., 0] * diff[..., 0] + q[..., 3] * diff[..., 1] \
-        + q[..., 4] * diff[..., 2]
-    qd_y = q[..., 3] * diff[..., 0] + q[..., 1] * diff[..., 1] \
-        + q[..., 5] * diff[..., 2]
-    qd_z = q[..., 4] * diff[..., 0] + q[..., 5] * diff[..., 1] \
-        + q[..., 2] * diff[..., 2]
-    qd = jnp.stack([qd_x, qd_y, qd_z], axis=-1)  # (C, L, 3)
+    qd = _quad_dot(q, diff)  # (C, L, 3)
     qq = jnp.sum(qd * diff, axis=-1)  # (C, L)
     acc = acc - jnp.einsum("cl,cld->cd", c5, qd)
     acc = acc + jnp.einsum(
         "cl,cld->cd", 2.5 * c5 * qq * inv_r2, diff
     )
     return acc
+
+
+def _quad_dot(q, diff):
+    """(Q diff) for symmetric-6-packed Q (..., 6) [xx,yy,zz,xy,xz,yz] and
+    diff (..., 3) — the single definition of the packed-component layout
+    shared by the force and potential quadrupole terms."""
+    qd_x = q[..., 0] * diff[..., 0] + q[..., 3] * diff[..., 1] \
+        + q[..., 4] * diff[..., 2]
+    qd_y = q[..., 3] * diff[..., 0] + q[..., 1] * diff[..., 1] \
+        + q[..., 5] * diff[..., 2]
+    qd_z = q[..., 4] * diff[..., 0] + q[..., 5] * diff[..., 1] \
+        + q[..., 2] * diff[..., 2]
+    return jnp.stack([qd_x, qd_y, qd_z], axis=-1)
 
 
 def _interaction_ids(coords_c, d, depth, offsets, parity_masks):
@@ -694,15 +701,7 @@ def _tree_pe_scaled(
         if cell_quad is None:
             return rows_c
         q = jnp.where(ok[..., None], cell_quad, jnp.asarray(0.0, dtype))
-        qd_x = q[..., 0] * diff[..., 0] + q[..., 3] * diff[..., 1] \
-            + q[..., 4] * diff[..., 2]
-        qd_y = q[..., 3] * diff[..., 0] + q[..., 1] * diff[..., 1] \
-            + q[..., 5] * diff[..., 2]
-        qd_z = q[..., 4] * diff[..., 0] + q[..., 5] * diff[..., 1] \
-            + q[..., 2] * diff[..., 2]
-        qq = (
-            qd_x * diff[..., 0] + qd_y * diff[..., 1] + qd_z * diff[..., 2]
-        )
+        qq = jnp.sum(_quad_dot(q, diff) * diff, axis=-1)
         hq = h_d * inv_r
         inv_r2 = inv_r * inv_r
         return rows_c + jnp.sum(

@@ -44,11 +44,13 @@ def resolve_dtype(name: str):
 
 
 # Direct-sum/tree crossover for backend='auto' (see docs/scaling.md).
-# TPU: the Pallas O(N^2) kernel runs ~1.6e11 pairs/s/chip (BASELINE.md),
-# so 256k bodies is ~0.43 s/step while the O(N log N) tree step stays
-# sub-second well past 1M — beyond ~256k direct sum only loses. CPU: the
-# chunked jnp kernel is ~2e8 pairs/s, pushing the crossover down to ~32k.
-TREE_CROSSOVER_TPU = 262_144
+# TPU: MEASURED on a v5e (benchmarks/crossover.py, 2026-07-31): the
+# Pallas O(N^2) kernel sustains ~1.8e11 pairs/s/chip, and the gather-
+# bound tree never catches it up to 1M (tree/direct time ratio 80x at
+# 65k, 6.6x at 1M, halving per doubling of N) — extrapolating the
+# measured slope puts the crossover at ~8M bodies. CPU: measured with
+# the native FFI kernel, the tree wins from ~32k (BASELINE.md).
+TREE_CROSSOVER_TPU = 8_388_608
 TREE_CROSSOVER_CPU = 32_768
 # Forcing O(N^2) here means >=2.7e11 pairs/step — minutes/step on CPU,
 # multiple seconds/step on one chip. Probably a mistake; warn.
@@ -90,9 +92,18 @@ def _resolve_backend(config: SimulationConfig) -> str:
     if backend == "auto" and config.periodic_box > 0.0:
         return "pm"  # the only periodic-capable solver
     if backend not in ("auto", "direct"):
+        _warn_n = DIRECT_SUM_WARN_N
+        if (
+            backend == "pallas"
+            and jax.devices()[0].platform == "tpu"
+        ):
+            # On the chip the Pallas kernel IS the measured fast path up
+            # to the tree crossover (docs/scaling.md) — only warn where
+            # the tree would actually win.
+            _warn_n = TREE_CROSSOVER_TPU
         if (
             backend in ("dense", "chunked", "pallas", "cpp")
-            and config.n >= DIRECT_SUM_WARN_N
+            and config.n >= _warn_n
             # A ring shard streams sources and can never assemble the
             # full set a global tree build needs, so there is no faster
             # alternative to suggest — don't nag the merger preset.
@@ -292,6 +303,14 @@ class Simulator:
                 f"(force_backend 'pm' or 'auto'); got {self.backend!r} — "
                 "tree/p3m/direct backends are isolated-BC"
             )
+        # Optional per-block precompute hook (aux built inside the jitted
+        # block but OUTSIDE its scan): set by backends whose accel has a
+        # step-invariant expensive prefix. p3m uses it for the Ewald
+        # kernel transform — XLA does not hoist the in-graph build out of
+        # while bodies (measured on the compiled HLO), so without this a
+        # 500-step block would pay 3 extra grid-sized FFTs per step.
+        self._accel_setup = None
+        self._accel2_aux = None
         if self.mesh is not None:
             from .parallel import make_sharded_accel2
 
@@ -320,6 +339,11 @@ class Simulator:
             # O(N) elementwise add: composes with every backend and
             # shards trivially with the positions.
             self._accel2 = lambda pos, m: self_gravity(pos, m) + ext(pos)
+            if self._accel2_aux is not None:
+                aux_gravity = self._accel2_aux
+                self._accel2_aux = (
+                    lambda pos, m, aux: aux_gravity(pos, m, aux) + ext(pos)
+                )
 
         self._local_vs_kernel = None
         self._rect_accel = None
@@ -429,6 +453,18 @@ class Simulator:
             )
             if note:
                 warnings.warn(note, stacklevel=2)
+            from .ops.p3m import _force_kernel_hat, p3m_accelerations_vs
+
+            self._accel_setup = lambda dtype: _force_kernel_hat(
+                2 * config.pm_grid, config.p3m_sigma_cells, dtype
+            )
+            self._accel2_aux = lambda pos, m, khat: p3m_accelerations_vs(
+                pos, pos, m, grid=config.pm_grid,
+                sigma_cells=config.p3m_sigma_cells,
+                rcut_sigmas=config.p3m_rcut_sigmas,
+                cap=config.p3m_cap, chunk=config.fast_chunk, khat=khat,
+                **common,
+            )
             return lambda pos, m: p3m_accelerations(
                 pos, m, grid=config.pm_grid,
                 sigma_cells=config.p3m_sigma_cells,
@@ -495,10 +531,16 @@ class Simulator:
                     accel_full=self._accel2,
                 )
         else:
+            if self._accel_setup is not None and self._accel2_aux is not None:
+                # Step-invariant prefix hoisted out of the scan by
+                # construction: built here (inside the jitted block),
+                # closed over as tracers by the step body.
+                aux = self._accel_setup(state.positions.dtype)
+                accel = lambda pos: self._accel2_aux(pos, masses, aux)
+            else:
+                accel = lambda pos: self._accel2(pos, masses)
             step = make_step_fn(
-                self.config.integrator,
-                lambda pos: self._accel2(pos, masses),
-                self.config.dt,
+                self.config.integrator, accel, self.config.dt,
             )
 
         def body(carry, _):
@@ -1048,7 +1090,7 @@ class Simulator:
             # monitors. Above small N, a fast-solver run prices its energy
             # sample with the same O(N log N) machinery (tree monopole
             # potential; P3M runs use it too — same isolated-BC physics).
-            from .ops.diagnostics import kinetic_energy
+            from .ops.diagnostics import kinetic_energy_f64
             from .ops.tree import recommended_depth_data, tree_potential_energy
 
             # Resolve the depth once per run (host np.unique passes over
@@ -1060,7 +1102,10 @@ class Simulator:
                     state.positions, config.tree_leaf_cap
                 )
                 self._energy_tree_depth = depth
-            e = kinetic_energy(state) + tree_potential_energy(
+            # Host-f64 sum: tree_potential_energy returns np.float64
+            # precisely because |PE| can exceed fp32 range; adding a
+            # jnp f32 KE would demote the whole thing back to f32.
+            e = kinetic_energy_f64(state) + tree_potential_energy(
                 state.positions, state.masses, depth=depth,
                 leaf_cap=config.tree_leaf_cap, ws=config.tree_ws,
                 chunk=config.fast_chunk, g=config.g,
@@ -1071,5 +1116,11 @@ class Simulator:
                 state, g=config.g, cutoff=config.cutoff, eps=config.eps,
             )
         if self._ext_phi is not None:
-            e = e + jnp.sum(state.masses * self._ext_phi(state.positions))
+            ext_e = jnp.sum(state.masses * self._ext_phi(state.positions))
+            if isinstance(e, np.floating):
+                # Keep the host-f64 accumulation (tree/p3m branch) —
+                # jnp's weak promotion would demote f64 + f32 to f32.
+                e = e + np.float64(jax.device_get(ext_e))
+            else:
+                e = e + ext_e
         return e
