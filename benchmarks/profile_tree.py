@@ -85,6 +85,15 @@ def main(argv) -> int:
 
     t_full = timed(jax.jit(full), pos, label="tree_accelerations (full)")
 
+    # 3b. Dense-grid FMM (the gather-free fast path; ops/fmm.py).
+    from gravity_tpu.ops.fmm import fmm_accelerations
+
+    def fmm(p):
+        return fmm_accelerations(p, masses, depth=depth, eps=0.05, g=1.0)
+
+    t_fmm = timed(jax.jit(fmm), pos, label="fmm_accelerations (full)")
+    print(f"fmm speedup vs tree: {t_full / t_fmm:.2f}x")
+
     # 4. Direct-sum reference point at this n (chunked to bound memory).
     from gravity_tpu.ops.forces import pairwise_accelerations_chunked
 

@@ -52,7 +52,7 @@ def _add_config_args(p: argparse.ArgumentParser) -> None:
                    choices=["float32", "float64", "bfloat16"], default=None)
     p.add_argument("--force-backend", dest="force_backend",
                    choices=["auto", "direct", "dense", "chunked", "pallas",
-                            "cpp", "tree", "pm", "p3m"],
+                            "cpp", "tree", "fmm", "pm", "p3m"],
                    default=None)
     p.add_argument("--chunk", type=int, default=None)
     p.add_argument("--tree-depth", dest="tree_depth", type=int, default=None)

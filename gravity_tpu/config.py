@@ -45,8 +45,9 @@ class SimulationConfig:
     # auto (scale-aware, may pick an approximate fast solver) | direct
     # (scale-aware among EXACT O(N^2) backends only) | dense | chunked |
     # pallas (direct sum) | cpp (native XLA FFI host kernel, CPU
-    # platform) | tree (octree) | pm (FFT mesh) | p3m (FFT mesh +
-    # cell-list pair correction)
+    # platform) | tree (octree) | fmm (dense-grid gather-free FMM,
+    # single-host) | pm (FFT mesh) | p3m (FFT mesh + cell-list pair
+    # correction)
     force_backend: str = "auto"
     chunk: int = 1024
     tree_depth: int = 0  # 0 = auto (recommended_depth)

@@ -1,0 +1,542 @@
+"""Dense-grid FMM gravity — the gather-free fast solver for TPU.
+
+The levelized octree in ``ops/tree.py`` (far="direct") is physically
+accurate but GATHER-BOUND on TPU: every target gathers ~343 interaction-
+list cells per level plus 27 neighbor-cell blocks, and TPU gathers are
+index-rate-limited — measured 39.5 s for one 1M-body force evaluation on
+a v5e while the Pallas O(N^2) direct sum does 5.97 s (benchmarks/
+crossover.py, docs/scaling.md). This module is the redesign that removes
+the gathers instead of feeding them: a classic fast-multipole downward
+pass evaluated on DENSE per-level grids, where every "neighbor lookup"
+is a static shift of a whole array (pad + dynamic_slice) — zero gather
+indices anywhere except one final per-particle leaf lookup.
+
+Decomposition (identical interaction sets to ops/tree.py, same
+``_parity_mask_table`` geometry):
+
+- **Coarse levels d in [2, depth-1]** — every leaf receives a p=1 local
+  expansion (acceleration F and its Jacobian J, 9 numbers) about its
+  OWN center, summing each ancestor's interaction list: children of the
+  parent's radius-ws neighborhood that are not own-neighbors. On the
+  dense leaf grid the ancestor's o-neighbor is a shifted slice of the
+  level grid upsampled to leaf resolution (exact: adding o*2^k cannot
+  carry into the top bits), and the parity mask is a periodic bit
+  pattern: one ``lax.scan`` over the 7^3 offsets per level, each step
+  shifting whole arrays and doing a masked monopole+Jacobian
+  accumulation. No indices, pure elementwise. Expanding about LEAF
+  centers (not each level's own centers) keeps the p=1 truncation
+  ratio <= ~0.29 — a naive M2L+L2L cascade at p=1 has worst-case ratio
+  ~0.87 and fails at the 30% level (measured; that is why ops/tree.py's
+  ``far="expansion"`` uses the same leaf-centered structure).
+- **Finest level, exact per target** — the level-depth interaction list
+  (its expansion ratio would be too large for p=1): per offset, the
+  source cell monopole for EVERY cell is one shifted slice of the leaf
+  (mass, com) grids, evaluated against target positions in (cell, slot)
+  layout.
+- **Near field, leaf level** — exact pair sums between each leaf cell
+  and its 27 neighbors, on the Morton-sorted padded per-cell arrays
+  ((S^3, cap) layout): for each of the 27 offsets the source block for
+  EVERY cell is one shifted slice of the padded grid, and the pair
+  kernel is a dense (cap_t, cap_s) batched contraction — MXU/VPU food.
+  Overflow beyond ``leaf_cap`` degrades to the same cell-size-softened
+  remainder monopole as ops/tree.py.
+- **Evaluation** — per particle: F, J at its leaf (the one gather, N
+  indices) and acc = F + J . (x - leaf_center) + near + overflow.
+
+Accuracy contract: the p=1 target expansion truncates at the same order
+as ops/tree.py's ``far="expansion"`` mode — a few percent median force
+error on 3D clouds, ~1% on disks (see tests/test_fmm.py) — traded for
+an order-of-magnitude step-time win at 1M bodies. ``ops/tree.py`` with
+``far="direct"`` (quadrupole cells, per-target exact lists) remains the
+high-accuracy tree path.
+
+The reference has no fast solver at all (its only scaling is
+parallelizing the O(N^2) pair set, SURVEY 2e); both this module and
+ops/tree.py are capability adds beyond `/root/reference/`.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..constants import CUTOFF_RADIUS, G
+from .cells import build_padded_cells, grid_coords
+from .tree import (
+    _near_offsets,
+    _offsets,
+    _parity_mask_table,
+    build_octree,
+)
+
+
+def _cell_centers(origin, span, sd: int, dtype):
+    """(sd, sd, sd, 3) cell-center coordinates at a level with sd cells/axis."""
+    h = span / sd
+    ix = (jnp.arange(sd, dtype=dtype) + 0.5) * h
+    cx, cy, cz = jnp.meshgrid(ix, ix, ix, indexing="ij")
+    return origin + jnp.stack([cx, cy, cz], axis=-1)
+
+
+def _bit_parity_grid(side: int, k: int) -> jnp.ndarray:
+    """(side, side, side) int32 parity p of the level-(depth-k) ancestor:
+    p = (bit_k(x) << 2) | (bit_k(y) << 1) | bit_k(z) for leaf coords."""
+    ix = (jnp.arange(side, dtype=jnp.int32) >> k) & 1
+    px, py, pz = jnp.meshgrid(ix, ix, ix, indexing="ij")
+    return (px << 2) | (py << 1) | pz
+
+
+def _coarse_leaf_expansions(
+    levels, origin, span, depth: int, ws: int, g, eps, dtype
+):
+    """p=1 local expansions (F (S,S,S,3), J6 (S,S,S,6)) about LEAF
+    centers, summing the interaction lists of every ancestor level
+    d in [2, depth-1] — the same decomposition as ops/tree.py's
+    ``far="expansion"`` (truncation ratio <= ~0.29 at d = depth-1,
+    halving per coarser level), but with every "lookup" a shifted
+    slice of the level grid upsampled to leaf resolution: zero gathers.
+
+    Exactness of the upsample+shift: for a leaf l and level-d offset o,
+    (l + o * 2^k) >> k == (l >> k) + o (k = depth - d), so reading the
+    upsampled grid at leaf shift o * 2^k IS the ancestor's o-neighbor.
+    """
+    side = 1 << depth
+    offsets = jnp.asarray(_offsets(ws), jnp.int32)  # (L, 3)
+    pmask_t = jnp.asarray(_parity_mask_table(ws))  # (8, L)
+    centers = _cell_centers(origin, span, side, dtype)
+
+    f = jnp.zeros((side, side, side, 3), dtype)
+    j6 = jnp.zeros((side, side, side, 6), dtype)
+    trace_w = jnp.zeros((side, side, side), dtype)
+    for d in range(2, depth):
+        k = depth - d
+        sd = 1 << d
+        rep = 1 << k
+        # Pad + slice at LEVEL resolution, upsample the slice: identical
+        # to slicing an upsampled grid (offsets are whole level cells;
+        # adding o*2^k cannot carry into the top bits) at ~rep^3 less
+        # transient memory than padding the leaf-resolution upsample.
+        pad = 2 * ws + 1
+        mass_p = jnp.pad(levels[d][0].reshape(sd, sd, sd), pad)
+        com_p = jnp.pad(
+            levels[d][1].reshape(sd, sd, sd, 3),
+            ((pad, pad),) * 3 + ((0, 0),),
+        )
+        parity = _bit_parity_grid(side, k)
+
+        def upsample(a, rep=rep):
+            return jnp.repeat(
+                jnp.repeat(jnp.repeat(a, rep, 0), rep, 1), rep, 2
+            )
+
+        def body(carry, xs, mass_p=mass_p, com_p=com_p, parity=parity,
+                 pad=pad, upsample=upsample, sd=sd):
+            f, j6, trace_w = carry
+            off, pm_row = xs
+            start = (pad + off[0], pad + off[1], pad + off[2])
+            sm = upsample(
+                jax.lax.dynamic_slice(mass_p, start, (sd, sd, sd))
+            )
+            sc = upsample(
+                jax.lax.dynamic_slice(
+                    com_p, start + (0,), (sd, sd, sd, 3)
+                )
+            )
+            ok = jnp.logical_and(pm_row[parity], sm > 0)
+            diff = jnp.where(
+                ok[..., None], sc - centers, jnp.asarray(0.0, dtype)
+            )
+            r2 = jnp.sum(diff * diff, axis=-1) + jnp.asarray(
+                eps * eps, dtype
+            )
+            safe = jnp.where(ok, r2, jnp.asarray(1.0, dtype))
+            inv_r = jax.lax.rsqrt(safe)
+            inv_r2 = inv_r * inv_r
+            w = jnp.where(
+                ok,
+                ((jnp.asarray(g, dtype) * sm) * inv_r) * inv_r2,
+                jnp.asarray(0.0, dtype),
+            )
+            f = f + w[..., None] * diff
+            w3 = 3.0 * w * inv_r2
+            j6 = j6 + jnp.stack(
+                [
+                    w3 * diff[..., 0] * diff[..., 0],
+                    w3 * diff[..., 1] * diff[..., 1],
+                    w3 * diff[..., 2] * diff[..., 2],
+                    w3 * diff[..., 0] * diff[..., 1],
+                    w3 * diff[..., 0] * diff[..., 2],
+                    w3 * diff[..., 1] * diff[..., 2],
+                ],
+                axis=-1,
+            )
+            return (f, j6, trace_w + w), None
+
+        (f, j6, trace_w), _ = jax.lax.scan(
+            body, (f, j6, trace_w), (offsets, pmask_t.T)
+        )
+    j6 = (
+        j6.at[..., 0].add(-trace_w)
+        .at[..., 1].add(-trace_w)
+        .at[..., 2].add(-trace_w)
+    )
+    return f, j6
+
+
+def _finest_exact_shifted(
+    cells_pos, cmass_l, ccom_l, origin, span, side: int, leaf_cap: int,
+    ws: int, g, eps, slab: int, dtype,
+):
+    """Finest-level interaction list, EXACT per target (its p=1
+    expansion ratio would be too large — same reasoning as ops/tree.py):
+    for each of the 7^3 offsets (parity-masked), the source monopole for
+    every cell is one shifted slice of the leaf-level (mass, com) grids,
+    evaluated against the target positions in (cell, slot) layout.
+
+    Returns (S^3, cap, 3) accelerations."""
+    near_pad = 2 * ws + 1
+    s = side
+    offsets = jnp.asarray(_offsets(ws), jnp.int32)
+    pmask_t = jnp.asarray(_parity_mask_table(ws))
+    parity = _bit_parity_grid(s, 0)
+    pos_g = cells_pos.reshape(s, s, s, leaf_cap, 3)
+    mass_g = cmass_l.reshape(s, s, s)
+    com_g = ccom_l.reshape(s, s, s, 3)
+    mass_p = jnp.pad(mass_g, near_pad)
+    com_p = jnp.pad(com_g, ((near_pad, near_pad),) * 3 + ((0, 0),))
+
+    n_slabs = max(1, s // slab)
+    b = s // n_slabs
+
+    def one_slab(x0):
+        tpos = jax.lax.dynamic_slice(
+            pos_g, (x0, 0, 0, 0, 0), (b, s, s, leaf_cap, 3)
+        ).reshape(-1, leaf_cap, 3)
+        par = jax.lax.dynamic_slice(
+            parity, (x0, 0, 0), (b, s, s)
+        ).reshape(-1)
+        c = tpos.shape[0]
+
+        def body(acc, xs):
+            off, pm_row = xs
+            start = (
+                near_pad + x0 + off[0], near_pad + off[1], near_pad + off[2]
+            )
+            sm = jax.lax.dynamic_slice(mass_p, start, (b, s, s)).reshape(c)
+            sc = jax.lax.dynamic_slice(
+                com_p, start + (0,), (b, s, s, 3)
+            ).reshape(c, 3)
+            ok = jnp.logical_and(pm_row[par], sm > 0)  # (C,)
+            diff = jnp.where(
+                ok[:, None, None],
+                sc[:, None, :] - tpos,
+                jnp.asarray(0.0, dtype),
+            )
+            r2 = jnp.sum(diff * diff, axis=-1) + jnp.asarray(
+                eps * eps, dtype
+            )
+            inv_r = jax.lax.rsqrt(r2)
+            w = jnp.where(
+                ok[:, None],
+                ((jnp.asarray(g, dtype) * sm[:, None]) * inv_r)
+                * inv_r * inv_r,
+                jnp.asarray(0.0, dtype),
+            )
+            return acc + w[..., None] * diff, None
+
+        acc0 = jnp.zeros((c, leaf_cap, 3), dtype)
+        acc, _ = jax.lax.scan(body, acc0, (offsets, pmask_t.T))
+        return acc
+
+    slabs = jax.lax.map(one_slab, jnp.arange(n_slabs, dtype=jnp.int32) * b)
+    return slabs.reshape(s * s * s, leaf_cap, 3)
+
+
+def _near_field_shifted(
+    cells_pos, cells_mass, leaf_count, cmass_l, ccom_l, m_scale,
+    origin, span, side: int, leaf_cap: int, ws: int, g, cutoff, eps,
+    slab: int, dtype,
+):
+    """Exact near field on the (S^3, cap) padded-cell layout, one shifted
+    slice per neighbor offset — plus the remainder-monopole overflow
+    correction, whose per-SOURCE-cell remainder mass/COM is computed once
+    globally (not per target chunk as in ops/tree.py).
+
+    Returns (S^3, cap, 3) accelerations in (cell, slot) layout."""
+    near = jnp.asarray(_near_offsets(ws), jnp.int32)  # (27, 3)
+    pad = ws
+    s = side
+    pos_g = cells_pos.reshape(s, s, s, leaf_cap, 3)
+    mass_g = cells_mass.reshape(s, s, s, leaf_cap)
+    cnt_g = leaf_count.reshape(s, s, s)
+
+    # Global per-cell overflow remainder (mass beyond the padded prefix).
+    pref_mhat = jnp.sum(mass_g, axis=-1) / m_scale  # padded slots are 0
+    cell_mhat = (cmass_l / m_scale).reshape(s, s, s)
+    over_g = cnt_g > leaf_cap
+    rem_mhat = jnp.maximum(jnp.where(over_g, cell_mhat - pref_mhat, 0.0), 0.0)
+    tot_mw = ccom_l.reshape(s, s, s, 3) * cell_mhat[..., None]
+    pref_mw = (
+        jnp.sum(mass_g[..., None] * pos_g, axis=-2) / m_scale
+    )
+    rem_com = (tot_mw - pref_mw) / jnp.maximum(
+        rem_mhat, jnp.asarray(1e-37, dtype)
+    )[..., None]
+
+    pos_p = jnp.pad(pos_g, ((pad, pad),) * 3 + ((0, 0), (0, 0)))
+    mass_p = jnp.pad(mass_g, ((pad, pad),) * 3 + ((0, 0),))
+    rem_mhat_p = jnp.pad(rem_mhat, pad)
+    rem_com_p = jnp.pad(rem_com, ((pad, pad),) * 3 + ((0, 0),))
+    over_p = jnp.pad(over_g, pad)
+
+    cell_h = span / s
+    eps_over = jnp.maximum(jnp.asarray(eps, dtype), 0.5 * cell_h)
+
+    n_slabs = max(1, s // slab)
+    assert s % slab == 0 or n_slabs == 1
+    b = s // n_slabs
+
+    def one_slab(x0):
+        # Target block: b x-planes of cells.
+        tpos = jax.lax.dynamic_slice(
+            pos_g, (x0, 0, 0, 0, 0), (b, s, s, leaf_cap, 3)
+        ).reshape(-1, leaf_cap, 3)
+        c = tpos.shape[0]
+
+        def body(acc, off):
+            start3 = (pad + x0 + off[0], pad + off[1], pad + off[2])
+            spos = jax.lax.dynamic_slice(
+                pos_p, start3 + (0, 0), (b, s, s, leaf_cap, 3)
+            ).reshape(c, leaf_cap, 3)
+            smass = jax.lax.dynamic_slice(
+                mass_p, start3 + (0,), (b, s, s, leaf_cap)
+            ).reshape(c, leaf_cap)
+            # (C, capT, capS) pair kernel; padded slots carry mass 0 so
+            # no explicit mask is needed beyond the cutoff guard.
+            diff = spos[:, None, :, :] - tpos[:, :, None, :]
+            r2s = jnp.sum(diff * diff, axis=-1) + jnp.asarray(
+                eps * eps, dtype
+            )
+            ok = r2s > jnp.asarray(cutoff * cutoff, dtype)
+            safe = jnp.where(ok, r2s, jnp.asarray(1.0, dtype))
+            inv_r = jax.lax.rsqrt(safe)
+            w = jnp.where(
+                ok,
+                ((jnp.asarray(g, dtype) * smass[:, None, :]) * inv_r)
+                * inv_r * inv_r,
+                jnp.asarray(0.0, dtype),
+            )
+            acc = acc + jnp.einsum("cts,ctsd->ctd", w, diff)
+
+            # Overflow remainder of THIS neighbor cell, softened at the
+            # resolution scale (same contract as ops/tree.py).
+            r_m = jax.lax.dynamic_slice(
+                rem_mhat_p, start3, (b, s, s)
+            ).reshape(c)
+            r_c = jax.lax.dynamic_slice(
+                rem_com_p, start3 + (0,), (b, s, s, 3)
+            ).reshape(c, 3)
+            r_over = jax.lax.dynamic_slice(
+                over_p, start3, (b, s, s)
+            ).reshape(c)
+            diff_o = jnp.where(
+                r_over[:, None, None],
+                r_c[:, None, :] - tpos,
+                jnp.asarray(0.0, dtype),
+            )
+            r2o = jnp.sum(diff_o * diff_o, axis=-1) + eps_over * eps_over
+            inv_ro = jax.lax.rsqrt(r2o)
+            w_o = jnp.where(
+                r_over[:, None],
+                ((jnp.asarray(g, dtype) * (r_m * m_scale))[:, None]
+                 * inv_ro) * inv_ro * inv_ro,
+                jnp.asarray(0.0, dtype),
+            )
+            acc = acc + w_o[..., None] * diff_o
+            return acc, None
+
+        acc0 = jnp.zeros((c, leaf_cap, 3), dtype)
+        acc, _ = jax.lax.scan(body, acc0, near)
+        return acc
+
+    slabs = jax.lax.map(one_slab, jnp.arange(n_slabs, dtype=jnp.int32) * b)
+    return slabs.reshape(s * s * s, leaf_cap, 3)
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "depth", "leaf_cap", "ws", "g", "cutoff", "eps", "slab",
+    ),
+)
+def fmm_accelerations(
+    positions: jax.Array,
+    masses: jax.Array,
+    *,
+    depth: int = 6,
+    leaf_cap: int = 32,
+    ws: int = 1,
+    g: float = G,
+    cutoff: float = CUTOFF_RADIUS,
+    eps: float = 0.0,
+    slab: int = 4,
+) -> jax.Array:
+    """Dense-grid FMM accelerations for all particles (targets = sources
+    — the sorted-cell near field requires the targets to BE the binned
+    sources; sharded target slices use ops/tree.py instead).
+
+    ``slab`` bounds near-field memory: the (cells, cap, cap) pair
+    buffers are built for slab*side^2 cells at a time.
+    """
+    n = positions.shape[0]
+    dtype = positions.dtype
+    levels, origin, span, coords = build_octree(positions, masses, depth)
+    side = 1 << depth
+
+    # ---- Coarse far field: p=1 expansions about leaf centers ----
+    f_loc, j_loc = _coarse_leaf_expansions(
+        levels, origin, span, depth, ws, g, eps, dtype
+    )
+
+    # ---- Near field in (cell, slot) layout ----
+    leaf_ids = (coords[:, 0] * side + coords[:, 1]) * side + coords[:, 2]
+    order = jnp.argsort(leaf_ids)
+    sorted_pos = positions[order]
+    sorted_mass = masses[order]
+    n_leaves = side**3
+    leaf_count = jax.ops.segment_sum(
+        jnp.ones((n,), jnp.int32), leaf_ids, num_segments=n_leaves
+    )
+    leaf_start = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(leaf_count)[:-1]]
+    )
+    cells_pos, cells_mass = build_padded_cells(
+        sorted_pos, sorted_mass, leaf_ids[order], leaf_start, n_leaves,
+        leaf_cap,
+    )
+    m_scale = jnp.maximum(jnp.max(masses), jnp.asarray(1e-37, dtype))
+    near_cell = _near_field_shifted(
+        cells_pos, cells_mass, leaf_count, levels[depth][0],
+        levels[depth][1], m_scale, origin, span, side, leaf_cap, ws,
+        g, cutoff, eps, slab, dtype,
+    )
+    # Finest-level interaction list, exact per target (see ops/tree.py:
+    # its p=1 expansion ratio would be too large).
+    near_cell = near_cell + _finest_exact_shifted(
+        cells_pos, levels[depth][0], levels[depth][1], origin, span,
+        side, leaf_cap, ws, g, eps, slab, dtype,
+    )
+
+    # ---- Per-particle evaluation (the one gather: N leaf lookups) ----
+    sorted_ids = leaf_ids[order]
+    slot = jnp.arange(n, dtype=jnp.int32) - leaf_start[sorted_ids]
+    over_t = slot >= leaf_cap
+    near_sorted = near_cell[sorted_ids, jnp.minimum(slot, leaf_cap - 1)]
+
+    # Overflow TARGETS (slot >= cap) have no row in the (cell, slot)
+    # layout — the clamped gather above would silently hand them another
+    # particle's near field. They instead get the full 7^3 neighborhood
+    # as softened cell monopoles evaluated at their OWN position: the
+    # near 3^3 with cell-size softening (the same bounded resolution-
+    # limited degradation the source-side overflow contract uses; the
+    # own-cell self term is bounded by that softening too), the
+    # interaction-list cells with the run's eps. Gated on any-overflow:
+    # well-sized runs (recommended_depth_data) never pay the per-
+    # particle gathers in this branch.
+    def overflow_target_near(_):
+        coords_s = coords[order]  # (N, 3) leaf coords, sorted order
+        offsets = jnp.asarray(_offsets(ws), jnp.int32)
+        pmask_t = jnp.asarray(_parity_mask_table(ws))
+        parity = (
+            ((coords_s[:, 0] & 1) << 2)
+            | ((coords_s[:, 1] & 1) << 1)
+            | (coords_s[:, 2] & 1)
+        )
+        cmass_l = levels[depth][0]
+        ccom_l = levels[depth][1]
+        eps_over = jnp.maximum(
+            jnp.asarray(eps, dtype), 0.5 * span / side
+        )
+
+        def body(acc, xs):
+            off, pm_row = xs
+            cell = coords_s + off[None, :]
+            in_b = jnp.all(
+                jnp.logical_and(cell >= 0, cell < side), axis=-1
+            )
+            ids = (
+                jnp.clip(cell[:, 0], 0, side - 1) * side
+                + jnp.clip(cell[:, 1], 0, side - 1)
+            ) * side + jnp.clip(cell[:, 2], 0, side - 1)
+            is_near = jnp.max(jnp.abs(off)) <= ws
+            ok = jnp.logical_and(
+                in_b,
+                jnp.logical_or(is_near, pm_row[parity]),
+            )
+            sm = cmass_l[ids]
+            ok = jnp.logical_and(ok, sm > 0)
+            diff = jnp.where(
+                ok[:, None],
+                ccom_l[ids] - sorted_pos,
+                jnp.asarray(0.0, dtype),
+            )
+            eps_here = jnp.where(
+                is_near, eps_over, jnp.asarray(eps, dtype)
+            )
+            r2 = jnp.sum(diff * diff, axis=-1) + eps_here * eps_here
+            inv_r = jax.lax.rsqrt(r2)
+            w = jnp.where(
+                ok,
+                ((jnp.asarray(g, dtype) * sm) * inv_r) * inv_r * inv_r,
+                jnp.asarray(0.0, dtype),
+            )
+            return acc + w[:, None] * diff, None
+
+        mono, _ = jax.lax.scan(
+            body,
+            jnp.zeros((n, 3), dtype),
+            (offsets, pmask_t.T),
+        )
+        # The monopole pass covers the finest interaction list too, so
+        # it REPLACES the whole (cell, slot) near+finest sum for these
+        # targets.
+        return jnp.where(over_t[:, None], mono, near_sorted)
+
+    near_sorted = jax.lax.cond(
+        jnp.any(over_t),
+        overflow_target_near,
+        lambda _: near_sorted,
+        operand=None,
+    )
+
+    h_leaf = span / side
+    f_flat = f_loc.reshape(n_leaves, 3)
+    j_flat = j_loc.reshape(n_leaves, 6)
+    centers = origin + (
+        jnp.stack(
+            [
+                sorted_ids // (side * side),
+                (sorted_ids // side) % side,
+                sorted_ids % side,
+            ],
+            axis=-1,
+        ).astype(dtype)
+        + 0.5
+    ) * h_leaf
+    dx = sorted_pos - centers
+    jf = f_flat[sorted_ids]
+    jj = j_flat[sorted_ids]
+    jx = jj[:, 0] * dx[:, 0] + jj[:, 3] * dx[:, 1] + jj[:, 4] * dx[:, 2]
+    jy = jj[:, 3] * dx[:, 0] + jj[:, 1] * dx[:, 1] + jj[:, 5] * dx[:, 2]
+    jz = jj[:, 4] * dx[:, 0] + jj[:, 5] * dx[:, 1] + jj[:, 2] * dx[:, 2]
+    far_sorted = jf + jnp.stack([jx, jy, jz], axis=1)
+
+    acc_sorted = far_sorted + near_sorted
+    # Scatter back to the caller's particle order.
+    inv = jnp.zeros((n,), jnp.int32).at[order].set(
+        jnp.arange(n, dtype=jnp.int32)
+    )
+    return acc_sorted[inv]

@@ -105,6 +105,41 @@ def _force_kernel_hat(m2: int, sigma_cells: float, dtype):
     return _force_kernel_hat_graph(m2, sigma_cells, dtype)
 
 
+def _kernel_body(xp, erf_fn, set_origin, m2: int, sigma_cells: float,
+                 dtype):
+    """The ONE definition of the Ewald force kernel + CIC deconvolution,
+    parameterized over the array namespace (np for the cached CPU
+    constants, jnp for the in-graph TPU build — they must never
+    diverge). Returns (k grid, window w, separations (sx, sy, sz))."""
+    idx = xp.arange(m2)
+    sep = xp.where(idx < m2 // 2, idx, idx - m2).astype(dtype)
+    sx = sep[:, None, None]
+    sy = sep[None, :, None]
+    sz = sep[None, None, :]
+    r2 = sx * sx + sy * sy + sz * sz
+    r = xp.sqrt(r2)
+    a = 1.0 / (math.sqrt(2.0) * sigma_cells)
+    u = a * r
+    safe_r = xp.maximum(r, xp.asarray(1e-20, dtype))
+    k = (
+        erf_fn(u) / (safe_r * safe_r * safe_r)
+        - (2.0 * a / math.sqrt(math.pi))
+        * xp.exp(-u * u) / (safe_r * safe_r)
+    )
+    k = set_origin(k, 4.0 * a**3 / (3.0 * math.sqrt(math.pi)))
+    # Deconvolve the CIC assignment window (applied twice: deposit and
+    # gather). Per axis the CIC window is sinc^2; the Gaussian damping
+    # of the long-range kernel (e^{-k^2 sigma^2/2}, sigma >= h) bounds
+    # the high-k amplification, so this is the standard Hockney &
+    # Eastwood sharpening, not a noise amplifier.
+    fx = xp.fft.fftfreq(m2).astype(dtype)
+    fz = xp.fft.rfftfreq(m2).astype(dtype)
+    wx = xp.sinc(fx) ** 2
+    wz = xp.sinc(fz) ** 2
+    w = (wx[:, None, None] * wx[None, :, None] * wz[None, None, :]) ** 2
+    return k, w, (sx, sy, sz)
+
+
 @lru_cache(maxsize=8)
 def _force_kernel_hat_np(m2: int, sigma_cells: float, dtype_str: str):
     """Numpy kernel transform as (real, imag) float pairs (complex split
@@ -113,33 +148,20 @@ def _force_kernel_hat_np(m2: int, sigma_cells: float, dtype_str: str):
     from scipy.special import erf as np_erf
 
     rdtype = np.float64 if dtype_str == "float64" else np.float32
-    idx = np.arange(m2)
-    sep = np.where(idx < m2 // 2, idx, idx - m2).astype(np.float64)
-    sx = sep[:, None, None]
-    sy = sep[None, :, None]
-    sz = sep[None, None, :]
-    r2 = sx * sx + sy * sy + sz * sz
-    r = np.sqrt(r2)
-    a = 1.0 / (math.sqrt(2.0) * sigma_cells)
-    u = a * r
-    safe_r = np.maximum(r, 1e-20)
-    k = (
-        np_erf(u) / (safe_r * safe_r * safe_r)
-        - (2.0 * a / math.sqrt(math.pi))
-        * np.exp(-u * u) / (safe_r * safe_r)
+
+    def set_origin(k, v):
+        k[0, 0, 0] = v
+        return k
+
+    k, w, seps = _kernel_body(
+        np, np_erf, set_origin, m2, sigma_cells, np.float64
     )
-    k[0, 0, 0] = 4.0 * a**3 / (3.0 * math.sqrt(math.pi))
-    fx = np.fft.fftfreq(m2)
-    fz = np.fft.rfftfreq(m2)
-    wx = np.sinc(fx) ** 2
-    wz = np.sinc(fz) ** 2
-    w = (wx[:, None, None] * wx[None, :, None] * wz[None, None, :]) ** 2
 
     def real_imag(s):
         kh = np.fft.rfftn(-k * s) / w
         return kh.real.astype(rdtype), kh.imag.astype(rdtype)
 
-    return tuple(real_imag(s) for s in (sx, sy, sz))
+    return tuple(real_imag(s) for s in seps)
 
 
 def _force_kernel_hat_graph(m2: int, sigma_cells: float, dtype):
@@ -159,39 +181,14 @@ def _force_kernel_hat_graph(m2: int, sigma_cells: float, dtype):
     as literal constants — 6 x 67M floats at grid 256, which breaks the
     axon remote-compile transport; and complex buffers cannot cross the
     program boundary on that runtime at all. In-graph, the program text
-    stays small, every complex value is internal, and XLA's loop-
-    invariant code motion can hoist the build out of step loops (the
-    kernel depends only on static shapes).
+    stays small and every complex value is internal; step loops hoist it
+    per block via the Simulator's accel-setup hook.
     """
-    idx = jnp.arange(m2)
-    sep = jnp.where(idx < m2 // 2, idx, idx - m2).astype(dtype)
-    sx = sep[:, None, None]
-    sy = sep[None, :, None]
-    sz = sep[None, None, :]
-    r2 = sx * sx + sy * sy + sz * sz
-    r = jnp.sqrt(r2)
-    a = 1.0 / (math.sqrt(2.0) * sigma_cells)
-    u = a * r
-    safe_r = jnp.maximum(r, jnp.asarray(1e-20, dtype))
-    k = (
-        erf(u) / (safe_r * safe_r * safe_r)
-        - (2.0 * a / math.sqrt(math.pi))
-        * jnp.exp(-u * u) / (safe_r * safe_r)
+    k, w, seps = _kernel_body(
+        jnp, erf, lambda kk, v: kk.at[0, 0, 0].set(v), m2, sigma_cells,
+        dtype,
     )
-    k = k.at[0, 0, 0].set(4.0 * a**3 / (3.0 * math.sqrt(math.pi)))
-    # Deconvolve the CIC assignment window (applied twice: deposit and
-    # gather). Per axis the CIC window is sinc^2; the Gaussian damping of
-    # the long-range kernel (e^{-k^2 sigma^2/2}, sigma >= h) bounds the
-    # high-k amplification, so this is the standard Hockney & Eastwood
-    # sharpening, not a noise amplifier.
-    fx = jnp.fft.fftfreq(m2).astype(dtype)
-    fz = jnp.fft.rfftfreq(m2).astype(dtype)
-    wx = jnp.sinc(fx) ** 2
-    wz = jnp.sinc(fz) ** 2
-    w = (
-        wx[:, None, None] * wx[None, :, None] * wz[None, None, :]
-    ) ** 2
-    return tuple(jnp.fft.rfftn(-k * s) / w for s in (sx, sy, sz))
+    return tuple(jnp.fft.rfftn(-k * s) / w for s in seps)
 
 
 def _mesh_accelerations(targets, positions, masses, origin, span, *, grid,

@@ -267,7 +267,22 @@ class Simulator:
         # particle axis (the reference pads nothing; zero-mass padding is
         # exact — see ParticleState.pad_to).
         self.mesh = None
+        if self.backend == "fmm" and config.integrator == "multirate":
+            # make_local_kernel has no fmm branch: fmm computes full-set
+            # accelerations only, with no targets-vs-sources form for
+            # the multirate rectangular kicks.
+            raise ValueError(
+                "force_backend 'fmm' computes full-set accelerations "
+                "only (no targets-vs-sources form for the multirate "
+                "rectangular kicks); use 'tree' with multirate"
+            )
         if config.sharding != "none":
+            if self.backend == "fmm":
+                raise ValueError(
+                    "force_backend 'fmm' is single-host (its sorted-cell "
+                    "near field needs targets == sources); use 'tree' "
+                    "with sharding='allgather' on a mesh"
+                )
             if config.sharding == "ring" and self.backend in (
                 "tree", "pm", "p3m"
             ):
@@ -426,6 +441,17 @@ class Simulator:
                 pos, m, depth=depth, leaf_cap=config.tree_leaf_cap,
                 ws=config.tree_ws, far=config.tree_far,
                 chunk=config.fast_chunk, **common,
+            )
+        if self.backend == "fmm":
+            from .ops.fmm import fmm_accelerations
+            from .ops.tree import recommended_depth_data
+
+            depth = config.tree_depth or recommended_depth_data(
+                self.state.positions, config.tree_leaf_cap
+            )
+            return lambda pos, m: fmm_accelerations(
+                pos, m, depth=depth, leaf_cap=config.tree_leaf_cap,
+                ws=config.tree_ws, **common,
             )
         if self.backend == "pm":
             if config.periodic_box > 0.0:
@@ -1082,7 +1108,7 @@ class Simulator:
                 assignment=config.pm_assignment,
             )
         elif (
-            self.backend in ("tree", "p3m")
+            self.backend in ("tree", "fmm", "p3m")
             and self.n_real > ENERGY_TREE_THRESHOLD
         ):
             # Scale-aware diagnostic: the dense pair scan costs ~5.5e11
