@@ -268,14 +268,18 @@ def cmd_run(args: argparse.Namespace) -> int:
         # Audit the ACTIVE backend's kernel against the jnp direct sum
         # (pallas: bit-level divergence check; tree/pm/p3m: live accuracy
         # audit of the approximation).
-        kernel = (
-            make_local_kernel(config, sim.backend)
-            if sim.backend not in ("dense", "chunked") else None
-        )
+        # fmm has no targets-vs-sources form (make_local_kernel would
+        # raise): audit its full-set result row-sampled instead.
+        full_acc = None
+        kernel = None
+        if sim.backend == "fmm":
+            full_acc = sim.accel_fn(final.positions)
+        elif sim.backend not in ("dense", "chunked"):
+            kernel = make_local_kernel(config, sim.backend)
         check = debug_check_forces(
             final.positions, final.masses,
             g=config.g, cutoff=config.cutoff, eps=config.eps,
-            kernel=kernel,
+            kernel=kernel, full_acc=full_acc,
         )
         logger.log_print(
             f"Force cross-check ({sim.backend} vs jnp direct): "

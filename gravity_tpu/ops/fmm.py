@@ -43,12 +43,15 @@ Decomposition (identical interaction sets to ops/tree.py, same
 - **Evaluation** — per particle: F, J at its leaf (the one gather, N
   indices) and acc = F + J . (x - leaf_center) + near + overflow.
 
-Accuracy contract: the p=1 target expansion truncates at the same order
-as ops/tree.py's ``far="expansion"`` mode — a few percent median force
-error on 3D clouds, ~1% on disks (see tests/test_fmm.py) — traded for
-an order-of-magnitude step-time win at 1M bodies. ``ops/tree.py`` with
-``far="direct"`` (quadrupole cells, per-target exact lists) remains the
-high-accuracy tree path.
+Accuracy contract (defaults: ``order=2`` target expansions + source
+quadrupoles): ~0.2-0.3% median force error on uniform/cold-collapse
+clouds and disks — the same class as ops/tree.py's ``far="direct"`` —
+measured in tests/test_fmm.py. ``order=1, quad=False`` reproduces
+``far="expansion"`` exactly (0.6-1% median). Two fp32 traps bound this
+accuracy and are designed around: the Taylor factors 3w/r^2 (Jacobian)
+and w/r^4 (Hessian moments) are subnormals at astronomical scales, so
+every accumulation uses unit directions and h_leaf-normalized moments
+(all O(w)); see the inline notes.
 
 The reference has no fast solver at all (its only scaling is
 parallelizing the O(N^2) pair set, SURVEY 2e); both this module and
@@ -69,6 +72,7 @@ from .tree import (
     _near_offsets,
     _offsets,
     _parity_mask_table,
+    _quad_correction,
     build_octree,
 )
 
@@ -90,7 +94,8 @@ def _bit_parity_grid(side: int, k: int) -> jnp.ndarray:
 
 
 def _coarse_leaf_expansions(
-    levels, origin, span, depth: int, ws: int, g, eps, dtype
+    levels, origin, span, depth: int, ws: int, g, eps, dtype,
+    order: int = 2, m_scale=None,
 ):
     """p=1 local expansions (F (S,S,S,3), J6 (S,S,S,6)) about LEAF
     centers, summing the interaction lists of every ancestor level
@@ -111,6 +116,15 @@ def _coarse_leaf_expansions(
     f = jnp.zeros((side, side, side, 3), dtype)
     j6 = jnp.zeros((side, side, side, 6), dtype)
     trace_w = jnp.zeros((side, side, side), dtype)
+    # p=2 moments in flush-safe hatted units (see fmm_accelerations):
+    # Bhat = sum (w hq) uhat, Chat = sum (w hq) uhat uhat uhat (10 packed
+    # symmetric components), with uhat = u/r O(1) and hq = h_leaf/r.
+    # The raw Taylor factors s3 = w/r^2 ~ 1e-45 and s5 = w/r^4 ~ 1e-69
+    # FLUSH TO ZERO in fp32 at astronomical scales; every hatted factor
+    # stays O(w) and the h_leaf powers are reapplied at evaluation.
+    h_leaf = span / side
+    a3 = jnp.zeros((side, side, side, 3), dtype) if order >= 2 else None
+    t10 = jnp.zeros((side, side, side, 10), dtype) if order >= 2 else None
     for d in range(2, depth):
         k = depth - d
         sd = 1 << d
@@ -125,6 +139,16 @@ def _coarse_leaf_expansions(
             levels[d][1].reshape(sd, sd, sd, 3),
             ((pad, pad),) * 3 + ((0, 0),),
         )
+        use_quad = len(levels[d]) > 2
+        quad_p = (
+            jnp.pad(
+                levels[d][2].reshape(sd, sd, sd, 6),
+                ((pad, pad),) * 3 + ((0, 0),),
+            )
+            if use_quad
+            else None
+        )
+        h_d = span / sd
         parity = _bit_parity_grid(side, k)
 
         def upsample(a, rep=rep):
@@ -132,9 +156,10 @@ def _coarse_leaf_expansions(
                 jnp.repeat(jnp.repeat(a, rep, 0), rep, 1), rep, 2
             )
 
-        def body(carry, xs, mass_p=mass_p, com_p=com_p, parity=parity,
-                 pad=pad, upsample=upsample, sd=sd):
-            f, j6, trace_w = carry
+        def body(carry, xs, mass_p=mass_p, com_p=com_p, quad_p=quad_p,
+                 parity=parity, pad=pad, upsample=upsample, sd=sd,
+                 h_d=h_d, use_quad=use_quad, h_leaf=h_leaf):
+            f, j6, trace_w, a3, t10 = carry
             off, pm_row = xs
             start = (pad + off[0], pad + off[1], pad + off[2])
             sm = upsample(
@@ -161,34 +186,73 @@ def _coarse_leaf_expansions(
                 jnp.asarray(0.0, dtype),
             )
             f = f + w[..., None] * diff
-            w3 = 3.0 * w * inv_r2
+            # Unit direction FIRST: the textbook factor 3 w / r^2 is
+            # ~1e-44 at astronomical scales — an fp32 subnormal flush
+            # that silently deletes the Jacobian's anisotropic part
+            # (measured as a 10% far-field error); 3 w uhat uhat keeps
+            # every intermediate O(w).
+            uh = diff * inv_r[..., None]
+            if use_quad:
+                # Source-quadrupole correction into F (its gradient is
+                # higher order in the target expansion; dropped).
+                sq = upsample(
+                    jax.lax.dynamic_slice(
+                        quad_p, start + (0,), (sd, sd, sd, 6)
+                    )
+                )
+                sq = jnp.where(ok[..., None], sq, jnp.asarray(0.0, dtype))
+                f = f + _quad_correction(
+                    diff, inv_r, sq, ok, g, m_scale, h_d, dtype
+                )
+            w3 = 3.0 * w
             j6 = j6 + jnp.stack(
                 [
-                    w3 * diff[..., 0] * diff[..., 0],
-                    w3 * diff[..., 1] * diff[..., 1],
-                    w3 * diff[..., 2] * diff[..., 2],
-                    w3 * diff[..., 0] * diff[..., 1],
-                    w3 * diff[..., 0] * diff[..., 2],
-                    w3 * diff[..., 1] * diff[..., 2],
+                    w3 * uh[..., 0] * uh[..., 0],
+                    w3 * uh[..., 1] * uh[..., 1],
+                    w3 * uh[..., 2] * uh[..., 2],
+                    w3 * uh[..., 0] * uh[..., 1],
+                    w3 * uh[..., 0] * uh[..., 2],
+                    w3 * uh[..., 1] * uh[..., 2],
                 ],
                 axis=-1,
             )
-            return (f, j6, trace_w + w), None
+            if a3 is not None:
+                whq = w * (h_leaf * inv_r)
+                ux, uy, uz = uh[..., 0], uh[..., 1], uh[..., 2]
+                a3_new = a3 + whq[..., None] * uh
+                t10_new = t10 + jnp.stack(
+                    [
+                        whq * ux * ux * ux,  # xxx
+                        whq * uy * uy * uy,  # yyy
+                        whq * uz * uz * uz,  # zzz
+                        whq * ux * ux * uy,  # xxy
+                        whq * ux * ux * uz,  # xxz
+                        whq * ux * uy * uy,  # xyy
+                        whq * uy * uy * uz,  # yyz
+                        whq * ux * uz * uz,  # xzz
+                        whq * uy * uz * uz,  # yzz
+                        whq * ux * uy * uz,  # xyz
+                    ],
+                    axis=-1,
+                )
+            else:
+                a3_new, t10_new = a3, t10
+            return (f, j6, trace_w + w, a3_new, t10_new), None
 
-        (f, j6, trace_w), _ = jax.lax.scan(
-            body, (f, j6, trace_w), (offsets, pmask_t.T)
+        (f, j6, trace_w, a3, t10), _ = jax.lax.scan(
+            body, (f, j6, trace_w, a3, t10), (offsets, pmask_t.T)
         )
     j6 = (
         j6.at[..., 0].add(-trace_w)
         .at[..., 1].add(-trace_w)
         .at[..., 2].add(-trace_w)
     )
-    return f, j6
+    return f, j6, a3, t10
 
 
 def _finest_exact_shifted(
     cells_pos, cmass_l, ccom_l, origin, span, side: int, leaf_cap: int,
-    ws: int, g, eps, slab: int, dtype,
+    ws: int, g, eps, slab: int, dtype, cquad_l=None, m_scale=None,
 ):
     """Finest-level interaction list, EXACT per target (its p=1
     expansion ratio would be too large — same reasoning as ops/tree.py):
@@ -207,6 +271,15 @@ def _finest_exact_shifted(
     com_g = ccom_l.reshape(s, s, s, 3)
     mass_p = jnp.pad(mass_g, near_pad)
     com_p = jnp.pad(com_g, ((near_pad, near_pad),) * 3 + ((0, 0),))
+    quad_p = (
+        jnp.pad(
+            cquad_l.reshape(s, s, s, 6),
+            ((near_pad, near_pad),) * 3 + ((0, 0),),
+        )
+        if cquad_l is not None
+        else None
+    )
+    h_leaf = span / s
 
     n_slabs = max(1, s // slab)
     b = s // n_slabs
@@ -238,14 +311,32 @@ def _finest_exact_shifted(
             r2 = jnp.sum(diff * diff, axis=-1) + jnp.asarray(
                 eps * eps, dtype
             )
-            inv_r = jax.lax.rsqrt(r2)
+            # Guard masked lanes: diff is zeroed there, so with eps=0
+            # rsqrt(0) = inf and any 0 * inf downstream poisons to NaN.
+            safe = jnp.where(ok[:, None], r2, jnp.asarray(1.0, dtype))
+            inv_r = jax.lax.rsqrt(safe)
             w = jnp.where(
                 ok[:, None],
                 ((jnp.asarray(g, dtype) * sm[:, None]) * inv_r)
                 * inv_r * inv_r,
                 jnp.asarray(0.0, dtype),
             )
-            return acc + w[..., None] * diff, None
+            acc = acc + w[..., None] * diff
+            if quad_p is not None:
+                # Source quadrupole of the finest-list cells — the
+                # dominant error term of the monopole-only evaluation
+                # (cells 2-3 h away with extent h: (h/r)^2 ~ 10%).
+                sq = jax.lax.dynamic_slice(
+                    quad_p, start + (0,), (b, s, s, 6)
+                ).reshape(c, 6)
+                sq = jnp.where(
+                    ok[:, None], sq, jnp.asarray(0.0, dtype)
+                )
+                acc = acc + _quad_correction(
+                    diff, inv_r, sq[:, None, :], ok[:, None], g,
+                    m_scale, h_leaf, dtype,
+                )
+            return acc, None
 
         acc0 = jnp.zeros((c, leaf_cap, 3), dtype)
         acc, _ = jax.lax.scan(body, acc0, (offsets, pmask_t.T))
@@ -370,6 +461,7 @@ def _near_field_shifted(
     jax.jit,
     static_argnames=(
         "depth", "leaf_cap", "ws", "g", "cutoff", "eps", "slab",
+        "order", "quad",
     ),
 )
 def fmm_accelerations(
@@ -383,6 +475,8 @@ def fmm_accelerations(
     cutoff: float = CUTOFF_RADIUS,
     eps: float = 0.0,
     slab: int = 4,
+    order: int = 2,
+    quad: bool = True,
 ) -> jax.Array:
     """Dense-grid FMM accelerations for all particles (targets = sources
     — the sorted-cell near field requires the targets to BE the binned
@@ -393,19 +487,23 @@ def fmm_accelerations(
     """
     n = positions.shape[0]
     dtype = positions.dtype
-    levels, origin, span, coords = build_octree(positions, masses, depth)
+    levels, origin, span, coords = build_octree(
+        positions, masses, depth, quad=quad
+    )
     side = 1 << depth
+    m_scale = jnp.maximum(jnp.max(masses), jnp.asarray(1e-37, dtype))
 
-    # ---- Coarse far field: p=1 expansions about leaf centers ----
-    f_loc, j_loc = _coarse_leaf_expansions(
-        levels, origin, span, depth, ws, g, eps, dtype
+    # ---- Coarse far field: p=order expansions about leaf centers ----
+    f_loc, j_loc, a_loc, t_loc = _coarse_leaf_expansions(
+        levels, origin, span, depth, ws, g, eps, dtype, order=order,
+        m_scale=m_scale,
     )
 
     # ---- Near field in (cell, slot) layout ----
     leaf_ids = (coords[:, 0] * side + coords[:, 1]) * side + coords[:, 2]
-    order = jnp.argsort(leaf_ids)
-    sorted_pos = positions[order]
-    sorted_mass = masses[order]
+    sort_order = jnp.argsort(leaf_ids)
+    sorted_pos = positions[sort_order]
+    sorted_mass = masses[sort_order]
     n_leaves = side**3
     leaf_count = jax.ops.segment_sum(
         jnp.ones((n,), jnp.int32), leaf_ids, num_segments=n_leaves
@@ -414,10 +512,9 @@ def fmm_accelerations(
         [jnp.zeros((1,), jnp.int32), jnp.cumsum(leaf_count)[:-1]]
     )
     cells_pos, cells_mass = build_padded_cells(
-        sorted_pos, sorted_mass, leaf_ids[order], leaf_start, n_leaves,
+        sorted_pos, sorted_mass, leaf_ids[sort_order], leaf_start, n_leaves,
         leaf_cap,
     )
-    m_scale = jnp.maximum(jnp.max(masses), jnp.asarray(1e-37, dtype))
     near_cell = _near_field_shifted(
         cells_pos, cells_mass, leaf_count, levels[depth][0],
         levels[depth][1], m_scale, origin, span, side, leaf_cap, ws,
@@ -428,10 +525,11 @@ def fmm_accelerations(
     near_cell = near_cell + _finest_exact_shifted(
         cells_pos, levels[depth][0], levels[depth][1], origin, span,
         side, leaf_cap, ws, g, eps, slab, dtype,
+        cquad_l=levels[depth][2] if quad else None, m_scale=m_scale,
     )
 
     # ---- Per-particle evaluation (the one gather: N leaf lookups) ----
-    sorted_ids = leaf_ids[order]
+    sorted_ids = leaf_ids[sort_order]
     slot = jnp.arange(n, dtype=jnp.int32) - leaf_start[sorted_ids]
     over_t = slot >= leaf_cap
     near_sorted = near_cell[sorted_ids, jnp.minimum(slot, leaf_cap - 1)]
@@ -447,7 +545,7 @@ def fmm_accelerations(
     # well-sized runs (recommended_depth_data) never pay the per-
     # particle gathers in this branch.
     def overflow_target_near(_):
-        coords_s = coords[order]  # (N, 3) leaf coords, sorted order
+        coords_s = coords[sort_order]  # (N, 3) leaf coords, sorted order
         offsets = jnp.asarray(_offsets(ws), jnp.int32)
         pmask_t = jnp.asarray(_parity_mask_table(ws))
         parity = (
@@ -533,10 +631,48 @@ def fmm_accelerations(
     jy = jj[:, 3] * dx[:, 0] + jj[:, 1] * dx[:, 1] + jj[:, 5] * dx[:, 2]
     jz = jj[:, 4] * dx[:, 0] + jj[:, 5] * dx[:, 1] + jj[:, 2] * dx[:, 2]
     far_sorted = jf + jnp.stack([jx, jy, jz], axis=1)
+    if order >= 2:
+        # Second-order term (1/2) H : dx dx with
+        # H_ijk = -3 s3 (d_ij u_k + d_ik u_j + d_jk u_i) + 15 s5 u_i u_j u_k:
+        #   = h_leaf * [ -3 dxh (Bhat.dxh) - 1.5 |dxh|^2 Bhat
+        #                + 7.5 Chat : dxh dxh ]
+        # in the flush-safe hatted moments (Bhat = sum w hq uhat,
+        # Chat = sum w hq uhat uhat uhat; dxh = dx / h_leaf) — the raw
+        # s3/s5 factors are fp32 subnormals at astronomical scales.
+        aa = a_loc.reshape(n_leaves, 3)[sorted_ids]
+        tt = t_loc.reshape(n_leaves, 10)[sorted_ids]
+        dxh = dx / h_leaf
+        x, y, z = dxh[:, 0], dxh[:, 1], dxh[:, 2]
+        adx = aa[:, 0] * x + aa[:, 1] * y + aa[:, 2] * z
+        dx2 = x * x + y * y + z * z
+        # (T : dx dx)_i = sum_jk T_ijk dx_j dx_k, expanded per component
+        # of the packed symmetric tensor.
+        txx, tyy, tzz = tt[:, 0], tt[:, 1], tt[:, 2]
+        txxy, txxz, txyy = tt[:, 3], tt[:, 4], tt[:, 5]
+        tyyz, txzz, tyzz = tt[:, 6], tt[:, 7], tt[:, 8]
+        txyz = tt[:, 9]
+        tdd_x = (
+            txx * x * x + txyy * y * y + txzz * z * z
+            + 2.0 * (txxy * x * y + txxz * x * z + txyz * y * z)
+        )
+        tdd_y = (
+            txxy * x * x + tyy * y * y + tyzz * z * z
+            + 2.0 * (txyy * x * y + txyz * x * z + tyyz * y * z)
+        )
+        tdd_z = (
+            txxz * x * x + tyyz * y * y + tzz * z * z
+            + 2.0 * (txyz * x * y + txzz * x * z + tyzz * y * z)
+        )
+        tdd = jnp.stack([tdd_x, tdd_y, tdd_z], axis=1)
+        far_sorted = far_sorted + h_leaf * (
+            -3.0 * adx[:, None] * dxh
+            - 1.5 * dx2[:, None] * aa
+            + 7.5 * tdd
+        )
 
     acc_sorted = far_sorted + near_sorted
     # Scatter back to the caller's particle order.
-    inv = jnp.zeros((n,), jnp.int32).at[order].set(
+    inv = jnp.zeros((n,), jnp.int32).at[sort_order].set(
         jnp.arange(n, dtype=jnp.int32)
     )
     return acc_sorted[inv]

@@ -242,17 +242,21 @@ def _leaf_expansions(
             )
             f = f + jnp.einsum("cl,cld->cd", w, diff)
             # Jacobian of a(x) = sum w (s - x):
-            #   J_ij = -w delta_ij + 3 w diff_i diff_j / r2soft.
-            w3 = 3.0 * w * inv_r2  # (C, L)
+            #   J_ij = -w delta_ij + 3 w uhat_i uhat_j, uhat = diff / r.
+            # The textbook 3 w / r^2 factor is an fp32 subnormal at
+            # astronomical scales (~1e-44) and flushes to zero, deleting
+            # the anisotropic part; unit directions keep it O(w).
+            uh = diff * inv_r[..., None]  # (C, L, 3), O(1)
+            w3 = 3.0 * w  # (C, L)
             trace_w = trace_w + jnp.sum(w, axis=1)
             j6 = j6 + jnp.stack(
                 [
-                    jnp.einsum("cl,cl->c", w3, diff[..., 0] ** 2),
-                    jnp.einsum("cl,cl->c", w3, diff[..., 1] ** 2),
-                    jnp.einsum("cl,cl->c", w3, diff[..., 2] ** 2),
-                    jnp.einsum("cl,cl->c", w3, diff[..., 0] * diff[..., 1]),
-                    jnp.einsum("cl,cl->c", w3, diff[..., 0] * diff[..., 2]),
-                    jnp.einsum("cl,cl->c", w3, diff[..., 1] * diff[..., 2]),
+                    jnp.einsum("cl,cl->c", w3, uh[..., 0] ** 2),
+                    jnp.einsum("cl,cl->c", w3, uh[..., 1] ** 2),
+                    jnp.einsum("cl,cl->c", w3, uh[..., 2] ** 2),
+                    jnp.einsum("cl,cl->c", w3, uh[..., 0] * uh[..., 1]),
+                    jnp.einsum("cl,cl->c", w3, uh[..., 0] * uh[..., 2]),
+                    jnp.einsum("cl,cl->c", w3, uh[..., 1] * uh[..., 2]),
                 ],
                 axis=1,
             )
@@ -306,18 +310,9 @@ def _monopole_acc(pos, cell_mass, cell_com, mask, g, eps, dtype,
     # Quadrupole: in diff = -u terms,
     #   a_q = G [ (Q diff)/r^5 ... ] with u = -diff:
     #   a_k = G [ -(Q diff)_k / r^5 + (5/2)(diff.Q diff) diff_k / r^7 ].
-    inv_r2 = inv_r * inv_r
-    s1 = (jnp.asarray(g, dtype) * m_scale) * inv_r
-    hq = h_d * inv_r
-    c5 = jnp.where(ok, s1 * hq * hq * inv_r2, jnp.asarray(0.0, dtype))
     q = jnp.where(ok[..., None], cell_quad, jnp.asarray(0.0, dtype))
-    qd = _quad_dot(q, diff)  # (C, L, 3)
-    qq = jnp.sum(qd * diff, axis=-1)  # (C, L)
-    acc = acc - jnp.einsum("cl,cld->cd", c5, qd)
-    acc = acc + jnp.einsum(
-        "cl,cld->cd", 2.5 * c5 * qq * inv_r2, diff
-    )
-    return acc
+    corr = _quad_correction(diff, inv_r, q, ok, g, m_scale, h_d, dtype)
+    return acc + jnp.sum(corr, axis=1)
 
 
 def _quad_dot(q, diff):
@@ -331,6 +326,28 @@ def _quad_dot(q, diff):
     qd_z = q[..., 4] * diff[..., 0] + q[..., 5] * diff[..., 1] \
         + q[..., 2] * diff[..., 2]
     return jnp.stack([qd_x, qd_y, qd_z], axis=-1)
+
+
+def _quad_correction(diff, inv_r, q_masked, ok, g, m_scale, h, dtype):
+    """Per-source acceleration correction of a normalized traceless
+    quadrupole Q_hat = Q / (m_scale h^2):
+
+        a_q = -c5 (Q_hat diff) + 2.5 c5 (diff . Q_hat diff) inv_r^2 diff
+
+    with the fp32-safe ordering c5 = (g m_scale inv_r)(h inv_r)^2 inv_r^2
+    — every factor O(m_scale/r) or O(1), where the raw G Q / r^5 flushes
+    to zero at astronomical scales. The ONE definition shared by the
+    tree's per-target far field and the fmm's coarse/finest passes
+    (callers sum over their source axis as needed)."""
+    inv_r2 = inv_r * inv_r
+    s1 = (jnp.asarray(g, dtype) * m_scale) * inv_r
+    hq = h * inv_r
+    c5 = jnp.where(ok, s1 * hq * hq * inv_r2, jnp.asarray(0.0, dtype))
+    qd = _quad_dot(q_masked, diff)
+    qq = jnp.sum(qd * diff, axis=-1)
+    return -c5[..., None] * qd + (
+        2.5 * c5 * qq * inv_r2
+    )[..., None] * diff
 
 
 def _interaction_ids(coords_c, d, depth, offsets, parity_masks):

@@ -83,6 +83,7 @@ def debug_check_forces(
     sample: int = 2048,
     seed: int = 0,
     kernel=None,
+    full_acc=None,
 ) -> dict:
     """Cross-check a force kernel against the pure-jnp direct sum on (a
     sample of) live state. Returns {max_rel_err, median_rel_err, n_checked}.
@@ -90,6 +91,11 @@ def debug_check_forces(
     ``kernel``: a LocalKernel (targets, sources, masses) -> acc; defaults
     to the Pallas kernel. Passing the active backend's kernel (tree/p3m/
     pm included) turns this into a live accuracy audit of fast solvers.
+
+    ``full_acc``: precomputed (N, 3) accelerations for ALL particles —
+    for backends with no targets-vs-sources form (fmm computes the full
+    set only); the sampled rows are compared instead of calling a
+    kernel.
 
     The TPU analog of running compute-sanitizer on the reference's racy
     CUDA kernel (`/root/reference/cuda.cu:47-49`): by construction the only
@@ -102,11 +108,17 @@ def debug_check_forces(
     cutoff = CUTOFF_RADIUS if cutoff is None else cutoff
     n = positions.shape[0]
     if n > sample:
-        idx = np.random.RandomState(seed).choice(n, sample, replace=False)
-        targets = positions[np.sort(idx)]
+        idx = np.sort(
+            np.random.RandomState(seed).choice(n, sample, replace=False)
+        )
+        targets = positions[idx]
     else:
+        idx = None
         targets = positions
-    if kernel is None:
+    if full_acc is not None:
+        got = full_acc if idx is None else full_acc[idx]
+        kernel = lambda t, p, m: got  # noqa: E731
+    elif kernel is None:
         from functools import partial
 
         from ..ops.pallas_forces import pallas_accelerations_vs
