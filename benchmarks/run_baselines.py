@@ -66,6 +66,13 @@ CONFIGS = {
              p3m_cap=64),
         dict(bench_steps=3),
     ),
+    "1m-fmm": (
+        "1M-body Milky-Way disk, dense-grid FMM (gather-free)",
+        dict(model="disk", n=1_048_576, g=1.0, dt=2.0e-3, eps=0.05,
+             integrator="leapfrog", force_backend="fmm",
+             tree_leaf_cap=32),
+        dict(bench_steps=3),
+    ),
     # Bonus (beyond BASELINE.json): the cosmology path.
     "cosmo-262k": (
         "262,144-body Zel'dovich ICs, periodic-box PM (grid=128)",

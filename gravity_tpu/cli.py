@@ -269,11 +269,23 @@ def cmd_run(args: argparse.Namespace) -> int:
         # (pallas: bit-level divergence check; tree/pm/p3m: live accuracy
         # audit of the approximation).
         # fmm has no targets-vs-sources form (make_local_kernel would
-        # raise): audit its full-set result row-sampled instead.
+        # raise): audit its full-set result row-sampled instead —
+        # recomputed as PURE self-gravity (sim.accel_fn folds in any
+        # --external field, which the jnp reference lacks).
         full_acc = None
         kernel = None
         if sim.backend == "fmm":
-            full_acc = sim.accel_fn(final.positions)
+            from .ops.fmm import fmm_accelerations
+            from .ops.tree import recommended_depth_data
+
+            depth = config.tree_depth or recommended_depth_data(
+                final.positions, config.tree_leaf_cap
+            )
+            full_acc = fmm_accelerations(
+                final.positions, final.masses, depth=depth,
+                leaf_cap=config.tree_leaf_cap, ws=config.tree_ws,
+                g=config.g, cutoff=config.cutoff, eps=config.eps,
+            )
         elif sim.backend not in ("dense", "chunked"):
             kernel = make_local_kernel(config, sim.backend)
         check = debug_check_forces(
@@ -600,6 +612,19 @@ def _validate_tpu_battery(checks: dict) -> None:
     err_t = rel_err(acc_t, ref_d)
     checks["tpu_tree_parity"] = {
         "n": n_tree, "median_rel_err": err_t, "ok": err_t < 0.05,
+    }
+
+    # Dense-grid FMM vs exact on the same disk (gather-free fast path;
+    # p=2 + source quadrupoles: ~0.3% median).
+    from .ops.fmm import fmm_accelerations
+
+    acc_f = fmm_accelerations(
+        disk.positions, disk.masses,
+        depth=recommended_depth_data(disk.positions), g=1.0, eps=0.05,
+    )
+    err_f = rel_err(acc_f, ref_d)
+    checks["tpu_fmm_parity"] = {
+        "n": n_tree, "median_rel_err": err_f, "ok": err_f < 0.02,
     }
 
     # The sharded code path (shard_map + collectives) on mesh=(1,):

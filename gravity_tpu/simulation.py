@@ -267,15 +267,6 @@ class Simulator:
         # particle axis (the reference pads nothing; zero-mass padding is
         # exact — see ParticleState.pad_to).
         self.mesh = None
-        if self.backend == "fmm" and config.integrator == "multirate":
-            # make_local_kernel has no fmm branch: fmm computes full-set
-            # accelerations only, with no targets-vs-sources form for
-            # the multirate rectangular kicks.
-            raise ValueError(
-                "force_backend 'fmm' computes full-set accelerations "
-                "only (no targets-vs-sources form for the multirate "
-                "rectangular kicks); use 'tree' with multirate"
-            )
         if config.sharding != "none":
             if self.backend == "fmm":
                 raise ValueError(
@@ -377,8 +368,30 @@ class Simulator:
                     "multirate_rungs must be in [2, 6]; got "
                     f"{config.multirate_rungs}"
                 )
+            # fmm has no targets-vs-sources form; the (K, N) fast kicks
+            # use the exact dense rectangular kernel while the once-per-
+            # outer-step full evaluation stays on the backend. That is
+            # only sane for explicitly small K: the dense kick builds a
+            # (K, N, 3) buffer, and the auto default K = n//8 at fmm's
+            # million-body scale would be a ~1.5 TB allocation.
+            if self.backend == "fmm":
+                k_req = config.multirate_k
+                if k_req <= 0:
+                    raise ValueError(
+                        "force_backend 'fmm' + multirate needs an explicit "
+                        "(small) --multirate-k: the fast kicks use a dense "
+                        "(K, N) kernel and the auto default K = n//8 does "
+                        "not scale to fmm's target sizes"
+                    )
+                if k_req * self.state.n > (1 << 25):
+                    raise ValueError(
+                        f"multirate_k={k_req} x n={self.state.n} exceeds "
+                        f"the dense fast-kick budget (2^25 pair entries); "
+                        "lower k or use force_backend 'tree'"
+                    )
             base_kernel = make_local_kernel(
-                config, self.backend, positions=self.state.positions
+                config, "dense" if self.backend == "fmm" else self.backend,
+                positions=self.state.positions,
             )
             if self.mesh is not None:
                 # Sharded fast rung: replicated K-target rectangular
