@@ -38,32 +38,50 @@ from .numerics import tiny as _tiny  # noqa: E402  (FTZ-safe divisor floor)
 
 
 def acceleration_timestep(acc, *, eta: float, eps: float, dt_max: float,
-                          mask=None):
+                          mask=None, exclude_fastest: int = 0):
     """``eta * sqrt(eps / max|a|)``, clipped to (0, dt_max].
 
     ``mask`` (bool (N,)) restricts the max to real particles — zero-mass
     padding (sharding) must not drive the global step.
+
+    ``exclude_fastest``: drop the k largest |a| before taking the max —
+    the multirate composition hook: the rung ladder integrates those k
+    at a subdivided step, so they must not drag the OUTER dt down (the
+    "one bound binary stalls the whole system" wall).
     """
     dtype = acc.dtype
     a = jnp.linalg.norm(acc, axis=-1)
     if mask is not None:
         a = jnp.where(mask, a, jnp.asarray(0.0, dtype))
-    amax = jnp.max(a)
+    if exclude_fastest > 0:
+        kk = min(exclude_fastest + 1, a.shape[0])
+        amax = jax.lax.top_k(a, kk)[0][-1]
+    else:
+        amax = jnp.max(a)
     dt = jnp.asarray(eta, dtype) * jnp.sqrt(
         jnp.asarray(eps, dtype) / jnp.maximum(amax, _tiny(dtype))
     )
     return jnp.minimum(dt, jnp.asarray(dt_max, dtype))
 
 
-def velocity_timestep(vel, acc, *, eta: float, dt_max: float, mask=None):
-    """``eta * min(|v| / |a|)``, clipped to (0, dt_max]."""
+def velocity_timestep(vel, acc, *, eta: float, dt_max: float, mask=None,
+                      exclude_fastest: int = 0):
+    """``eta * min(|v| / |a|)``, clipped to (0, dt_max].
+
+    ``exclude_fastest``: drop the k smallest timescales before the min
+    (see acceleration_timestep)."""
     dtype = vel.dtype
     v = jnp.linalg.norm(vel, axis=-1)
     a = jnp.linalg.norm(acc, axis=-1)
     ratio = v / jnp.maximum(a, _tiny(dtype))
     if mask is not None:
         ratio = jnp.where(mask, ratio, jnp.asarray(jnp.inf, dtype))
-    dt = jnp.asarray(eta, dtype) * jnp.min(ratio)
+    if exclude_fastest > 0:
+        kk = min(exclude_fastest + 1, ratio.shape[0])
+        dt_min_kept = -jax.lax.top_k(-ratio, kk)[0][-1]
+    else:
+        dt_min_kept = jnp.min(ratio)
+    dt = jnp.asarray(eta, dtype) * dt_min_kept
     return jnp.minimum(dt, jnp.asarray(dt_max, dtype))
 
 
@@ -78,7 +96,8 @@ class AdaptiveResult(NamedTuple):
 
 
 def make_timestep_fn(
-    criterion: str, *, eta: float, eps: float, dt_max: float
+    criterion: str, *, eta: float, eps: float, dt_max: float,
+    exclude_fastest: int = 0,
 ) -> Callable:
     """(state, acc) -> dt for a named criterion ('accel' | 'velocity')."""
     if criterion == "accel":
@@ -89,12 +108,13 @@ def make_timestep_fn(
                 "unsoftened runs"
             )
         return lambda state, acc: acceleration_timestep(
-            acc, eta=eta, eps=eps, dt_max=dt_max, mask=state.masses > 0
+            acc, eta=eta, eps=eps, dt_max=dt_max, mask=state.masses > 0,
+            exclude_fastest=exclude_fastest,
         )
     if criterion == "velocity":
         return lambda state, acc: velocity_timestep(
             state.velocities, acc, eta=eta, dt_max=dt_max,
-            mask=state.masses > 0,
+            mask=state.masses > 0, exclude_fastest=exclude_fastest,
         )
     raise ValueError(
         f"unknown timestep criterion {criterion!r}; "
@@ -116,6 +136,8 @@ def adaptive_run(
     t0=0.0,
     comp0=0.0,
     acc0: jax.Array | None = None,
+    step_fn: Callable | None = None,
+    exclude_fastest: int = 0,
 ) -> AdaptiveResult:
     """Integrate to ``t_end`` with per-step adaptive dt, fully jitted.
 
@@ -134,8 +156,24 @@ def adaptive_run(
     Time is accumulated with Kahan compensation so sub-ulp steps still
     make progress in float32 state dtypes (``comp0`` carries the
     compensation across restarts).
+
+    ``step_fn``: optional ``(state, acc, dt) -> (state, new_acc)``
+    override of the default carried-acc KDK — the composition hook for
+    the multirate rung ladder (adaptive OUTER dt per step, per-particle
+    power-of-two rungs within it; ops/multirate.py's step functions
+    already take dt as a runtime value, so they trace straight in). The
+    returned ``new_acc`` must be the full-system acceleration at the new
+    positions: the dt criterion reads it to size the next step. Pass
+    ``exclude_fastest = <the rung capacity>`` so the criterion sizes the
+    outer step from the SLOW remainder — that exclusion, not the ladder
+    alone, is what removes the one-bound-binary stall (the ladder then
+    covers the excluded set's dynamic range with ``2^(rungs-1)``-fold
+    subdivision; size the ladder accordingly).
     """
-    dt_fn = make_timestep_fn(criterion, eta=eta, eps=eps, dt_max=dt_max)
+    dt_fn = make_timestep_fn(
+        criterion, eta=eta, eps=eps, dt_max=dt_max,
+        exclude_fastest=exclude_fastest,
+    )
     dtype = state.positions.dtype
     if acc0 is None:
         acc0 = accel_fn(state.positions)
@@ -151,7 +189,10 @@ def adaptive_run(
         dt = jnp.minimum(
             jnp.maximum(dt_fn(st, acc), dt_floor), t_end_c - t
         )
-        st, new_acc = leapfrog_kdk(st, dt, accel_fn, acc)
+        if step_fn is None:
+            st, new_acc = leapfrog_kdk(st, dt, accel_fn, acc)
+        else:
+            st, new_acc = step_fn(st, acc, dt)
         # Kahan-compensated t += dt: dt can be orders of magnitude below
         # ulp(t) near t_end in fp32; naive accumulation would stall.
         y = dt - comp

@@ -512,6 +512,28 @@ class Simulator:
             )
         raise ValueError(self.backend)
 
+    def _multirate_plan(self):
+        """(k, capacities | None) for the multirate configuration — ONE
+        derivation of the auto-k default and the 8^(r-1) capacity ladder
+        (used by the fixed-dt block and the adaptive composition), with
+        the oversized-ladder guard applied in both."""
+        config = self.config
+        n = self.state.n
+        k = min(config.multirate_k or max(1, n // 8), n)
+        rungs = config.multirate_rungs
+        if rungs > 2:
+            capacities = tuple(
+                max(1, k // (8 ** (r - 1))) for r in range(1, rungs)
+            )
+            if sum(capacities) > n:
+                raise ValueError(
+                    f"rung capacities {capacities} (from "
+                    f"multirate_k={k}, rungs={rungs}) exceed "
+                    f"n={n}; lower multirate_k"
+                )
+            return k, capacities
+        return k, None
+
     # --- the jitted hot loop ---
 
     def _block_fn(self, state: ParticleState, acc, *, n_steps: int,
@@ -527,21 +549,10 @@ class Simulator:
                 make_rung_ladder_step_fn,
             )
 
-            k = min(self.config.multirate_k or max(1, state.n // 8),
-                    state.n)
-            rungs = self.config.multirate_rungs
-            if rungs > 2:
+            k, capacities = self._multirate_plan()
+            if capacities is not None:
                 # Power-of-two ladder: rung r capacity k // 8^(r-1),
                 # floored at 1 (GADGET-style geometric occupancy).
-                capacities = tuple(
-                    max(1, k // (8 ** (r - 1))) for r in range(1, rungs)
-                )
-                if sum(capacities) > state.n:
-                    raise ValueError(
-                        f"rung capacities {capacities} (from "
-                        f"multirate_k={k}, rungs={rungs}) exceed "
-                        f"n={state.n}; lower multirate_k"
-                    )
                 if self.mesh is not None:
                     step = make_rung_ladder_sharded_step_fn(
                         self.mesh, self._rect_accel,
@@ -627,8 +638,26 @@ class Simulator:
         metrics_logger=None,
         start_step: int = 0,
     ) -> dict:
-        """Run the configured number of steps; returns a results dict."""
+        """Run the configured number of steps; returns a results dict.
+
+        ``config.adaptive`` runs dispatch to :meth:`run_adaptive` — the
+        CLI did this already, but a Python-API caller setting
+        ``adaptive=True`` and calling ``run()`` must not silently get a
+        fixed-dt integration (review finding).
+        """
         config = self.config
+        if config.adaptive:
+            if steps is not None or start_step:
+                raise ValueError(
+                    "adaptive runs take their span from config.steps "
+                    "(t_end = steps * dt); use run_adaptive(start_t=...) "
+                    "to resume"
+                )
+            return self.run_adaptive(
+                logger, trajectory_writer=trajectory_writer,
+                checkpoint_manager=checkpoint_manager,
+                metrics_logger=metrics_logger,
+            )
         total_steps = config.steps if steps is None else steps
         # Recording only happens when there is somewhere to put the frames;
         # config.record_trajectories alone (no writer) must not make the
@@ -908,18 +937,61 @@ class Simulator:
         criterion = config.timestep_criterion
         if criterion == "auto":
             criterion = "accel" if config.eps > 0.0 else "velocity"
-        if config.integrator not in ("euler", "leapfrog"):
+        if config.integrator not in ("euler", "leapfrog", "multirate"):
             # "euler" is only the config default, not a real request for
             # adaptive Euler; anything else would be silently ignored.
             raise ValueError(
-                f"adaptive mode integrates with KDK leapfrog; "
-                f"integrator={config.integrator!r} is not supported "
+                f"adaptive mode integrates with KDK leapfrog (or the "
+                f"multirate rung ladder); integrator="
+                f"{config.integrator!r} is not supported "
                 "(use fixed-dt runs for verlet/yoshida4)"
             )
+        if config.integrator == "multirate" and self.mesh is not None:
+            raise ValueError(
+                "adaptive + multirate composition is single-host for "
+                "now; drop --sharding or use fixed-dt multirate"
+            )
+
+        # Adaptive x multirate composition: the adaptive criterion sizes
+        # the OUTER dt each step, and the rung ladder subdivides it per
+        # particle — the answer to the "one deeply bound binary drags
+        # the whole system to its timestep" scaling wall (the multirate
+        # step functions take dt as a runtime value, so they trace
+        # straight into the adaptive while_loop).
+        step_fn = None
+        exclude_fastest = 0
+        mode = "adaptive-kdk"
+        if config.integrator == "multirate":
+            from .ops.multirate import rung_ladder_step, two_rung_step
+
+            k, capacities = self._multirate_plan()
+            # The criterion sizes the outer step from the SLOW remainder
+            # — without this exclusion the fastest particle still drags
+            # the global dt and the ladder only adds work.
+            exclude_fastest = k
+            if capacities is not None:
+                step_fn = partial(
+                    rung_ladder_step, accel_vs=self._local_vs_kernel,
+                    capacities=capacities, accel_full=self._accel2,
+                )
+                mode = (
+                    f"adaptive-multirate (rungs="
+                    f"{config.multirate_rungs}, k={k})"
+                )
+            else:
+                step_fn = partial(
+                    two_rung_step, accel_vs=self._local_vs_kernel,
+                    k=k, n_sub=config.multirate_sub,
+                    accel_full=self._accel2,
+                )
+                mode = (
+                    f"adaptive-multirate (k={k}, "
+                    f"sub={config.multirate_sub})"
+                )
 
         self._banner(
             logger, config.steps,
-            f"adaptive-kdk ({criterion}, eta={config.eta})",
+            f"{mode} ({criterion}, eta={config.eta})",
         )
 
         block_cap = max(1, min(config.progress_every,
@@ -941,6 +1013,8 @@ class Simulator:
                         eps=config.eps,
                         criterion=criterion,
                         max_steps=budget,
+                        step_fn=step_fn,
+                        exclude_fastest=exclude_fastest,
                     )
                 )
             return _block_fns[budget](st, t0=t0, comp0=comp0, acc0=acc0)
