@@ -86,13 +86,43 @@ def main(argv) -> int:
     t_full = timed(jax.jit(full), pos, label="tree_accelerations (full)")
 
     # 3b. Dense-grid FMM (the gather-free fast path; ops/fmm.py).
-    from gravity_tpu.ops.fmm import fmm_accelerations
+    from gravity_tpu.ops.fmm import (
+        _coarse_leaf_expansions,
+        fmm_accelerations,
+    )
 
     def fmm(p):
         return fmm_accelerations(p, masses, depth=depth, eps=0.05, g=1.0)
 
     t_fmm = timed(jax.jit(fmm), pos, label="fmm_accelerations (full)")
     print(f"fmm speedup vs tree: {t_full / t_fmm:.2f}x")
+
+    def fmm_fast(p):
+        return fmm_accelerations(
+            p, masses, depth=depth, eps=0.05, g=1.0, order=1, quad=False
+        )
+
+    timed(jax.jit(fmm_fast), pos, label="fmm (order=1, no quad)")
+
+    # Expansion cost isolated from the (separately measured) build:
+    # build once outside, pass the pyramid as ARGUMENTS (closing over
+    # concrete arrays would inline them as literal constants — the
+    # remote-compile payload trap documented in ops/p3m.py).
+    levels_c, origin_c, span_c, _ = jax.jit(
+        lambda p: build_octree(p, masses, depth, quad=True)
+    )(pos)
+
+    def fmm_coarse(levels, origin, span):
+        f, _, _, _ = _coarse_leaf_expansions(
+            levels, origin, span, depth, 1, 1.0, 0.05, pos.dtype,
+            m_scale=jnp.max(masses),
+        )
+        return f
+
+    timed(
+        jax.jit(fmm_coarse), levels_c, origin_c, span_c,
+        label="fmm coarse expansions only",
+    )
 
     # 4. Direct-sum reference point at this n (chunked to bound memory).
     from gravity_tpu.ops.forces import pairwise_accelerations_chunked

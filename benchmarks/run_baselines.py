@@ -73,6 +73,14 @@ CONFIGS = {
              tree_leaf_cap=32),
         dict(bench_steps=3),
     ),
+    "2m-fmm": (
+        "2x1M-body galaxy merger, dense-grid FMM (single-chip, "
+        "gather-free)",
+        dict(model="merger", n=2_097_152, g=1.0, dt=2.0e-3, eps=0.05,
+             integrator="leapfrog", force_backend="fmm",
+             tree_leaf_cap=32),
+        dict(bench_steps=3),
+    ),
     # Bonus (beyond BASELINE.json): the cosmology path.
     "cosmo-262k": (
         "262,144-body Zel'dovich ICs, periodic-box PM (grid=128)",
