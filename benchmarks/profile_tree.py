@@ -109,15 +109,17 @@ def main(argv) -> int:
     # concrete arrays would inline them as literal constants — the
     # remote-compile payload trap documented in ops/p3m.py).
     levels_c, origin_c, span_c, _ = jax.jit(
-        lambda p: build_octree(p, masses, depth, quad=True)
-    )(pos)
+        lambda p, m: build_octree(p, m, depth, quad=True)
+    )(pos, masses)
 
     def fmm_coarse(levels, origin, span):
-        f, _, _, _ = _coarse_leaf_expansions(
+        # Return ALL outputs: discarding j6/a3/t10 would let XLA
+        # dead-code-eliminate the moment accumulations from the scan and
+        # under-report the stage this timing exists to isolate.
+        return _coarse_leaf_expansions(
             levels, origin, span, depth, 1, 1.0, 0.05, pos.dtype,
             m_scale=jnp.max(masses),
         )
-        return f
 
     timed(
         jax.jit(fmm_coarse), levels_c, origin_c, span_c,

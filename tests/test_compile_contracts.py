@@ -1,0 +1,83 @@
+"""Compiled-program contracts behind the round-3 on-chip fixes.
+
+These pin properties of the LOWERED/COMPILED programs that no numerical
+test can see, but that decide whether the framework runs on the tunneled
+TPU at all (BASELINE.md, round-3 chip session):
+
+1. The in-graph P3M Ewald kernel builder (the path that ships to a
+   remote compiler on TPU) must not inline literal constants — 6 x 67M
+   floats at grid 256 broke the axon remote-compile transport
+   ("Broken pipe"). The CPU platform deliberately DOES use cached numpy
+   constants instead (no per-step rebuild on any path), so the contract
+   is pinned on the builder, not the platform dispatcher.
+2. Inside the Simulator's scanned step block, the kernel build must be
+   hoisted OUT of the while body (XLA does not do this motion itself —
+   without the accel-setup hook every step pays 3 extra grid-sized
+   FFTs).
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from gravity_tpu.config import SimulationConfig
+from gravity_tpu.simulation import Simulator
+
+
+def test_p3m_in_graph_kernel_has_no_giant_literals():
+    """The in-graph builder lowers to a KB-scale program: shifts, erf,
+    FFTs — never dense literal constants of the kernel itself."""
+    from gravity_tpu.ops.p3m import _force_kernel_hat_graph
+
+    txt = jax.jit(
+        lambda: _force_kernel_hat_graph(64, 1.25, jnp.float32)
+    ).lower().as_text()
+    # grid=32 -> padded 64^3: inlined kernels would be 3 x 140k complex
+    # values (tens of MB of text); the in-graph program stays small.
+    assert len(txt) < 2_000_000, (
+        f"in-graph kernel lowered to {len(txt)} bytes — literal "
+        "constants are back"
+    )
+
+
+def test_p3m_kernel_hoisted_out_of_scan():
+    """The compiled step block keeps the kernel FFTs OUTSIDE the while
+    body: 4 FFTs per step (rho forward + 3 inverse), the 3 kernel
+    transforms hoisted to the block prologue.
+
+    The CPU dispatcher would hide this behind cached constants, so the
+    in-graph builder is forced — exactly what the TPU path runs.
+    """
+    if jax.devices()[0].platform != "cpu":
+        pytest.skip("compiled-HLO inspection runs on the CPU platform")
+    from gravity_tpu.ops import p3m as p3m_mod
+
+    orig = p3m_mod._force_kernel_hat
+    p3m_mod._force_kernel_hat = p3m_mod._force_kernel_hat_graph
+    try:
+        cfg = SimulationConfig(
+            model="plummer", n=1024, dt=3600.0, eps=1e9,
+            integrator="leapfrog", force_backend="p3m", pm_grid=16,
+        )
+        sim = Simulator(cfg)
+        from gravity_tpu.ops.integrators import init_carry
+
+        acc = init_carry(sim.accel_fn, sim.state)
+        hlo = sim._run_block.lower(
+            sim.state, acc, n_steps=4, record=False
+        ).compile().as_text()
+    finally:
+        p3m_mod._force_kernel_hat = orig
+    body_ffts = sum(
+        1 for line in hlo.splitlines()
+        if " fft(" in line and "/while/body/" in line
+    )
+    total_ffts = sum(1 for line in hlo.splitlines() if " fft(" in line)
+    assert body_ffts == 4, (
+        f"{body_ffts} FFTs in the while body (expected 4: rho rfftn + "
+        "3 irfftn); the kernel hoist regressed"
+    )
+    assert total_ffts >= 7, (
+        f"only {total_ffts} FFTs total — the in-graph kernel build is "
+        "missing from the block prologue"
+    )
