@@ -483,14 +483,25 @@ def fmm_accelerations(
     sources; sharded target slices use ops/tree.py instead).
 
     ``slab`` bounds near-field memory: the (cells, cap, cap) pair
-    buffers are built for slab*side^2 cells at a time.
+    buffers are built for slab*side^2 cells at a time — and is auto-
+    clamped (rounded down to a power of two, so it always divides the
+    power-of-two side) so the dominant (slab*side^2, cap, cap, 3)
+    temporary stays under ~1 GB fp32. The clamp floors at slab=1: a
+    single x-plane at extreme depth/cap (side=256, cap=64 -> ~3.2 GB)
+    can still exceed the target — deep high-cap runs budget HBM
+    themselves.
     """
+    side = 1 << depth
+    slab_cap = max(
+        1, (1 << 28) // max(1, 3 * side * side * leaf_cap * leaf_cap)
+    )
+    slab = min(slab, 1 << (slab_cap.bit_length() - 1))
+    slab = max(1, 1 << (slab.bit_length() - 1))  # power of two, >= 1
     n = positions.shape[0]
     dtype = positions.dtype
     levels, origin, span, coords = build_octree(
         positions, masses, depth, quad=quad
     )
-    side = 1 << depth
     m_scale = jnp.maximum(jnp.max(masses), jnp.asarray(1e-37, dtype))
 
     # ---- Coarse far field: p=order expansions about leaf centers ----

@@ -87,7 +87,9 @@ def _resolve_direct(config: SimulationConfig, on_tpu: bool) -> str:
     return "chunked"
 
 
-def _resolve_backend(config: SimulationConfig) -> str:
+def _resolve_backend(config: SimulationConfig, on_tpu=None) -> str:
+    """Resolve 'auto'/'direct' to a concrete backend. ``on_tpu``
+    overrides platform detection (tests)."""
     backend = config.force_backend
     if backend == "auto" and config.periodic_box > 0.0:
         return "pm"  # the only periodic-capable solver
@@ -120,15 +122,27 @@ def _resolve_backend(config: SimulationConfig) -> str:
                 stacklevel=2,
             )
         return backend
-    on_tpu = jax.devices()[0].platform == "tpu"
+    if on_tpu is None:
+        on_tpu = jax.devices()[0].platform == "tpu"
     if backend == "direct":
         # Exactness guarantee without hardware knowledge: never routes
         # to an approximate solver regardless of scale.
         return _resolve_direct(config, on_tpu)
-    # auto: above the measured crossover the O(N log N) octree wins over
-    # any direct sum — unless the ring strategy is requested (see above).
+    # auto: above the measured crossover a fast solver wins over any
+    # direct sum — unless the ring strategy is requested (see above).
     crossover = TREE_CROSSOVER_TPU if on_tpu else TREE_CROSSOVER_CPU
     if config.n >= crossover and config.sharding != "ring":
+        if (
+            on_tpu
+            and config.sharding == "none"
+            and config.integrator != "multirate"
+        ):
+            # On the chip the gather-bound tree measured 6.6x slower
+            # than even the direct sum at 1M (docs/scaling.md); the
+            # dense-grid FMM is its gather-free reorganization at the
+            # same accuracy class. Single-host only (no vs-form), and
+            # multirate needs the tree's rectangular kernels.
+            return "fmm"
         return "tree"
     return _resolve_direct(config, on_tpu)
 

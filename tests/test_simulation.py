@@ -402,3 +402,24 @@ def test_energy_routes_through_tree_above_threshold(monkeypatch):
     )
     assert e_dense != 0.0
     assert abs(e_tree - e_dense) / abs(e_dense) < 0.02
+
+
+def test_auto_routes_fmm_on_tpu_above_crossover():
+    """On TPU, auto above the crossover picks the gather-free fmm for
+    single-host runs; tree remains the sharded and multirate choice."""
+    from gravity_tpu.config import SimulationConfig
+    from gravity_tpu.simulation import TREE_CROSSOVER_TPU, _resolve_backend
+
+    n = TREE_CROSSOVER_TPU
+    assert _resolve_backend(
+        SimulationConfig(n=n), on_tpu=True
+    ) == "fmm"
+    assert _resolve_backend(
+        SimulationConfig(n=n, sharding="allgather"), on_tpu=True
+    ) == "tree"
+    assert _resolve_backend(
+        SimulationConfig(n=n, integrator="multirate"), on_tpu=True
+    ) == "tree"
+    assert _resolve_backend(
+        SimulationConfig(n=n - 1), on_tpu=True
+    ) == "pallas"
