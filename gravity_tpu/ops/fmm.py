@@ -253,6 +253,7 @@ def _coarse_leaf_expansions(
 def _finest_exact_shifted(
     cells_pos, cmass_l, ccom_l, origin, span, side: int, leaf_cap: int,
     ws: int, g, eps, slab: int, dtype, cquad_l=None, m_scale=None,
+    slab_ids=None,
 ):
     """Finest-level interaction list, EXACT per target (its p=1
     expansion ratio would be too large — same reasoning as ops/tree.py):
@@ -283,6 +284,8 @@ def _finest_exact_shifted(
 
     n_slabs = max(1, s // slab)
     b = s // n_slabs
+    if slab_ids is None:
+        slab_ids = jnp.arange(n_slabs, dtype=jnp.int32) * b
 
     def one_slab(x0):
         tpos = jax.lax.dynamic_slice(
@@ -342,14 +345,14 @@ def _finest_exact_shifted(
         acc, _ = jax.lax.scan(body, acc0, (offsets, pmask_t.T))
         return acc
 
-    slabs = jax.lax.map(one_slab, jnp.arange(n_slabs, dtype=jnp.int32) * b)
-    return slabs.reshape(s * s * s, leaf_cap, 3)
+    slabs = jax.lax.map(one_slab, slab_ids)
+    return slabs.reshape(-1, leaf_cap, 3)
 
 
 def _near_field_shifted(
     cells_pos, cells_mass, leaf_count, cmass_l, ccom_l, m_scale,
     origin, span, side: int, leaf_cap: int, ws: int, g, cutoff, eps,
-    slab: int, dtype,
+    slab: int, dtype, slab_ids=None,
 ):
     """Exact near field on the (S^3, cap) padded-cell layout, one shifted
     slice per neighbor offset — plus the remainder-monopole overflow
@@ -370,8 +373,11 @@ def _near_field_shifted(
     over_g = cnt_g > leaf_cap
     rem_mhat = jnp.maximum(jnp.where(over_g, cell_mhat - pref_mhat, 0.0), 0.0)
     tot_mw = ccom_l.reshape(s, s, s, 3) * cell_mhat[..., None]
-    pref_mw = (
-        jnp.sum(mass_g[..., None] * pos_g, axis=-2) / m_scale
+    # Normalized-mass ordering: raw m * x overflows fp32 at astronomical
+    # scales (7.8e27 kg x 1.5e13 m = 1.2e41) — normalize BEFORE the
+    # product, same rule as build_octree and tree._overflow_remainder.
+    pref_mw = jnp.sum(
+        (mass_g / m_scale)[..., None] * pos_g, axis=-2
     )
     rem_com = (tot_mw - pref_mw) / jnp.maximum(
         rem_mhat, jnp.asarray(1e-37, dtype)
@@ -389,6 +395,8 @@ def _near_field_shifted(
     n_slabs = max(1, s // slab)
     assert s % slab == 0 or n_slabs == 1
     b = s // n_slabs
+    if slab_ids is None:
+        slab_ids = jnp.arange(n_slabs, dtype=jnp.int32) * b
 
     def one_slab(x0):
         # Target block: b x-planes of cells.
@@ -453,8 +461,22 @@ def _near_field_shifted(
         acc, _ = jax.lax.scan(body, acc0, near)
         return acc
 
-    slabs = jax.lax.map(one_slab, jnp.arange(n_slabs, dtype=jnp.int32) * b)
-    return slabs.reshape(s * s * s, leaf_cap, 3)
+    slabs = jax.lax.map(one_slab, slab_ids)
+    return slabs.reshape(-1, leaf_cap, 3)
+
+
+def _clamp_slab(slab: int, depth: int, leaf_cap: int) -> int:
+    """Power-of-two slab under a ~1 GB fp32 budget for the dominant
+    (slab*side^2, cap, cap, 3) near-field temporary. Floors at 1: a
+    single x-plane at extreme depth/cap (side=256, cap=64 -> ~3.2 GB)
+    can still exceed the target — deep high-cap runs budget HBM
+    themselves."""
+    side = 1 << depth
+    slab_cap = max(
+        1, (1 << 28) // max(1, 3 * side * side * leaf_cap * leaf_cap)
+    )
+    slab = min(slab, 1 << (slab_cap.bit_length() - 1))
+    return max(1, 1 << (slab.bit_length() - 1))
 
 
 @partial(
@@ -478,25 +500,29 @@ def fmm_accelerations(
     order: int = 2,
     quad: bool = True,
 ) -> jax.Array:
-    """Dense-grid FMM accelerations for all particles (targets = sources
-    — the sorted-cell near field requires the targets to BE the binned
-    sources; sharded target slices use ops/tree.py instead).
+    """Dense-grid FMM accelerations for all particles (targets =
+    sources — the sorted-cell near field requires the targets to BE the
+    binned sources; for a mesh use :func:`make_sharded_fmm_accel`).
 
-    ``slab`` bounds near-field memory: the (cells, cap, cap) pair
-    buffers are built for slab*side^2 cells at a time — and is auto-
-    clamped (rounded down to a power of two, so it always divides the
-    power-of-two side) so the dominant (slab*side^2, cap, cap, 3)
-    temporary stays under ~1 GB fp32. The clamp floors at slab=1: a
-    single x-plane at extreme depth/cap (side=256, cap=64 -> ~3.2 GB)
-    can still exceed the target — deep high-cap runs budget HBM
-    themselves.
+    ``slab`` bounds near-field memory (see _clamp_slab).
     """
-    side = 1 << depth
-    slab_cap = max(
-        1, (1 << 28) // max(1, 3 * side * side * leaf_cap * leaf_cap)
+    return _fmm_core(
+        positions, masses, depth=depth, leaf_cap=leaf_cap, ws=ws, g=g,
+        cutoff=cutoff, eps=eps, slab=_clamp_slab(slab, depth, leaf_cap),
+        order=order, quad=quad, slab_ids=None, axis_names=None,
     )
-    slab = min(slab, 1 << (slab_cap.bit_length() - 1))
-    slab = max(1, 1 << (slab.bit_length() - 1))  # power of two, >= 1
+
+
+def _fmm_core(
+    positions, masses, *, depth, leaf_cap, ws, g, cutoff, eps, slab,
+    order, quad, slab_ids, axis_names,
+):
+    """Full-set FMM evaluation. With ``slab_ids``/``axis_names`` (the
+    sharded path) each device computes only its x-slab subset of the
+    near + finest passes — embarrassingly parallel given the replicated
+    cell grids — and the (cells, cap, 3) results are re-assembled with
+    one all_gather (device-major concat == x-major slab order)."""
+    side = 1 << depth
     n = positions.shape[0]
     dtype = positions.dtype
     levels, origin, span, coords = build_octree(
@@ -529,7 +555,7 @@ def fmm_accelerations(
     near_cell = _near_field_shifted(
         cells_pos, cells_mass, leaf_count, levels[depth][0],
         levels[depth][1], m_scale, origin, span, side, leaf_cap, ws,
-        g, cutoff, eps, slab, dtype,
+        g, cutoff, eps, slab, dtype, slab_ids=slab_ids,
     )
     # Finest-level interaction list, exact per target (see ops/tree.py:
     # its p=1 expansion ratio would be too large).
@@ -537,7 +563,14 @@ def fmm_accelerations(
         cells_pos, levels[depth][0], levels[depth][1], origin, span,
         side, leaf_cap, ws, g, eps, slab, dtype,
         cquad_l=levels[depth][2] if quad else None, m_scale=m_scale,
+        slab_ids=slab_ids,
     )
+    if axis_names is not None:
+        # Each device computed a contiguous x-major slab subset; the
+        # device-major all_gather concat restores full x-major order.
+        near_cell = jax.lax.all_gather(
+            near_cell, axis_names, tiled=True
+        )
 
     # ---- Per-particle evaluation (the one gather: N leaf lookups) ----
     sorted_ids = leaf_ids[sort_order]
@@ -687,3 +720,76 @@ def fmm_accelerations(
         jnp.arange(n, dtype=jnp.int32)
     )
     return acc_sorted[inv]
+
+
+def make_sharded_fmm_accel(
+    mesh,
+    *,
+    depth: int,
+    leaf_cap: int = 32,
+    ws: int = 1,
+    g: float = G,
+    cutoff: float = CUTOFF_RADIUS,
+    eps: float = 0.0,
+    slab: int = 4,
+    order: int = 2,
+    quad: bool = True,
+):
+    """(positions, masses) -> accelerations with the FMM's near + finest
+    passes sharded over the mesh (the same replicated-build contract as
+    the sharded tree: octree pyramid, cell arrays, and coarse
+    expansions are rebuilt per device — O(N) with small constants —
+    while the dominant slab passes split P ways, re-assembled with one
+    (cells, cap, 3) all_gather riding ICI).
+
+    Requires n % mesh.size == 0 (ParticleState.pad_to) and a power-of-
+    two mesh no larger than the number of slabs; the slab width shrinks
+    automatically until the slab count divides the mesh.
+    """
+    from jax.sharding import PartitionSpec as P_
+
+    axes = mesh.axis_names
+    p_total = mesh.size
+    side = 1 << depth
+    # min(side) first: a slab wider than the grid would yield ZERO
+    # slabs and sail through both divisibility checks (0 % p == 0),
+    # silently dropping the whole near field (review finding).
+    slab_eff = min(_clamp_slab(slab, depth, leaf_cap), side)
+    # Every device needs an equal, non-empty contiguous run of slabs.
+    while slab_eff > 1 and (side // slab_eff) % p_total:
+        slab_eff //= 2
+    if (side // slab_eff) % p_total:
+        raise ValueError(
+            f"mesh size {p_total} does not divide the {side // slab_eff} "
+            f"near-field slabs at depth={depth}; use a power-of-two mesh "
+            f"<= {side}"
+        )
+    n_slabs = side // slab_eff
+    local_slabs = n_slabs // p_total
+    spec = P_(axes)
+
+    def body(pos_l, m_l):
+        pos = jax.lax.all_gather(pos_l, axes, tiled=True)
+        m = jax.lax.all_gather(m_l, axes, tiled=True)
+        # Linear device index, row-major over the mesh axes (matches
+        # the P(axes) block partitioning of the particle axis).
+        idx = jax.lax.axis_index(axes[0])
+        for a in axes[1:]:
+            idx = idx * jax.lax.axis_size(a) + jax.lax.axis_index(a)
+        slab_ids = (
+            idx * local_slabs + jnp.arange(local_slabs, dtype=jnp.int32)
+        ) * slab_eff
+        acc = _fmm_core(
+            pos, m, depth=depth, leaf_cap=leaf_cap, ws=ws, g=g,
+            cutoff=cutoff, eps=eps, slab=slab_eff, order=order,
+            quad=quad, slab_ids=slab_ids, axis_names=axes,
+        )
+        n_local = pos_l.shape[0]
+        return jax.lax.dynamic_slice(
+            acc, (idx * n_local, 0), (n_local, 3)
+        )
+
+    return jax.shard_map(
+        body, mesh=mesh, in_specs=(spec, spec), out_specs=spec,
+        check_vma=False,
+    )

@@ -282,14 +282,8 @@ class Simulator:
         # exact — see ParticleState.pad_to).
         self.mesh = None
         if config.sharding != "none":
-            if self.backend == "fmm":
-                raise ValueError(
-                    "force_backend 'fmm' is single-host (its sorted-cell "
-                    "near field needs targets == sources); use 'tree' "
-                    "with sharding='allgather' on a mesh"
-                )
             if config.sharding == "ring" and self.backend in (
-                "tree", "pm", "p3m"
+                "tree", "fmm", "pm", "p3m"
             ):
                 raise ValueError(
                     f"force backend {self.backend!r} needs the full source "
@@ -331,7 +325,22 @@ class Simulator:
         # 500-step block would pay 3 extra grid-sized FFTs per step.
         self._accel_setup = None
         self._accel2_aux = None
-        if self.mesh is not None:
+        if self.mesh is not None and self.backend == "fmm":
+            # fmm has no targets-vs-sources form; its sharded mode
+            # splits the dominant slab passes over the mesh instead
+            # (replicated build, one (cells, cap, 3) all_gather).
+            from .ops.fmm import make_sharded_fmm_accel
+            from .ops.tree import recommended_depth_data
+
+            depth = config.tree_depth or recommended_depth_data(
+                self.state.positions, config.tree_leaf_cap
+            )
+            self._accel2 = make_sharded_fmm_accel(
+                self.mesh, depth=depth, leaf_cap=config.tree_leaf_cap,
+                ws=config.tree_ws, g=config.g, cutoff=config.cutoff,
+                eps=config.eps,
+            )
+        elif self.mesh is not None:
             from .parallel import make_sharded_accel2
 
             self._accel2 = make_sharded_accel2(

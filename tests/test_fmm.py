@@ -14,7 +14,11 @@ import numpy as np
 import pytest
 
 from gravity_tpu.constants import G
-from gravity_tpu.models import create_cold_collapse, create_disk
+from gravity_tpu.models import (
+    create_cold_collapse,
+    create_disk,
+    create_plummer,
+)
 from gravity_tpu.ops.fmm import fmm_accelerations
 from gravity_tpu.ops.forces import pairwise_accelerations_dense
 from gravity_tpu.ops.tree import tree_accelerations
@@ -181,3 +185,52 @@ def test_fmm_composes_with_multirate(key):
     ) / (np.linalg.norm(np.asarray(lf.positions), axis=1) + 1e-300)
     assert bool(jnp.all(jnp.isfinite(mr.positions)))
     assert float(np.median(rel)) < 1e-3, float(np.median(rel))
+
+
+def test_fmm_overflow_at_astronomical_masses(key):
+    """Overflowing cells with astronomical masses: the remainder-mass
+    bookkeeping must use normalized-mass ordering (raw m * x is ~1e41,
+    past fp32 max — this NaN'd every shallow-depth Plummer run)."""
+    state = create_plummer(key, 128)
+    exact = pairwise_accelerations_dense(
+        state.positions, state.masses, eps=1e9
+    )
+    # Bounds scale with resolution: at depth 2 (side 4) the overflowed
+    # Plummer core is almost entirely cell-size-softened monopoles —
+    # same graceful-degradation contract as the tree's concentrated-core
+    # test (median 0.5 bound at depth 5 / cap 128 there).
+    for depth, bound in ((2, 0.8), (3, 0.5)):
+        out = fmm_accelerations(
+            state.positions, state.masses, depth=depth, eps=1e9,
+            leaf_cap=32,
+        )
+        assert bool(jnp.all(jnp.isfinite(out))), depth
+        rel = _rel_err(out, exact)
+        assert np.median(rel) < bound, (depth, float(np.median(rel)))
+
+
+def test_sharded_fmm_matches_unsharded(key):
+    """Slab-sharded fmm == single-host fmm to float roundoff on the
+    8-device mesh (flat and hierarchical): replicated build, split
+    near/finest passes, one cells all_gather."""
+    import numpy as np_
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from gravity_tpu.ops.fmm import make_sharded_fmm_accel
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device virtual mesh")
+    state = create_disk(key, 2048)
+    ref = fmm_accelerations(
+        state.positions, state.masses, depth=5, g=1.0, eps=0.05
+    )
+    for shape, names in (((8,), ("shard",)), ((2, 4), ("dcn", "shard"))):
+        mesh = Mesh(np_.array(jax.devices()).reshape(shape), names)
+        fn = make_sharded_fmm_accel(mesh, depth=5, g=1.0, eps=0.05)
+        sh = NamedSharding(mesh, P(names if len(names) > 1 else names[0]))
+        out = fn(
+            jax.device_put(state.positions, sh),
+            jax.device_put(state.masses, sh),
+        )
+        rel = _rel_err(out, ref)
+        assert np.median(rel) < 1e-6, (shape, float(np.median(rel)))
