@@ -46,7 +46,7 @@ class SimulationConfig:
     # (scale-aware among EXACT O(N^2) backends only) | dense | chunked |
     # pallas (direct sum) | cpp (native XLA FFI host kernel, CPU
     # platform) | tree (octree) | fmm (dense-grid gather-free FMM,
-    # single-host) | pm (FFT mesh) | p3m (FFT mesh + cell-list pair
+    # slab-sharded on a mesh) | pm (FFT mesh) | p3m (FFT mesh + cell-list pair
     # correction)
     force_backend: str = "auto"
     chunk: int = 1024
@@ -163,6 +163,10 @@ PRESETS = {
         model="disk", n=1_048_576, integrator="leapfrog",
         force_backend="p3m", pm_grid=256, p3m_cap=64,
         g=1.0, dt=2.0e-3, eps=0.05,
+    ),
+    "baseline-1m-fmm": SimulationConfig(
+        model="disk", n=1_048_576, integrator="leapfrog",
+        force_backend="fmm", g=1.0, dt=2.0e-3, eps=0.05,
     ),
     "baseline-2m-merger": SimulationConfig(
         model="merger", n=2_097_152, integrator="leapfrog",
