@@ -54,8 +54,16 @@ def acceleration_timestep(acc, *, eta: float, eps: float, dt_max: float,
     if mask is not None:
         a = jnp.where(mask, a, jnp.asarray(0.0, dtype))
     if exclude_fastest > 0:
-        kk = min(exclude_fastest + 1, a.shape[0])
-        amax = jax.lax.top_k(a, kk)[0][-1]
+        # Full sort, not top_k: the sorted array keeps the input's length
+        # (and therefore its sharding) — top_k's k-sized output cannot be
+        # laid out on a particle-sharded mesh.
+        kk = min(exclude_fastest, a.shape[0] - 1)
+        # Masked reduction, not a slice: extracting one element of a
+        # particle-sharded array is unimplemented for non-divisible
+        # output dims; iota + where + sum reduces to a replicated scalar.
+        srt = jnp.sort(a)
+        pick = jnp.arange(a.shape[0]) == (a.shape[0] - 1 - kk)
+        amax = jnp.sum(jnp.where(pick, srt, jnp.asarray(0.0, dtype)))
     else:
         amax = jnp.max(a)
     dt = jnp.asarray(eta, dtype) * jnp.sqrt(
@@ -77,8 +85,15 @@ def velocity_timestep(vel, acc, *, eta: float, dt_max: float, mask=None,
     if mask is not None:
         ratio = jnp.where(mask, ratio, jnp.asarray(jnp.inf, dtype))
     if exclude_fastest > 0:
-        kk = min(exclude_fastest + 1, ratio.shape[0])
-        dt_min_kept = -jax.lax.top_k(-ratio, kk)[0][-1]
+        # Full sort for sharding-compatibility (see acceleration_timestep).
+        kk = min(exclude_fastest, ratio.shape[0] - 1)
+        # Masked reduction for sharding-compatibility (see above). A
+        # picked inf (fewer real particles than the exclusion) flows to
+        # min(eta * inf, dt_max) = dt_max — the unconstrained-step
+        # semantics the unexcluded path has always had.
+        srt = jnp.sort(ratio)
+        pick = jnp.arange(ratio.shape[0]) == kk
+        dt_min_kept = jnp.sum(jnp.where(pick, srt, 0.0))
     else:
         dt_min_kept = jnp.min(ratio)
     dt = jnp.asarray(eta, dtype) * dt_min_kept

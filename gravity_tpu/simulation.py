@@ -969,10 +969,15 @@ class Simulator:
                 f"{config.integrator!r} is not supported "
                 "(use fixed-dt runs for verlet/yoshida4)"
             )
-        if config.integrator == "multirate" and self.mesh is not None:
+        if (
+            config.integrator == "multirate"
+            and self.mesh is not None
+            and config.multirate_rungs > 2
+        ):
             raise ValueError(
-                "adaptive + multirate composition is single-host for "
-                "now; drop --sharding or use fixed-dt multirate"
+                "adaptive + multirate composition supports the two-rung "
+                "scheme on a mesh (multirate_rungs=2); the sharded rung "
+                "ladder stays fixed-dt for now"
             )
 
         # Adaptive x multirate composition: the adaptive criterion sizes
@@ -992,7 +997,23 @@ class Simulator:
             # — without this exclusion the fastest particle still drags
             # the global dt and the ladder only adds work.
             exclude_fastest = k
-            if capacities is not None:
+            if self.mesh is not None:
+                from .ops.multirate import two_rung_step_sharded
+
+                # _build_fns prepared the sharded multirate kernels
+                # (integrator == "multirate" and a mesh imply both).
+                step_fn = partial(
+                    two_rung_step_sharded, mesh=self.mesh,
+                    rect_accel=self._rect_accel,
+                    fast_fast=self._fast_fast_kernel,
+                    accel_full=self._accel2, k=k,
+                    n_sub=config.multirate_sub,
+                )
+                mode = (
+                    f"adaptive-multirate sharded (k={k}, "
+                    f"sub={config.multirate_sub})"
+                )
+            elif capacities is not None:
                 step_fn = partial(
                     rung_ladder_step, accel_vs=self._local_vs_kernel,
                     capacities=capacities, accel_full=self._accel2,
