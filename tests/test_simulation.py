@@ -423,3 +423,26 @@ def test_auto_routes_fmm_on_tpu_above_crossover():
     assert _resolve_backend(
         SimulationConfig(n=n - 1), on_tpu=True
     ) == "pallas"
+
+
+def test_energy_routes_through_tree_for_fmm_backend(monkeypatch):
+    """fmm runs price --metrics-energy with the O(N log N) tree
+    potential too (same scalable-diagnostic contract as tree/p3m)."""
+    from gravity_tpu.ops import tree as tree_mod
+    from gravity_tpu import simulation as sim_mod
+
+    monkeypatch.setattr(sim_mod, "ENERGY_TREE_THRESHOLD", 256)
+    calls = {"n": 0}
+    real_pe = tree_mod.tree_potential_energy
+
+    def counting_pe(*a, **k):
+        calls["n"] += 1
+        return real_pe(*a, **k)
+
+    monkeypatch.setattr(tree_mod, "tree_potential_energy", counting_pe)
+    sim = Simulator(SimulationConfig(
+        model="disk", n=1024, g=1.0, dt=2e-3, eps=0.05, steps=1,
+        force_backend="fmm",
+    ))
+    float(sim.energy())
+    assert calls["n"] == 1
