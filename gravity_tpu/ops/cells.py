@@ -43,6 +43,32 @@ def _scatter_cells(values, slot, n_cells: int, cap: int, fill=0):
     )
 
 
+def bin_to_cells(points, weights, coords, side: int, cap: int):
+    """Morton-sort ``points`` and pad them into the (side^3, cap)
+    cell-slot layout — the one binning prologue shared by the fmm and
+    p3m shifted-slice passes (both for their sources and for their
+    separately-capped target binnings).
+
+    Returns (cells_pos, cells_w, count, start, sort_order, sorted_ids).
+    """
+    n = points.shape[0]
+    ids = (coords[:, 0] * side + coords[:, 1]) * side + coords[:, 2]
+    sort_order = jnp.argsort(ids)
+    sorted_ids = ids[sort_order]
+    n_cells = side**3
+    count = jax.ops.segment_sum(
+        jnp.ones((n,), jnp.int32), ids, num_segments=n_cells
+    )
+    start = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(count)[:-1]]
+    )
+    cells_pos, cells_w = build_padded_cells(
+        points[sort_order], weights[sort_order], sorted_ids, start,
+        n_cells, cap,
+    )
+    return cells_pos, cells_w, count, start, sort_order, sorted_ids
+
+
 def build_padded_cells(
     sorted_pos, sorted_mass, sorted_cell_ids, cell_start, n_cells: int,
     cap: int,

@@ -67,7 +67,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..constants import CUTOFF_RADIUS, G
-from .cells import build_padded_cells, grid_coords
+from .cells import bin_to_cells, build_padded_cells, grid_coords
 from .tree import (
     _near_offsets,
     _offsets,
@@ -75,6 +75,12 @@ from .tree import (
     _quad_correction,
     build_octree,
 )
+
+
+# Typed zero for trailing dynamic_slice indices: the literal 0 would be
+# promoted to int64 under jax_enable_x64 while the offset arithmetic is
+# int32, and dynamic_slice rejects mixed index types.
+_I0 = np.int32(0)
 
 
 def _cell_centers(origin, span, sd: int, dtype):
@@ -167,7 +173,7 @@ def _coarse_leaf_expansions(
             )
             sc = upsample(
                 jax.lax.dynamic_slice(
-                    com_p, start + (0,), (sd, sd, sd, 3)
+                    com_p, start + (_I0,), (sd, sd, sd, 3)
                 )
             )
             ok = jnp.logical_and(pm_row[parity], sm > 0)
@@ -197,7 +203,7 @@ def _coarse_leaf_expansions(
                 # higher order in the target expansion; dropped).
                 sq = upsample(
                     jax.lax.dynamic_slice(
-                        quad_p, start + (0,), (sd, sd, sd, 6)
+                        quad_p, start + (_I0,), (sd, sd, sd, 6)
                     )
                 )
                 sq = jnp.where(ok[..., None], sq, jnp.asarray(0.0, dtype))
@@ -289,10 +295,10 @@ def _finest_exact_shifted(
 
     def one_slab(x0):
         tpos = jax.lax.dynamic_slice(
-            pos_g, (x0, 0, 0, 0, 0), (b, s, s, leaf_cap, 3)
+            pos_g, (x0, _I0, _I0, _I0, _I0), (b, s, s, leaf_cap, 3)
         ).reshape(-1, leaf_cap, 3)
         par = jax.lax.dynamic_slice(
-            parity, (x0, 0, 0), (b, s, s)
+            parity, (x0, _I0, _I0), (b, s, s)
         ).reshape(-1)
         c = tpos.shape[0]
 
@@ -303,7 +309,7 @@ def _finest_exact_shifted(
             )
             sm = jax.lax.dynamic_slice(mass_p, start, (b, s, s)).reshape(c)
             sc = jax.lax.dynamic_slice(
-                com_p, start + (0,), (b, s, s, 3)
+                com_p, start + (_I0,), (b, s, s, 3)
             ).reshape(c, 3)
             ok = jnp.logical_and(pm_row[par], sm > 0)  # (C,)
             diff = jnp.where(
@@ -330,7 +336,7 @@ def _finest_exact_shifted(
                 # dominant error term of the monopole-only evaluation
                 # (cells 2-3 h away with extent h: (h/r)^2 ~ 10%).
                 sq = jax.lax.dynamic_slice(
-                    quad_p, start + (0,), (b, s, s, 6)
+                    quad_p, start + (_I0,), (b, s, s, 6)
                 ).reshape(c, 6)
                 sq = jnp.where(
                     ok[:, None], sq, jnp.asarray(0.0, dtype)
@@ -352,18 +358,31 @@ def _finest_exact_shifted(
 def _near_field_shifted(
     cells_pos, cells_mass, leaf_count, cmass_l, ccom_l, m_scale,
     origin, span, side: int, leaf_cap: int, ws: int, g, cutoff, eps,
-    slab: int, dtype, slab_ids=None,
+    slab: int, dtype, slab_ids=None, tcells_pos=None, t_cap=None,
 ):
     """Exact near field on the (S^3, cap) padded-cell layout, one shifted
     slice per neighbor offset — plus the remainder-monopole overflow
     correction, whose per-SOURCE-cell remainder mass/COM is computed once
     globally (not per target chunk as in ops/tree.py).
 
-    Returns (S^3, cap, 3) accelerations in (cell, slot) layout."""
+    ``tcells_pos``/``t_cap`` select a SEPARATE target binning (the
+    rectangular targets-vs-sources evaluation: targets binned on the
+    source grid with their own slot cap); by default the sources are
+    their own targets. Self-pairs in the self-case (and target-coincides-
+    with-source pairs in the rectangular case) contribute exactly zero
+    through the zero difference vector — the same contract as
+    ops/forces.accelerations_vs.
+
+    Returns (S^3, t_cap, 3) accelerations in (cell, slot) layout."""
     near = jnp.asarray(_near_offsets(ws), jnp.int32)  # (27, 3)
     pad = ws
     s = side
     pos_g = cells_pos.reshape(s, s, s, leaf_cap, 3)
+    if tcells_pos is None:
+        tpos_g, tcap = pos_g, leaf_cap
+    else:
+        tcap = t_cap if t_cap is not None else leaf_cap
+        tpos_g = tcells_pos.reshape(s, s, s, tcap, 3)
     mass_g = cells_mass.reshape(s, s, s, leaf_cap)
     cnt_g = leaf_count.reshape(s, s, s)
 
@@ -401,17 +420,17 @@ def _near_field_shifted(
     def one_slab(x0):
         # Target block: b x-planes of cells.
         tpos = jax.lax.dynamic_slice(
-            pos_g, (x0, 0, 0, 0, 0), (b, s, s, leaf_cap, 3)
-        ).reshape(-1, leaf_cap, 3)
+            tpos_g, (x0, _I0, _I0, _I0, _I0), (b, s, s, tcap, 3)
+        ).reshape(-1, tcap, 3)
         c = tpos.shape[0]
 
         def body(acc, off):
             start3 = (pad + x0 + off[0], pad + off[1], pad + off[2])
             spos = jax.lax.dynamic_slice(
-                pos_p, start3 + (0, 0), (b, s, s, leaf_cap, 3)
+                pos_p, start3 + (_I0, _I0), (b, s, s, leaf_cap, 3)
             ).reshape(c, leaf_cap, 3)
             smass = jax.lax.dynamic_slice(
-                mass_p, start3 + (0,), (b, s, s, leaf_cap)
+                mass_p, start3 + (_I0,), (b, s, s, leaf_cap)
             ).reshape(c, leaf_cap)
             # (C, capT, capS) pair kernel; padded slots carry mass 0 so
             # no explicit mask is needed beyond the cutoff guard.
@@ -436,7 +455,7 @@ def _near_field_shifted(
                 rem_mhat_p, start3, (b, s, s)
             ).reshape(c)
             r_c = jax.lax.dynamic_slice(
-                rem_com_p, start3 + (0,), (b, s, s, 3)
+                rem_com_p, start3 + (_I0,), (b, s, s, 3)
             ).reshape(c, 3)
             r_over = jax.lax.dynamic_slice(
                 over_p, start3, (b, s, s)
@@ -457,23 +476,24 @@ def _near_field_shifted(
             acc = acc + w_o[..., None] * diff_o
             return acc, None
 
-        acc0 = jnp.zeros((c, leaf_cap, 3), dtype)
+        acc0 = jnp.zeros((c, tcap, 3), dtype)
         acc, _ = jax.lax.scan(body, acc0, near)
         return acc
 
     slabs = jax.lax.map(one_slab, slab_ids)
-    return slabs.reshape(-1, leaf_cap, 3)
+    return slabs.reshape(-1, tcap, 3)
 
 
-def _clamp_slab(slab: int, depth: int, leaf_cap: int) -> int:
+def _clamp_slab(slab: int, depth: int, leaf_cap: int, t_cap=None) -> int:
     """Power-of-two slab under a ~1 GB fp32 budget for the dominant
-    (slab*side^2, cap, cap, 3) near-field temporary. Floors at 1: a
+    (slab*side^2, t_cap, cap, 3) near-field temporary. Floors at 1: a
     single x-plane at extreme depth/cap (side=256, cap=64 -> ~3.2 GB)
     can still exceed the target — deep high-cap runs budget HBM
     themselves."""
     side = 1 << depth
+    t_cap = leaf_cap if t_cap is None else t_cap
     slab_cap = max(
-        1, (1 << 28) // max(1, 3 * side * side * leaf_cap * leaf_cap)
+        1, (1 << 28) // max(1, 3 * side * side * leaf_cap * t_cap)
     )
     slab = min(slab, 1 << (slab_cap.bit_length() - 1))
     return max(1, 1 << (slab.bit_length() - 1))
@@ -537,21 +557,10 @@ def _fmm_core(
     )
 
     # ---- Near field in (cell, slot) layout ----
-    leaf_ids = (coords[:, 0] * side + coords[:, 1]) * side + coords[:, 2]
-    sort_order = jnp.argsort(leaf_ids)
+    (cells_pos, cells_mass, leaf_count, leaf_start, sort_order,
+     sorted_ids) = bin_to_cells(positions, masses, coords, side, leaf_cap)
     sorted_pos = positions[sort_order]
-    sorted_mass = masses[sort_order]
     n_leaves = side**3
-    leaf_count = jax.ops.segment_sum(
-        jnp.ones((n,), jnp.int32), leaf_ids, num_segments=n_leaves
-    )
-    leaf_start = jnp.concatenate(
-        [jnp.zeros((1,), jnp.int32), jnp.cumsum(leaf_count)[:-1]]
-    )
-    cells_pos, cells_mass = build_padded_cells(
-        sorted_pos, sorted_mass, leaf_ids[sort_order], leaf_start, n_leaves,
-        leaf_cap,
-    )
     near_cell = _near_field_shifted(
         cells_pos, cells_mass, leaf_count, levels[depth][0],
         levels[depth][1], m_scale, origin, span, side, leaf_cap, ws,
@@ -573,7 +582,6 @@ def _fmm_core(
         )
 
     # ---- Per-particle evaluation (the one gather: N leaf lookups) ----
-    sorted_ids = leaf_ids[sort_order]
     slot = jnp.arange(n, dtype=jnp.int32) - leaf_start[sorted_ids]
     over_t = slot >= leaf_cap
     near_sorted = near_cell[sorted_ids, jnp.minimum(slot, leaf_cap - 1)]
@@ -581,79 +589,175 @@ def _fmm_core(
     # Overflow TARGETS (slot >= cap) have no row in the (cell, slot)
     # layout — the clamped gather above would silently hand them another
     # particle's near field. They instead get the full 7^3 neighborhood
-    # as softened cell monopoles evaluated at their OWN position: the
-    # near 3^3 with cell-size softening (the same bounded resolution-
-    # limited degradation the source-side overflow contract uses; the
-    # own-cell self term is bounded by that softening too), the
-    # interaction-list cells with the run's eps. Gated on any-overflow:
-    # well-sized runs (recommended_depth_data) never pay the per-
-    # particle gathers in this branch.
-    def overflow_target_near(_):
-        coords_s = coords[sort_order]  # (N, 3) leaf coords, sorted order
-        offsets = jnp.asarray(_offsets(ws), jnp.int32)
-        pmask_t = jnp.asarray(_parity_mask_table(ws))
-        parity = (
-            ((coords_s[:, 0] & 1) << 2)
-            | ((coords_s[:, 1] & 1) << 1)
-            | (coords_s[:, 2] & 1)
-        )
-        cmass_l = levels[depth][0]
-        ccom_l = levels[depth][1]
-        eps_over = jnp.maximum(
-            jnp.asarray(eps, dtype), 0.5 * span / side
-        )
+    # as softened cell monopoles evaluated at their OWN position (see
+    # _monopole_neighborhood). Gated on any-overflow: well-sized runs
+    # (recommended_depth_data) never pay the per-particle gathers in
+    # this branch.
+    near_sorted = jax.lax.cond(
+        jnp.any(over_t),
+        lambda _: jnp.where(
+            over_t[:, None],
+            _monopole_neighborhood(
+                sorted_pos, coords[sort_order], levels[depth][0],
+                levels[depth][1], side, span, ws, g, eps, dtype,
+            ),
+            near_sorted,
+        ),
+        lambda _: near_sorted,
+        operand=None,
+    )
 
-        def body(acc, xs):
+    far_sorted = _eval_far(
+        sorted_ids, sorted_pos, f_loc, j_loc, a_loc, t_loc, origin,
+        span, side, order, dtype,
+    )
+
+    acc_sorted = far_sorted + near_sorted
+    # Scatter back to the caller's particle order.
+    inv = jnp.zeros((n,), jnp.int32).at[sort_order].set(
+        jnp.arange(n, dtype=jnp.int32)
+    )
+    return acc_sorted[inv]
+
+
+def _monopole_neighborhood(
+    eval_pos, eval_coords, cmass_l, ccom_l, side, span, ws, g, eps, dtype
+):
+    """Full 7^3 neighborhood of each eval point's leaf as softened cell
+    monopoles at the point's OWN position: the near 3^3 with cell-size
+    softening (the same bounded resolution-limited degradation the
+    source-side overflow contract uses; the own-cell self term is
+    bounded by that softening too), the interaction-list cells with the
+    run's eps. Covers the finest interaction list too, so the result
+    REPLACES the whole (cell, slot) near+finest sum for its targets.
+    Per-point gathers — only ever run for the overflow minority."""
+    m = eval_pos.shape[0]
+    offsets = jnp.asarray(_offsets(ws), jnp.int32)
+    pmask_t = jnp.asarray(_parity_mask_table(ws))
+    parity = (
+        ((eval_coords[:, 0] & 1) << 2)
+        | ((eval_coords[:, 1] & 1) << 1)
+        | (eval_coords[:, 2] & 1)
+    )
+    eps_over = jnp.maximum(jnp.asarray(eps, dtype), 0.5 * span / side)
+
+    def body(acc, xs):
+        off, pm_row = xs
+        cell = eval_coords + off[None, :]
+        in_b = jnp.all(
+            jnp.logical_and(cell >= 0, cell < side), axis=-1
+        )
+        ids = (
+            jnp.clip(cell[:, 0], 0, side - 1) * side
+            + jnp.clip(cell[:, 1], 0, side - 1)
+        ) * side + jnp.clip(cell[:, 2], 0, side - 1)
+        is_near = jnp.max(jnp.abs(off)) <= ws
+        ok = jnp.logical_and(
+            in_b,
+            jnp.logical_or(is_near, pm_row[parity]),
+        )
+        sm = cmass_l[ids]
+        ok = jnp.logical_and(ok, sm > 0)
+        diff = jnp.where(
+            ok[:, None],
+            ccom_l[ids] - eval_pos,
+            jnp.asarray(0.0, dtype),
+        )
+        eps_here = jnp.where(
+            is_near, eps_over, jnp.asarray(eps, dtype)
+        )
+        r2 = jnp.sum(diff * diff, axis=-1) + eps_here * eps_here
+        inv_r = jax.lax.rsqrt(r2)
+        w = jnp.where(
+            ok,
+            ((jnp.asarray(g, dtype) * sm) * inv_r) * inv_r * inv_r,
+            jnp.asarray(0.0, dtype),
+        )
+        return acc + w[:, None] * diff, None
+
+    mono, _ = jax.lax.scan(
+        body, jnp.zeros((m, 3), dtype), (offsets, pmask_t.T)
+    )
+    return mono
+
+
+def _monopole_all_levels(
+    eval_pos, eval_coords, levels, depth, side, span, ws, g, eps, dtype
+):
+    """COMPLETE per-point monopole evaluation at the point's own
+    position: the leaf-level 7^3 neighborhood (_monopole_neighborhood,
+    covering near + finest interaction list) plus every coarse
+    ancestor's parity-masked interaction list, all at REAL distances —
+    the fallback that replaces the whole far + near sum for targets the
+    (cell, slot) layout cannot serve (slot overflow, and out-of-cube
+    targets whose clipped-edge Taylor expansion would diverge). The
+    union of the per-level interaction sets tiles every cell exactly
+    once (the same telescoping as the main decomposition), so no mass
+    is dropped or double-counted; accuracy is the tree far="direct"
+    class (~1% median). Per-point gathers — only ever run for the
+    fallback minority."""
+    acc = _monopole_neighborhood(
+        eval_pos, eval_coords, levels[depth][0], levels[depth][1],
+        side, span, ws, g, eps, dtype,
+    )
+    offsets = jnp.asarray(_offsets(ws), jnp.int32)
+    pmask_t = jnp.asarray(_parity_mask_table(ws))
+    for d in range(2, depth):
+        kk = depth - d
+        sd = 1 << d
+        cd = eval_coords >> kk  # ancestor coords (clipped edge for
+        # out-of-cube points: their list is the edge cell's, with real
+        # distances to each COM)
+        parity = (
+            ((cd[:, 0] & 1) << 2) | ((cd[:, 1] & 1) << 1) | (cd[:, 2] & 1)
+        )
+        cmass_l = levels[d][0]
+        ccom_l = levels[d][1]
+
+        def body(acc_c, xs, cd=cd, parity=parity, cmass_l=cmass_l,
+                 ccom_l=ccom_l, sd=sd):
             off, pm_row = xs
-            cell = coords_s + off[None, :]
+            cell = cd + off[None, :]
             in_b = jnp.all(
-                jnp.logical_and(cell >= 0, cell < side), axis=-1
+                jnp.logical_and(cell >= 0, cell < sd), axis=-1
             )
             ids = (
-                jnp.clip(cell[:, 0], 0, side - 1) * side
-                + jnp.clip(cell[:, 1], 0, side - 1)
-            ) * side + jnp.clip(cell[:, 2], 0, side - 1)
-            is_near = jnp.max(jnp.abs(off)) <= ws
-            ok = jnp.logical_and(
-                in_b,
-                jnp.logical_or(is_near, pm_row[parity]),
-            )
+                jnp.clip(cell[:, 0], 0, sd - 1) * sd
+                + jnp.clip(cell[:, 1], 0, sd - 1)
+            ) * sd + jnp.clip(cell[:, 2], 0, sd - 1)
             sm = cmass_l[ids]
-            ok = jnp.logical_and(ok, sm > 0)
+            ok = jnp.logical_and(
+                jnp.logical_and(in_b, pm_row[parity]), sm > 0
+            )
             diff = jnp.where(
                 ok[:, None],
-                ccom_l[ids] - sorted_pos,
+                ccom_l[ids] - eval_pos,
                 jnp.asarray(0.0, dtype),
             )
-            eps_here = jnp.where(
-                is_near, eps_over, jnp.asarray(eps, dtype)
+            r2 = jnp.sum(diff * diff, axis=-1) + jnp.asarray(
+                eps * eps, dtype
             )
-            r2 = jnp.sum(diff * diff, axis=-1) + eps_here * eps_here
-            inv_r = jax.lax.rsqrt(r2)
+            safe = jnp.where(ok, r2, jnp.asarray(1.0, dtype))
+            inv_r = jax.lax.rsqrt(safe)
             w = jnp.where(
                 ok,
                 ((jnp.asarray(g, dtype) * sm) * inv_r) * inv_r * inv_r,
                 jnp.asarray(0.0, dtype),
             )
-            return acc + w[:, None] * diff, None
+            return acc_c + w[:, None] * diff, None
 
-        mono, _ = jax.lax.scan(
-            body,
-            jnp.zeros((n, 3), dtype),
-            (offsets, pmask_t.T),
-        )
-        # The monopole pass covers the finest interaction list too, so
-        # it REPLACES the whole (cell, slot) near+finest sum for these
-        # targets.
-        return jnp.where(over_t[:, None], mono, near_sorted)
+        acc, _ = jax.lax.scan(body, acc, (offsets, pmask_t.T))
+    return acc
 
-    near_sorted = jax.lax.cond(
-        jnp.any(over_t),
-        overflow_target_near,
-        lambda _: near_sorted,
-        operand=None,
-    )
 
+def _eval_far(
+    sorted_ids, sorted_pos, f_loc, j_loc, a_loc, t_loc, origin, span,
+    side, order, dtype,
+):
+    """Taylor-evaluate the per-leaf local expansions at the (sorted)
+    eval positions: acc = F + J.dx (+ the order-2 Hessian term) — one
+    9-float (plus 13 at order 2) gather per point."""
+    n_leaves = side**3
     h_leaf = span / side
     f_flat = f_loc.reshape(n_leaves, 3)
     j_flat = j_loc.reshape(n_leaves, 6)
@@ -713,11 +817,122 @@ def _fmm_core(
             - 1.5 * dx2[:, None] * aa
             + 7.5 * tdd
         )
+    return far_sorted
 
-    acc_sorted = far_sorted + near_sorted
-    # Scatter back to the caller's particle order.
-    inv = jnp.zeros((n,), jnp.int32).at[sort_order].set(
-        jnp.arange(n, dtype=jnp.int32)
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "depth", "leaf_cap", "t_cap", "ws", "g", "cutoff", "eps",
+        "slab", "order", "quad",
+    ),
+)
+def fmm_accelerations_vs(
+    targets: jax.Array,
+    positions: jax.Array,
+    masses: jax.Array,
+    *,
+    depth: int = 6,
+    leaf_cap: int = 32,
+    t_cap: int = 0,
+    ws: int = 1,
+    g: float = G,
+    cutoff: float = CUTOFF_RADIUS,
+    eps: float = 0.0,
+    slab: int = 4,
+    order: int = 2,
+    quad: bool = True,
+) -> jax.Array:
+    """Dense-grid FMM accelerations at ``targets`` (K, 3) from sources
+    (positions, masses) — the rectangular form every fast solver needs
+    to compose with multirate/sharded evaluation (the LocalKernel
+    contract of simulation.make_local_kernel; cf. tree_accelerations_vs).
+
+    Same decomposition as :func:`fmm_accelerations`, with the targets
+    given their OWN (cell, slot) binning on the source grid: the source
+    octree, coarse leaf expansions, source cell blocks, and overflow
+    remainders are identical; the near + finest shifted-slice passes
+    read target positions from the target binning (``t_cap`` slots per
+    cell, default = ``leaf_cap``) against the same shifted source
+    blocks. Targets the (cell, slot) layout cannot serve — slot
+    overflow beyond ``t_cap``, or targets OUTSIDE the source cube
+    (clipped into edge cells by ``grid_coords``, where the edge leaf's
+    Taylor expansion would be evaluated far from its center and
+    diverge) — are instead evaluated with the complete per-level
+    monopole hierarchy at their own position (:func:`_monopole_all_
+    levels`: real distances, every cell covered exactly once, tree-
+    class ~1% accuracy). Targets that coincide with sources (a target
+    subset of the source set: the multirate fast rung) see exactly
+    zero self-force through the zero difference vector, matching
+    ops/forces.accelerations_vs.
+    """
+    t_cap = t_cap or leaf_cap
+    side = 1 << depth
+    k = targets.shape[0]
+    dtype = positions.dtype
+    levels, origin, span, coords = build_octree(
+        positions, masses, depth, quad=quad
+    )
+    m_scale = jnp.maximum(jnp.max(masses), jnp.asarray(1e-37, dtype))
+    slab_c = _clamp_slab(slab, depth, leaf_cap, t_cap)
+
+    f_loc, j_loc, a_loc, t_loc = _coarse_leaf_expansions(
+        levels, origin, span, depth, ws, g, eps, dtype, order=order,
+        m_scale=m_scale,
+    )
+
+    # Source cell blocks (the same binning as _fmm_core), then the
+    # targets binned on the same grid with their own slot cap.
+    cells_pos, cells_mass, leaf_count, _, _, _ = bin_to_cells(
+        positions, masses, coords, side, leaf_cap
+    )
+    t_coords = grid_coords(targets, origin, span, side)
+    tcells_pos, _, _, t_start, t_sort, t_sorted_ids = bin_to_cells(
+        targets, jnp.ones((k,), dtype), t_coords, side, t_cap
+    )
+    t_sorted_pos = targets[t_sort]
+
+    near_cell = _near_field_shifted(
+        cells_pos, cells_mass, leaf_count, levels[depth][0],
+        levels[depth][1], m_scale, origin, span, side, leaf_cap, ws,
+        g, cutoff, eps, slab_c, dtype, tcells_pos=tcells_pos,
+        t_cap=t_cap,
+    )
+    near_cell = near_cell + _finest_exact_shifted(
+        tcells_pos, levels[depth][0], levels[depth][1], origin, span,
+        side, t_cap, ws, g, eps, slab_c, dtype,
+        cquad_l=levels[depth][2] if quad else None, m_scale=m_scale,
+    )
+
+    slot = jnp.arange(k, dtype=jnp.int32) - t_start[t_sorted_ids]
+    in_cube = jnp.all(
+        jnp.logical_and(
+            t_sorted_pos >= origin, t_sorted_pos <= origin + span
+        ),
+        axis=1,
+    )
+    fallback = jnp.logical_or(slot >= t_cap, jnp.logical_not(in_cube))
+    near_sorted = near_cell[t_sorted_ids, jnp.minimum(slot, t_cap - 1)]
+    far_sorted = _eval_far(
+        t_sorted_ids, t_sorted_pos, f_loc, j_loc, a_loc, t_loc,
+        origin, span, side, order, dtype,
+    )
+
+    acc_sorted = jax.lax.cond(
+        jnp.any(fallback),
+        lambda a: jnp.where(
+            fallback[:, None],
+            _monopole_all_levels(
+                t_sorted_pos, t_coords[t_sort], levels, depth, side,
+                span, ws, g, eps, dtype,
+            ),
+            a,
+        ),
+        lambda a: a,
+        far_sorted + near_sorted,
+    )
+    inv = jnp.zeros((k,), jnp.int32).at[t_sort].set(
+        jnp.arange(k, dtype=jnp.int32)
     )
     return acc_sorted[inv]
 

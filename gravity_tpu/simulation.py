@@ -43,15 +43,59 @@ def resolve_dtype(name: str):
     return _DTYPES[name]
 
 
-# Direct-sum/tree crossover for backend='auto' (see docs/scaling.md).
-# TPU: MEASURED on a v5e (benchmarks/crossover.py, 2026-07-31): the
-# Pallas O(N^2) kernel sustains ~1.8e11 pairs/s/chip, and the gather-
-# bound tree never catches it up to 1M (tree/direct time ratio 80x at
-# 65k, 6.6x at 1M, halving per doubling of N) — extrapolating the
-# measured slope puts the crossover at ~8M bodies. CPU: measured with
-# the native FFI kernel, the tree wins from ~32k (BASELINE.md).
+# Direct-sum/fast-solver crossover for backend='auto' (docs/scaling.md).
+# TPU: the gather-bound tree was MEASURED on a v5e never to catch the
+# Pallas direct sum up to 1M (time ratio 80x at 65k, 6.6x at 1M,
+# halving per doubling of N -> tree crossover ~8M;
+# benchmarks/crossover.py, 2026-07-31). The dense-grid FMM removes the
+# gathers; its cost model (27 x S^3 x cap^2 near-field pair ops + 343
+# shifted-slice cell passes, ~10x fewer ops than direct at 1M and all
+# of them dense VPU/MXU work) puts its crossover near ~512k — a
+# PROVISIONAL constant until benchmarks/crossover.py runs its
+# three-way sweep on a live chip and records the measurement in
+# CROSSOVER_TPU.json, which overrides this default (see
+# _measured_fast_crossover). CPU: measured with the native FFI kernel,
+# the tree wins from ~32k (BASELINE.md).
+FMM_CROSSOVER_TPU = 524_288
 TREE_CROSSOVER_TPU = 8_388_608
 TREE_CROSSOVER_CPU = 32_768
+_CROSSOVER_FILE = "CROSSOVER_TPU.json"
+_crossover_cache: dict = {}
+
+
+def _measured_fast_crossover(on_tpu: bool) -> tuple[int, str]:
+    """(N, backend): above N, backend='auto' routes to this fast solver.
+
+    On TPU, prefers the chip measurement benchmarks/crossover.py writes
+    to CROSSOVER_TPU.json (repo root) over the cost-model default — the
+    router's contract is "provably picks the measured-fastest backend",
+    so a measurement always wins over a model. The file's
+    ``winning_backend`` is honored too: a sweep where only the TREE
+    beat direct must not route to fmm in the very regime fmm was
+    measured to lose (review finding)."""
+    if not on_tpu:
+        return TREE_CROSSOVER_CPU, "tree"
+    if "tpu" not in _crossover_cache:
+        import json as _json
+        import os as _os
+
+        value, backend = FMM_CROSSOVER_TPU, "fmm"
+        path = _os.path.join(
+            _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))),
+            _CROSSOVER_FILE,
+        )
+        try:
+            with open(path) as f:
+                data = _json.load(f)
+            value = int(data["fast_crossover"])
+            if data.get("winning_backend") in ("tree", "fmm"):
+                backend = data["winning_backend"]
+        except (OSError, KeyError, ValueError, TypeError):
+            pass
+        _crossover_cache["tpu"] = (value, backend)
+    return _crossover_cache["tpu"]
+
+
 # Forcing O(N^2) here means >=2.7e11 pairs/step — minutes/step on CPU,
 # multiple seconds/step on one chip. Probably a mistake; warn.
 DIRECT_SUM_WARN_N = 524_288
@@ -130,25 +174,20 @@ def _resolve_backend(config: SimulationConfig, on_tpu=None) -> str:
         return _resolve_direct(config, on_tpu)
     # auto: above the measured crossover a fast solver wins over any
     # direct sum — unless the ring strategy is requested (see above).
-    crossover = TREE_CROSSOVER_TPU if on_tpu else TREE_CROSSOVER_CPU
+    # On TPU the default winner is the dense-grid FMM (the gather-free
+    # reorganization of the tree, which the chip measured 6.6x slower
+    # than even the direct sum at 1M — docs/scaling.md); sharded runs
+    # use the slab-decomposed make_sharded_fmm_accel, multirate fast
+    # kicks the rectangular fmm_accelerations_vs. A recorded chip sweep
+    # (CROSSOVER_TPU.json) overrides both the threshold and the winner.
+    crossover, fast_backend = _measured_fast_crossover(on_tpu)
     if config.n >= crossover and config.sharding != "ring":
-        if (
-            on_tpu
-            and config.sharding == "none"
-            and config.integrator != "multirate"
-        ):
-            # On the chip the gather-bound tree measured 6.6x slower
-            # than even the direct sum at 1M (docs/scaling.md); the
-            # dense-grid FMM is its gather-free reorganization at the
-            # same accuracy class. Single-host only (no vs-form), and
-            # multirate needs the tree's rectangular kernels.
-            return "fmm"
-        return "tree"
+        return fast_backend
     return _resolve_direct(config, on_tpu)
 
 
 def make_local_kernel(config: SimulationConfig, backend: str,
-                      positions=None):
+                      positions=None, k_targets=None):
     """LocalKernel (pos_targets, pos_sources, m_sources) -> acc for the
     resolved backend.
 
@@ -163,6 +202,15 @@ def make_local_kernel(config: SimulationConfig, backend: str,
     count occupied leaves instead of assuming uniform 3D occupancy —
     pass the initial state whenever it exists (disks/halos are lower-
     dimensional and the count-only estimate under-resolves them badly).
+
+    ``k_targets`` (optional) declares that callers will pass ~K targets
+    per call (the multirate fast rung). The shifted-slice backends'
+    rectangular cost scales with their static target-slot cap, NOT with
+    K, so without the hint a K-target kick would cost a full force
+    evaluation; with it, fmm sizes t_cap to the expected per-cell
+    target occupancy (4x headroom for clustering), and a K small enough
+    for the dense (K, N) kick budget short-circuits to the exact dense
+    kernel (review finding).
     """
     common = dict(g=config.g, cutoff=config.cutoff, eps=config.eps)
     if backend in ("dense", "chunked"):
@@ -210,6 +258,31 @@ def make_local_kernel(config: SimulationConfig, backend: str,
             leaf_cap=config.tree_leaf_cap, ws=config.tree_ws,
             far=config.tree_far, chunk=config.fast_chunk, **common,
         )
+    if backend == "fmm":
+        from .ops.fmm import fmm_accelerations_vs
+        from .ops.tree import recommended_depth, recommended_depth_data
+
+        if k_targets is not None and k_targets * config.n <= (1 << 25):
+            # Tiny target sets: the exact dense (K, N) kick is cheaper
+            # than any grid pass and has zero approximation error.
+            return partial(accelerations_vs, **common)
+        depth = config.tree_depth or (
+            recommended_depth_data(positions, config.tree_leaf_cap)
+            if positions is not None
+            else recommended_depth(config.n, config.tree_leaf_cap)
+        )
+        t_cap = 0
+        if k_targets is not None:
+            t_cap = min(
+                config.tree_leaf_cap,
+                max(4, -(-4 * config.tree_leaf_cap * k_targets
+                         // max(1, config.n))),
+            )
+        return partial(
+            fmm_accelerations_vs, depth=depth,
+            leaf_cap=config.tree_leaf_cap, ws=config.tree_ws,
+            t_cap=t_cap, **common,
+        )
     if backend == "pm":
         if config.periodic_box > 0.0:
             from .ops.periodic import pm_periodic_accelerations_vs
@@ -236,11 +309,22 @@ def make_local_kernel(config: SimulationConfig, backend: str,
         )
         if note:
             warnings.warn(note, stacklevel=2)
+        t_cap = 0
+        if k_targets is not None:
+            # Slice-mode rectangular cost scales with the target cap;
+            # size it to the expected K-target cell occupancy (4x
+            # clustering headroom) instead of the full cap.
+            t_cap = min(
+                config.p3m_cap,
+                max(4, -(-4 * config.p3m_cap * k_targets
+                         // max(1, config.n))),
+            )
         return partial(
             p3m_accelerations_vs, grid=config.pm_grid,
             sigma_cells=config.p3m_sigma_cells,
             rcut_sigmas=config.p3m_rcut_sigmas,
-            cap=config.p3m_cap, chunk=config.fast_chunk, **common,
+            cap=config.p3m_cap, chunk=config.fast_chunk,
+            short_mode=config.p3m_short, t_cap=t_cap, **common,
         )
     raise ValueError(f"unknown force backend {backend!r}")
 
@@ -326,9 +410,10 @@ class Simulator:
         self._accel_setup = None
         self._accel2_aux = None
         if self.mesh is not None and self.backend == "fmm":
-            # fmm has no targets-vs-sources form; its sharded mode
-            # splits the dominant slab passes over the mesh instead
-            # (replicated build, one (cells, cap, 3) all_gather).
+            # Sharded fmm splits the dominant slab passes over the mesh
+            # (replicated build, one (cells, cap, 3) all_gather) — work
+            # scales 1/P without the per-device target re-binning the
+            # rectangular fmm_accelerations_vs path would need.
             from .ops.fmm import make_sharded_fmm_accel
             from .ops.tree import recommended_depth_data
 
@@ -391,30 +476,16 @@ class Simulator:
                     "multirate_rungs must be in [2, 6]; got "
                     f"{config.multirate_rungs}"
                 )
-            # fmm has no targets-vs-sources form; the (K, N) fast kicks
-            # use the exact dense rectangular kernel while the once-per-
-            # outer-step full evaluation stays on the backend. That is
-            # only sane for explicitly small K: the dense kick builds a
-            # (K, N, 3) buffer, and the auto default K = n//8 at fmm's
-            # million-body scale would be a ~1.5 TB allocation.
-            if self.backend == "fmm":
-                k_req = config.multirate_k
-                if k_req <= 0:
-                    raise ValueError(
-                        "force_backend 'fmm' + multirate needs an explicit "
-                        "(small) --multirate-k: the fast kicks use a dense "
-                        "(K, N) kernel and the auto default K = n//8 does "
-                        "not scale to fmm's target sizes"
-                    )
-                if k_req * self.state.n > (1 << 25):
-                    raise ValueError(
-                        f"multirate_k={k_req} x n={self.state.n} exceeds "
-                        f"the dense fast-kick budget (2^25 pair entries); "
-                        "lower k or use force_backend 'tree'"
-                    )
+            # Every backend (incl. fmm since its rectangular
+            # fmm_accelerations_vs form landed) provides the (K, N)
+            # LocalKernel the fast kicks need. The K hint lets the
+            # shifted-slice backends size their static target caps to
+            # the actual fast-rung occupancy instead of paying a
+            # full-evaluation near-field pass per sub-kick.
+            k_mr, _ = self._multirate_plan()
             base_kernel = make_local_kernel(
-                config, "dense" if self.backend == "fmm" else self.backend,
-                positions=self.state.positions,
+                config, self.backend, positions=self.state.positions,
+                k_targets=k_mr,
             )
             if self.mesh is not None:
                 # Sharded fast rung: replicated K-target rectangular
@@ -525,13 +596,14 @@ class Simulator:
                 sigma_cells=config.p3m_sigma_cells,
                 rcut_sigmas=config.p3m_rcut_sigmas,
                 cap=config.p3m_cap, chunk=config.fast_chunk, khat=khat,
-                **common,
+                short_mode=config.p3m_short, **common,
             )
             return lambda pos, m: p3m_accelerations(
                 pos, m, grid=config.pm_grid,
                 sigma_cells=config.p3m_sigma_cells,
                 rcut_sigmas=config.p3m_rcut_sigmas,
-                cap=config.p3m_cap, chunk=config.fast_chunk, **common,
+                cap=config.p3m_cap, chunk=config.fast_chunk,
+                short_mode=config.p3m_short, **common,
             )
         raise ValueError(self.backend)
 

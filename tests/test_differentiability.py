@@ -154,3 +154,63 @@ def test_grad_through_block_timestep_schemes(key, x64):
         fd = (loss(v0 + eps * e) - loss(v0 - eps * e)) / (2 * eps)
         np.testing.assert_allclose(float(g[3, 1]), float(fd), rtol=1e-5,
                                    err_msg=name)
+
+
+def test_fmm_rollout_grad_matches_finite_difference(key, x64):
+    """jax.grad flows through the dense-grid FMM's full pipeline —
+    octree segment_sums, argsort/scatter cell binning, shifted-slice
+    scans, the overflow lax.cond, and the Taylor evaluation — and a
+    rollout gradient matches central finite differences (VERDICT r3
+    item 9: the fast solver most likely to break autodiff).
+
+    Caveat pinned here: the cell ASSIGNMENT is piecewise-constant in
+    positions, so the loss is differentiable almost everywhere; a fixed
+    seed keeps every particle away from cell boundaries at the FD step
+    scale."""
+    from gravity_tpu.models import create_disk
+    from gravity_tpu.ops.fmm import fmm_accelerations
+
+    n = 256
+    state = create_disk(key, n, dtype=jnp.float64)
+    masses = state.masses
+
+    def accel(p):
+        return fmm_accelerations(
+            p, masses, depth=3, g=1.0, eps=0.05, leaf_cap=32
+        )
+
+    step = make_step_fn("leapfrog", accel, 2e-3)
+
+    @jax.jit
+    def loss(scale):
+        st = _rollout(
+            step, accel,
+            ParticleState(state.positions, state.velocities * scale,
+                          masses),
+            5,
+        )
+        return jnp.sum(st.positions**2)
+
+    g = jax.grad(loss)(1.0)
+    assert bool(jnp.isfinite(g))
+    h = 1e-6
+    fd = (loss(1.0 + h) - loss(1.0 - h)) / (2 * h)
+    # The FD probe shifts every position, so a handful of particles can
+    # cross cell boundaries and re-bin; the envelope is looser than the
+    # dense kernels' 5e-4 but still pins gradient correctness.
+    np.testing.assert_allclose(float(g), float(fd), rtol=5e-3)
+
+    # And through the rectangular form (the multirate fast-kick path).
+    from gravity_tpu.ops.fmm import fmm_accelerations_vs
+
+    def loss_vs(scale):
+        tgt = state.positions[:32] * scale
+        a = fmm_accelerations_vs(
+            tgt, state.positions, masses, depth=3, g=1.0, eps=0.05
+        )
+        return jnp.sum(a * a) * 1e-4
+
+    g2 = jax.grad(loss_vs)(1.0)
+    assert bool(jnp.isfinite(g2))
+    fd2 = (loss_vs(1.0 + h) - loss_vs(1.0 - h)) / (2 * h)
+    np.testing.assert_allclose(float(g2), float(fd2), rtol=5e-3)

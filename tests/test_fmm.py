@@ -160,10 +160,10 @@ def test_fmm_overflow_targets_feel_neighbors(key):
 
 
 def test_fmm_composes_with_multirate(key):
-    """fmm supplies the once-per-outer-step full evaluation while the
-    (K, N) fast kicks use the exact dense rectangular kernel — the
-    composition must run and stay close to the plain-leapfrog fmm
-    trajectory over a few steps."""
+    """fmm supplies the once-per-outer-step full evaluation AND the
+    (K, N) fast kicks (rectangular fmm_accelerations_vs, VERDICT r3
+    item 5) — the composition must run and stay close to the
+    plain-leapfrog fmm trajectory over a few steps."""
     from gravity_tpu.config import SimulationConfig
     from gravity_tpu.simulation import Simulator
 
@@ -209,6 +209,134 @@ def test_fmm_overflow_at_astronomical_masses(key):
         assert np.median(rel) < bound, (depth, float(np.median(rel)))
 
 
+def test_fmm_vs_equals_self_on_same_points(key):
+    """fmm_accelerations_vs(targets=sources) == fmm_accelerations to
+    float roundoff: the target binning reproduces the source binning
+    (same grid, same stable argsort keys), so every pass sees identical
+    operands. Pins the rectangular form to the validated self form.
+
+    Uses an overflow-free geometry (uniform cloud, occupancy << cap):
+    for slot-OVERFLOW targets the two entry points intentionally
+    differ — the self form keeps its Taylor far field + monopole near
+    fallback, the rectangular form replaces the whole sum with the
+    all-levels monopole hierarchy (which also serves out-of-cube
+    targets) — and that envelope is pinned by the overflow/external
+    tests below."""
+    from gravity_tpu.ops.fmm import fmm_accelerations_vs
+
+    pos, m, eps, g = _make_model(key, 2048, "uniform")
+    a_self = fmm_accelerations(pos, m, depth=4, g=g, eps=eps)
+    a_vs = fmm_accelerations_vs(pos, pos, m, depth=4, g=g, eps=eps)
+    np.testing.assert_allclose(
+        np.asarray(a_vs), np.asarray(a_self), rtol=1e-5,
+        atol=float(jnp.max(jnp.abs(a_self))) * 1e-6,
+    )
+
+
+@pytest.mark.parametrize("model", ["uniform", "disk"])
+def test_fmm_vs_accuracy_at_arbitrary_targets(key, model):
+    """The rectangular evaluation holds the documented accuracy envelope
+    at targets that are NOT sources (probe points scattered through the
+    source cloud) — the shape the multirate fast rung and sharded
+    target-slice evaluation consume."""
+    from gravity_tpu.ops.forces import accelerations_vs
+    from gravity_tpu.ops.fmm import fmm_accelerations_vs
+
+    n = 2048
+    pos, m, eps, g = _make_model(key, n, model)
+    # Probe targets: jittered copies of a source subset — inside the
+    # cube, off the exact source points.
+    span = jnp.max(pos, axis=0) - jnp.min(pos, axis=0)
+    tgt = pos[:512] + 0.01 * span * jax.random.normal(
+        jax.random.fold_in(key, 7), (512, 3), jnp.float32
+    )
+    exact = accelerations_vs(tgt, pos, m, g=g, eps=eps)
+    out = fmm_accelerations_vs(tgt, pos, m, depth=5, g=g, eps=eps)
+    rel = _rel_err(out, exact)
+    assert np.median(rel) < 0.008, f"median {np.median(rel):.4f}"
+    assert np.percentile(rel, 90) < 0.03, (
+        f"p90 {np.percentile(rel, 90):.4f}"
+    )
+
+
+def test_fmm_vs_subset_targets_match_dense_rect(key):
+    """Targets = a subset of the sources (the multirate fast-rung call
+    shape): the rectangular fmm matches the dense rectangular kick it
+    replaced, within the fmm envelope — and feels zero self-force."""
+    from gravity_tpu.ops.forces import accelerations_vs
+    from gravity_tpu.ops.fmm import fmm_accelerations_vs
+
+    state = create_disk(key, 2048)
+    tgt = state.positions[::8]  # every 8th particle, 256 targets
+    exact = accelerations_vs(
+        tgt, state.positions, state.masses, g=1.0, eps=0.05
+    )
+    out = fmm_accelerations_vs(
+        tgt, state.positions, state.masses, depth=5, g=1.0, eps=0.05
+    )
+    rel = _rel_err(out, exact)
+    assert np.median(rel) < 0.008, f"median {np.median(rel):.4f}"
+
+
+def test_fmm_vs_target_overflow_fallback(key):
+    """More targets in one cell than t_cap: the overflow targets take
+    the softened monopole-neighborhood fallback — finite, and still
+    pointing at the dominant mass (same contract as the self-form
+    overflow-target test)."""
+    from gravity_tpu.ops.fmm import fmm_accelerations_vs
+
+    # Sources: one heavy body + light corner markers spanning the cube.
+    heavy = jnp.asarray([[4.5, 2.5, 2.5]], jnp.float32)
+    corners = jnp.asarray(
+        [[0.05, 0.05, 0.05], [7.95, 7.95, 7.95]], jnp.float32
+    )
+    pos = jnp.concatenate([heavy, corners])
+    m = jnp.asarray([1.0, 1e-6, 1e-6], jnp.float32)
+    # 24 probe targets crowded into the adjacent cell, t_cap=16.
+    tgt = jnp.asarray([2.5, 2.5, 2.5], jnp.float32) + 1e-3 * (
+        jax.random.normal(key, (24, 3), jnp.float32)
+    )
+    out = fmm_accelerations_vs(
+        tgt, pos, m, depth=3, leaf_cap=16, t_cap=16, g=1.0, eps=0.5
+    )
+    assert bool(jnp.all(jnp.isfinite(out)))
+    assert bool(jnp.all(out[:, 0] > 0))  # all pulled toward +x heavy
+
+
+def test_fmm_vs_external_targets(key):
+    """Targets OUTSIDE the source cube (field probes): the complete
+    monopole-hierarchy fallback evaluates at real distances — no Taylor
+    divergence from the clipped edge cell (review finding). A distant
+    probe sees the cloud as a monopole (nearly exact); just-outside
+    probes stay within the tree-class envelope."""
+    from gravity_tpu.ops.forces import accelerations_vs
+    from gravity_tpu.ops.fmm import fmm_accelerations_vs
+
+    state = create_disk(key, 2048)
+    pos, m = state.positions, state.masses
+    lo = jnp.min(pos, axis=0)
+    hi = jnp.max(pos, axis=0)
+    span = jnp.max(hi - lo)
+    center = 0.5 * (hi + lo)
+    tgt = jnp.stack(
+        [
+            center + jnp.asarray([10.0, 0.0, 0.0], jnp.float32) * span,
+            center + jnp.asarray([0.0, -3.0, 0.0], jnp.float32) * span,
+            hi + 0.02 * span,  # just outside the corner
+        ]
+    )
+    exact = accelerations_vs(tgt, pos, m, g=1.0, eps=0.05)
+    out = fmm_accelerations_vs(tgt, pos, m, depth=4, g=1.0, eps=0.05)
+    assert bool(jnp.all(jnp.isfinite(out)))
+    rel = _rel_err(out, exact)
+    # Distant probes: the whole cloud is far field -> sub-percent.
+    assert float(rel[0]) < 0.02, float(rel[0])
+    assert float(rel[1]) < 0.02, float(rel[1])
+    # Just outside: resolution-limited (cell-size softening) but sane —
+    # the pre-fix Taylor extrapolation was off by orders of magnitude.
+    assert float(rel[2]) < 0.5, float(rel[2])
+
+
 def test_sharded_fmm_matches_unsharded(key):
     """Slab-sharded fmm == single-host fmm to float roundoff on the
     8-device mesh (flat and hierarchical): replicated build, split
@@ -234,3 +362,45 @@ def test_sharded_fmm_matches_unsharded(key):
         )
         rel = _rel_err(out, ref)
         assert np.median(rel) < 1e-6, (shape, float(np.median(rel)))
+
+
+def test_sharded_fmm_realistic_occupancy_with_overflow(key):
+    """Slab-sharded fmm at REALISTIC scale (n=65,536 on the 8-device
+    mesh, ~8k particles/device) with leaf-cap overflow FORCED (cap=16 at
+    depth 4: mean occupancy 16/cell, the disk's center far denser) —
+    exercises slab divisibility, the overflow remainder monopoles, and
+    the overflow-target lax.cond branch under shard_map, none of which
+    the 2k-body smoke test reaches (VERDICT r3 item 7)."""
+    import numpy as np_
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from gravity_tpu.ops.fmm import make_sharded_fmm_accel
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device virtual mesh")
+    n = 65_536
+    state = create_disk(key, n)
+    kwargs = dict(depth=4, leaf_cap=16, g=1.0, eps=0.05)
+    ref = fmm_accelerations(state.positions, state.masses, **kwargs)
+    assert bool(jnp.all(jnp.isfinite(ref)))
+    mesh = Mesh(np_.array(jax.devices()).reshape(8), ("shard",))
+    fn = make_sharded_fmm_accel(mesh, **kwargs)
+    sh = NamedSharding(mesh, P("shard"))
+    out = fn(
+        jax.device_put(state.positions, sh),
+        jax.device_put(state.masses, sh),
+    )
+    rel = _rel_err(out, ref)
+    assert np.median(rel) < 1e-6, float(np.median(rel))
+    assert float(np.max(rel)) < 1e-4, float(np.max(rel))
+    # The config genuinely overflowed: the disk core must exceed cap.
+    from gravity_tpu.ops.cells import grid_coords
+
+    origin = jnp.min(state.positions, axis=0)
+    span = float(
+        jnp.max(jnp.max(state.positions, axis=0) - origin) * 1.0001
+    )
+    coords = grid_coords(state.positions, origin, span, 16)
+    ids = (coords[:, 0] * 16 + coords[:, 1]) * 16 + coords[:, 2]
+    counts = np.bincount(np.asarray(ids), minlength=16**3)
+    assert counts.max() > 16, "test geometry failed to overflow the cap"

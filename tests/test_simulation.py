@@ -405,24 +405,101 @@ def test_energy_routes_through_tree_above_threshold(monkeypatch):
 
 
 def test_auto_routes_fmm_on_tpu_above_crossover():
-    """On TPU, auto above the crossover picks the gather-free fmm for
-    single-host runs; tree remains the sharded and multirate choice."""
+    """On TPU, auto above the crossover picks the gather-free fmm —
+    single-host, sharded (slab decomposition), and multirate (the
+    rectangular fmm_accelerations_vs fast kicks) alike; only the ring
+    strategy (which cannot build a global grid) is excluded."""
     from gravity_tpu.config import SimulationConfig
-    from gravity_tpu.simulation import TREE_CROSSOVER_TPU, _resolve_backend
+    from gravity_tpu.simulation import (
+        _measured_fast_crossover,
+        _resolve_backend,
+    )
 
-    n = TREE_CROSSOVER_TPU
+    n, _backend = _measured_fast_crossover(True)
     assert _resolve_backend(
         SimulationConfig(n=n), on_tpu=True
     ) == "fmm"
     assert _resolve_backend(
         SimulationConfig(n=n, sharding="allgather"), on_tpu=True
-    ) == "tree"
+    ) == "fmm"
     assert _resolve_backend(
         SimulationConfig(n=n, integrator="multirate"), on_tpu=True
-    ) == "tree"
+    ) == "fmm"
+    assert _resolve_backend(
+        SimulationConfig(n=n, sharding="ring"), on_tpu=True
+    ) != "fmm"
     assert _resolve_backend(
         SimulationConfig(n=n - 1), on_tpu=True
     ) == "pallas"
+
+
+def test_multirate_fast_kick_kernel_sizes_to_k():
+    """The multirate fast-kick kernel is K-aware (review finding): a K
+    inside the dense budget short-circuits to the exact dense kernel;
+    a large K gets the rectangular fmm/p3m kernel with its static
+    target cap scaled to the expected K occupancy instead of paying a
+    full-evaluation grid pass per sub-kick."""
+    from gravity_tpu.ops.forces import accelerations_vs
+    from gravity_tpu.simulation import make_local_kernel
+
+    cfg = SimulationConfig(
+        n=1_048_576, force_backend="fmm", tree_depth=6
+    )
+    # 8 * 1M pair entries fit the 2^25 dense budget -> dense kernel.
+    k_small = make_local_kernel(cfg, "fmm", k_targets=8)
+    assert getattr(k_small, "func", None) is accelerations_vs
+    # 1024 targets at 1M sources -> fmm rect with t_cap ~ occupancy.
+    k_large = make_local_kernel(cfg, "fmm", k_targets=1024)
+    assert k_large.func.__name__ == "fmm_accelerations_vs"
+    assert k_large.keywords["t_cap"] == 4
+    # Full-set hint keeps the full cap.
+    k_full = make_local_kernel(cfg, "fmm", k_targets=cfg.n)
+    assert k_full.keywords["t_cap"] == cfg.tree_leaf_cap
+
+    cfg_p = SimulationConfig(
+        n=1_048_576, force_backend="p3m", pm_grid=256, p3m_cap=64
+    )
+    kp = make_local_kernel(cfg_p, "p3m", k_targets=1024)
+    assert kp.keywords["t_cap"] == 4
+
+
+def test_measured_crossover_file_overrides_default(tmp_path, monkeypatch):
+    """CROSSOVER_TPU.json (written by benchmarks/crossover.py on a live
+    chip) overrides the cost-model FMM_CROSSOVER_TPU default: a chip
+    measurement always beats the model."""
+    import json
+
+    from gravity_tpu import simulation as sim_mod
+
+    monkeypatch.setattr(sim_mod, "_crossover_cache", {})
+    fake_root = tmp_path / "repo"
+    fake_pkg = fake_root / "gravity_tpu"
+    fake_pkg.mkdir(parents=True)
+    (fake_root / "CROSSOVER_TPU.json").write_text(
+        json.dumps({"fast_crossover": 131_072, "winning_backend": "fmm"})
+    )
+    # Point the module's __file__-derived repo root at the tmp repo.
+    monkeypatch.setattr(
+        sim_mod, "__file__", str(fake_pkg / "simulation.py")
+    )
+    assert sim_mod._measured_fast_crossover(True) == (131_072, "fmm")
+    # Cached after first read.
+    (fake_root / "CROSSOVER_TPU.json").unlink()
+    assert sim_mod._measured_fast_crossover(True) == (131_072, "fmm")
+    # CPU path ignores the file entirely.
+    assert sim_mod._measured_fast_crossover(False) == (
+        sim_mod.TREE_CROSSOVER_CPU, "tree"
+    )
+    # A sweep where only the TREE beat direct routes to tree, not fmm
+    # (review finding: never route to a backend measured slower).
+    monkeypatch.setattr(sim_mod, "_crossover_cache", {})
+    (fake_root / "CROSSOVER_TPU.json").write_text(
+        json.dumps({"fast_crossover": 262_144, "winning_backend": "tree"})
+    )
+    assert sim_mod._measured_fast_crossover(True) == (262_144, "tree")
+    from gravity_tpu.config import SimulationConfig as _SC
+
+    assert sim_mod._resolve_backend(_SC(n=262_144), on_tpu=True) == "tree"
 
 
 def test_energy_routes_through_tree_for_fmm_backend(monkeypatch):
