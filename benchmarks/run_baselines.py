@@ -66,6 +66,23 @@ CONFIGS = {
              p3m_cap=64),
         dict(bench_steps=3),
     ),
+    "1m-p3m-gather": (
+        "1M-body Milky-Way disk, P3M with the gather short-range "
+        "(A/B against the default shifted-slice pass on TPU)",
+        dict(model="disk", n=1_048_576, g=1.0, dt=2.0e-3, eps=0.05,
+             integrator="leapfrog", force_backend="p3m", pm_grid=256,
+             p3m_cap=64, p3m_short="gather"),
+        dict(bench_steps=3),
+    ),
+    "1m-p3m-s2": (
+        "1M-body Milky-Way disk, P3M slice short-range at the "
+        "occupancy-matched sigma (sigma_cells=2.0: binning occupancy "
+        "~cap, so the dense slot layout wastes nothing)",
+        dict(model="disk", n=1_048_576, g=1.0, dt=2.0e-3, eps=0.05,
+             integrator="leapfrog", force_backend="p3m", pm_grid=256,
+             p3m_cap=64, p3m_sigma_cells=2.0, p3m_short="slice"),
+        dict(bench_steps=3),
+    ),
     "1m-fmm": (
         "1M-body Milky-Way disk, dense-grid FMM (gather-free)",
         dict(model="disk", n=1_048_576, g=1.0, dt=2.0e-3, eps=0.05,

@@ -58,6 +58,10 @@ class SimulationConfig:
     p3m_sigma_cells: float = 1.25  # Ewald split scale, in PM cells
     p3m_rcut_sigmas: float = 4.0  # short-range truncation, in sigmas
     p3m_cap: int = 128  # static per-cell source cap of the cell list
+    # Short-range data movement: "gather" (per-target cell-block
+    # gathers; CPU-friendly), "slice" (fmm-style shifted-slice pass,
+    # zero gather indices — the TPU path), "auto" = slice on TPU.
+    p3m_short: str = "auto"
     # Target-chunk size for the fast solvers' lax.map (bigger chunks =
     # fewer sequential trips; memory per chunk ~ chunk * 27 * cap * 16 B).
     fast_chunk: int = 4096

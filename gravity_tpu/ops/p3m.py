@@ -43,8 +43,9 @@ import jax.numpy as jnp
 from jax.scipy.special import erf
 
 from ..constants import CUTOFF_RADIUS, G
-from .cells import build_padded_cells, grid_coords, map_target_chunks
+from .cells import bin_to_cells, grid_coords, map_target_chunks
 from .pm import bounding_cube, cic_deposit, cic_gather
+from .tree import _near_offsets
 
 
 def check_p3m_sizing(
@@ -242,11 +243,175 @@ def _short_range_w(r2, u, eps2, alpha3, dtype):
     return newt + alpha3 * h_over_u2
 
 
+def _short_range_shifted(
+    tcells_pos, t_cap, cells_pos, cells_mass, cell_count, cmass_hat,
+    ccom, m_scale, span, side, cap, g, cutoff, eps, alpha, rcut, dtype,
+):
+    """Gather-free short-range pass: for each of the 27 neighbor offsets
+    the source block for EVERY cell is one shifted slice of the padded
+    (S^3, cap) grid — the fmm near-field data movement (ops/fmm.py,
+    whose gather-based predecessor the chip measured index-rate-bound)
+    with the Ewald erfc pair kernel. The per-SOURCE-cell overflow
+    remainder (mass beyond the padded prefix) is computed once globally
+    and added as a cell-size-softened monopole through the same
+    short-range kernel. Returns (S^3, t_cap, 3) accelerations in
+    (cell, slot) layout.
+
+    Efficiency note (docs/scaling.md): the dense (cell, slot) layout
+    pays for empty slots, so this pass wants the binning occupancy near
+    ``cap`` — with the default sigma_cells=1.25 the occupancy is ~8x
+    below cap at 1M and the slice pass does ~8x the gather pass's
+    arithmetic (all of it dense VPU work); at sigma_cells=2.0 the
+    occupancies match and the arithmetic does too.
+    """
+    s = side
+    pad = 1
+    pos_g = cells_pos.reshape(s, s, s, cap, 3)
+    mass_g = cells_mass.reshape(s, s, s, cap)
+    tpos_g = tcells_pos.reshape(s, s, s, t_cap, 3)
+    cnt_g = cell_count.reshape(s, s, s)
+
+    # Global per-cell overflow remainder (normalized-mass ordering: raw
+    # m * x overflows fp32 at astronomical scales).
+    pref_mhat = jnp.sum(mass_g, axis=-1) / m_scale
+    cell_mhat = cmass_hat.reshape(s, s, s)
+    over_g = cnt_g > cap
+    rem_mhat = jnp.maximum(
+        jnp.where(over_g, cell_mhat - pref_mhat, 0.0), 0.0
+    )
+    tot_mw = ccom.reshape(s, s, s, 3) * cell_mhat[..., None]
+    pref_mw = jnp.sum((mass_g / m_scale)[..., None] * pos_g, axis=-2)
+    rem_com = (tot_mw - pref_mw) / jnp.maximum(
+        rem_mhat, jnp.asarray(1e-37, dtype)
+    )[..., None]
+
+    pos_p = jnp.pad(pos_g, ((pad, pad),) * 3 + ((0, 0), (0, 0)))
+    mass_p = jnp.pad(mass_g, ((pad, pad),) * 3 + ((0, 0),))
+    rem_mhat_p = jnp.pad(rem_mhat, pad)
+    rem_com_p = jnp.pad(rem_com, ((pad, pad),) * 3 + ((0, 0),))
+    over_p = jnp.pad(over_g, pad)
+
+    near = jnp.asarray(_near_offsets(1), jnp.int32)
+    alpha_t = jnp.asarray(alpha, dtype)
+    alpha3_t = alpha_t * alpha_t * alpha_t
+    eps2 = jnp.asarray(eps * eps, dtype)
+    cell_h = span / s
+    eps_o2 = jnp.maximum(eps2, (0.5 * cell_h) * (0.5 * cell_h))
+    i0 = jnp.int32(0)
+
+    def one_plane(x0):
+        tpos = jax.lax.dynamic_slice(
+            tpos_g, (x0, i0, i0, i0, i0), (1, s, s, t_cap, 3)
+        ).reshape(-1, t_cap, 3)
+        c = tpos.shape[0]
+
+        def body(acc, off):
+            start3 = (pad + x0 + off[0], pad + off[1], pad + off[2])
+            spos = jax.lax.dynamic_slice(
+                pos_p, start3 + (i0, i0), (1, s, s, cap, 3)
+            ).reshape(c, cap, 3)
+            smass = jax.lax.dynamic_slice(
+                mass_p, start3 + (i0,), (1, s, s, cap)
+            ).reshape(c, cap)
+            diff = spos[:, None, :, :] - tpos[:, :, None, :]
+            r2 = jnp.sum(diff * diff, axis=-1)  # (C, t_cap, cap)
+            ok = jnp.logical_and(
+                smass[:, None, :] > 0,
+                r2 < jnp.asarray(rcut * rcut, dtype),
+            )
+            ok = jnp.logical_and(
+                ok, r2 + eps2 > jnp.asarray(cutoff * cutoff, dtype)
+            )
+            ok = jnp.logical_and(ok, r2 > 0)  # self/coincident pairs
+            w = _short_range_w(
+                r2, alpha_t * jnp.sqrt(r2), eps2, alpha3_t, dtype
+            )
+            w = jnp.where(
+                ok, jnp.asarray(g, dtype) * smass[:, None, :] * w, 0.0
+            )
+            acc = acc + jnp.einsum("cts,ctsd->ctd", w, diff)
+
+            # Overflow remainder of THIS neighbor cell.
+            r_m = jax.lax.dynamic_slice(
+                rem_mhat_p, start3, (1, s, s)
+            ).reshape(c)
+            r_c = jax.lax.dynamic_slice(
+                rem_com_p, start3 + (i0,), (1, s, s, 3)
+            ).reshape(c, 3)
+            r_over = jax.lax.dynamic_slice(
+                over_p, start3, (1, s, s)
+            ).reshape(c)
+            diff_o = jnp.where(
+                r_over[:, None, None],
+                r_c[:, None, :] - tpos,
+                jnp.asarray(0.0, dtype),
+            )
+            r2o = jnp.sum(diff_o * diff_o, axis=-1)
+            w_o = _short_range_w(
+                r2o, alpha_t * jnp.sqrt(r2o), eps_o2, alpha3_t, dtype
+            )
+            w_o = jnp.where(
+                r_over[:, None],
+                jnp.asarray(g, dtype) * (r_m * m_scale)[:, None] * w_o,
+                0.0,
+            )
+            return acc + w_o[..., None] * diff_o, None
+
+        acc0 = jnp.zeros((c, t_cap, 3), dtype)
+        acc, _ = jax.lax.scan(body, acc0, near)
+        return acc
+
+    planes = jax.lax.map(one_plane, jnp.arange(s, dtype=jnp.int32))
+    return planes.reshape(-1, t_cap, 3)
+
+
+def _short_overflow_targets(
+    t_pos, t_coords, cmass, ccom, span, side, g, eps, alpha, dtype,
+):
+    """Short-range fallback for targets beyond ``t_cap``: the 27
+    neighbor cells as whole-cell monopoles through the erfc kernel with
+    cell-size softening — the same bounded resolution-limited
+    degradation as source-side overflow. Per-target gathers, only ever
+    run for the overflow minority."""
+    m = t_pos.shape[0]
+    near = jnp.asarray(_near_offsets(1), jnp.int32)
+    alpha_t = jnp.asarray(alpha, dtype)
+    alpha3_t = alpha_t * alpha_t * alpha_t
+    cell_h = span / side
+    eps_o2 = jnp.maximum(
+        jnp.asarray(eps * eps, dtype), (0.5 * cell_h) * (0.5 * cell_h)
+    )
+
+    def body(acc, off):
+        cell = t_coords + off[None, :]
+        in_b = jnp.all(
+            jnp.logical_and(cell >= 0, cell < side), axis=-1
+        )
+        ids = (
+            jnp.clip(cell[:, 0], 0, side - 1) * side
+            + jnp.clip(cell[:, 1], 0, side - 1)
+        ) * side + jnp.clip(cell[:, 2], 0, side - 1)
+        sm = cmass[ids]
+        ok = jnp.logical_and(in_b, sm > 0)
+        diff = jnp.where(
+            ok[:, None], ccom[ids] - t_pos, jnp.asarray(0.0, dtype)
+        )
+        r2 = jnp.sum(diff * diff, axis=-1)
+        w = _short_range_w(
+            r2, alpha_t * jnp.sqrt(r2), eps_o2, alpha3_t, dtype
+        )
+        w = jnp.where(ok, jnp.asarray(g, dtype) * sm * w, 0.0)
+        return acc + w[:, None] * diff, None
+
+    acc, _ = jax.lax.scan(body, jnp.zeros((m, 3), dtype), near)
+    return acc
+
+
 @partial(
     jax.jit,
     static_argnames=(
         "grid", "sigma_cells", "rcut_sigmas", "cap", "chunk",
-        "g", "cutoff", "eps",
+        "g", "cutoff", "eps", "short_mode", "t_cap", "_self",
     ),
 )
 def p3m_accelerations_vs(
@@ -263,6 +428,9 @@ def p3m_accelerations_vs(
     cutoff: float = CUTOFF_RADIUS,
     eps: float = 0.0,
     khat=None,
+    short_mode: str = "auto",
+    t_cap: int = 0,
+    _self: bool = False,
 ) -> jax.Array:
     """P3M accelerations at ``targets`` from sources (positions, masses),
     isolated boundary conditions.
@@ -275,6 +443,19 @@ def p3m_accelerations_vs(
     (erfc at 4 sigma ~ 6e-5); ``cap`` the static per-cell source cap of
     the cell list (overflow degrades to a softened monopole, never drops
     mass).
+
+    ``short_mode`` selects the short-range data movement:
+
+    - ``"gather"`` — per-target (C, 27) block gathers from the padded
+      cell list (the CPU-friendly path; gathers are cheap there).
+    - ``"slice"`` — the fmm-style shifted-slice pass: targets binned
+      into their own (S^3, t_cap) layout, source blocks read as 27
+      whole-grid shifted slices, zero gather indices in the hot loop
+      (TPU gathers are index-rate-limited — the failure mode the chip
+      measured on the tree backend). Prefers occupancy ~ ``cap``
+      (sigma_cells ~ 2.0 at 1M/grid 256); see docs/scaling.md.
+    - ``"auto"`` (default) — "slice" when tracing for TPU, else
+      "gather".
     """
     n = positions.shape[0]
     dtype = positions.dtype
@@ -297,18 +478,8 @@ def p3m_accelerations_vs(
     cell_ids = (coords[:, 0] * side + coords[:, 1]) * side + coords[:, 2]
     t_coords = grid_coords(targets, origin, span, side)
 
-    order = jnp.argsort(cell_ids)
-    sorted_pos = positions[order]
-    sorted_mass = masses[order]
-    cell_count = jax.ops.segment_sum(
-        jnp.ones((n,), jnp.int32), cell_ids, num_segments=n_cells
-    )
-    cell_start = jnp.concatenate(
-        [jnp.zeros((1,), jnp.int32), jnp.cumsum(cell_count)[:-1]]
-    )
-    cells_pos, cells_mass = build_padded_cells(
-        sorted_pos, sorted_mass, cell_ids[order], cell_start, n_cells, cap
-    )
+    (cells_pos, cells_mass, cell_count, cell_start, src_sort,
+     src_sorted_ids) = bin_to_cells(positions, masses, coords, side, cap)
     m_scale = jnp.maximum(jnp.max(masses), jnp.asarray(1e-37, dtype))
     # Per-cell mass/COM for the overflow fallback (normalized-mass
     # accumulation: m * x overflows fp32 for planetary masses).
@@ -319,15 +490,57 @@ def p3m_accelerations_vs(
     )
     ccom = cmw / jnp.maximum(cmass_hat, jnp.asarray(1e-37, dtype))[:, None]
 
-    near = jnp.asarray(
-        [
-            (dx, dy, dz)
-            for dx in (-1, 0, 1)
-            for dy in (-1, 0, 1)
-            for dz in (-1, 0, 1)
-        ],
-        jnp.int32,
-    )
+    mode = short_mode
+    if mode == "auto":
+        # Trace-time platform dispatch, same rule as _force_kernel_hat:
+        # gathers are cheap on CPU, index-rate-limited on TPU.
+        mode = "slice" if jax.default_backend() == "tpu" else "gather"
+    if mode == "slice":
+        t_cap_eff = t_cap or cap
+        kt = targets.shape[0]
+        if _self and t_cap_eff == cap:
+            # Self form (targets IS positions): the target binning is
+            # bitwise the source binning — skip the duplicate full-N
+            # argsort + padded scatter (review finding).
+            tcells_pos, t_start, t_sort, t_sorted_ids = (
+                cells_pos, cell_start, src_sort, src_sorted_ids
+            )
+        else:
+            tcells_pos, _, _, t_start, t_sort, t_sorted_ids = bin_to_cells(
+                targets, jnp.ones((kt,), dtype), t_coords, side, t_cap_eff
+            )
+        near_cell = _short_range_shifted(
+            tcells_pos, t_cap_eff, cells_pos, cells_mass, cell_count,
+            cmass_hat, ccom, m_scale, span, side, cap, g, cutoff, eps,
+            alpha, rcut, dtype,
+        )
+        slot = jnp.arange(kt, dtype=jnp.int32) - t_start[t_sorted_ids]
+        over_t = slot >= t_cap_eff
+        short_sorted = near_cell[
+            t_sorted_ids, jnp.minimum(slot, t_cap_eff - 1)
+        ]
+        short_sorted = jax.lax.cond(
+            jnp.any(over_t),
+            lambda ss: jnp.where(
+                over_t[:, None],
+                _short_overflow_targets(
+                    targets[t_sort], t_coords[t_sort],
+                    cmass_hat * m_scale, ccom, span, side, g, eps,
+                    alpha, dtype,
+                ),
+                ss,
+            ),
+            lambda ss: ss,
+            short_sorted,
+        )
+        inv = jnp.zeros((kt,), jnp.int32).at[t_sort].set(
+            jnp.arange(kt, dtype=jnp.int32)
+        )
+        return acc + short_sorted[inv]
+    if mode != "gather":
+        raise ValueError(f"unknown p3m short_mode {short_mode!r}")
+
+    near = jnp.asarray(_near_offsets(1), jnp.int32)
 
 
     alpha_t = jnp.asarray(alpha, dtype)
@@ -433,4 +646,6 @@ def p3m_accelerations(
     **kwargs,
 ) -> jax.Array:
     """P3M accelerations for all particles (targets = sources)."""
-    return p3m_accelerations_vs(positions, positions, masses, **kwargs)
+    return p3m_accelerations_vs(
+        positions, positions, masses, _self=True, **kwargs
+    )
