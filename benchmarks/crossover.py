@@ -1,10 +1,14 @@
-"""Measure the direct-sum / tree crossover on the current platform.
+"""Measure the direct / tree / fmm crossover on the current platform.
 
 Times one carried-acc leapfrog force evaluation per backend over a
 range of N on the disk model (the 1m-tree baseline family), printing
 one JSON line per (n, backend) and a suggested crossover — the number
-that calibrates ``simulation.TREE_CROSSOVER_TPU`` / ``_CPU``
-(docs/scaling.md "Automatic backend selection").
+that calibrates the auto router (``simulation._measured_fast_crossover``
+reads the CROSSOVER_TPU.json this writes; docs/scaling.md "Automatic
+backend selection"). The sweep is
+three-way: the gather-bound tree and the gather-free dense-grid FMM
+are independent contenders against the Pallas/FFI direct sum, and the
+suggested crossover is the first n where the best FAST solver wins.
 
 Usage:
     python benchmarks/crossover.py              # default N ladder
@@ -57,7 +61,7 @@ def main(argv) -> int:
     for n in ns:
         iters = max(1, min(10, (262_144 // n) or 1))
         row = {"n": n, "platform": platform}
-        for backend in ("direct", "tree"):
+        for backend in ("direct", "tree", "fmm"):
             cfg = SimulationConfig(
                 model="disk", n=n, g=1.0, dt=2.0e-3, eps=0.05,
                 integrator="leapfrog", force_backend=backend,
@@ -70,20 +74,63 @@ def main(argv) -> int:
             )
             row[f"{backend}_s"] = dt_s
             row[f"{backend}_resolved"] = sim.backend
+            # Print the partial row too: a wedging tunnel mid-sweep
+            # should not lose the backends already timed at this n.
+            print(json.dumps({"partial": True, "n": n,
+                              "backend": backend, "s_per_eval": dt_s}))
         row["tree_speedup"] = row["direct_s"] / row["tree_s"]
+        row["fmm_speedup"] = row["direct_s"] / row["fmm_s"]
         results.append(row)
         print(json.dumps(row))
 
-    # Crossover = first n where the tree wins; refine with the ratio
-    # trend (direct scales ~n^2, tree ~n log n).
-    winners = [r for r in results if r["tree_speedup"] > 1.0]
+    # Crossover = first n where the best fast solver wins; refine with
+    # the ratio trend (direct scales ~n^2, tree/fmm ~n log n / ~n).
+    winners = [
+        r for r in results
+        if max(r["tree_speedup"], r["fmm_speedup"]) > 1.0
+    ]
     suggestion = winners[0]["n"] if winners else None
+    best = (
+        max(winners[0].items(), key=lambda kv: kv[1] if "speedup" in kv[0]
+            else -1.0)[0].replace("_speedup", "")
+        if winners else None
+    )
     print(json.dumps({
         "suggested_crossover": suggestion,
-        "note": "first measured n where the tree force eval beats the "
-                "direct sum on this platform; update "
-                "simulation.TREE_CROSSOVER_* and docs/scaling.md",
+        "winning_backend": best,
+        "note": "first measured n where a fast solver's force eval beats "
+                "the direct sum on this platform; on TPU this is "
+                "persisted to CROSSOVER_TPU.json for "
+                "simulation._measured_fast_crossover",
     }))
+    if on_tpu and results:
+        from gravity_tpu.simulation import FMM_CROSSOVER_TPU
+
+        # Persist the measurement for the auto router: a recorded chip
+        # measurement beats the cost-model default in simulation.py.
+        # No fast winner in the sweep -> record a lower bound, floored
+        # at the cost-model default: a small explicit ladder (e.g.
+        # `crossover.py 8192 16384`) that direct wins outright must
+        # never drag the router's threshold BELOW the default into the
+        # very regime it just measured direct to be fastest.
+        payload = {
+            "fast_crossover": (
+                suggestion if suggestion
+                else max(2 * max(ns), FMM_CROSSOVER_TPU)
+            ),
+            "winning_backend": best,
+            "measured_winner": bool(winners),
+            "rows": results,
+            "date": time.strftime("%Y-%m-%d %H:%M:%S UTC", time.gmtime()),
+            "device": str(jax.devices()[0].device_kind),
+        }
+        path = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "CROSSOVER_TPU.json",
+        )
+        with open(path, "w") as f:
+            json.dump(payload, f, indent=1)
+        print(json.dumps({"wrote": path}))
     return 0
 
 

@@ -52,7 +52,9 @@ def test_two_process_mesh_parity(tmp_path):
                     )
                 )
         for p in procs:
-            p.wait(timeout=300)
+            # Generous: the workers now also compile the tree and fmm
+            # fast-solver programs, and CI hosts can be single-core.
+            p.wait(timeout=600)
     finally:
         for p in procs:
             if p.poll() is None:
