@@ -101,7 +101,7 @@ def _bit_parity_grid(side: int, k: int) -> jnp.ndarray:
 
 def _coarse_leaf_expansions(
     levels, origin, span, depth: int, ws: int, g, eps, dtype,
-    order: int = 2, m_scale=None,
+    order: int = 2, m_scale=None, potential: bool = False,
 ):
     """p=1 local expansions (F (S,S,S,3), J6 (S,S,S,6)) about LEAF
     centers, summing the interaction lists of every ancestor level
@@ -131,6 +131,10 @@ def _coarse_leaf_expansions(
     h_leaf = span / side
     a3 = jnp.zeros((side, side, side, 3), dtype) if order >= 2 else None
     t10 = jnp.zeros((side, side, side, 10), dtype) if order >= 2 else None
+    # Scalar potential channel (sum g m / r_soft about leaf centers):
+    # phi = w * r2_safe exactly, since w = g m inv_r^3 (see
+    # fmm_potential_energy). Its p=1 gradient IS the force channel F.
+    phi = jnp.zeros((side, side, side), dtype) if potential else None
     for d in range(2, depth):
         k = depth - d
         sd = 1 << d
@@ -165,7 +169,7 @@ def _coarse_leaf_expansions(
         def body(carry, xs, mass_p=mass_p, com_p=com_p, quad_p=quad_p,
                  parity=parity, pad=pad, upsample=upsample, sd=sd,
                  h_d=h_d, use_quad=use_quad, h_leaf=h_leaf):
-            f, j6, trace_w, a3, t10 = carry
+            f, j6, trace_w, a3, t10, phi = carry
             off, pm_row = xs
             start = (pad + off[0], pad + off[1], pad + off[2])
             sm = upsample(
@@ -192,6 +196,8 @@ def _coarse_leaf_expansions(
                 jnp.asarray(0.0, dtype),
             )
             f = f + w[..., None] * diff
+            if phi is not None:
+                phi = phi + w * safe
             # Unit direction FIRST: the textbook factor 3 w / r^2 is
             # ~1e-44 at astronomical scales — an fp32 subnormal flush
             # that silently deletes the Jacobian's anisotropic part
@@ -243,23 +249,25 @@ def _coarse_leaf_expansions(
                 )
             else:
                 a3_new, t10_new = a3, t10
-            return (f, j6, trace_w + w, a3_new, t10_new), None
+            return (f, j6, trace_w + w, a3_new, t10_new, phi), None
 
-        (f, j6, trace_w, a3, t10), _ = jax.lax.scan(
-            body, (f, j6, trace_w, a3, t10), (offsets, pmask_t.T)
+        (f, j6, trace_w, a3, t10, phi), _ = jax.lax.scan(
+            body, (f, j6, trace_w, a3, t10, phi), (offsets, pmask_t.T)
         )
     j6 = (
         j6.at[..., 0].add(-trace_w)
         .at[..., 1].add(-trace_w)
         .at[..., 2].add(-trace_w)
     )
+    if potential:
+        return f, j6, a3, t10, phi
     return f, j6, a3, t10
 
 
 def _finest_exact_shifted(
     cells_pos, cmass_l, ccom_l, origin, span, side: int, leaf_cap: int,
     ws: int, g, eps, slab: int, dtype, cquad_l=None, m_scale=None,
-    slab_ids=None,
+    slab_ids=None, potential: bool = False,
 ):
     """Finest-level interaction list, EXACT per target (its p=1
     expansion ratio would be too large — same reasoning as ops/tree.py):
@@ -302,7 +310,8 @@ def _finest_exact_shifted(
         ).reshape(-1)
         c = tpos.shape[0]
 
-        def body(acc, xs):
+        def body(carry, xs):
+            acc, phi = carry
             off, pm_row = xs
             start = (
                 near_pad + x0 + off[0], near_pad + off[1], near_pad + off[2]
@@ -331,6 +340,8 @@ def _finest_exact_shifted(
                 jnp.asarray(0.0, dtype),
             )
             acc = acc + w[..., None] * diff
+            if phi is not None:
+                phi = phi + w * safe
             if quad_p is not None:
                 # Source quadrupole of the finest-list cells — the
                 # dominant error term of the monopole-only evaluation
@@ -345,13 +356,19 @@ def _finest_exact_shifted(
                     diff, inv_r, sq[:, None, :], ok[:, None], g,
                     m_scale, h_leaf, dtype,
                 )
-            return acc, None
+            return (acc, phi), None
 
         acc0 = jnp.zeros((c, leaf_cap, 3), dtype)
-        acc, _ = jax.lax.scan(body, acc0, (offsets, pmask_t.T))
-        return acc
+        phi0 = jnp.zeros((c, leaf_cap), dtype) if potential else None
+        (acc, phi), _ = jax.lax.scan(
+            body, (acc0, phi0), (offsets, pmask_t.T)
+        )
+        return (acc, phi) if potential else acc
 
     slabs = jax.lax.map(one_slab, slab_ids)
+    if potential:
+        acc, phi = slabs
+        return acc.reshape(-1, leaf_cap, 3), phi.reshape(-1, leaf_cap)
     return slabs.reshape(-1, leaf_cap, 3)
 
 
@@ -359,6 +376,7 @@ def _near_field_shifted(
     cells_pos, cells_mass, leaf_count, cmass_l, ccom_l, m_scale,
     origin, span, side: int, leaf_cap: int, ws: int, g, cutoff, eps,
     slab: int, dtype, slab_ids=None, tcells_pos=None, t_cap=None,
+    potential: bool = False,
 ):
     """Exact near field on the (S^3, cap) padded-cell layout, one shifted
     slice per neighbor offset — plus the remainder-monopole overflow
@@ -424,7 +442,8 @@ def _near_field_shifted(
         ).reshape(-1, tcap, 3)
         c = tpos.shape[0]
 
-        def body(acc, off):
+        def body(carry, off):
+            acc, phi = carry
             start3 = (pad + x0 + off[0], pad + off[1], pad + off[2])
             spos = jax.lax.dynamic_slice(
                 pos_p, start3 + (_I0, _I0), (b, s, s, leaf_cap, 3)
@@ -448,6 +467,8 @@ def _near_field_shifted(
                 jnp.asarray(0.0, dtype),
             )
             acc = acc + jnp.einsum("cts,ctsd->ctd", w, diff)
+            if phi is not None:
+                phi = phi + jnp.sum(w * safe, axis=-1)
 
             # Overflow remainder of THIS neighbor cell, softened at the
             # resolution scale (same contract as ops/tree.py).
@@ -474,13 +495,19 @@ def _near_field_shifted(
                 jnp.asarray(0.0, dtype),
             )
             acc = acc + w_o[..., None] * diff_o
-            return acc, None
+            if phi is not None:
+                phi = phi + w_o * r2o
+            return (acc, phi), None
 
         acc0 = jnp.zeros((c, tcap, 3), dtype)
-        acc, _ = jax.lax.scan(body, acc0, near)
-        return acc
+        phi0 = jnp.zeros((c, tcap), dtype) if potential else None
+        (acc, phi), _ = jax.lax.scan(body, (acc0, phi0), near)
+        return (acc, phi) if potential else acc
 
     slabs = jax.lax.map(one_slab, slab_ids)
+    if potential:
+        acc, phi = slabs
+        return acc.reshape(-1, tcap, 3), phi.reshape(-1, tcap)
     return slabs.reshape(-1, tcap, 3)
 
 
@@ -621,7 +648,8 @@ def _fmm_core(
 
 
 def _monopole_neighborhood(
-    eval_pos, eval_coords, cmass_l, ccom_l, side, span, ws, g, eps, dtype
+    eval_pos, eval_coords, cmass_l, ccom_l, side, span, ws, g, eps,
+    dtype, potential: bool = False,
 ):
     """Full 7^3 neighborhood of each eval point's leaf as softened cell
     monopoles at the point's OWN position: the near 3^3 with cell-size
@@ -641,7 +669,8 @@ def _monopole_neighborhood(
     )
     eps_over = jnp.maximum(jnp.asarray(eps, dtype), 0.5 * span / side)
 
-    def body(acc, xs):
+    def body(carry, xs):
+        acc, phi = carry
         off, pm_row = xs
         cell = eval_coords + off[None, :]
         in_b = jnp.all(
@@ -667,22 +696,28 @@ def _monopole_neighborhood(
             is_near, eps_over, jnp.asarray(eps, dtype)
         )
         r2 = jnp.sum(diff * diff, axis=-1) + eps_here * eps_here
-        inv_r = jax.lax.rsqrt(r2)
+        safe = jnp.where(ok, r2, jnp.asarray(1.0, dtype))
+        inv_r = jax.lax.rsqrt(safe)
         w = jnp.where(
             ok,
             ((jnp.asarray(g, dtype) * sm) * inv_r) * inv_r * inv_r,
             jnp.asarray(0.0, dtype),
         )
-        return acc + w[:, None] * diff, None
+        acc = acc + w[:, None] * diff
+        if phi is not None:
+            phi = phi + w * safe
+        return (acc, phi), None
 
-    mono, _ = jax.lax.scan(
-        body, jnp.zeros((m, 3), dtype), (offsets, pmask_t.T)
+    phi0 = jnp.zeros((m,), dtype) if potential else None
+    (mono, phi), _ = jax.lax.scan(
+        body, (jnp.zeros((m, 3), dtype), phi0), (offsets, pmask_t.T)
     )
-    return mono
+    return (mono, phi) if potential else mono
 
 
 def _monopole_all_levels(
-    eval_pos, eval_coords, levels, depth, side, span, ws, g, eps, dtype
+    eval_pos, eval_coords, levels, depth, side, span, ws, g, eps,
+    dtype, potential: bool = False,
 ):
     """COMPLETE per-point monopole evaluation at the point's own
     position: the leaf-level 7^3 neighborhood (_monopole_neighborhood,
@@ -695,11 +730,13 @@ def _monopole_all_levels(
     once (the same telescoping as the main decomposition), so no mass
     is dropped or double-counted; accuracy is the tree far="direct"
     class (~1% median). Per-point gathers — only ever run for the
-    fallback minority."""
-    acc = _monopole_neighborhood(
+    fallback minority. With ``potential``, returns (acc, phi): the
+    scalar channel shared with :func:`fmm_potential_energy`."""
+    out = _monopole_neighborhood(
         eval_pos, eval_coords, levels[depth][0], levels[depth][1],
-        side, span, ws, g, eps, dtype,
+        side, span, ws, g, eps, dtype, potential=potential,
     )
+    acc, phi = out if potential else (out, None)
     offsets = jnp.asarray(_offsets(ws), jnp.int32)
     pmask_t = jnp.asarray(_parity_mask_table(ws))
     for d in range(2, depth):
@@ -714,8 +751,9 @@ def _monopole_all_levels(
         cmass_l = levels[d][0]
         ccom_l = levels[d][1]
 
-        def body(acc_c, xs, cd=cd, parity=parity, cmass_l=cmass_l,
+        def body(carry, xs, cd=cd, parity=parity, cmass_l=cmass_l,
                  ccom_l=ccom_l, sd=sd):
+            acc_c, phi_c = carry
             off, pm_row = xs
             cell = cd + off[None, :]
             in_b = jnp.all(
@@ -744,10 +782,33 @@ def _monopole_all_levels(
                 ((jnp.asarray(g, dtype) * sm) * inv_r) * inv_r * inv_r,
                 jnp.asarray(0.0, dtype),
             )
-            return acc_c + w[:, None] * diff, None
+            acc_c = acc_c + w[:, None] * diff
+            if phi_c is not None:
+                phi_c = phi_c + w * safe
+            return (acc_c, phi_c), None
 
-        acc, _ = jax.lax.scan(body, acc, (offsets, pmask_t.T))
-    return acc
+        (acc, phi), _ = jax.lax.scan(
+            body, (acc, phi), (offsets, pmask_t.T)
+        )
+    return (acc, phi) if potential else acc
+
+
+def _leaf_centers(sorted_ids, origin, span, side, dtype):
+    """Cell-center coordinates of flat leaf ids — the ONE id->center
+    decode shared by the force and potential Taylor evaluations (they
+    must agree on the expansion center to the bit)."""
+    h_leaf = span / side
+    return origin + (
+        jnp.stack(
+            [
+                sorted_ids // (side * side),
+                (sorted_ids // side) % side,
+                sorted_ids % side,
+            ],
+            axis=-1,
+        ).astype(dtype)
+        + 0.5
+    ) * h_leaf
 
 
 def _eval_far(
@@ -761,18 +822,7 @@ def _eval_far(
     h_leaf = span / side
     f_flat = f_loc.reshape(n_leaves, 3)
     j_flat = j_loc.reshape(n_leaves, 6)
-    centers = origin + (
-        jnp.stack(
-            [
-                sorted_ids // (side * side),
-                (sorted_ids // side) % side,
-                sorted_ids % side,
-            ],
-            axis=-1,
-        ).astype(dtype)
-        + 0.5
-    ) * h_leaf
-    dx = sorted_pos - centers
+    dx = sorted_pos - _leaf_centers(sorted_ids, origin, span, side, dtype)
     jf = f_flat[sorted_ids]
     jj = j_flat[sorted_ids]
     jx = jj[:, 0] * dx[:, 0] + jj[:, 3] * dx[:, 1] + jj[:, 4] * dx[:, 2]
@@ -935,6 +985,118 @@ def fmm_accelerations_vs(
         jnp.arange(k, dtype=jnp.int32)
     )
     return acc_sorted[inv]
+
+
+def fmm_potential_energy(
+    positions: jax.Array,
+    masses: jax.Array,
+    *,
+    depth: int = 6,
+    leaf_cap: int = 32,
+    ws: int = 1,
+    g: float = G,
+    cutoff: float = CUTOFF_RADIUS,
+    eps: float = 0.0,
+    slab: int = 4,
+):
+    """Total potential energy via the gather-free FMM decomposition:
+    -0.5 sum_i m_i phi_i with phi_i = sum_j g m_j / r_soft(i, j).
+
+    The TPU-native counterpart of ``tree.tree_potential_energy`` (whose
+    per-target interaction-list gathers are the access pattern the chip
+    measured index-rate-bound): the scalar channel rides the same
+    shifted-slice passes as the force — phi = w * r2_safe reuses the
+    pair weights, and the p=1 Taylor gradient of phi IS the force
+    channel F, so the coarse far field needs only one extra scalar
+    accumulator. Finest + near fields are exact per pair (softened by
+    ``eps``); conventions match ``forces.potential_energy`` exactly:
+    sub-``cutoff`` pairs contribute zero and the softened self term
+    (r = eps) is INCLUDED (a constant offset at fixed masses, so drift
+    metrics are unaffected and parity holds term by term). Cap-overflow
+    targets take the complete monopole-hierarchy fallback.
+
+    Returns a host ``np.float64`` (the -0.5 m_scale rescale happens in
+    f64 — the raw double sum reaches ~1e42 at astronomical masses).
+    """
+    s_hat, m_scale = _fmm_pe_scaled(
+        positions, masses, depth=depth, leaf_cap=leaf_cap, ws=ws, g=g,
+        cutoff=cutoff, eps=eps, slab=_clamp_slab(slab, depth, leaf_cap),
+    )
+    return (
+        np.float64(-0.5)
+        * np.float64(jax.device_get(m_scale))
+        * np.float64(jax.device_get(s_hat))
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=("depth", "leaf_cap", "ws", "g", "cutoff", "eps",
+                     "slab"),
+)
+def _fmm_pe_scaled(
+    positions, masses, *, depth, leaf_cap, ws, g, cutoff, eps, slab
+):
+    """(sum_i m_hat_i phi_i, m_scale) with phi in physical g*m/r units
+    (fp32-safe: ~g*M_total/R ~ 1e10 at astronomical scales; the final
+    m_scale rescale happens on the host in f64)."""
+    side = 1 << depth
+    n = positions.shape[0]
+    dtype = positions.dtype
+    levels, origin, span, coords = build_octree(
+        positions, masses, depth, quad=False
+    )
+    m_scale = jnp.maximum(jnp.max(masses), jnp.asarray(1e-37, dtype))
+
+    f_loc, _, _, _, phi_loc = _coarse_leaf_expansions(
+        levels, origin, span, depth, ws, g, eps, dtype, order=1,
+        m_scale=m_scale, potential=True,
+    )
+
+    (cells_pos, cells_mass, leaf_count, leaf_start, sort_order,
+     sorted_ids) = bin_to_cells(positions, masses, coords, side, leaf_cap)
+    sorted_pos = positions[sort_order]
+    n_leaves = side**3
+
+    _, phi_near = _near_field_shifted(
+        cells_pos, cells_mass, leaf_count, levels[depth][0],
+        levels[depth][1], m_scale, origin, span, side, leaf_cap, ws,
+        g, cutoff, eps, slab, dtype, potential=True,
+    )
+    _, phi_fin = _finest_exact_shifted(
+        cells_pos, levels[depth][0], levels[depth][1], origin, span,
+        side, leaf_cap, ws, g, eps, slab, dtype, potential=True,
+    )
+    phi_cell = phi_near + phi_fin
+
+    slot = jnp.arange(n, dtype=jnp.int32) - leaf_start[sorted_ids]
+    over_t = slot >= leaf_cap
+    phi_sorted = phi_cell[sorted_ids, jnp.minimum(slot, leaf_cap - 1)]
+
+    # Far field: phi(x) ~ phi_c + F . dx about the leaf center.
+    dx = sorted_pos - _leaf_centers(sorted_ids, origin, span, side, dtype)
+    phi_far = (
+        phi_loc.reshape(n_leaves)[sorted_ids]
+        + jnp.sum(f_loc.reshape(n_leaves, 3)[sorted_ids] * dx, axis=-1)
+    )
+    phi_total = phi_far + phi_sorted
+
+    phi_total = jax.lax.cond(
+        jnp.any(over_t),
+        lambda pt: jnp.where(
+            over_t,
+            _monopole_all_levels(
+                sorted_pos, coords[sort_order], levels, depth, side,
+                span, ws, g, eps, dtype, potential=True,
+            )[1],
+            pt,
+        ),
+        lambda pt: pt,
+        phi_total,
+    )
+
+    m_hat_sorted = masses[sort_order] / m_scale
+    return jnp.sum(m_hat_sorted * phi_total), m_scale
 
 
 def make_sharded_fmm_accel(

@@ -1331,15 +1331,29 @@ class Simulator:
                     state.positions, config.tree_leaf_cap
                 )
                 self._energy_tree_depth = depth
-            # Host-f64 sum: tree_potential_energy returns np.float64
+            # Host-f64 sum: the scalable PE functions return np.float64
             # precisely because |PE| can exceed fp32 range; adding a
             # jnp f32 KE would demote the whole thing back to f32.
-            e = kinetic_energy_f64(state) + tree_potential_energy(
-                state.positions, state.masses, depth=depth,
-                leaf_cap=config.tree_leaf_cap, ws=config.tree_ws,
-                chunk=config.fast_chunk, g=config.g,
-                cutoff=config.cutoff, eps=config.eps,
-            )
+            # On TPU the gather-free FMM potential carries the sample
+            # (the tree PE's per-target interaction-list gathers are
+            # the access pattern the chip measured index-rate-bound);
+            # on CPU the tree PE stays the measured-fast choice.
+            if jax.devices()[0].platform == "tpu":
+                from .ops.fmm import fmm_potential_energy
+
+                pe = fmm_potential_energy(
+                    state.positions, state.masses, depth=depth,
+                    leaf_cap=config.tree_leaf_cap, ws=config.tree_ws,
+                    g=config.g, cutoff=config.cutoff, eps=config.eps,
+                )
+            else:
+                pe = tree_potential_energy(
+                    state.positions, state.masses, depth=depth,
+                    leaf_cap=config.tree_leaf_cap, ws=config.tree_ws,
+                    chunk=config.fast_chunk, g=config.g,
+                    cutoff=config.cutoff, eps=config.eps,
+                )
+            e = kinetic_energy_f64(state) + pe
         else:
             e = diagnostics.total_energy(
                 state, g=config.g, cutoff=config.cutoff, eps=config.eps,
