@@ -126,6 +126,47 @@ def main(argv) -> int:
         label="fmm coarse expansions only",
     )
 
+    # 3c. Gather-free potential energy (the TPU --metrics-energy
+    # sample) vs the gather-based tree PE.
+    from gravity_tpu.ops.fmm import fmm_potential_energy
+    from gravity_tpu.ops.tree import tree_potential_energy
+
+    timed(
+        lambda p: fmm_potential_energy(
+            p, masses, depth=depth, eps=0.05, g=1.0
+        ),
+        pos, iters=1, label="fmm_potential_energy",
+    )
+    timed(
+        lambda p: tree_potential_energy(
+            p, masses, depth=depth, eps=0.05, g=1.0
+        ),
+        pos, iters=1, label="tree_potential_energy (ref)",
+    )
+
+    # 3d. P3M short-range A/B at this n: gather vs shifted-slice vs
+    # occupancy-matched sigma (grid/cap = the 1M baseline tag's at
+    # full scale; smaller smoke runs shrink the mesh with n so the
+    # FFTs don't dwarf the short-range stage under comparison).
+    from gravity_tpu.ops.p3m import p3m_accelerations
+
+    p3m_grid = 256 if n >= 262_144 else 64
+    for label, kw in (
+        ("p3m short=gather (sigma 1.25)", dict(short_mode="gather")),
+        ("p3m short=slice  (sigma 1.25)", dict(short_mode="slice")),
+        ("p3m short=slice  (sigma 2.0)",
+         dict(short_mode="slice", sigma_cells=2.0)),
+    ):
+        timed(
+            jax.jit(
+                lambda p, kw=kw: p3m_accelerations(
+                    p, masses, grid=p3m_grid, cap=64, eps=0.05, g=1.0,
+                    **kw
+                )
+            ),
+            pos, iters=1, label=label,
+        )
+
     # 4. Direct-sum reference point at this n (chunked to bound memory).
     from gravity_tpu.ops.forces import pairwise_accelerations_chunked
 

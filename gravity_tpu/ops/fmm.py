@@ -1163,7 +1163,7 @@ def make_sharded_fmm_accel(
         )
         n_local = pos_l.shape[0]
         return jax.lax.dynamic_slice(
-            acc, (idx * n_local, 0), (n_local, 3)
+            acc, (idx * n_local, _I0), (n_local, 3)
         )
 
     return jax.shard_map(

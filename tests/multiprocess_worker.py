@@ -151,6 +151,27 @@ def main() -> int:
                 err_msg=f"{name}: fast-solver parity, proc {proc_id}",
             )
 
+    # Slab-decomposed sharded fmm (make_sharded_fmm_accel): the near/
+    # finest slab passes split over the process-spanning mesh and the
+    # (cells, cap, 3) all_gather crosses the process boundary — the
+    # heavier collective the rectangular path above doesn't exercise.
+    from gravity_tpu.ops.fmm import make_sharded_fmm_accel
+
+    expected_fmm = np.asarray(
+        fmm_accelerations(pos_j, m_j, depth=3, leaf_cap=8)
+    )
+    slab_fn = make_sharded_fmm_accel(mesh, depth=3, leaf_cap=8)
+    # pos_g/m_g already carry the particle sharding from above.
+    acc = slab_fn(pos_g, m_g)
+    for shard in acc.addressable_shards:
+        np.testing.assert_allclose(
+            np.asarray(shard.data),
+            expected_fmm[shard.index],
+            rtol=1e-9,
+            atol=1e-30,
+            err_msg=f"slab-fmm parity, proc {proc_id}",
+        )
+
     print(f"WORKER_OK {proc_id}", flush=True)
     return 0
 

@@ -32,6 +32,13 @@ def test_cosmology_example():
     assert "GROWTH OK" in out.stdout
 
 
+def test_field_probe_example():
+    out = _run(["examples/field_probe.py", "--n", "2048", "--grid", "8"])
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "rotation curve" in out.stdout
+    assert "OK" in out.stdout
+
+
 def test_gradient_orbit_fit_example():
     out = _run(["examples/gradient_orbit_fit.py", "--iters", "120",
                 "--steps", "30"])
