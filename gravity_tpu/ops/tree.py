@@ -813,6 +813,60 @@ def recommended_depth(n: int, leaf_cap: int = 32) -> int:
     return max(2, min(8, math.ceil(math.log(target_cells, 8))))
 
 
+def estimate_cell_memory_bytes(
+    n: int, depth: int, leaf_cap: int, *, quad: bool = True,
+    dtype_bytes: int = 4,
+) -> int:
+    """Device-memory footprint of the octree/FMM cell structures at a
+    given depth: the level pyramid (mass + COM + quadrupole per cell,
+    summed over levels — a geometric series, x8/7 of the leaf level),
+    the padded (cells, cap) position/mass blocks, and the sorted
+    particle copies. The dominant term is the padded blocks:
+    16 B x 8^depth x leaf_cap (~1.1 GB at depth 7 / cap 32) — the
+    suspected HBM-pressure source of the round-3 `1m-tree` worker
+    crash, surfaced by :func:`warn_if_cell_memory_heavy` instead of
+    being discovered as an opaque device OOM."""
+    cells = (1 << depth) ** 3
+    per_cell = (10 if quad else 4) * dtype_bytes
+    pyramid = cells * per_cell * 8 // 7
+    padded = cells * leaf_cap * 4 * dtype_bytes  # pos(3) + mass(1)
+    particles = n * 12 * dtype_bytes  # sorted pos/mass/ids working set
+    return pyramid + padded + particles
+
+
+# Warn when the cell structures alone pass this fraction of a v5e's
+# 16 GB HBM — they sit NEXT to the integrator state, collectives, and
+# XLA scratch, so crossing it is the regime where the round-3 1m-tree
+# worker died with a bare "TPU worker process crashed".
+CELL_MEMORY_WARN_BYTES = 4 << 30
+
+
+def warn_if_cell_memory_heavy(
+    n: int, depth: int, leaf_cap: int, where: str, *,
+    dtype_bytes: int = 4,
+) -> int:
+    """Estimate + warn (returns the estimate in bytes). Pass the run's
+    actual element size: a float64 run allocates 2x the fp32 footprint
+    and must not estimate under the threshold in exactly the
+    HBM-pressure regime this audit exists for (review finding)."""
+    est = estimate_cell_memory_bytes(
+        n, depth, leaf_cap, dtype_bytes=dtype_bytes
+    )
+    if est > CELL_MEMORY_WARN_BYTES:
+        import warnings
+
+        warnings.warn(
+            f"{where}: octree cell structures at depth={depth}, "
+            f"leaf_cap={leaf_cap} need ~{est / (1 << 30):.1f} GiB of "
+            "device memory (padded per-cell blocks scale as "
+            "16 B x 8^depth x cap) before integrator state and XLA "
+            "scratch — expect HBM pressure on a 16 GiB chip. Lower "
+            "tree_depth/leaf_cap, or use p3m/pm at this scale.",
+            stacklevel=3,
+        )
+    return est
+
+
 def recommended_depth_data(
     positions, leaf_cap: int = 32, *, max_depth: int = 7
 ) -> int:

@@ -186,6 +186,30 @@ def _resolve_backend(config: SimulationConfig, on_tpu=None) -> str:
     return _resolve_direct(config, on_tpu)
 
 
+def _resolve_depth_and_warn(config: SimulationConfig, positions, where,
+                            n=None) -> int:
+    """Tree-family depth resolution + the HBM cell-structure audit —
+    the ONE place both happen (every tree/fmm solver-build path calls
+    this, so the audit cannot silently drop off one of them)."""
+    from .ops.tree import (
+        recommended_depth,
+        recommended_depth_data,
+        warn_if_cell_memory_heavy,
+    )
+
+    depth = config.tree_depth or (
+        recommended_depth_data(positions, config.tree_leaf_cap)
+        if positions is not None
+        else recommended_depth(config.n, config.tree_leaf_cap)
+    )
+    warn_if_cell_memory_heavy(
+        n if n is not None else config.n, depth, config.tree_leaf_cap,
+        where,
+        dtype_bytes={"float64": 8, "bfloat16": 2}.get(config.dtype, 4),
+    )
+    return depth
+
+
 def make_local_kernel(config: SimulationConfig, backend: str,
                       positions=None, k_targets=None):
     """LocalKernel (pos_targets, pos_sources, m_sources) -> acc for the
@@ -242,17 +266,9 @@ def make_local_kernel(config: SimulationConfig, backend: str,
             )
         return make_ffi_local_kernel(**common)
     if backend == "tree":
-        from .ops.tree import (
-            recommended_depth,
-            recommended_depth_data,
-            tree_accelerations_vs,
-        )
+        from .ops.tree import tree_accelerations_vs
 
-        depth = config.tree_depth or (
-            recommended_depth_data(positions, config.tree_leaf_cap)
-            if positions is not None
-            else recommended_depth(config.n, config.tree_leaf_cap)
-        )
+        depth = _resolve_depth_and_warn(config, positions, "tree kernel")
         return partial(
             tree_accelerations_vs, depth=depth,
             leaf_cap=config.tree_leaf_cap, ws=config.tree_ws,
@@ -260,17 +276,12 @@ def make_local_kernel(config: SimulationConfig, backend: str,
         )
     if backend == "fmm":
         from .ops.fmm import fmm_accelerations_vs
-        from .ops.tree import recommended_depth, recommended_depth_data
 
         if k_targets is not None and k_targets * config.n <= (1 << 25):
             # Tiny target sets: the exact dense (K, N) kick is cheaper
             # than any grid pass and has zero approximation error.
             return partial(accelerations_vs, **common)
-        depth = config.tree_depth or (
-            recommended_depth_data(positions, config.tree_leaf_cap)
-            if positions is not None
-            else recommended_depth(config.n, config.tree_leaf_cap)
-        )
+        depth = _resolve_depth_and_warn(config, positions, "fmm kernel")
         t_cap = 0
         if k_targets is not None:
             t_cap = min(
@@ -415,10 +426,10 @@ class Simulator:
             # scales 1/P without the per-device target re-binning the
             # rectangular fmm_accelerations_vs path would need.
             from .ops.fmm import make_sharded_fmm_accel
-            from .ops.tree import recommended_depth_data
 
-            depth = config.tree_depth or recommended_depth_data(
-                self.state.positions, config.tree_leaf_cap
+            depth = _resolve_depth_and_warn(
+                config, self.state.positions, "sharded fmm",
+                n=self.state.n,
             )
             self._accel2 = make_sharded_fmm_accel(
                 self.mesh, depth=depth, leaf_cap=config.tree_leaf_cap,
@@ -539,10 +550,10 @@ class Simulator:
             kernel = make_local_kernel(config, self.backend)
             return lambda pos, m: kernel(pos, pos, m)
         if self.backend == "tree":
-            from .ops.tree import recommended_depth_data, tree_accelerations
+            from .ops.tree import tree_accelerations
 
-            depth = config.tree_depth or recommended_depth_data(
-                self.state.positions, config.tree_leaf_cap
+            depth = _resolve_depth_and_warn(
+                config, self.state.positions, "tree backend", n=n
             )
             return lambda pos, m: tree_accelerations(
                 pos, m, depth=depth, leaf_cap=config.tree_leaf_cap,
@@ -551,10 +562,9 @@ class Simulator:
             )
         if self.backend == "fmm":
             from .ops.fmm import fmm_accelerations
-            from .ops.tree import recommended_depth_data
 
-            depth = config.tree_depth or recommended_depth_data(
-                self.state.positions, config.tree_leaf_cap
+            depth = _resolve_depth_and_warn(
+                config, self.state.positions, "fmm backend", n=n
             )
             return lambda pos, m: fmm_accelerations(
                 pos, m, depth=depth, leaf_cap=config.tree_leaf_cap,
