@@ -605,13 +605,14 @@ def _validate_tpu_battery(checks: dict) -> None:
     # floor even when the rest of the CPU battery shrinks further.
     n_tree = max(n_par, 2048)
     disk = create_disk(_jax.random.PRNGKey(2), n_tree)
+    # One host-side depth sweep serves the tree, fmm, and PE checks.
+    depth_d = recommended_depth_data(disk.positions)
     ref_d = pairwise_accelerations_chunked(
         disk.positions, disk.masses, chunk=min(2048, n_tree),
         g=1.0, eps=0.05,
     )
     acc_t = tree_accelerations(
-        disk.positions, disk.masses,
-        depth=recommended_depth_data(disk.positions), g=1.0, eps=0.05,
+        disk.positions, disk.masses, depth=depth_d, g=1.0, eps=0.05,
     )
     err_t = rel_err(acc_t, ref_d)
     checks["tpu_tree_parity"] = {
@@ -626,12 +627,28 @@ def _validate_tpu_battery(checks: dict) -> None:
     from .ops.fmm import fmm_accelerations
 
     acc_f = fmm_accelerations(
-        disk.positions, disk.masses,
-        depth=recommended_depth_data(disk.positions), g=1.0, eps=0.05,
+        disk.positions, disk.masses, depth=depth_d, g=1.0, eps=0.05,
     )
     err_f = rel_err(acc_f, ref_d)
     checks["tpu_fmm_parity"] = {
         "n": n_tree, "median_rel_err": err_f, "ok": err_f < 0.01,
+    }
+
+    # Gather-free potential energy vs the dense pair scan on the same
+    # disk (the TPU --metrics-energy sample; ~0.5% documented, gated
+    # at 2% like the tree-PE suite check).
+    from .ops.forces import potential_energy
+    from .ops.fmm import fmm_potential_energy
+
+    e_dense = float(potential_energy(
+        disk.positions, disk.masses, g=1.0, eps=0.05
+    ))
+    e_fmm = float(fmm_potential_energy(
+        disk.positions, disk.masses, depth=depth_d, g=1.0, eps=0.05,
+    ))
+    err_pe = abs(e_fmm - e_dense) / max(abs(e_dense), 1e-300)
+    checks["tpu_fmm_potential"] = {
+        "n": n_tree, "rel_err": err_pe, "ok": err_pe < 0.02,
     }
 
     # ...and on the cold-collapse geometry (3D cloud, the other

@@ -9,6 +9,16 @@ before jax initializes a backend, hence the env mutation at import time.
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Persistent compilation cache: the suite is compile-dominated on small
+# hosts, and repeated runs recompile identical programs without this.
+# (Reloads log a noisy XLA:CPU "machine feature +prefer-no-scatter"
+# mismatch error: those are XLA-internal pseudo-features absent from
+# host CPUID, not real ISA gaps — same-host reloads are safe.)
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(os.environ.get("TMPDIR", "/tmp"), "jax_cache_gravity_tpu"),
+)
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.3")
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
