@@ -1,0 +1,112 @@
+"""Sweep the dense-grid FMM's (depth, leaf_cap, order) space on the
+current platform and report s/eval — the measurement that sizes the
+near-field slot waste.
+
+The near-field pass costs 27 x 8^depth x cap^2 pair ops regardless of
+occupancy, so cap wants to sit close to the mean occupied-leaf load:
+``recommended_depth_data`` targets load <= cap/2, which pays up to 4x
+in padded slots for headroom against clustering. Whether tighter caps
+(more overflow monopoles, documented degradation) buy real wall-clock
+on the chip — and where (depth, cap) lands the 1M disk fastest — is
+exactly what a short tunnel window should measure, not model.
+
+Usage:
+    python benchmarks/tune_fmm.py [N] [--quick]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from gravity_tpu.utils.platform import ensure_live_backend  # noqa: E402
+
+ensure_live_backend()
+
+import jax  # noqa: E402
+
+
+def main(argv) -> int:
+    from gravity_tpu.models import create_disk
+    from gravity_tpu.ops.fmm import fmm_accelerations
+    from gravity_tpu.ops.tree import (
+        estimate_cell_memory_bytes,
+        recommended_depth_data,
+    )
+    from gravity_tpu.utils.timing import sync
+
+    args = [a for a in argv if not a.startswith("--")]
+    n = int(args[0]) if args else 262_144
+    quick = "--quick" in argv
+
+    platform = jax.devices()[0].platform
+    state = create_disk(jax.random.PRNGKey(0), n)
+    pos, masses = state.positions, state.masses
+    d0 = recommended_depth_data(pos)
+    print(f"platform={platform} n={n} recommended_depth={d0}")
+
+    configs = [
+        (d0, 32, 2),          # the router's default operating point
+        (d0, 16, 2),          # tighter cap: 4x less near-field arithmetic
+        (d0, 64, 2),          # looser cap: less overflow, 4x more
+        (d0 - 1, 64, 2),      # coarser grid, fatter cells
+        (d0, 32, 1),          # cheaper far field (p=1, ~1% class)
+    ]
+    if not quick:
+        configs += [(d0 + 1, 16, 2), (d0 - 1, 32, 2)]
+
+    rows = []
+    for depth, cap, order in configs:
+        if depth < 3:
+            continue
+        est = estimate_cell_memory_bytes(n, depth, cap)
+        if est > (8 << 30):
+            print(json.dumps({
+                "depth": depth, "cap": cap, "order": order,
+                "skipped": f"cell structures ~{est / (1 << 30):.1f} GiB",
+            }))
+            continue
+        fn = jax.jit(
+            lambda p, m, depth=depth, cap=cap, order=order:
+            fmm_accelerations(
+                p, m, depth=depth, leaf_cap=cap, order=order,
+                g=1.0, eps=0.05, quad=order >= 2,
+            )
+        )
+        try:
+            out = fn(pos, masses)
+            sync(out)
+            iters = 1 if n >= 1_000_000 else 3
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                out = fn(pos, masses)
+            sync(out)
+            dt = (time.perf_counter() - t0) / iters
+        except Exception as e:  # noqa: BLE001 — record, keep sweeping
+            print(json.dumps({
+                "depth": depth, "cap": cap, "order": order,
+                "error": str(e)[:200],
+            }))
+            continue
+        eff = n * (n - 1) / 2 / dt
+        row = {
+            "depth": depth, "cap": cap, "order": order,
+            "s_per_eval": round(dt, 4),
+            "eff_pairs_per_s": f"{eff:.3e}",
+            "cell_mem_gib": round(est / (1 << 30), 2),
+        }
+        rows.append(row)
+        print(json.dumps(row))
+
+    if rows:
+        best = min(rows, key=lambda r: r["s_per_eval"])
+        print(json.dumps({"best": best, "platform": platform}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

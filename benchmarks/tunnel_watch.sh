@@ -29,8 +29,11 @@ while true; do
       # 5. North-star end-to-end: 1M-body leapfrog steps, auto backend.
       timeout 3600 python -m gravity_tpu run --preset baseline-1m \
         --force-backend auto --steps 10 >>"$LOG" 2>&1
-      # 6. Stage breakdown (tree vs fmm pass-by-pass at 1M).
+      # 6. Stage breakdown (tree vs fmm pass-by-pass at 1M) and the
+      #    fmm (depth, cap, order) operating-point sweep.
       timeout 2400 python benchmarks/profile_tree.py 1048576 >>"$LOG" 2>&1
+      timeout 2400 python benchmarks/tune_fmm.py 262144 >>"$LOG" 2>&1
+      timeout 3600 python benchmarks/tune_fmm.py 1048576 --quick >>"$LOG" 2>&1
       # 7. Remaining baseline tags with the round-3 fixes, plus the
       #    P3M short-range A/B (slice default vs gather vs
       #    occupancy-matched sigma).

@@ -67,7 +67,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..constants import CUTOFF_RADIUS, G
-from .cells import bin_to_cells, build_padded_cells, grid_coords
+from .cells import bin_to_cells, grid_coords
 from .tree import (
     _near_offsets,
     _offsets,
