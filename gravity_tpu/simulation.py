@@ -107,6 +107,11 @@ MERGE_GRID_THRESHOLD = 32_768
 # O(N log N) tree potential instead of the dense O(N^2) pair scan (which
 # would cost more than the force step it monitors; ops/tree.py).
 ENERGY_TREE_THRESHOLD = 16_384
+# Multirate fast kicks with K * N pair entries at or under this budget
+# use the exact dense (K, N) rectangular kernel; above it the
+# shifted-slice backends serve the kicks with occupancy-scaled target
+# caps (make_local_kernel).
+DENSE_KICK_BUDGET = 1 << 25
 
 
 def _resolve_direct(config: SimulationConfig, on_tpu: bool) -> str:
@@ -277,7 +282,7 @@ def make_local_kernel(config: SimulationConfig, backend: str,
     if backend == "fmm":
         from .ops.fmm import fmm_accelerations_vs
 
-        if k_targets is not None and k_targets * config.n <= (1 << 25):
+        if k_targets is not None and k_targets * config.n <= DENSE_KICK_BUDGET:
             # Tiny target sets: the exact dense (K, N) kick is cheaper
             # than any grid pass and has zero approximation error.
             return partial(accelerations_vs, **common)
