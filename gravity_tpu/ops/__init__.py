@@ -46,6 +46,11 @@ from .integrators import (
     velocity_verlet,
     yoshida4,
 )
+from .fmm import (
+    fmm_accelerations,
+    fmm_accelerations_vs,
+    fmm_potential_energy,
+)
 from .p3m import p3m_accelerations
 from .spectra import density_power_spectrum
 
@@ -64,6 +69,9 @@ __all__ = [
     "friends_of_friends",
     "eds_kick_factor",
     "energy_drift",
+    "fmm_accelerations",
+    "fmm_accelerations_vs",
+    "fmm_potential_energy",
     "growing_mode_momenta",
     "growth_rate",
     "half_mass_radius",
