@@ -18,7 +18,8 @@ os.environ.setdefault(
     "JAX_COMPILATION_CACHE_DIR",
     os.path.join(os.environ.get("TMPDIR", "/tmp"), "jax_cache_gravity_tpu"),
 )
-os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.3")
+# (the env-var spelling of the min-compile-time floor is not honored
+# by this jax version; set via config.update below instead)
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
@@ -43,6 +44,7 @@ def subprocess_env():
 # alone is not enough. Re-override after import so tests run on the
 # 8-device virtual CPU platform (true float64, deterministic).
 jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
 
 
 @pytest.fixture
