@@ -81,3 +81,58 @@ def test_p3m_kernel_hoisted_out_of_scan():
         f"only {total_ffts} FFTs total — the in-graph kernel build is "
         "missing from the block prologue"
     )
+
+
+def test_fmm_and_p3m_slice_programs_stay_small():
+    """The round-4 shifted-slice programs (fmm self/rect/PE, p3m slice
+    short-range) must lower without giant literal constants — the same
+    remote-compile-transport contract as the Ewald kernel: pads,
+    slices, and scans over the small static offset tables, never a
+    dense baked array."""
+    import numpy as np
+
+    from gravity_tpu.ops.fmm import (
+        fmm_accelerations,
+        fmm_accelerations_vs,
+        _fmm_pe_scaled,
+    )
+    from gravity_tpu.ops.p3m import p3m_accelerations_vs
+
+    pos = jnp.asarray(
+        np.random.default_rng(0).normal(size=(512, 3)).astype(np.float32)
+    )
+    m = jnp.ones((512,), jnp.float32)
+    tgt = pos[:64]
+
+    programs = {
+        "fmm_self": lambda: fmm_accelerations(
+            pos, m, depth=4, g=1.0, eps=0.05
+        ),
+        "fmm_rect": lambda: fmm_accelerations_vs(
+            tgt, pos, m, depth=4, g=1.0, eps=0.05
+        ),
+        "fmm_pe": lambda: _fmm_pe_scaled(
+            pos, m, depth=4, leaf_cap=32, ws=1, g=1.0, cutoff=1e-10,
+            eps=0.05, slab=4,
+        ),
+        "p3m_slice": lambda: p3m_accelerations_vs(
+            tgt, pos, m, grid=32, eps=1e9, short_mode="slice",
+        ),
+    }
+    # Force the in-graph Ewald builder: the CPU dispatcher deliberately
+    # inlines cached numpy kernel constants (documented, local-compile
+    # friendly) — the contract is about what ships to the TPU remote
+    # compiler.
+    from gravity_tpu.ops import p3m as p3m_mod
+
+    orig = p3m_mod._force_kernel_hat
+    p3m_mod._force_kernel_hat = p3m_mod._force_kernel_hat_graph
+    try:
+        for name, fn in programs.items():
+            txt = jax.jit(fn).lower().as_text()
+            assert len(txt) < 4_000_000, (
+                f"{name} lowered to {len(txt)} bytes — a dense literal "
+                "constant is being baked into the program"
+            )
+    finally:
+        p3m_mod._force_kernel_hat = orig
