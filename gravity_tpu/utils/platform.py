@@ -46,13 +46,13 @@ def _force_cpu() -> None:
 def enable_compilation_cache(path: str | None = None) -> None:
     """Point JAX's persistent compilation cache at a stable directory.
 
-    First compiles dominate wall-clock in both environments this repo
-    runs in — ~20-40 s per program over the axon remote-compile
-    transport (a short tunnel window should spend its minutes
-    MEASURING, not recompiling programs it compiled last window) and
-    comparable times on a small CPU host. Safe everywhere: backends
-    that cannot serialize executables just skip the cache (jax logs
-    and proceeds). No-op if the user already configured a cache dir.
+    First compiles cost ~20-40 s per program over the axon
+    remote-compile transport — a short tunnel window should spend
+    measuring, not recompiling last window's programs. Called ONLY on
+    the live-TPU path: XLA:CPU's compile-and-serialize segfaulted a
+    full suite run with the cache active (2026-08-01), so the CPU
+    platform runs uncached. No-op if the user already configured a
+    cache dir.
     """
     import jax
 
@@ -79,13 +79,14 @@ def ensure_live_backend(probe_timeout_s: float = 60.0) -> bool:
     No-ops (returns False) when the platform is already CPU-only, e.g.
     under the test conftest or a virtual host-device mesh. Set
     ``GRAVITY_TPU_NO_PROBE=1`` to skip the probe and trust the configured
-    platform (returns True). Also points the persistent compilation
-    cache at a stable directory (every entry point passes through
-    here, and recompiles are the main tax on short chip windows).
+    platform (returns True). On the live-TPU path it also points the
+    persistent compilation cache at a stable directory (recompiles are
+    the main tax on short chip windows); the CPU platform deliberately
+    runs UNCACHED — XLA:CPU's compile-and-serialize path segfaulted a
+    full suite run (2026-08-01), and CPU compiles are cheap anyway.
     """
     import jax
 
-    enable_compilation_cache()
     if "xla_force_host_platform_device_count" in os.environ.get(
         "XLA_FLAGS", ""
     ):
@@ -106,8 +107,10 @@ def ensure_live_backend(probe_timeout_s: float = 60.0) -> bool:
         if importlib.util.find_spec("libtpu") is None:
             return True
     if os.environ.get("GRAVITY_TPU_NO_PROBE"):
+        enable_compilation_cache()
         return True
     if tpu_tunnel_alive(probe_timeout_s):
+        enable_compilation_cache()
         return True
     print(
         "warning: TPU backend unreachable (wedged tunnel?); "

@@ -9,17 +9,14 @@ before jax initializes a backend, hence the env mutation at import time.
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"
-# Persistent compilation cache: the suite is compile-dominated on small
-# hosts, and repeated runs recompile identical programs without this.
-# (Reloads log a noisy XLA:CPU "machine feature +prefer-no-scatter"
-# mismatch error: those are XLA-internal pseudo-features absent from
-# host CPUID, not real ISA gaps — same-host reloads are safe.)
-os.environ.setdefault(
-    "JAX_COMPILATION_CACHE_DIR",
-    os.path.join(os.environ.get("TMPDIR", "/tmp"), "jax_cache_gravity_tpu"),
-)
-# (the env-var spelling of the min-compile-time floor is not honored
-# by this jax version; set via config.update below instead)
+# NO persistent compilation cache on the CPU platform: with the cache
+# active and an aggressive write floor, one full-suite run SEGFAULTED
+# inside XLA:CPU's compile-and-serialize path
+# (jax/_src/compiler.py _compile_and_write_cache, 2026-08-01) — and
+# cached CPU executables reload with "machine feature" mismatch errors
+# besides. The cache is enabled only on the live-TPU path
+# (utils/platform.ensure_live_backend), where remote-compile time is
+# the real cost and the serialization happens in the TPU runtime.
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
@@ -44,7 +41,6 @@ def subprocess_env():
 # alone is not enough. Re-override after import so tests run on the
 # 8-device virtual CPU platform (true float64, deterministic).
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
 
 
 @pytest.fixture
