@@ -43,6 +43,22 @@ def subprocess_env():
 jax.config.update("jax_platforms", "cpu")
 
 
+@pytest.fixture(autouse=True, scope="module")
+def _release_compiled_programs():
+    """Drop jax's in-process executable caches after each test module.
+
+    The suite compiles many hundreds of XLA:CPU programs in one
+    process; at ~360 tests the accumulated JIT state started
+    segfaulting the compiler itself near the end of full runs
+    (backend_compile_and_load, twice at the same 98% position on
+    2026-08-01, while every module passes in isolation). Releasing
+    executables between modules bounds the accumulation; cross-module
+    cache reuse is minimal, so the wall-clock cost is noise.
+    """
+    yield
+    jax.clear_caches()
+
+
 @pytest.fixture
 def key():
     return jax.random.PRNGKey(0)
