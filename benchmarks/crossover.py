@@ -50,7 +50,9 @@ def main(argv) -> int:
     platform = jax.devices()[0].platform
     on_tpu = platform == "tpu"
     if argv:
-        ns = [int(a) for a in argv]
+        # Ascending and deduped: the winner/crossover selection below
+        # indexes "largest measured n" by position (review finding).
+        ns = sorted({int(a) for a in argv})
     elif on_tpu:
         ns = [65_536, 131_072, 262_144, 524_288, 1_048_576]
     else:
@@ -80,27 +82,38 @@ def main(argv) -> int:
                               "backend": backend, "s_per_eval": dt_s}))
         row["tree_speedup"] = row["direct_s"] / row["tree_s"]
         row["fmm_speedup"] = row["direct_s"] / row["fmm_s"]
+        row["winner"] = (
+            "fmm" if row["fmm_speedup"] >= row["tree_speedup"] else "tree"
+        ) if max(row["tree_speedup"], row["fmm_speedup"]) > 1.0 else "direct"
         results.append(row)
         print(json.dumps(row))
 
-    # Crossover = first n where the best fast solver wins; refine with
-    # the ratio trend (direct scales ~n^2, tree/fmm ~n log n / ~n).
-    winners = [
-        r for r in results
-        if max(r["tree_speedup"], r["fmm_speedup"]) > 1.0
-    ]
-    suggestion = winners[0]["n"] if winners else None
-    best = (
-        max(winners[0].items(), key=lambda kv: kv[1] if "speedup" in kv[0]
-            else -1.0)[0].replace("_speedup", "")
-        if winners else None
-    )
+    # Routed backend = the winner at the LARGEST measured n — the
+    # regime the router applies it to — not at the crossover point,
+    # where a solver can win narrowly while the other dominates
+    # asymptotically (advisor finding, round 4). Per-n winners are
+    # recorded in the rows for future interpolation.
+    winners = [r for r in results if r["winner"] != "direct"]
+    best = winners[-1]["winner"] if winners else None
+    # Crossover = start of the CONTIGUOUS suffix of the ladder where
+    # `best` beats direct (not the first n where anything wins — the
+    # router applies (crossover, best) as a pair, and must never route
+    # `best` into a regime this very sweep measured it slower than the
+    # direct sum, including a noisy mid-ladder loss; review finding).
+    suggestion = None
+    if winners:
+        for r in reversed(results):
+            if r[f"{best}_speedup"] > 1.0:
+                suggestion = r["n"]
+            else:
+                break
     print(json.dumps({
         "suggested_crossover": suggestion,
         "winning_backend": best,
-        "note": "first measured n where a fast solver's force eval beats "
-                "the direct sum on this platform; on TPU this is "
-                "persisted to CROSSOVER_TPU.json for "
+        "note": "start of the contiguous ladder suffix where the routed "
+                "backend (winning_backend = winner at the largest "
+                "measured n) beats the direct sum on this platform; on "
+                "TPU this is persisted to CROSSOVER_TPU.json for "
                 "simulation._measured_fast_crossover",
     }))
     if on_tpu and results:
@@ -124,10 +137,11 @@ def main(argv) -> int:
             "date": time.strftime("%Y-%m-%d %H:%M:%S UTC", time.gmtime()),
             "device": str(jax.devices()[0].device_kind),
         }
-        path = os.path.join(
-            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-            "CROSSOVER_TPU.json",
-        )
+        from gravity_tpu.simulation import crossover_file_path
+
+        # The reader's own resolver: the sweep must write exactly
+        # where _measured_fast_crossover reads (review finding).
+        path = crossover_file_path()
         with open(path, "w") as f:
             json.dump(payload, f, indent=1)
         print(json.dumps({"wrote": path}))

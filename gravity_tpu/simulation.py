@@ -63,6 +63,23 @@ _CROSSOVER_FILE = "CROSSOVER_TPU.json"
 _crossover_cache: dict = {}
 
 
+def crossover_file_path() -> str:
+    """Where the measured TPU crossover sweep lives — ONE resolver
+    shared by the reader (:func:`_measured_fast_crossover`) and the
+    writer (``benchmarks/crossover.py``), so the sweep can never write
+    where the router does not read (review finding).
+
+    ``GRAVITY_TPU_CROSSOVER_FILE`` overrides the dev-layout default
+    (the repo root two levels up breaks for installed site-packages
+    layouts)."""
+    import os as _os
+
+    return _os.environ.get("GRAVITY_TPU_CROSSOVER_FILE") or _os.path.join(
+        _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))),
+        _CROSSOVER_FILE,
+    )
+
+
 def _measured_fast_crossover(on_tpu: bool) -> tuple[int, str]:
     """(N, backend): above N, backend='auto' routes to this fast solver.
 
@@ -75,23 +92,30 @@ def _measured_fast_crossover(on_tpu: bool) -> tuple[int, str]:
     measured to lose (review finding)."""
     if not on_tpu:
         return TREE_CROSSOVER_CPU, "tree"
-    if "tpu" not in _crossover_cache:
-        import json as _json
-        import os as _os
+    import json as _json
+    import os as _os
 
+    # The cache is keyed on (path, mtime) so a sweep written
+    # mid-process — e.g. by the tunnel-watch battery — takes effect on
+    # the next Simulator without a restart (advisor finding).
+    path = crossover_file_path()
+    try:
+        mtime = _os.path.getmtime(path)
+    except OSError:
+        mtime = None
+    key = (path, mtime)
+    if _crossover_cache.get("key") != key:
         value, backend = FMM_CROSSOVER_TPU, "fmm"
-        path = _os.path.join(
-            _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))),
-            _CROSSOVER_FILE,
-        )
-        try:
-            with open(path) as f:
-                data = _json.load(f)
-            value = int(data["fast_crossover"])
-            if data.get("winning_backend") in ("tree", "fmm"):
-                backend = data["winning_backend"]
-        except (OSError, KeyError, ValueError, TypeError):
-            pass
+        if mtime is not None:
+            try:
+                with open(path) as f:
+                    data = _json.load(f)
+                value = int(data["fast_crossover"])
+                if data.get("winning_backend") in ("tree", "fmm"):
+                    backend = data["winning_backend"]
+            except (OSError, KeyError, ValueError, TypeError):
+                pass
+        _crossover_cache["key"] = key
         _crossover_cache["tpu"] = (value, backend)
     return _crossover_cache["tpu"]
 
@@ -215,6 +239,47 @@ def _resolve_depth_and_warn(config: SimulationConfig, positions, where,
     return depth
 
 
+def _occupancy_t_cap(cap: int, k_targets: int, n: int, positions,
+                     side: int, where: str) -> int:
+    """Static target-slot cap for a ~K-target rectangular kick on a
+    side^3 cell grid.
+
+    Mean-occupancy sizing (4x clustering headroom) is the fallback; when
+    concrete initial positions are available the K fastest particles are
+    modeled as landing density-proportionally — the expected target
+    count in a cell scales with that cell's occupancy, so the densest
+    cell needs ~K * max_count / N slots (2x headroom on top). Mean-based
+    sizing silently degrades exactly the close-encounter kicks to the
+    monopole fallback in clustered runs (advisor finding, round 4);
+    when even the full cap cannot hold the modeled densest-cell target
+    load, warn instead of silently overflowing.
+    """
+    mean_based = max(4, -(-4 * cap * k_targets // max(1, n)))
+    if positions is None:
+        return min(cap, mean_based)
+    pos = np.asarray(positions, dtype=np.float64)
+    lo = pos.min(axis=0)
+    span = float(np.max(pos.max(axis=0) - lo)) or 1.0
+    u = np.clip(
+        ((pos - lo[None, :]) / span * side).astype(np.int64), 0, side - 1
+    )
+    ids = (u[:, 0] * side + u[:, 1]) * side + u[:, 2]
+    max_count = int(np.bincount(ids, minlength=side**3).max())
+    density_based = -(-2 * k_targets * max_count // max(1, n))
+    if density_based > cap:
+        import warnings
+
+        warnings.warn(
+            f"{where}: the densest cell holds {max_count} of {n} bodies; "
+            f"~{density_based} fast-rung target slots would be needed "
+            f"but the static cap is {cap} — a fraction of fast kicks "
+            "will take the softened monopole fallback. Raise the cell "
+            "cap or deepen the grid.",
+            stacklevel=3,
+        )
+    return min(cap, max(mean_based, density_based))
+
+
 def make_local_kernel(config: SimulationConfig, backend: str,
                       positions=None, k_targets=None):
     """LocalKernel (pos_targets, pos_sources, m_sources) -> acc for the
@@ -289,10 +354,9 @@ def make_local_kernel(config: SimulationConfig, backend: str,
         depth = _resolve_depth_and_warn(config, positions, "fmm kernel")
         t_cap = 0
         if k_targets is not None:
-            t_cap = min(
-                config.tree_leaf_cap,
-                max(4, -(-4 * config.tree_leaf_cap * k_targets
-                         // max(1, config.n))),
+            t_cap = _occupancy_t_cap(
+                config.tree_leaf_cap, k_targets, config.n, positions,
+                1 << depth, "fmm kernel",
             )
         return partial(
             fmm_accelerations_vs, depth=depth,
@@ -328,12 +392,17 @@ def make_local_kernel(config: SimulationConfig, backend: str,
         t_cap = 0
         if k_targets is not None:
             # Slice-mode rectangular cost scales with the target cap;
-            # size it to the expected K-target cell occupancy (4x
-            # clustering headroom) instead of the full cap.
-            t_cap = min(
-                config.p3m_cap,
-                max(4, -(-4 * config.p3m_cap * k_targets
-                         // max(1, config.n))),
+            # size it to the expected K-target cell occupancy instead
+            # of the full cap.
+            from .ops.p3m import binning_side
+
+            t_cap = _occupancy_t_cap(
+                config.p3m_cap, k_targets, config.n, positions,
+                binning_side(
+                    config.pm_grid, config.p3m_sigma_cells,
+                    config.p3m_rcut_sigmas,
+                ),
+                "p3m kernel",
             )
         return partial(
             p3m_accelerations_vs, grid=config.pm_grid,

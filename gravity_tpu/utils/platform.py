@@ -58,6 +58,18 @@ def enable_compilation_cache(path: str | None = None) -> None:
 
     if os.environ.get("JAX_COMPILATION_CACHE_DIR"):
         return
+    try:
+        # Verify the *initialized* platform really is TPU before turning
+        # the cache on: with GRAVITY_TPU_NO_PROBE=1 this is reached on
+        # trust, and a libtpu install whose device init resolves to CPU
+        # would otherwise re-enable the cache on the segfault-prone
+        # XLA:CPU path (advisor finding, round 4). Both call sites have
+        # already probed or been told to trust device init, so
+        # jax.devices() here cannot newly hang.
+        if jax.devices()[0].platform != "tpu":
+            return
+    except RuntimeError:
+        return
     path = path or os.path.join(
         os.environ.get("TMPDIR", "/tmp"), "jax_cache_gravity_tpu"
     )

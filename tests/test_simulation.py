@@ -463,6 +463,48 @@ def test_multirate_fast_kick_kernel_sizes_to_k():
     assert kp.keywords["t_cap"] == 4
 
 
+def test_multirate_t_cap_sizes_from_actual_clustering():
+    """With concrete initial positions, the fast-kick target cap is
+    sized from the DENSEST cell's occupancy (targets modeled as
+    density-proportional — the K fastest particles concentrate in
+    clustered regions), not from the mean; an un-servable density
+    warns instead of silently overflowing to the monopole fallback
+    (advisor finding, round 4)."""
+    import warnings
+
+    import numpy as np
+    import pytest
+
+    from gravity_tpu.simulation import _occupancy_t_cap
+
+    rng = np.random.default_rng(7)
+    n, cap, side = 8192, 32, 8
+    uniform = rng.uniform(-1.0, 1.0, size=(n, 3))
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        t_uni = _occupancy_t_cap(cap, 16, n, uniform, side, "test")
+    # Uniform occupancy: the density model agrees with the mean model.
+    assert t_uni == 4
+    # A quarter of the bodies packed inside one cell: the densest cell
+    # holds ~n/4 -> ceil(2 * 16 * (n/4) / n) = 8 slots needed.
+    clustered = uniform.copy()
+    # Cluster placed in a cell interior (0.6 is ~0.4 cell-widths from
+    # the nearest boundary at side=8), not at the origin, which is a
+    # cell CORNER that would split the cluster across 8 cells.
+    clustered[: n // 4] = 0.6 + 1e-3 * rng.uniform(
+        -1.0, 1.0, size=(n // 4, 3)
+    )
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        t_clu = _occupancy_t_cap(cap, 16, n, clustered, side, "test")
+    assert t_clu >= 8 > t_uni
+    # K large enough that even the full cap cannot hold the modeled
+    # densest-cell load: clamp to cap and warn.
+    with pytest.warns(UserWarning, match="monopole fallback"):
+        t_over = _occupancy_t_cap(cap, 128, n, clustered, side, "test")
+    assert t_over == cap
+
+
 def test_measured_crossover_file_overrides_default(tmp_path, monkeypatch):
     """CROSSOVER_TPU.json (written by benchmarks/crossover.py on a live
     chip) overrides the cost-model FMM_CROSSOVER_TPU default: a chip
@@ -483,9 +525,30 @@ def test_measured_crossover_file_overrides_default(tmp_path, monkeypatch):
         sim_mod, "__file__", str(fake_pkg / "simulation.py")
     )
     assert sim_mod._measured_fast_crossover(True) == (131_072, "fmm")
-    # Cached after first read.
+    # The cache is keyed on the file's mtime (advisor finding): a sweep
+    # written mid-process — the tunnel-watch battery — takes effect on
+    # the next Simulator without a restart, and deleting the file
+    # reverts to the cost-model default.
     (fake_root / "CROSSOVER_TPU.json").unlink()
-    assert sim_mod._measured_fast_crossover(True) == (131_072, "fmm")
+    assert sim_mod._measured_fast_crossover(True) == (
+        sim_mod.FMM_CROSSOVER_TPU, "fmm"
+    )
+    import os as _os
+
+    (fake_root / "CROSSOVER_TPU.json").write_text(
+        json.dumps({"fast_crossover": 65_536, "winning_backend": "fmm"})
+    )
+    _os.utime(fake_root / "CROSSOVER_TPU.json", (1, 1))
+    assert sim_mod._measured_fast_crossover(True) == (65_536, "fmm")
+    # GRAVITY_TPU_CROSSOVER_FILE overrides the dev-layout default path
+    # (installed site-packages layouts have no repo root to walk to).
+    alt = tmp_path / "alt.json"
+    alt.write_text(
+        json.dumps({"fast_crossover": 98_304, "winning_backend": "tree"})
+    )
+    monkeypatch.setenv("GRAVITY_TPU_CROSSOVER_FILE", str(alt))
+    assert sim_mod._measured_fast_crossover(True) == (98_304, "tree")
+    monkeypatch.delenv("GRAVITY_TPU_CROSSOVER_FILE")
     # CPU path ignores the file entirely.
     assert sim_mod._measured_fast_crossover(False) == (
         sim_mod.TREE_CROSSOVER_CPU, "tree"
