@@ -107,6 +107,13 @@ def main(argv) -> int:
                 suggestion = r["n"]
             else:
                 break
+    if winners and suggestion is None:
+        # The candidate loses at the TOP of the ladder (direct retook
+        # the largest measured n): there is no fast regime to route
+        # into — record a lower bound like the no-winner branch, never
+        # a backend the sweep last measured losing (review finding).
+        best = None
+        winners = []
     print(json.dumps({
         "suggested_crossover": suggestion,
         "winning_backend": best,

@@ -5,8 +5,10 @@
 # (platform:"tpu" for the first time in five rounds), then the on-chip
 # smoke gate, then the flagship chip-untested component (FMM at 1M/2M),
 # the three-way crossover that calibrates auto routing, and the
-# north-star 1M end-to-end step. Each command is individually timed out
-# so a mid-run wedge loses one measurement, not the window.
+# north-star 1M end-to-end step. Each step is individually timed out
+# AND preceded by a cheap liveness re-probe, so a mid-battery wedge
+# loses one measurement — not the sum of every remaining step's
+# timeout (~13 h) grinding the big benches on the CPU fallback.
 #
 # After the first full battery, keep probing and refresh the bench.py
 # headline every ~30 min so BENCH_LAST_TPU.json stays as fresh as the
@@ -20,45 +22,60 @@ cd /root/repo
 mkdir -p /root/repo/chip_logs
 LOG=/root/repo/chip_logs/tunnel_watch_r5.log
 battery_done=0
+
+alive() { timeout 75 python -c "import jax; jax.devices()" >/dev/null 2>&1; }
+
+# step <timeout_s> <cmd...>: re-probe, then run. A dead probe aborts
+# the battery (aborted=1) so the outer loop goes back to waiting.
+aborted=0
+step() {
+  [ "$aborted" = 1 ] && return
+  if ! alive; then
+    echo "=== tunnel died mid-battery before: ${*:2} ($(date -u +%FT%TZ)) ===" >>"$LOG"
+    aborted=1
+    return
+  fi
+  timeout "$@" >>"$LOG" 2>&1
+}
+
 while true; do
-  if timeout 75 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+  if alive; then
     if [ "$battery_done" = 0 ]; then
       echo "=== TUNNEL ALIVE $(date -u +%FT%TZ) — round-5 battery ===" >>"$LOG"
+      aborted=0
       # 1. Driver headline first (fast, writes BENCH_LAST_TPU.json).
-      timeout 1200 python bench.py >>"$LOG" 2>&1
+      step 1200 python bench.py
       # 2. On-chip smoke gate (incl. the fmm parity check).
-      timeout 1200 python -m gravity_tpu validate --tpu >>"$LOG" 2>&1
+      step 1200 python -m gravity_tpu validate --tpu
       # 3. The flagship chip-untested component: FMM at 1M and 2M.
-      timeout 3600 python benchmarks/run_baselines.py 1m-fmm >>"$LOG" 2>&1
-      timeout 5400 python benchmarks/run_baselines.py 2m-fmm >>"$LOG" 2>&1
+      step 3600 python benchmarks/run_baselines.py 1m-fmm
+      step 5400 python benchmarks/run_baselines.py 2m-fmm
       # 4. Three-way direct/tree/fmm crossover (calibrates auto routing;
       #    writes CROSSOVER_TPU.json for the router).
-      timeout 5400 python benchmarks/crossover.py >>"$LOG" 2>&1
+      step 5400 python benchmarks/crossover.py
       # 5. North-star end-to-end: 1M-body leapfrog steps, auto backend.
-      timeout 3600 python -m gravity_tpu run --preset baseline-1m \
-        --force-backend auto --steps 10 >>"$LOG" 2>&1
+      step 3600 python -m gravity_tpu run --preset baseline-1m \
+        --force-backend auto --steps 10
       # 6. P3M short-range A/B on the chip (VERDICT r4 item 3: the CPU
       #    A/B contradicts the TPU slice default; decide from the chip).
-      timeout 3600 python benchmarks/run_baselines.py 1m-p3m >>"$LOG" 2>&1
-      timeout 3600 python benchmarks/run_baselines.py 1m-p3m-gather >>"$LOG" 2>&1
-      timeout 3600 python benchmarks/run_baselines.py 1m-p3m-s2 >>"$LOG" 2>&1
+      step 3600 python benchmarks/run_baselines.py 1m-p3m
+      step 3600 python benchmarks/run_baselines.py 1m-p3m-gather
+      step 3600 python benchmarks/run_baselines.py 1m-p3m-s2
       # 7. 1m-tree under the HBM audit (VERDICT r4 item 7 root-cause).
-      timeout 3600 python benchmarks/run_baselines.py 1m-tree >>"$LOG" 2>&1
+      step 3600 python benchmarks/run_baselines.py 1m-tree
       # 8. Stage breakdown and fmm operating-point sweep.
-      timeout 2400 python benchmarks/profile_tree.py 1048576 >>"$LOG" 2>&1
-      timeout 2400 python benchmarks/tune_fmm.py 262144 >>"$LOG" 2>&1
-      timeout 3600 python benchmarks/tune_fmm.py 1048576 --quick >>"$LOG" 2>&1
+      step 2400 python benchmarks/profile_tree.py 1048576
+      step 2400 python benchmarks/tune_fmm.py 262144
+      step 3600 python benchmarks/tune_fmm.py 1048576 --quick
       # 9. Remaining baseline tags.
-      timeout 5400 python benchmarks/run_baselines.py 2m-merger >>"$LOG" 2>&1
-      timeout 2400 python benchmarks/run_baselines.py cosmo-262k >>"$LOG" 2>&1
-      timeout 1200 python benchmarks/tune_pallas.py 262144 >>"$LOG" 2>&1
-      # Mark the battery done ONLY if the tunnel is still answering at
-      # the end: a tunnel that wedged mid-battery (every remaining step
-      # burning its timeout with no measurements) must leave
-      # battery_done=0 so a later healthy window re-runs the battery
-      # rather than just refreshing bench.py (review finding).
-      if timeout 75 python -c "import jax; jax.devices()" >/dev/null 2>&1
-      then
+      step 5400 python benchmarks/run_baselines.py 2m-merger
+      step 2400 python benchmarks/run_baselines.py cosmo-262k
+      step 1200 python benchmarks/tune_pallas.py 262144
+      # Mark the battery done ONLY if it ran to the end with the tunnel
+      # still answering: a wedge mid-battery must leave battery_done=0
+      # so a later healthy window re-runs the battery rather than just
+      # refreshing bench.py (review finding).
+      if [ "$aborted" = 0 ] && alive; then
         echo "=== BATTERY DONE $(date -u +%FT%TZ) ===" >>"$LOG"
         battery_done=1
         touch /tmp/chip_battery_r5_done

@@ -255,7 +255,12 @@ def _occupancy_t_cap(cap: int, k_targets: int, n: int, positions,
     load, warn instead of silently overflowing.
     """
     mean_based = max(4, -(-4 * cap * k_targets // max(1, n)))
-    if positions is None:
+    if positions is None or not getattr(
+        positions, "is_fully_addressable", True
+    ):
+        # Multi-host mesh: the global array cannot be fetched to this
+        # host (same guard as ops.tree.recommended_depth_data); fall
+        # back to the mean-based estimate rather than crash.
         return min(cap, mean_based)
     pos = np.asarray(positions, dtype=np.float64)
     lo = pos.min(axis=0)

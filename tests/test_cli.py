@@ -7,6 +7,8 @@ import os
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.fast  # reference-contract lane (README: two-tier tests)
+
 from gravity_tpu.cli import main
 
 

@@ -5,6 +5,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.fast  # reference-contract lane (README: two-tier tests)
+
 from gravity_tpu.constants import CUTOFF_RADIUS, G
 from gravity_tpu.models import create_solar_system
 from gravity_tpu.ops.forces import (

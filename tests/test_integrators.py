@@ -5,6 +5,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.fast  # reference-contract lane (README: two-tier tests)
+
 from gravity_tpu.constants import DEFAULT_DT, G
 from gravity_tpu.models import create_solar_system
 from gravity_tpu.ops.diagnostics import energy_drift, total_energy

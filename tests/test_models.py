@@ -5,6 +5,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.fast  # reference-contract lane (README: two-tier tests)
+
 from gravity_tpu import constants as C
 from gravity_tpu.models import (
     MODELS,

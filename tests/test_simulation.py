@@ -10,6 +10,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.fast  # reference-contract lane (README: two-tier tests)
+
 from gravity_tpu.config import PRESETS, SimulationConfig
 from gravity_tpu.simulation import Simulator
 from gravity_tpu.utils.logging import RunLogger

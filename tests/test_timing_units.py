@@ -8,6 +8,8 @@ import sys
 
 import pytest
 
+pytestmark = pytest.mark.fast  # reference-contract lane (README: two-tier tests)
+
 from gravity_tpu.utils.timing import StepTimer, pairs_per_step, throughput
 
 
