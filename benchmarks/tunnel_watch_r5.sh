@@ -61,6 +61,9 @@ while true; do
       step 3600 python benchmarks/run_baselines.py 1m-p3m
       step 3600 python benchmarks/run_baselines.py 1m-p3m-gather
       step 3600 python benchmarks/run_baselines.py 1m-p3m-s2
+      #    ...and persist the winner so the auto short mode routes on
+      #    the measurement (writes P3M_SHORT_TPU.json).
+      step 3600 python benchmarks/p3m_short_ab.py
       # 7. 1m-tree under the HBM audit (VERDICT r4 item 7 root-cause).
       step 3600 python benchmarks/run_baselines.py 1m-tree
       # 8. Stage breakdown and fmm operating-point sweep.

@@ -103,11 +103,16 @@ def ffi_pairwise_accelerations(
 def make_ffi_local_kernel(
     *, g: float = G, cutoff: float = CUTOFF_RADIUS, eps: float = 0.0
 ):
-    """A LocalKernel closure for the sharded strategies (CPU platform)."""
+    """A LocalKernel closure for the sharded strategies (CPU platform).
 
-    def kernel(pos_i, pos_j, masses_j):
+    Differentiable via :func:`ops.forces.wrap_with_dense_vjp` (the XLA
+    FFI call has no autodiff rule; the backward runs the dense jnp
+    math of the same force contract)."""
+    from .forces import wrap_with_dense_vjp
+
+    def _forward(pos_i, pos_j, masses_j):
         return ffi_accelerations_vs(
             pos_i, pos_j, masses_j, g=g, cutoff=cutoff, eps=eps
         )
 
-    return kernel
+    return wrap_with_dense_vjp(_forward, g=g, cutoff=cutoff, eps=eps)

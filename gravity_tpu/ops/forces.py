@@ -162,3 +162,33 @@ def potential_energy(
 
     rows = jax.lax.map(one_chunk, pos_chunks).reshape(n_padded)[:n]
     return -0.5 * jnp.sum(gm * rows)
+
+
+def wrap_with_dense_vjp(
+    forward, *, g: float = G, cutoff: float = CUTOFF_RADIUS,
+    eps: float = 0.0,
+):
+    """Attach a custom VJP to a LocalKernel whose native form has no
+    autodiff rule (the Pallas kernel, the C++ XLA FFI kernel): the
+    backward pass is ``jax.vjp`` of :func:`accelerations_vs` — the same
+    ``_pair_weights`` force contract the native kernels implement, so
+    gradients are exact for the math the forward computes. The backward
+    materializes the dense (M, K) pair block: fine at trajectory-
+    optimization scale, not meant for 262k+ grads. ONE definition so
+    the two native kernels cannot drift (review finding)."""
+
+    @jax.custom_vjp
+    def kernel(pos_i, pos_j, masses_j):
+        return forward(pos_i, pos_j, masses_j)
+
+    def _fwd(pos_i, pos_j, masses_j):
+        return forward(pos_i, pos_j, masses_j), (pos_i, pos_j, masses_j)
+
+    def _bwd(res, ct):
+        _, vjp = jax.vjp(
+            partial(accelerations_vs, g=g, cutoff=cutoff, eps=eps), *res
+        )
+        return vjp(ct)
+
+    kernel.defvjp(_fwd, _bwd)
+    return kernel

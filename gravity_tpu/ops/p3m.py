@@ -48,6 +48,77 @@ from .pm import bounding_cube, cic_deposit, cic_gather
 from .tree import _near_offsets
 
 
+_SHORT_AB_FILE = "P3M_SHORT_TPU.json"
+_short_ab_cache: dict = {}
+
+
+def p3m_short_ab_path() -> str:
+    """Where the measured TPU slice-vs-gather A/B lives — shared by the
+    reader (:func:`resolve_short_mode`) and the writer
+    (``benchmarks/p3m_short_ab.py``). ``GRAVITY_TPU_P3M_SHORT_FILE``
+    overrides the dev-layout default (repo root)."""
+    import os
+
+    return os.environ.get("GRAVITY_TPU_P3M_SHORT_FILE") or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))),
+        _SHORT_AB_FILE,
+    )
+
+
+def measured_short_mode():
+    """The chip-measured short-range winner ("slice"/"gather"), or None
+    when no measurement is recorded. Cache keyed on the file's mtime so
+    an A/B written mid-process (the tunnel-watch battery) takes effect
+    on the next trace without a restart — the same measurement-beats-
+    model contract as ``simulation._measured_fast_crossover``."""
+    import json
+    import os
+
+    path = p3m_short_ab_path()
+    try:
+        mtime = os.path.getmtime(path)
+    except OSError:
+        mtime = None
+    key = (path, mtime)
+    if _short_ab_cache.get("key") != key:
+        winner = None
+        if mtime is not None:
+            try:
+                with open(path) as f:
+                    data = json.load(f)
+                # isinstance: valid-but-non-object JSON (a bare list or
+                # string from an interrupted producer) must fall back
+                # to the cost model, not crash the trace.
+                if isinstance(data, dict) and data.get("winner") in (
+                    "slice", "gather"
+                ):
+                    winner = data["winner"]
+            except (OSError, ValueError, TypeError):
+                pass
+        _short_ab_cache["key"] = key
+        _short_ab_cache["winner"] = winner
+    return _short_ab_cache["winner"]
+
+
+def resolve_short_mode(short_mode: str, backend: str | None = None) -> str:
+    """Resolve 'auto' to a concrete short-range mode for ``backend``
+    (default: the current trace platform).
+
+    CPU: 'gather' — measured faster (BASELINE.md round-4 A/B: gather
+    269 ms vs slice 283 ms at sigma 2.0, 1141 ms at sigma 1.25).
+    TPU: the recorded chip A/B (:func:`measured_short_mode`) when one
+    exists, else the cost-model default 'slice' (gathers are
+    index-rate-limited on TPU — the failure mode the chip measured on
+    the tree backend; the slice pass is gather-free)."""
+    if short_mode != "auto":
+        return short_mode
+    backend = backend or jax.default_backend()
+    if backend == "tpu":
+        return measured_short_mode() or "slice"
+    return "gather"
+
+
 def check_p3m_sizing(
     n: int, grid: int, sigma_cells: float, rcut_sigmas: float, cap: int
 ) -> str | None:
@@ -219,17 +290,27 @@ def _mesh_accelerations(targets, positions, masses, origin, span, *, grid,
     return cic_gather(acc_field, targets, origin, h)
 
 
-def _short_range_w(r2, u, eps2, alpha3, dtype):
-    """diff-multiplier w(r) of the short-range pair force, u = alpha * r.
+def _short_range_w(r2, alpha, eps2, alpha3, dtype):
+    """diff-multiplier w(r) of the short-range pair force.
 
-    w = (r^2 + eps^2)^(-3/2) + alpha^3 * hfun(u) / u^2  where
+    w = (r^2 + eps^2)^(-3/2) + alpha^3 * hfun(u) / u^2  where u = alpha*r,
     hfun(u) = (2/sqrt(pi)) exp(-u^2) - erf(u)/u  (<= 0: the correction
     subtracts the mesh's smooth kernel so the pair sum adds the exact
     softened-Newtonian force for near pairs). hfun/u^2 is evaluated by
     series below u = 0.05 (the exact form is 0/0 at u = 0). ``eps2`` may
     be elementwise (the overflow fallback widens it per cell).
+
+    The sqrt and rsqrt both live behind floors: sqrt'(0) and rsqrt'(0)
+    are inf, and every caller has masked lanes with r2 exactly 0
+    (self-pairs, padded slots, zeroed overflow diffs) whose where-mask
+    turns that inf into 0 * inf = NaN in the BACKWARD pass, poisoning
+    jax.grad through the whole p3m pipeline (the rsqrt needs it too
+    whenever eps == 0 — the op's default). The floor is far below the
+    cutoff contract's r^2 (1e-20), so no live pair ever sees it.
     """
-    newt = jax.lax.rsqrt(r2 + eps2)
+    tiny = jnp.asarray(1e-30, dtype)
+    u = alpha * jnp.sqrt(jnp.maximum(r2, tiny))
+    newt = jax.lax.rsqrt(jnp.maximum(r2 + eps2, tiny))
     newt = newt * newt * newt
     safe_u = jnp.maximum(u, jnp.asarray(1e-20, dtype))
     two_over_sqrt_pi = jnp.asarray(2.0 / math.sqrt(math.pi), dtype)
@@ -324,7 +405,7 @@ def _short_range_shifted(
             )
             ok = jnp.logical_and(ok, r2 > 0)  # self/coincident pairs
             w = _short_range_w(
-                r2, alpha_t * jnp.sqrt(r2), eps2, alpha3_t, dtype
+                r2, alpha_t, eps2, alpha3_t, dtype
             )
             w = jnp.where(
                 ok, jnp.asarray(g, dtype) * smass[:, None, :] * w, 0.0
@@ -348,7 +429,7 @@ def _short_range_shifted(
             )
             r2o = jnp.sum(diff_o * diff_o, axis=-1)
             w_o = _short_range_w(
-                r2o, alpha_t * jnp.sqrt(r2o), eps_o2, alpha3_t, dtype
+                r2o, alpha_t, eps_o2, alpha3_t, dtype
             )
             w_o = jnp.where(
                 r_over[:, None],
@@ -398,13 +479,33 @@ def _short_overflow_targets(
         )
         r2 = jnp.sum(diff * diff, axis=-1)
         w = _short_range_w(
-            r2, alpha_t * jnp.sqrt(r2), eps_o2, alpha3_t, dtype
+            r2, alpha_t, eps_o2, alpha3_t, dtype
         )
         w = jnp.where(ok, jnp.asarray(g, dtype) * sm * w, 0.0)
         return acc + w[:, None] * diff, None
 
     acc, _ = jax.lax.scan(body, jnp.zeros((m, 3), dtype), near)
     return acc
+
+
+def p3m_accelerations_vs(
+    targets: jax.Array,
+    positions: jax.Array,
+    masses: jax.Array,
+    *,
+    short_mode: str = "auto",
+    **kwargs,
+) -> jax.Array:
+    """See :func:`_p3m_accelerations_vs_impl` — this thin wrapper
+    resolves ``short_mode='auto'`` BEFORE the jit boundary, so the
+    executable cache is keyed on the concrete mode: a P3M_SHORT_TPU.json
+    written mid-process re-routes the next call instead of being
+    shadowed forever by an executable compiled under the 'auto' key
+    (review finding)."""
+    return _p3m_accelerations_vs_impl(
+        targets, positions, masses,
+        short_mode=resolve_short_mode(short_mode), **kwargs,
+    )
 
 
 @partial(
@@ -414,7 +515,7 @@ def _short_overflow_targets(
         "g", "cutoff", "eps", "short_mode", "t_cap", "_self",
     ),
 )
-def p3m_accelerations_vs(
+def _p3m_accelerations_vs_impl(
     targets: jax.Array,
     positions: jax.Array,
     masses: jax.Array,
@@ -454,8 +555,11 @@ def p3m_accelerations_vs(
       (TPU gathers are index-rate-limited — the failure mode the chip
       measured on the tree backend). Prefers occupancy ~ ``cap``
       (sigma_cells ~ 2.0 at 1M/grid 256); see docs/scaling.md.
-    - ``"auto"`` (default) — "slice" when tracing for TPU, else
-      "gather".
+    - ``"auto"`` (default) — platform-keyed: "gather" off-TPU (measured
+      faster on CPU, BASELINE.md round-4 A/B); on TPU the recorded chip
+      A/B in P3M_SHORT_TPU.json (``benchmarks/p3m_short_ab.py``) when
+      one exists, else the cost-model default "slice"
+      (:func:`resolve_short_mode`).
     """
     n = positions.shape[0]
     dtype = positions.dtype
@@ -490,11 +594,10 @@ def p3m_accelerations_vs(
     )
     ccom = cmw / jnp.maximum(cmass_hat, jnp.asarray(1e-37, dtype))[:, None]
 
-    mode = short_mode
-    if mode == "auto":
-        # Trace-time platform dispatch, same rule as _force_kernel_hat:
-        # gathers are cheap on CPU, index-rate-limited on TPU.
-        mode = "slice" if jax.default_backend() == "tpu" else "gather"
+    # Trace-time platform dispatch (gathers are cheap on CPU,
+    # index-rate-limited on TPU), with a recorded chip A/B overriding
+    # the cost model (measurement-beats-model; resolve_short_mode).
+    mode = resolve_short_mode(short_mode)
     if mode == "slice":
         t_cap_eff = t_cap or cap
         kt = targets.shape[0]
@@ -549,7 +652,6 @@ def p3m_accelerations_vs(
     def pair_w(diff, src_m, ok):
         """Masked short-range diff-multiplier for gathered sources."""
         r2 = jnp.sum(diff * diff, axis=-1)
-        r = jnp.sqrt(r2)
         ok = jnp.logical_and(ok, r2 < jnp.asarray(rcut * rcut, dtype))
         ok = jnp.logical_and(
             ok, r2 + jnp.asarray(eps * eps, dtype)
@@ -559,7 +661,7 @@ def p3m_accelerations_vs(
         # mesh kernel handles smoothly).
         ok = jnp.logical_and(ok, r2 > 0)
         w = _short_range_w(
-            r2, alpha_t * r, jnp.asarray(eps * eps, dtype), alpha3_t, dtype
+            r2, alpha_t, jnp.asarray(eps * eps, dtype), alpha3_t, dtype
         )
         w = jnp.where(ok, jnp.asarray(g, dtype) * src_m * w, 0.0)
         return w
@@ -616,7 +718,6 @@ def p3m_accelerations_vs(
             )[..., None]
             diff_o = rem_com - pos_c[:, None, :]
             r2 = jnp.sum(diff_o * diff_o, axis=-1)
-            r = jnp.sqrt(r2)
             # Cell-size-softened: an overflowing cell's COM can sit
             # arbitrarily close to a target.
             cell_h = span / side
@@ -624,7 +725,7 @@ def p3m_accelerations_vs(
                 jnp.asarray(eps * eps, dtype),
                 (0.5 * cell_h) * (0.5 * cell_h),
             )
-            w_o = _short_range_w(r2, alpha_t * r, eps_o2, alpha3_t, dtype)
+            w_o = _short_range_w(r2, alpha_t, eps_o2, alpha3_t, dtype)
             w_o = jnp.where(
                 over, jnp.asarray(g, dtype) * rem_mhat * m_scale * w_o, 0.0
             )

@@ -192,13 +192,19 @@ def make_pallas_local_kernel(
     *, g: float = G, cutoff: float = CUTOFF_RADIUS, eps: float = 0.0,
     tile_i: int = TILE_I, tile_j: int = TILE_J, interpret: bool = False,
 ):
-    """A LocalKernel closure for the sharded strategies."""
+    """A LocalKernel closure for the sharded strategies.
 
-    def kernel(pos_i, pos_j, masses_j):
+    Differentiable via :func:`ops.forces.wrap_with_dense_vjp`
+    (pallas_call has no autodiff rule; the backward runs the dense jnp
+    math of the same force contract).
+    """
+    from .forces import wrap_with_dense_vjp
+
+    def _forward(pos_i, pos_j, masses_j):
         return pallas_accelerations_vs(
             pos_i, pos_j, masses_j,
             g=g, cutoff=cutoff, eps=eps,
             tile_i=tile_i, tile_j=tile_j, interpret=interpret,
         )
 
-    return kernel
+    return wrap_with_dense_vjp(_forward, g=g, cutoff=cutoff, eps=eps)

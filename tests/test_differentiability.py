@@ -214,3 +214,153 @@ def test_fmm_rollout_grad_matches_finite_difference(key, x64):
     assert bool(jnp.isfinite(g2))
     fd2 = (loss_vs(1.0 + h) - loss_vs(1.0 - h)) / (2 * h)
     np.testing.assert_allclose(float(g2), float(fd2), rtol=5e-3)
+
+
+def test_pm_rollout_grad_matches_finite_difference(key, x64):
+    """jax.grad flows through the PM pipeline — CIC deposit (piecewise-
+    linear in positions), the FFT Poisson solve, and CIC gather — and
+    matches central finite differences. The mesh ASSIGNMENT weights are
+    differentiable (CIC is a tent function); only the cell flooring is
+    piecewise-constant, same caveat as the fmm test above."""
+    from gravity_tpu.models import create_disk
+    from gravity_tpu.ops.pm import pm_accelerations
+
+    state = create_disk(key, 256, dtype=jnp.float64)
+    masses = state.masses
+
+    def loss(scale):
+        a = pm_accelerations(
+            state.positions * scale, masses, grid=32, g=1.0, eps=0.05
+        )
+        return jnp.sum(a * a)
+
+    g = jax.grad(loss)(1.0)
+    assert bool(jnp.isfinite(g))
+    h = 1e-6
+    fd = (loss(1.0 + h) - loss(1.0 - h)) / (2 * h)
+    np.testing.assert_allclose(float(g), float(fd), rtol=1e-6)
+
+
+@pytest.mark.parametrize("mode", ["gather", "slice"])
+@pytest.mark.parametrize("eps", [0.05, 0.0])
+def test_p3m_rollout_grad_matches_finite_difference(key, x64, mode, eps):
+    """jax.grad through BOTH P3M short-range data movements (the
+    whole-block gather path and the TPU shifted-slice path) matches
+    finite differences. Regression: the short-range kernel computed
+    sqrt(r2) on masked r2 == 0 lanes (self-pairs, padded slots, zeroed
+    overflow diffs); sqrt'(0) = inf made the where-mask emit 0 * inf =
+    NaN in the backward pass, so grads through p3m were NaN until the
+    sqrt moved inside _short_range_w behind a floor (round 5). eps=0
+    (the op default) needs the same floor under the Newtonian rsqrt —
+    covered by the eps parametrization."""
+    from gravity_tpu.models import create_disk
+    from gravity_tpu.ops.p3m import p3m_accelerations
+
+    state = create_disk(key, 256, dtype=jnp.float64)
+    masses = state.masses
+
+    def loss(scale):
+        a = p3m_accelerations(
+            state.positions * scale, masses, grid=32, g=1.0, eps=eps,
+            cap=32, short_mode=mode,
+        )
+        return jnp.sum(a * a)
+
+    g = jax.grad(loss)(1.0)
+    assert bool(jnp.isfinite(g))
+    h = 1e-6
+    fd = (loss(1.0 + h) - loss(1.0 - h)) / (2 * h)
+    np.testing.assert_allclose(float(g), float(fd), rtol=1e-5)
+
+
+@pytest.mark.parametrize("strategy", ["allgather", "ring"])
+def test_sharded_rollout_grad_matches_finite_difference(key, x64, strategy):
+    """jax.grad composes with the sharded force strategies — through
+    lax.all_gather and the ppermute ring alike — over a scanned
+    leapfrog rollout on the 8-device virtual mesh (VERDICT round-4
+    item 6: close the differentiability matrix's sharded row)."""
+    from jax.sharding import Mesh
+
+    from gravity_tpu.parallel.sharded import make_sharded_accel2
+
+    mesh = Mesh(np.array(jax.devices()), ("shard",))
+    pos, masses = _random_system(key, 64)
+    vel0 = jnp.zeros_like(pos)
+    accel2 = make_sharded_accel2(mesh, strategy=strategy)
+
+    def accel(p):
+        return accel2(p, masses)
+
+    step = make_step_fn("leapfrog", accel, 3600.0)
+
+    def loss(scale):
+        st = ParticleState(pos, vel0 + scale * 1e3, masses)
+        final = _rollout(step, accel, st, 5)
+        return jnp.sum(final.positions**2)
+
+    g = jax.grad(loss)(1.0)
+    assert bool(jnp.isfinite(g))
+    h = 1e-4
+    fd = (loss(1.0 + h) - loss(1.0 - h)) / (2 * h)
+    np.testing.assert_allclose(float(g), float(fd), rtol=1e-5)
+
+
+def test_native_kernels_grad_via_dense_vjp(key, x64):
+    """The Pallas and C++ FFI kernels (no native autodiff rule) carry a
+    custom VJP routed through the dense jnp kernel — gradients match
+    the dense backend's exactly (same _pair_weights contract)."""
+    from gravity_tpu.ops.forces import accelerations_vs
+    from gravity_tpu.ops.pallas_forces import make_pallas_local_kernel
+
+    pos, masses = _random_system(key, 64, dtype=jnp.float32)
+
+    def loss_with(kernel):
+        return lambda p: jnp.sum(kernel(p, p, masses) ** 2)
+
+    dense = lambda ti, sj, m: accelerations_vs(ti, sj, m, eps=0.0)  # noqa: E731
+    g_ref = jax.grad(loss_with(dense))(pos)
+
+    # rtol: the custom-VJP backward math is IDENTICAL to dense; the
+    # residual fp32 difference enters only through the cotangent
+    # (2 * acc), where acc is the pallas vs dense forward (roundoff).
+    pallas = make_pallas_local_kernel(interpret=True)
+    g_pallas = jax.grad(loss_with(pallas))(pos)
+    np.testing.assert_allclose(
+        np.asarray(g_pallas), np.asarray(g_ref), rtol=5e-4
+    )
+
+    from gravity_tpu.ops.ffi_forces import (
+        ffi_forces_available,
+        make_ffi_local_kernel,
+    )
+
+    if ffi_forces_available():
+        cpp = make_ffi_local_kernel()
+        g_cpp = jax.grad(loss_with(cpp))(pos)
+        np.testing.assert_allclose(
+            np.asarray(g_cpp), np.asarray(g_ref), rtol=5e-4
+        )
+
+
+def test_tree_grad_matches_finite_difference(key, x64):
+    """jax.grad through the octree backend (Morton sort, segment_sums,
+    capped-exact near field, multipole far field) matches finite
+    differences — same a.e.-differentiability caveat as fmm/pm."""
+    from gravity_tpu.models import create_disk
+    from gravity_tpu.ops.tree import tree_accelerations
+
+    state = create_disk(key, 256, dtype=jnp.float64)
+    masses = state.masses
+
+    def loss(scale):
+        a = tree_accelerations(
+            state.positions * scale, masses, depth=3, g=1.0, eps=0.05,
+            leaf_cap=32,
+        )
+        return jnp.sum(a * a)
+
+    g = jax.grad(loss)(1.0)
+    assert bool(jnp.isfinite(g))
+    h = 1e-6
+    fd = (loss(1.0 + h) - loss(1.0 - h)) / (2 * h)
+    np.testing.assert_allclose(float(g), float(fd), rtol=1e-5)

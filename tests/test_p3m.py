@@ -229,3 +229,43 @@ def test_simulator_warns_on_small_cap():
         warnings.simplefilter("always")
         Simulator(config)
     assert any("p3m cap" in str(x.message) for x in w)
+
+
+def test_short_mode_measurement_beats_model(tmp_path, monkeypatch):
+    """'auto' routes the short-range pass on a recorded chip A/B when
+    one exists (P3M_SHORT_TPU.json, written by
+    benchmarks/p3m_short_ab.py) and on the platform cost model
+    otherwise — the same measurement-beats-model contract as
+    CROSSOVER_TPU.json (VERDICT round-4 item 3)."""
+    import json
+
+    from gravity_tpu.ops import p3m as p3m_mod
+
+    monkeypatch.setattr(p3m_mod, "_short_ab_cache", {})
+    # Explicit modes pass through untouched.
+    assert p3m_mod.resolve_short_mode("slice", "cpu") == "slice"
+    assert p3m_mod.resolve_short_mode("gather", "tpu") == "gather"
+    # Cost-model defaults: gather off-TPU, slice on TPU (no file).
+    monkeypatch.setenv(
+        "GRAVITY_TPU_P3M_SHORT_FILE", str(tmp_path / "missing.json")
+    )
+    assert p3m_mod.resolve_short_mode("auto", "cpu") == "gather"
+    assert p3m_mod.resolve_short_mode("auto", "tpu") == "slice"
+    # A recorded measurement overrides the TPU model...
+    ab = tmp_path / "ab.json"
+    ab.write_text(json.dumps({"winner": "gather"}))
+    monkeypatch.setenv("GRAVITY_TPU_P3M_SHORT_FILE", str(ab))
+    assert p3m_mod.resolve_short_mode("auto", "tpu") == "gather"
+    # ...takes effect mid-process on rewrite (mtime-keyed cache)...
+    ab.write_text(json.dumps({"winner": "slice"}))
+    import os
+
+    os.utime(ab, (1, 1))
+    assert p3m_mod.resolve_short_mode("auto", "tpu") == "slice"
+    # ...and never touches the CPU default (measured separately).
+    ab.write_text(json.dumps({"winner": "slice"}))
+    assert p3m_mod.resolve_short_mode("auto", "cpu") == "gather"
+    # Garbage winner values fall back to the model.
+    ab.write_text(json.dumps({"winner": "warp-drive"}))
+    os.utime(ab, (2, 2))
+    assert p3m_mod.resolve_short_mode("auto", "tpu") == "slice"
