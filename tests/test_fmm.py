@@ -507,3 +507,31 @@ def test_sharded_fmm_realistic_occupancy_with_overflow(key):
     ids = (coords[:, 0] * 16 + coords[:, 1]) * 16 + coords[:, 2]
     counts = np.bincount(np.asarray(ids), minlength=16**3)
     assert counts.max() > 16, "test geometry failed to overflow the cap"
+
+
+def test_sharded_fmm_hierarchical_mesh_merger_run():
+    """The 2x1M merger's fast-solver route (VERDICT r4 item 4), at test
+    scale: a Simulator run with force_backend=fmm over the hierarchical
+    (2, 4) DCN x ICI mesh on the merger model stays within float
+    roundoff (1e-5 relative) of the unsharded fmm run — the slab
+    decomposition composes the linear device index across BOTH mesh
+    axes."""
+    import dataclasses
+
+    from gravity_tpu.config import SimulationConfig
+    from gravity_tpu.simulation import Simulator
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device virtual mesh")
+    base = SimulationConfig(
+        model="merger", n=256, steps=2, dt=1.0e4, eps=1e9, seed=5,
+        integrator="leapfrog", force_backend="fmm", tree_depth=3,
+    )
+    un = Simulator(base).run()["final_state"]
+    sh = Simulator(dataclasses.replace(
+        base, sharding="allgather", mesh_shape=(2, 4)
+    )).run()["final_state"]
+    assert bool(jnp.all(jnp.isfinite(sh.positions)))
+    scale = float(np.abs(np.asarray(un.positions)).max())
+    err = np.abs(np.asarray(sh.positions) - np.asarray(un.positions)).max()
+    assert err < 1e-5 * scale, (err, scale)
