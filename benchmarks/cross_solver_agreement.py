@@ -84,14 +84,27 @@ def main(argv=None) -> int:
         "--solvers", nargs="+", default=["tree", "fmm", "p3m"],
         choices=["tree", "fmm", "p3m", "pm"],
     )
+    # Operating-point knobs: at 1M the disk packs ~78 bodies per
+    # occupied leaf at the railed depth 7, so the baseline leaf_cap 32
+    # routes half the near field through overflow monopoles — the
+    # tuned run raises the caps to show the solvers at their intended
+    # accuracy class, alongside the baseline-config run.
+    ap.add_argument("--leaf-cap", type=int, default=32)
+    ap.add_argument("--p3m-cap", type=int, default=64)
+    ap.add_argument("--p3m-sigma", type=float, default=1.25)
+    ap.add_argument("--tree-depth", type=int, default=0)
+    ap.add_argument("--ws", type=int, default=1,
+                    help="tree/fmm opening criterion (2 = ~4x tighter)")
     args = ap.parse_args(argv)
 
     # The 1m-tree baseline family's units (g=1 disk, eps=0.05) — the
     # exact workload whose large-N correctness this pins.
     base = dict(
         model=args.model, n=args.n, g=1.0, dt=2.0e-3, eps=0.05,
-        integrator="leapfrog", seed=7, tree_leaf_cap=32,
-        pm_grid=256, p3m_cap=64,
+        integrator="leapfrog", seed=7, tree_leaf_cap=args.leaf_cap,
+        pm_grid=256, p3m_cap=args.p3m_cap,
+        p3m_sigma_cells=args.p3m_sigma, tree_depth=args.tree_depth,
+        tree_ws=args.ws,
     )
 
     rng = np.random.default_rng(args.seed)
@@ -101,9 +114,13 @@ def main(argv=None) -> int:
     state = None
     for solver in args.solvers:
         cfg = SimulationConfig(**dict(base, force_backend=solver))
-        sim = Simulator(cfg)
+        # Reuse the first solver's ICs: Simulator accepts a prebuilt
+        # state, and the 1M/2M disk/merger build (vectorized bisection
+        # + velocity setup) is multi-second per construction (review
+        # finding). Same seed would give the same ICs anyway.
+        sim = Simulator(cfg, state=state)
         if state is None:
-            state = sim.state  # same seed -> same ICs for every solver
+            state = sim.state
         fn = jax.jit(sim._accel2)
         t0 = time.perf_counter()
         acc = fn(state.positions, state.masses)

@@ -1,0 +1,111 @@
+"""Cross-solver force-agreement gates (VERDICT round-4 item 2).
+
+The three fast solvers are INDEPENDENT approximations (octree
+multipoles, dense-grid FMM, Ewald-split P3M); agreement between them —
+each within its error budget of an exact fp64 direct-sum sample — is
+the chip-independent correctness story for the large-N regime. The
+full-scale (1M/2M) version runs as
+``benchmarks/cross_solver_agreement.py`` with results recorded in
+BASELINE.md; these tests pin the same three-way contract at suite-
+affordable sizes (the host is a single CPU core).
+
+Two error metrics, per docs/scaling.md "Cross-solver validation": the
+per-particle relative error (|Δa|/|a_exact|) is dominated on the disk
+by bulk-force CANCELLATION — the net force on a bulk particle is ~10x
+smaller than the field scale — while the scaled error (|Δa|/RMS|a|)
+measures solver inaccuracy against the field. Budgets below are
+2-4x over values measured 2026-08-01 (single-core CPU, seed 42).
+
+The reference's only validation idea is exactly this — cross-backend
+comparison of the same workload (`/root/reference/mpi.c:249-257` vs
+`/root/reference/pyspark.py:195-198`) — at N <= 1000 by eyeball; here
+it is quantitative with an fp64 umpire.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow  # minutes-scale; excluded from -m fast
+
+from gravity_tpu.models import create_disk
+from gravity_tpu.ops.forces import accelerations_vs
+
+
+def _exact_fp64_sample(positions, masses, idx, *, g, eps, chunk=256):
+    pos64 = jnp.asarray(np.asarray(positions), jnp.float64)
+    m64 = jnp.asarray(np.asarray(masses), jnp.float64)
+    out = []
+    for s in range(0, len(idx), chunk):
+        out.append(np.asarray(accelerations_vs(
+            pos64[idx[s:s + chunk]], pos64, m64, g=g, eps=eps
+        )))
+    return np.concatenate(out, axis=0)
+
+
+def _setup(n):
+    state = create_disk(jax.random.PRNGKey(42), n, dtype=jnp.float32)
+    idx = np.random.default_rng(0).choice(n, 256, replace=False)
+    idx.sort()
+    exact = _exact_fp64_sample(
+        state.positions, state.masses, idx, g=1.0, eps=0.05
+    )
+    norm = np.linalg.norm(exact, axis=-1)
+    norm = np.where(norm > 0, norm, 1.0)
+    rms = float(np.sqrt(np.mean(norm**2)))
+    return state, idx, exact, norm, rms
+
+
+def _med(a, b, scale):
+    return float(np.median(np.linalg.norm(a - b, axis=-1) / scale))
+
+
+def test_tree_p3m_exact_three_way_agreement_65k(x64):
+    """65k disk: the octree at near-field-resolving depth matches the
+    exact sample at the 0.1% class even on the cancellation metric
+    (measured 0.11%); P3M's thin-disk mesh error sits at the few-%
+    class on the SCALED metric (its raw median reads ~14% purely from
+    cancellation — same solver, same forces)."""
+    from gravity_tpu.ops.p3m import p3m_accelerations
+    from gravity_tpu.ops.tree import tree_accelerations
+
+    state, idx, exact, norm, rms = _setup(65_536)
+    pos, masses = state.positions, state.masses
+    acc_tree = np.asarray(tree_accelerations(
+        pos, masses, depth=7, leaf_cap=64, g=1.0, eps=0.05
+    ))[idx]
+    acc_p3m = np.asarray(p3m_accelerations(
+        pos, masses, grid=256, cap=128, g=1.0, eps=0.05
+    ))[idx]
+
+    assert _med(acc_tree, exact, norm) < 0.005  # measured 1.1e-3
+    assert _med(acc_p3m, exact, rms) < 0.05     # scaled; measured ~2-3%
+    assert _med(acc_p3m, exact, norm) < 0.30    # raw, cancellation-bound
+    assert _med(acc_tree, acc_p3m, rms) < 0.05  # pairwise, scaled
+
+
+def test_fmm_joins_the_agreement_8k(x64):
+    """8k disk at shared depth 5: the dense-grid FMM and the octree —
+    independent implementations of the same multipole class — agree at
+    the 0.3% median (measured 2.7e-3) while both carry the same
+    depth-limited error vs exact (measured 4.5% raw median; depth 7
+    drives the tree to 0.1%, see the 65k gate — depth is the accuracy
+    dial, tests/test_tree.py::test_recommended_depth_data_beats_count_only).
+    Kept at 8k/depth 5 because the shifted-slice passes are single-core-
+    CPU-slow while being the cheap path on TPU."""
+    from gravity_tpu.ops.fmm import fmm_accelerations
+    from gravity_tpu.ops.tree import tree_accelerations
+
+    state, idx, exact, norm, rms = _setup(8_192)
+    pos, masses = state.positions, state.masses
+    acc_fmm = np.asarray(fmm_accelerations(
+        pos, masses, depth=5, leaf_cap=64, g=1.0, eps=0.05
+    ))[idx]
+    acc_tree = np.asarray(tree_accelerations(
+        pos, masses, depth=5, leaf_cap=64, g=1.0, eps=0.05
+    ))[idx]
+
+    assert _med(acc_fmm, acc_tree, norm) < 0.01  # measured 2.7e-3
+    assert _med(acc_fmm, exact, norm) < 0.10     # depth-5-limited, 4.5e-2
+    assert _med(acc_fmm, exact, rms) < 0.03      # scaled

@@ -523,9 +523,16 @@ def test_sharded_fmm_hierarchical_mesh_merger_run():
 
     if len(jax.devices()) < 8:
         pytest.skip("needs the 8-device virtual mesh")
+    # The merger model is built in galactic units (positions ~tens of
+    # kpc, masses ~unity): g=1/eps=0.05 per the baseline merger family.
+    # SI-scale g/eps here would make forces ~1e-36 and the parity
+    # assertion vacuous pure drift (review finding). dt is large enough
+    # that the force-driven displacement (~a dt^2 ~ 1e-3 of the
+    # position scale) clears the 1e-5 gate by ~100x — a wrong sharded
+    # force moves positions detectably, not just the shared drift.
     base = SimulationConfig(
-        model="merger", n=256, steps=2, dt=1.0e4, eps=1e9, seed=5,
-        integrator="leapfrog", force_backend="fmm", tree_depth=3,
+        model="merger", n=256, steps=2, dt=0.5, eps=0.05, g=1.0,
+        seed=5, integrator="leapfrog", force_backend="fmm", tree_depth=3,
     )
     un = Simulator(base).run()["final_state"]
     sh = Simulator(dataclasses.replace(
