@@ -43,35 +43,40 @@ while true; do
     if [ "$battery_done" = 0 ]; then
       echo "=== TUNNEL ALIVE $(date -u +%FT%TZ) — round-5 battery ===" >>"$LOG"
       aborted=0
-      # 1. Driver headline first (fast, writes BENCH_LAST_TPU.json).
+      # Landed LIVE in the 2026-08-01 08:29-09:30 UTC window: bench.py
+      # (1.843e11, platform:tpu), validate --tpu (all ok), 1m-fmm
+      # (16.71 s/step -> router re-pointed). Battery reordered so the
+      # next window measures what that one did not.
+      # 1. Driver headline first (fast, writes BENCH_LAST_TPU.json,
+      #    doubles as the liveness canary).
       step 1200 python bench.py
-      # 2. On-chip smoke gate (incl. the fmm parity check).
-      step 1200 python -m gravity_tpu validate --tpu
-      # 3. The flagship chip-untested component: FMM at 1M and 2M.
-      step 3600 python benchmarks/run_baselines.py 1m-fmm
-      step 5400 python benchmarks/run_baselines.py 2m-fmm
-      # 4. Three-way direct/tree/fmm crossover (calibrates auto routing;
-      #    writes CROSSOVER_TPU.json for the router).
+      # 2. Three-way direct/tree/fmm crossover (wedged mid-sweep in the
+      #    08:29 window; writes CROSSOVER_TPU.json for the router).
+      #    Default 65k..1M ladder — NOT 2M; the 2M tree eval is what ate
+      #    the first window.
       step 5400 python benchmarks/crossover.py
-      # 5. North-star end-to-end: 1M-body leapfrog steps, auto backend.
+      # 3. North-star end-to-end: 1M-body leapfrog steps, auto backend
+      #    (now routes the measured-fastest Pallas direct sum).
       step 3600 python -m gravity_tpu run --preset baseline-1m \
         --force-backend auto --steps 10
-      # 6. P3M short-range A/B on the chip (VERDICT r4 item 3: the CPU
+      # 4. P3M short-range A/B on the chip (VERDICT r4 item 3: the CPU
       #    A/B contradicts the TPU slice default; decide from the chip).
-      step 3600 python benchmarks/run_baselines.py 1m-p3m
-      step 3600 python benchmarks/run_baselines.py 1m-p3m-gather
-      step 3600 python benchmarks/run_baselines.py 1m-p3m-s2
-      #    ...and persist the winner so the auto short mode routes on
-      #    the measurement (writes P3M_SHORT_TPU.json).
       step 3600 python benchmarks/p3m_short_ab.py
-      # 7. 1m-tree under the HBM audit (VERDICT r4 item 7 root-cause).
+      step 3600 python benchmarks/run_baselines.py 1m-p3m
+      # 5. 1m-tree under the HBM audit (VERDICT r4 item 7 root-cause).
       step 3600 python benchmarks/run_baselines.py 1m-tree
-      # 8. Stage breakdown and fmm operating-point sweep.
+      # 6. The 2M merger end-to-end (auto -> direct now) and 2M fmm.
+      step 5400 python benchmarks/run_baselines.py 2m-merger
+      step 5400 python benchmarks/run_baselines.py 2m-fmm
+      # 7. Stage breakdown and fmm operating-point sweep (explains the
+      #    16.71 s/eval: where does the FMM spend it?).
       step 2400 python benchmarks/profile_tree.py 1048576
       step 2400 python benchmarks/tune_fmm.py 262144
       step 3600 python benchmarks/tune_fmm.py 1048576 --quick
-      # 9. Remaining baseline tags.
-      step 5400 python benchmarks/run_baselines.py 2m-merger
+      # 8. Regression gate + remaining tags.
+      step 1200 python -m gravity_tpu validate --tpu
+      step 3600 python benchmarks/run_baselines.py 1m-p3m-gather
+      step 3600 python benchmarks/run_baselines.py 1m-p3m-s2
       step 2400 python benchmarks/run_baselines.py cosmo-262k
       step 1200 python benchmarks/tune_pallas.py 262144
       # Mark the battery done ONLY if it ran to the end with the tunnel

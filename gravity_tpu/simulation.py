@@ -48,15 +48,19 @@ def resolve_dtype(name: str):
 # Pallas direct sum up to 1M (time ratio 80x at 65k, 6.6x at 1M,
 # halving per doubling of N -> tree crossover ~8M;
 # benchmarks/crossover.py, 2026-07-31). The dense-grid FMM removes the
-# gathers; its cost model (27 x S^3 x cap^2 near-field pair ops + 343
-# shifted-slice cell passes, ~10x fewer ops than direct at 1M and all
-# of them dense VPU/MXU work) puts its crossover near ~512k — a
-# PROVISIONAL constant until benchmarks/crossover.py runs its
-# three-way sweep on a live chip and records the measurement in
-# CROSSOVER_TPU.json, which overrides this default (see
-# _measured_fast_crossover). CPU: measured with the native FFI kernel,
-# the tree wins from ~32k (BASELINE.md).
-FMM_CROSSOVER_TPU = 524_288
+# gathers, but the 2026-08-01 live-chip measurement (run_baselines
+# 1m-fmm: 16.71 s/eval at 1M disk vs the Pallas direct sum's 5.97
+# s/eval, same chip/model family) shows the direct sum still wins at
+# 1M by 2.8x — the ~512k cost model undercounted how hard the MXU
+# drives the dense N^2 relative to the FMM's many small shifted-slice
+# passes. Scaling the two measured points (direct O(N^2), fmm ~O(N))
+# puts the intersection at ~2.9M; the default snaps UP to the 4M
+# ladder point so the exact direct sum keeps the boundary region
+# (1M/2M BASELINE configs route direct, measured-fastest AND exact).
+# A live three-way benchmarks/crossover.py sweep still overrides this
+# via CROSSOVER_TPU.json (measurement beats model). CPU: measured with
+# the native FFI kernel, the tree wins from ~32k (BASELINE.md).
+FMM_CROSSOVER_TPU = 4_194_304
 TREE_CROSSOVER_TPU = 8_388_608
 TREE_CROSSOVER_CPU = 32_768
 _CROSSOVER_FILE = "CROSSOVER_TPU.json"
@@ -203,11 +207,14 @@ def _resolve_backend(config: SimulationConfig, on_tpu=None) -> str:
         return _resolve_direct(config, on_tpu)
     # auto: above the measured crossover a fast solver wins over any
     # direct sum — unless the ring strategy is requested (see above).
-    # On TPU the default winner is the dense-grid FMM (the gather-free
-    # reorganization of the tree, which the chip measured 6.6x slower
-    # than even the direct sum at 1M — docs/scaling.md); sharded runs
-    # use the slab-decomposed make_sharded_fmm_accel, multirate fast
-    # kicks the rectangular fmm_accelerations_vs. A recorded chip sweep
+    # On TPU the chip measurements put that crossover HIGH: the Pallas
+    # direct sum beat the tree 6.6x and the dense-grid FMM 2.8x at 1M
+    # (docs/scaling.md; 2026-07-31 / 2026-08-01 live), so every
+    # BASELINE config through the 2M merger routes direct, and the FMM
+    # (the gather-free winner among the fast solvers) takes over at
+    # the measured-extrapolated ~3M boundary; sharded runs use the
+    # slab-decomposed make_sharded_fmm_accel, multirate fast kicks the
+    # rectangular fmm_accelerations_vs. A recorded chip sweep
     # (CROSSOVER_TPU.json) overrides both the threshold and the winner.
     crossover, fast_backend = _measured_fast_crossover(on_tpu)
     if config.n >= crossover and config.sharding != "ring":
