@@ -84,10 +84,25 @@ CONFIGS = {
         dict(bench_steps=3),
     ),
     "1m-fmm": (
-        "1M-body Milky-Way disk, dense-grid FMM (gather-free)",
+        "1M-body Milky-Way disk, dense-grid FMM (gather-free; mode "
+        "pinned dense — the 2026-08-01 16.71 s/eval chip datum's "
+        "config)",
         dict(model="disk", n=1_048_576, g=1.0, dt=2.0e-3, eps=0.05,
              integrator="leapfrog", force_backend="fmm",
-             tree_leaf_cap=32),
+             fmm_mode="dense", tree_leaf_cap=32),
+        dict(bench_steps=3),
+    ),
+    "1m-sfmm": (
+        "1M-body Milky-Way disk, SPARSE cell-list FMM (occupancy-"
+        "proportional redesign; data-driven depth/cap)",
+        dict(model="disk", n=1_048_576, g=1.0, dt=2.0e-3, eps=0.05,
+             integrator="leapfrog", force_backend="sfmm"),
+        dict(bench_steps=3),
+    ),
+    "2m-sfmm": (
+        "2x1M-body galaxy merger, SPARSE cell-list FMM (single-chip)",
+        dict(model="merger", n=2_097_152, g=1.0, dt=2.0e-3, eps=0.05,
+             integrator="leapfrog", force_backend="sfmm"),
         dict(bench_steps=3),
     ),
     "2m-fmm": (

@@ -52,8 +52,12 @@ def _add_config_args(p: argparse.ArgumentParser) -> None:
                    choices=["float32", "float64", "bfloat16"], default=None)
     p.add_argument("--force-backend", dest="force_backend",
                    choices=["auto", "direct", "dense", "chunked", "pallas",
-                            "cpp", "tree", "fmm", "pm", "p3m"],
+                            "cpp", "tree", "fmm", "sfmm", "pm", "p3m"],
                    default=None)
+    p.add_argument("--fmm-mode", dest="fmm_mode",
+                   choices=["auto", "dense", "sparse"], default=None,
+                   help="fmm layout: sparse = occupied-cell compaction "
+                        "for clustered states (auto picks by occupancy)")
     p.add_argument("--chunk", type=int, default=None)
     p.add_argument("--tree-depth", dest="tree_depth", type=int, default=None)
     p.add_argument("--tree-leaf-cap", dest="tree_leaf_cap", type=int,

@@ -46,9 +46,16 @@ class SimulationConfig:
     # (scale-aware among EXACT O(N^2) backends only) | dense | chunked |
     # pallas (direct sum) | cpp (native XLA FFI host kernel, CPU
     # platform) | tree (octree) | fmm (dense-grid gather-free FMM,
-    # slab-sharded on a mesh) | pm (FFT mesh) | p3m (FFT mesh + cell-list pair
-    # correction)
+    # slab-sharded on a mesh) | sfmm (sparse cell-list FMM — forces the
+    # clustered-state layout; fmm + fmm_mode is the usual entry) |
+    # pm (FFT mesh) | p3m (FFT mesh + cell-list pair correction)
     force_backend: str = "auto"
+    # fmm layout: "dense" (shifted-slice grids, quasi-uniform states) |
+    # "sparse" (occupied-cell compaction, ops/sfmm.py — clustered
+    # states) | "auto" = sparse when the initial state occupies <5% of
+    # the dense grid's cells (single-host only; meshes use the dense
+    # slab-sharded path).
+    fmm_mode: str = "auto"
     chunk: int = 1024
     tree_depth: int = 0  # 0 = auto (recommended_depth)
     tree_leaf_cap: int = 32

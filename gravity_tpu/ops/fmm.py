@@ -737,6 +737,21 @@ def _monopole_all_levels(
         side, span, ws, g, eps, dtype, potential=potential,
     )
     acc, phi = out if potential else (out, None)
+    return _monopole_coarse_levels(
+        eval_pos, eval_coords, levels, depth, ws, g, eps, dtype,
+        acc, phi, potential=potential,
+    )
+
+
+def _monopole_coarse_levels(
+    eval_pos, eval_coords, levels, depth, ws, g, eps, dtype,
+    acc, phi, potential: bool = False,
+):
+    """The coarse-ancestor half of :func:`_monopole_all_levels` — every
+    level-d (d in [2, depth-1]) parity-masked interaction list as
+    monopoles at the point's own position, accumulated onto ``acc`` /
+    ``phi``. Factored out so the sparse evaluator (ops/sfmm.py) can
+    pair it with its table-based leaf neighborhood."""
     offsets = jnp.asarray(_offsets(ws), jnp.int32)
     pmask_t = jnp.asarray(_parity_mask_table(ws))
     for d in range(2, depth):

@@ -115,7 +115,7 @@ def _measured_fast_crossover(on_tpu: bool) -> tuple[int, str]:
                 with open(path) as f:
                     data = _json.load(f)
                 value = int(data["fast_crossover"])
-                if data.get("winning_backend") in ("tree", "fmm"):
+                if data.get("winning_backend") in ("tree", "fmm", "sfmm"):
                     backend = data["winning_backend"]
             except (OSError, KeyError, ValueError, TypeError):
                 pass
@@ -218,6 +218,11 @@ def _resolve_backend(config: SimulationConfig, on_tpu=None) -> str:
     # (CROSSOVER_TPU.json) overrides both the threshold and the winner.
     crossover, fast_backend = _measured_fast_crossover(on_tpu)
     if config.n >= crossover and config.sharding != "ring":
+        if fast_backend == "sfmm" and config.sharding != "none":
+            # The sparse FMM is single-host; on a mesh, auto degrades
+            # to the slab-sharded dense fmm rather than routing into a
+            # backend the Simulator would reject (review finding).
+            return "fmm"
         return fast_backend
     return _resolve_direct(config, on_tpu)
 
@@ -356,7 +361,11 @@ def make_local_kernel(config: SimulationConfig, backend: str,
             leaf_cap=config.tree_leaf_cap, ws=config.tree_ws,
             far=config.tree_far, chunk=config.fast_chunk, **common,
         )
-    if backend == "fmm":
+    if backend in ("fmm", "sfmm"):
+        # The rectangular (targets-vs-sources) multirate kicks use the
+        # dense-grid form for both fmm modes: the fast-kick target set
+        # is small and re-binned per call, where the sparse layout's
+        # compaction prologue would dominate its own savings.
         from .ops.fmm import fmm_accelerations_vs
 
         if k_targets is not None and k_targets * config.n <= DENSE_KICK_BUDGET:
@@ -447,6 +456,9 @@ class Simulator:
         self.config = config
         self.dtype = resolve_dtype(config.dtype)
         self.backend = _resolve_backend(config)
+        # Which fmm layout the build resolved to (False until an
+        # fmm/sfmm accel builder runs; benchmarks introspect this).
+        self.fmm_sparse = False
 
         if state is None:
             key = jax.random.PRNGKey(config.seed)
@@ -464,12 +476,20 @@ class Simulator:
         self.mesh = None
         if config.sharding != "none":
             if config.sharding == "ring" and self.backend in (
-                "tree", "fmm", "pm", "p3m"
+                "tree", "fmm", "sfmm", "pm", "p3m"
             ):
                 raise ValueError(
                     f"force backend {self.backend!r} needs the full source "
                     "set per chip to build its tree/mesh; use "
                     "sharding='allgather'"
+                )
+            if self.backend == "sfmm" or (
+                self.backend == "fmm" and config.fmm_mode == "sparse"
+            ):
+                raise ValueError(
+                    "the sparse FMM is single-host for now; on a mesh "
+                    "use force_backend='fmm' (fmm_mode dense/auto), "
+                    "whose slab-sharded passes split over devices"
                 )
             from .parallel import make_particle_mesh, shard_state
 
@@ -646,9 +666,58 @@ class Simulator:
                 ws=config.tree_ws, far=config.tree_far,
                 chunk=config.fast_chunk, **common,
             )
-        if self.backend == "fmm":
+        if self.backend in ("fmm", "sfmm"):
+            from .ops.sfmm import recommended_sparse_params
+
+            # Mode resolution (eager, from the initial state): sparse
+            # when explicitly asked, or — in auto — when the state
+            # occupies <5% of its resolving grid's cells, the regime
+            # where the dense design's volume-priced passes are ~all
+            # empty space and its depth rail (<=7) forces cap-overflow
+            # monopoles (measured: 16.71 s/eval and a degraded error
+            # tail at 1M disk on a v5 lite vs the sparse layout's
+            # occupancy-proportional cost; BASELINE.md 2026-08-01).
+            sizing = None
+            sparse = self.backend == "sfmm" or config.fmm_mode == "sparse"
+            if self.backend == "fmm" and config.fmm_mode == "auto":
+                sizing = recommended_sparse_params(
+                    self.state.positions,
+                    cap_max=max(32, config.tree_leaf_cap),
+                )
+                depth_s, _, _, occ = sizing
+                sparse = occ < 0.05 * (1 << (3 * depth_s))
+            if sparse:
+                from .ops.sfmm import sfmm_accelerations
+
+                if config.tree_depth:
+                    # Forced depth: size k_cells from the occupancy AT
+                    # that depth (min_depth pins the sweep to it) — a
+                    # cheaper depth's occupancy would undersize the
+                    # cell capacity and silently rank-overflow exactly
+                    # the precision the user dialed up (review finding).
+                    depth_s = config.tree_depth
+                    cap_s = config.tree_leaf_cap
+                    _, _, k_cells, _ = recommended_sparse_params(
+                        self.state.positions, cap_max=cap_s,
+                        min_depth=depth_s, max_depth=depth_s,
+                    )
+                else:
+                    depth_s, cap_s, k_cells, _ = (
+                        sizing
+                        if sizing is not None
+                        else recommended_sparse_params(
+                            self.state.positions,
+                            cap_max=max(32, config.tree_leaf_cap),
+                        )
+                    )
+                self.fmm_sparse = True
+                return lambda pos, m: sfmm_accelerations(
+                    pos, m, depth=depth_s, leaf_cap=cap_s,
+                    k_cells=k_cells, ws=config.tree_ws, **common,
+                )
             from .ops.fmm import fmm_accelerations
 
+            self.fmm_sparse = False
             depth = _resolve_depth_and_warn(
                 config, self.state.positions, "fmm backend", n=n
             )
@@ -1407,7 +1476,7 @@ class Simulator:
                 assignment=config.pm_assignment,
             )
         elif (
-            self.backend in ("tree", "fmm", "p3m")
+            self.backend in ("tree", "fmm", "sfmm", "p3m")
             and self.n_real > ENERGY_TREE_THRESHOLD
         ):
             # Scale-aware diagnostic: the dense pair scan costs ~5.5e11
