@@ -14,6 +14,7 @@ from __future__ import annotations
 import os
 import sys
 import time
+from functools import partial
 
 # Runnable as `python benchmarks/profile_tree.py` from the repo root.
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -125,6 +126,63 @@ def main(argv) -> int:
         jax.jit(fmm_coarse), levels_c, origin_c, span_c,
         label="fmm coarse expansions only",
     )
+
+    # 3b'. Sparse cell-list FMM (ops/sfmm.py) at its data-driven
+    # sizing, with the stage split (build / coarse / near+finest) —
+    # the numbers that decide where the sparse design's chip time goes
+    # (gather-rate far field vs pair-kernel near field) and whether
+    # the per-level window-gather batching is worth building.
+    from gravity_tpu.ops import sfmm as _sfmm
+
+    s_depth, s_cap, s_k, s_occ = _sfmm.recommended_sparse_params(pos)
+    print(
+        f"sfmm sizing: depth={s_depth} cap={s_cap} k_cells={s_k} "
+        f"occupied={s_occ}"
+    )
+
+    def sfmm_full(p):
+        return _sfmm.sfmm_accelerations(
+            p, masses, depth=s_depth, leaf_cap=s_cap, k_cells=s_k,
+            eps=0.05, g=1.0,
+        )
+
+    t_sfmm = timed(jax.jit(sfmm_full), pos, label="sfmm_accelerations (full)")
+    print(f"sfmm speedup vs dense fmm: {t_fmm / t_sfmm:.2f}x")
+
+    # Same k_chunk-multiple rounding sfmm_accelerations applies — the
+    # stage functions require k_cells divisible into equal chunks.
+    s_kc = max(8192, (s_k + 8191) // 8192 * 8192)
+
+    def sfmm_build(p):
+        b = _sfmm._build_sparse(p, masses, s_depth, s_kc, s_cap, True)
+        return b["cells_pos"], b["table"], b["occ_com"]
+
+    timed(jax.jit(sfmm_build), pos, label="sfmm build (compaction)")
+
+    def sfmm_coarse(p, window):
+        b = _sfmm._build_sparse(p, masses, s_depth, s_kc, s_cap, True)
+        return _sfmm._sparse_coarse_expansions(
+            b, s_depth, 1, 1.0, 0.05, p.dtype, 2, window=window
+        )
+
+    # Both far-mode data movements — the platform-keyed default
+    # (far_mode="auto") follows whichever this A/B measures faster.
+    timed(
+        jax.jit(partial(sfmm_coarse, window=True)), pos,
+        label="sfmm build+coarse (window mode)",
+    )
+    timed(
+        jax.jit(partial(sfmm_coarse, window=False)), pos,
+        label="sfmm build+coarse (gather mode)",
+    )
+
+    def sfmm_near(p):
+        b = _sfmm._build_sparse(p, masses, s_depth, s_kc, s_cap, True)
+        return _sfmm._sparse_near_finest(
+            b, s_depth, s_cap, 1, 1.0, 1e-10, 0.05, p.dtype, True, 8192
+        )
+
+    timed(jax.jit(sfmm_near), pos, label="sfmm build+near+finest")
 
     # 3c. Gather-free potential energy (the TPU --metrics-energy
     # sample) vs the gather-based tree PE.

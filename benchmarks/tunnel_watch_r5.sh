@@ -50,11 +50,15 @@ while true; do
       # 1. Driver headline first (fast, writes BENCH_LAST_TPU.json,
       #    doubles as the liveness canary).
       step 1200 python bench.py
-      # 2. Three-way direct/tree/fmm crossover (wedged mid-sweep in the
-      #    08:29 window; writes CROSSOVER_TPU.json for the router).
+      # 2. The round-5 sparse FMM at 1M — the occupancy-proportional
+      #    redesign the 16.71 s/eval dense datum motivated; its chip
+      #    number decides the large-N fast-solver story.
+      step 3600 python benchmarks/run_baselines.py 1m-sfmm
+      # 3. Four-way direct/tree/fmm/sfmm crossover (wedged mid-sweep in
+      #    the 08:29 window; writes CROSSOVER_TPU.json for the router).
       #    Default 65k..1M ladder — NOT 2M; the 2M tree eval is what ate
       #    the first window.
-      step 5400 python benchmarks/crossover.py
+      step 7200 python benchmarks/crossover.py
       # 3. North-star end-to-end: 1M-body leapfrog steps, auto backend
       #    (now routes the measured-fastest Pallas direct sum).
       step 3600 python -m gravity_tpu run --preset baseline-1m \

@@ -282,7 +282,7 @@ def cmd_run(args: argparse.Namespace) -> int:
         # --external field, which the jnp reference lacks).
         full_acc = None
         kernel = None
-        if sim.backend == "fmm":
+        if sim.backend == "fmm" and not sim.fmm_sparse:
             from .ops.fmm import fmm_accelerations
             from .ops.tree import recommended_depth_data
 
@@ -292,6 +292,23 @@ def cmd_run(args: argparse.Namespace) -> int:
             full_acc = fmm_accelerations(
                 final.positions, final.masses, depth=depth,
                 leaf_cap=config.tree_leaf_cap, ws=config.tree_ws,
+                g=config.g, cutoff=config.cutoff, eps=config.eps,
+            )
+        elif sim.backend == "sfmm" or (
+            sim.backend == "fmm" and sim.fmm_sparse
+        ):
+            # Same full-set row-sampled audit as the dense fmm, at the
+            # sparse solver's own data-driven sizing (routing it into
+            # make_local_kernel's rectangular audit measured a bogus
+            # 51% "error" — that path never built the sparse layout).
+            from .ops.sfmm import resolve_sfmm_sizing, sfmm_accelerations
+
+            s_depth, s_cap, s_k = resolve_sfmm_sizing(
+                final.positions, config.tree_depth, config.tree_leaf_cap
+            )
+            full_acc = sfmm_accelerations(
+                final.positions, final.masses, depth=s_depth,
+                leaf_cap=s_cap, k_cells=s_k, ws=config.tree_ws,
                 g=config.g, cutoff=config.cutoff, eps=config.eps,
             )
         elif sim.backend not in ("dense", "chunked"):

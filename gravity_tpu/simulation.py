@@ -687,28 +687,14 @@ class Simulator:
                 depth_s, _, _, occ = sizing
                 sparse = occ < 0.05 * (1 << (3 * depth_s))
             if sparse:
-                from .ops.sfmm import sfmm_accelerations
+                from .ops.sfmm import resolve_sfmm_sizing, sfmm_accelerations
 
-                if config.tree_depth:
-                    # Forced depth: size k_cells from the occupancy AT
-                    # that depth (min_depth pins the sweep to it) — a
-                    # cheaper depth's occupancy would undersize the
-                    # cell capacity and silently rank-overflow exactly
-                    # the precision the user dialed up (review finding).
-                    depth_s = config.tree_depth
-                    cap_s = config.tree_leaf_cap
-                    _, _, k_cells, _ = recommended_sparse_params(
-                        self.state.positions, cap_max=cap_s,
-                        min_depth=depth_s, max_depth=depth_s,
-                    )
+                if sizing is not None and not config.tree_depth:
+                    depth_s, cap_s, k_cells, _ = sizing
                 else:
-                    depth_s, cap_s, k_cells, _ = (
-                        sizing
-                        if sizing is not None
-                        else recommended_sparse_params(
-                            self.state.positions,
-                            cap_max=max(32, config.tree_leaf_cap),
-                        )
+                    depth_s, cap_s, k_cells = resolve_sfmm_sizing(
+                        self.state.positions, config.tree_depth,
+                        config.tree_leaf_cap,
                     )
                 self.fmm_sparse = True
                 return lambda pos, m: sfmm_accelerations(

@@ -51,17 +51,21 @@ def _make_model(key, n, model):
     return state.positions, state.masses, 0.05, 1.0
 
 
+@pytest.mark.parametrize("far_mode", ["gather", "window"])
 @pytest.mark.parametrize("model", ["uniform", "cold"])
-def test_sfmm_matches_dense_fmm_exactly(key, model):
+def test_sfmm_matches_dense_fmm_exactly(key, model, far_mode):
     """On overflow-free states the sparse and dense FMMs share
     interaction sets and expansion math to the operation — only the
     data movement differs (per-cell gathers vs shifted slices) — so
-    they agree to float-reordering tolerance."""
+    they agree to float-reordering tolerance. Both far-mode data
+    movements are pinned: "window" is the TPU default, which the
+    CPU-platform suite would otherwise never execute."""
     n = 2048
     pos, m, eps, g = _make_model(key, n, model)
     dense = fmm_accelerations(pos, m, depth=4, g=g, eps=eps)
     sparse = sfmm_accelerations(
-        pos, m, depth=4, k_cells=4096, k_chunk=4096, g=g, eps=eps
+        pos, m, depth=4, k_cells=4096, k_chunk=4096, g=g, eps=eps,
+        far_mode=far_mode,
     )
     err = _rel_err(sparse, dense)
     assert float(np.median(err)) < 1e-5
