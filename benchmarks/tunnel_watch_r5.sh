@@ -59,25 +59,25 @@ while true; do
       #    Default 65k..1M ladder — NOT 2M; the 2M tree eval is what ate
       #    the first window.
       step 7200 python benchmarks/crossover.py
-      # 3. North-star end-to-end: 1M-body leapfrog steps, auto backend
+      # 4. North-star end-to-end: 1M-body leapfrog steps, auto backend
       #    (now routes the measured-fastest Pallas direct sum).
       step 3600 python -m gravity_tpu run --preset baseline-1m \
         --force-backend auto --steps 10
-      # 4. P3M short-range A/B on the chip (VERDICT r4 item 3: the CPU
+      # 5. P3M short-range A/B on the chip (VERDICT r4 item 3: the CPU
       #    A/B contradicts the TPU slice default; decide from the chip).
       step 3600 python benchmarks/p3m_short_ab.py
       step 3600 python benchmarks/run_baselines.py 1m-p3m
-      # 5. 1m-tree under the HBM audit (VERDICT r4 item 7 root-cause).
+      # 6. 1m-tree under the HBM audit (VERDICT r4 item 7 root-cause).
       step 3600 python benchmarks/run_baselines.py 1m-tree
-      # 6. The 2M merger end-to-end (auto -> direct now) and 2M fmm.
+      # 7. The 2M merger end-to-end (auto -> direct now) and 2M fmm.
       step 5400 python benchmarks/run_baselines.py 2m-merger
       step 5400 python benchmarks/run_baselines.py 2m-fmm
-      # 7. Stage breakdown and fmm operating-point sweep (explains the
+      # 8. Stage breakdown and fmm operating-point sweep (explains the
       #    16.71 s/eval: where does the FMM spend it?).
       step 2400 python benchmarks/profile_tree.py 1048576
       step 2400 python benchmarks/tune_fmm.py 262144
       step 3600 python benchmarks/tune_fmm.py 1048576 --quick
-      # 8. Regression gate + remaining tags.
+      # 9. Regression gate + remaining tags.
       step 1200 python -m gravity_tpu validate --tpu
       step 3600 python benchmarks/run_baselines.py 1m-p3m-gather
       step 3600 python benchmarks/run_baselines.py 1m-p3m-s2

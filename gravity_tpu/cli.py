@@ -298,14 +298,14 @@ def cmd_run(args: argparse.Namespace) -> int:
             sim.backend == "fmm" and sim.fmm_sparse
         ):
             # Same full-set row-sampled audit as the dense fmm, at the
-            # sparse solver's own data-driven sizing (routing it into
+            # AS-RUN sizing the Simulator stored (routing it into
             # make_local_kernel's rectangular audit measured a bogus
-            # 51% "error" — that path never built the sparse layout).
-            from .ops.sfmm import resolve_sfmm_sizing, sfmm_accelerations
+            # 51% "error", and re-sizing from the evolved final state
+            # would audit a different solver than the one that
+            # produced the trajectory).
+            from .ops.sfmm import sfmm_accelerations
 
-            s_depth, s_cap, s_k = resolve_sfmm_sizing(
-                final.positions, config.tree_depth, config.tree_leaf_cap
-            )
+            s_depth, s_cap, s_k = sim.sfmm_sizing
             full_acc = sfmm_accelerations(
                 final.positions, final.masses, depth=s_depth,
                 leaf_cap=s_cap, k_cells=s_k, ws=config.tree_ws,
@@ -689,6 +689,22 @@ def _validate_tpu_battery(checks: dict) -> None:
     err_fc = rel_err(acc_fc, ref_c)
     checks["tpu_fmm_parity_cold"] = {
         "n": n_tree, "median_rel_err": err_fc, "ok": err_fc < 0.01,
+    }
+
+    # Sparse cell-list FMM (ops/sfmm.py) on the clustered disk — its
+    # design target — at the data-driven sizing, incl. the TPU window
+    # far mode the CPU suite never executes live.
+    from .ops.sfmm import resolve_sfmm_sizing, sfmm_accelerations
+
+    s_depth, s_cap, s_k = resolve_sfmm_sizing(disk.positions, 0, 32)
+    acc_s = sfmm_accelerations(
+        disk.positions, disk.masses, depth=s_depth, leaf_cap=s_cap,
+        k_cells=s_k, g=1.0, eps=0.05,
+    )
+    err_sf = rel_err(acc_s, ref_d)
+    checks["tpu_sfmm_parity_disk"] = {
+        "n": n_tree, "depth": s_depth, "cap": s_cap,
+        "median_rel_err": err_sf, "ok": err_sf < 0.01,
     }
 
     # The sharded code path (shard_map + collectives) on mesh=(1,):

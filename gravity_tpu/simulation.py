@@ -697,6 +697,10 @@ class Simulator:
                         config.tree_leaf_cap,
                     )
                 self.fmm_sparse = True
+                # The as-run sizing, for audits (cli --debug-check):
+                # an audit must measure THIS solver, not one re-sized
+                # from the evolved final state (review finding).
+                self.sfmm_sizing = (depth_s, cap_s, k_cells)
                 return lambda pos, m: sfmm_accelerations(
                     pos, m, depth=depth_s, leaf_cap=cap_s,
                     k_cells=k_cells, ws=config.tree_ws, **common,
