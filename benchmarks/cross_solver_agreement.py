@@ -82,7 +82,7 @@ def main(argv=None) -> int:
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument(
         "--solvers", nargs="+", default=["tree", "fmm", "p3m"],
-        choices=["tree", "fmm", "p3m", "pm"],
+        choices=["tree", "fmm", "sfmm", "p3m", "pm"],
     )
     # Operating-point knobs: at 1M the disk packs ~78 bodies per
     # occupied leaf at the railed depth 7, so the baseline leaf_cap 32
