@@ -52,9 +52,10 @@ class SimulationConfig:
     force_backend: str = "auto"
     # fmm layout: "dense" (shifted-slice grids, quasi-uniform states) |
     # "sparse" (occupied-cell compaction, ops/sfmm.py — clustered
-    # states) | "auto" = sparse when the initial state occupies <5% of
-    # the dense grid's cells (single-host only; meshes use the dense
-    # slab-sharded path).
+    # states; chunk-sharded on a mesh) | "auto" = sparse when the
+    # initial state occupies <5% of the dense grid's cells (single-host
+    # decision; auto on a mesh stays on the dense slab-sharded path —
+    # force sfmm/sparse to shard the sparse layout).
     fmm_mode: str = "auto"
     chunk: int = 1024
     tree_depth: int = 0  # 0 = auto (recommended_depth)
