@@ -1,0 +1,138 @@
+"""Sparse-FMM operating-point sweep: time one force evaluation at the
+data-driven (depth, cap) sizing and its neighbors (depth +-1, cap x/2,
+x2), plus both far modes at the recommended point.
+
+The sizing heuristic (sfmm.recommended_sparse_params: overflow-fraction
+criterion, cap ~ p95 occupied load, cheapest admissible estimated cost)
+picks the operating point from data; this sweep is the measurement that
+validates or re-points it on the actual platform — the same
+measurement-beats-model contract as CROSSOVER_TPU.json and
+P3M_SHORT_TPU.json. Accuracy per point is sampled against a small exact
+subset so speed never silently trades away the error contract.
+
+Usage:
+    python benchmarks/tune_sfmm.py            # 262,144-body disk
+    python benchmarks/tune_sfmm.py 1048576
+    python benchmarks/tune_sfmm.py 1048576 --model merger
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from gravity_tpu.utils.platform import ensure_live_backend  # noqa: E402
+
+ensure_live_backend()
+
+
+def main(argv) -> int:
+    import jax
+    import numpy as np
+
+    from gravity_tpu.models import create_disk, create_merger
+    from gravity_tpu.ops.sfmm import (
+        recommended_sparse_params,
+        sfmm_accelerations,
+    )
+    from gravity_tpu.utils.timing import sync
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("n", nargs="?", type=int, default=262_144)
+    ap.add_argument("--model", default="disk", choices=["disk", "merger"])
+    ap.add_argument("--sample", type=int, default=256)
+    args = ap.parse_args(argv)
+
+    maker = create_disk if args.model == "disk" else create_merger
+    state = maker(jax.random.PRNGKey(0), args.n)
+    pos, m = state.positions, state.masses
+    g, eps = 1.0, 0.05
+
+    d0, c0, k0, occ = recommended_sparse_params(np.asarray(pos))
+    print(json.dumps({
+        "recommended": {"depth": d0, "cap": c0, "k_cells": k0,
+                        "occupied": occ},
+        "n": args.n, "model": args.model,
+        "platform": jax.devices()[0].platform,
+    }), flush=True)
+
+    rng = np.random.default_rng(0)
+    idx = np.sort(rng.choice(args.n, size=min(args.sample, args.n),
+                             replace=False))
+    # Target-chunked oracle (bounds the (chunk, N, 3) diff; an unchunked
+    # 1M-source eval is multi-GB before the sweep starts).
+    from cross_solver_agreement import exact_sample_accels
+
+    exact = np.asarray(exact_sample_accels(
+        pos, m, idx, g=g, cutoff=1e-10, eps=eps
+    ))
+    e_norm = np.linalg.norm(exact, axis=-1)
+    e_norm = np.where(e_norm > 0, e_norm, 1.0)
+
+    def timed_point(depth, cap, far_mode):
+        # Size K from the occupancy AT this depth (the forced-depth
+        # contract the Simulator uses).
+        _, _, k, _ = recommended_sparse_params(
+            np.asarray(pos), cap_max=cap, min_depth=depth,
+            max_depth=depth,
+        )
+
+        def ev():
+            return sfmm_accelerations(
+                pos, m, depth=depth, leaf_cap=cap, k_cells=k,
+                g=g, eps=eps, far_mode=far_mode,
+            )
+
+        out = ev()
+        sync(out)
+        t0 = time.perf_counter()
+        out = ev()
+        sync(out)
+        dt_s = time.perf_counter() - t0
+        err = np.linalg.norm(np.asarray(out)[idx] - exact, axis=-1)
+        return {
+            "depth": depth, "cap": cap, "k_cells": k,
+            "far_mode": far_mode, "s_per_eval": dt_s,
+            "median_rel_err": float(np.median(err / e_norm)),
+        }
+
+    # Resolve the platform default ONCE so every row records a concrete
+    # mode and the A/B times only the non-default alternative.
+    default_fm = (
+        "window" if jax.devices()[0].platform == "tpu" else "gather"
+    )
+    other_fm = "gather" if default_fm == "window" else "window"
+
+    points = [(d0, c0, default_fm)]
+    if d0 > 4:
+        points.append((d0 - 1, c0, default_fm))
+    if d0 < 9:
+        points.append((d0 + 1, c0, default_fm))
+    if c0 > 4:
+        points.append((d0, c0 // 2, default_fm))
+    if c0 < 128:
+        points.append((d0, c0 * 2, default_fm))
+    # Far-mode A/B at the recommended point: the default was already
+    # timed as the first row.
+    points.append((d0, c0, other_fm))
+    for depth, cap, fm in points:
+        # One failing point (OOM/compile at the deeper table) must not
+        # abort the unattended chip-window sweep — same contract as
+        # tune_fmm.py.
+        try:
+            print(json.dumps(timed_point(depth, cap, fm)), flush=True)
+        except Exception as e:  # noqa: BLE001
+            print(json.dumps({
+                "depth": depth, "cap": cap, "far_mode": fm,
+                "error": f"{type(e).__name__}: {e}"[:300],
+            }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -77,6 +77,9 @@ while true; do
       step 2400 python benchmarks/profile_tree.py 1048576
       step 2400 python benchmarks/tune_fmm.py 262144
       step 3600 python benchmarks/tune_fmm.py 1048576 --quick
+      #    ...and the sparse operating point: validates the data-driven
+      #    (depth, cap) sizing + the far-mode platform default on chip.
+      step 3600 python benchmarks/tune_sfmm.py 1048576
       # 9. Regression gate + remaining tags.
       step 1200 python -m gravity_tpu validate --tpu
       step 3600 python benchmarks/run_baselines.py 1m-p3m-gather
