@@ -65,12 +65,20 @@ def main(argv) -> int:
     idx = np.sort(rng.choice(args.n, size=min(args.sample, args.n),
                              replace=False))
     # Target-chunked oracle (bounds the (chunk, N, 3) diff; an unchunked
-    # 1M-source eval is multi-GB before the sweep starts).
+    # 1M-source eval is multi-GB before the sweep starts). x64 ON for
+    # the oracle only: without it the float64 casts canonicalize to
+    # fp32 and the reference's own rounding floor contaminates the
+    # ~1e-3 medians this sweep gates on (review finding) — then OFF so
+    # the sweep times the solver in its configured fp32.
     from cross_solver_agreement import exact_sample_accels
 
-    exact = np.asarray(exact_sample_accels(
-        pos, m, idx, g=g, cutoff=1e-10, eps=eps
-    ))
+    jax.config.update("jax_enable_x64", True)
+    try:
+        exact = np.asarray(exact_sample_accels(
+            pos, m, idx, g=g, cutoff=1e-10, eps=eps
+        ))
+    finally:
+        jax.config.update("jax_enable_x64", False)
     e_norm = np.linalg.norm(exact, axis=-1)
     e_norm = np.where(e_norm > 0, e_norm, 1.0)
 
@@ -101,11 +109,12 @@ def main(argv) -> int:
             "median_rel_err": float(np.median(err / e_norm)),
         }
 
-    # Resolve the platform default ONCE so every row records a concrete
-    # mode and the A/B times only the non-default alternative.
-    default_fm = (
-        "window" if jax.devices()[0].platform == "tpu" else "gather"
-    )
+    # Resolve the platform default ONCE (the library's own resolver, so
+    # the sweep labels exactly what far_mode='auto' routes) and A/B
+    # only the non-default alternative.
+    from gravity_tpu.ops.sfmm import resolve_far_mode
+
+    default_fm = resolve_far_mode("auto")
     other_fm = "gather" if default_fm == "window" else "window"
 
     points = [(d0, c0, default_fm)]

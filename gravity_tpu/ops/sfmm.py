@@ -700,14 +700,7 @@ def sfmm_accelerations(
     contract and parameters otherwise match
     :func:`gravity_tpu.ops.fmm.fmm_accelerations`."""
     k_cells = max(k_chunk, (k_cells + k_chunk - 1) // k_chunk * k_chunk)
-    if far_mode == "auto":
-        far_mode = (
-            "window" if jax.devices()[0].platform == "tpu" else "gather"
-        )
-    if far_mode not in ("window", "gather"):
-        raise ValueError(
-            f"far_mode {far_mode!r}: choose 'auto', 'window' or 'gather'"
-        )
+    far_mode = resolve_far_mode(far_mode)
 
     return _sfmm_core(
         positions, masses, depth=depth, leaf_cap=leaf_cap,
@@ -816,6 +809,22 @@ def _sfmm_core(
         jnp.arange(n, dtype=jnp.int32)
     )
     return acc_sorted[inv]
+
+
+def resolve_far_mode(far_mode: str) -> str:
+    """The ONE far_mode='auto' resolution (window on TPU — the
+    index-rate choice; gather on CPU — the cache-resident-grid choice,
+    both measured), shared by the single-host and sharded entry points
+    and the benchmarks that label their rows with it."""
+    if far_mode == "auto":
+        far_mode = (
+            "window" if jax.devices()[0].platform == "tpu" else "gather"
+        )
+    if far_mode not in ("window", "gather"):
+        raise ValueError(
+            f"far_mode {far_mode!r}: choose 'auto', 'window' or 'gather'"
+        )
+    return far_mode
 
 
 def resolve_sfmm_sizing(positions, tree_depth: int, tree_leaf_cap: int):
@@ -940,14 +949,7 @@ def make_sharded_sfmm_accel(
 
     axes = mesh.axis_names
     p_total = mesh.size
-    if far_mode == "auto":
-        far_mode = (
-            "window" if jax.devices()[0].platform == "tpu" else "gather"
-        )
-    if far_mode not in ("window", "gather"):
-        raise ValueError(
-            f"far_mode {far_mode!r}: choose 'auto', 'window' or 'gather'"
-        )
+    far_mode = resolve_far_mode(far_mode)
     # Split the CONFIGURED K over devices by shrinking the chunk, not
     # by inflating K to k_chunk*P (which made an 8-device mesh do 4x
     # the single-host cell work at small sizings — review finding):

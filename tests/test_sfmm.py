@@ -89,6 +89,7 @@ def test_sfmm_accuracy_class_at_resolving_depth(key):
     assert float(np.percentile(err, 99)) < 0.1
 
 
+@pytest.mark.fast
 def test_recommended_params_resolve_clustered_depth(key):
     """The sizing criterion is overflow mass fraction, not mean load:
     the 8k disk needs depth >= 6 to resolve its dense center (a
@@ -145,6 +146,7 @@ def test_sfmm_rank_overflow_degrades_finite(key):
     assert float(np.median(err)) < 0.3
 
 
+@pytest.mark.fast
 def test_sfmm_small_n_near_exact(key):
     """Tiny N on a deep grid: every pair lands in the near/finest
     range, so the sparse FMM is near-exact — the small-N sanity the
