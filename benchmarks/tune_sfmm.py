@@ -72,13 +72,14 @@ def main(argv) -> int:
     # the sweep times the solver in its configured fp32.
     from cross_solver_agreement import exact_sample_accels
 
+    prev_x64 = jax.config.jax_enable_x64
     jax.config.update("jax_enable_x64", True)
     try:
         exact = np.asarray(exact_sample_accels(
             pos, m, idx, g=g, cutoff=1e-10, eps=eps
         ))
     finally:
-        jax.config.update("jax_enable_x64", False)
+        jax.config.update("jax_enable_x64", prev_x64)
     e_norm = np.linalg.norm(exact, axis=-1)
     e_norm = np.where(e_norm > 0, e_norm, 1.0)
 

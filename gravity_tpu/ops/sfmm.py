@@ -699,7 +699,7 @@ def sfmm_accelerations(
     grids — measured 3x faster on CPU), "auto" = by platform. Accuracy
     contract and parameters otherwise match
     :func:`gravity_tpu.ops.fmm.fmm_accelerations`."""
-    k_cells = max(k_chunk, (k_cells + k_chunk - 1) // k_chunk * k_chunk)
+    k_cells = effective_k_cells(k_cells, k_chunk)
     far_mode = resolve_far_mode(far_mode)
 
     return _sfmm_core(
@@ -811,6 +811,30 @@ def _sfmm_core(
     return acc_sorted[inv]
 
 
+def effective_k_cells(k_cells: int, k_chunk: int = 8192) -> int:
+    """The k the single-host solver ACTUALLY runs with: k_cells rounded
+    up to a k_chunk multiple (the chunked stages need equal chunks).
+    One definition shared by sfmm_accelerations and audits — comparing
+    occupancy against the nominal k would report degradation that
+    never happened."""
+    return max(k_chunk, (k_cells + k_chunk - 1) // k_chunk * k_chunk)
+
+
+def _host_cell_ids(pos: "np.ndarray", depth: int) -> "np.ndarray":
+    """Host-side leaf ids on the same bounding cube build_octree uses —
+    the ONE binning formula shared by the sizing sweep and the post-run
+    occupancy audit (two copies would let the audit bin on a different
+    grid than the sizing)."""
+    side = 1 << depth
+    lo = pos.min(axis=0)
+    hi = pos.max(axis=0)
+    span = float((hi - lo).max()) * 1.0001 + 1e-30
+    origin = 0.5 * (hi + lo) - 0.5 * span
+    u = (pos - origin[None, :]) / span
+    c = np.clip((u * side).astype(np.int64), 0, side - 1)
+    return (c[:, 0] * side + c[:, 1]) * side + c[:, 2]
+
+
 def resolve_far_mode(far_mode: str) -> str:
     """The ONE far_mode='auto' resolution (window on TPU — the
     index-rate choice; gather on CPU — the cache-resident-grid choice,
@@ -892,10 +916,9 @@ def recommended_sparse_params(
         # must yield a sizing, not an unpack crash (review finding).
         if depth > lo and side**3 * 4 > table_budget_bytes:
             break
-        u = (pos - origin[None, :]) / span
-        c = np.clip((u * side).astype(np.int64), 0, side - 1)
-        ids = (c[:, 0] * side + c[:, 1]) * side + c[:, 2]
-        _, counts = np.unique(ids, return_counts=True)
+        _, counts = np.unique(
+            _host_cell_ids(pos, depth), return_counts=True
+        )
         occ = len(counts)
         p95 = float(np.percentile(counts, 95))
         cap = 4
@@ -991,3 +1014,18 @@ def make_sharded_sfmm_accel(
     fn.k_eff = k_eff
     fn.k_chunk_eff = k_chunk_eff
     return fn
+
+
+def final_occupancy_check(positions, sizing):
+    """Host-side occupancy count of ``positions`` at an as-run sparse
+    sizing (depth, cap, k_cells_effective) — the Simulator's post-run
+    drift audit: occupancy beyond the effective k means rank-overflow
+    cells degraded to the monopole fallback mid-run (the jitted path
+    cannot warn)."""
+    depth, cap, k_cells = sizing
+    ids = _host_cell_ids(np.asarray(positions), depth)
+    occ = int(len(np.unique(ids)))
+    return {
+        "depth": depth, "cap": cap, "k_cells": int(k_cells),
+        "occupied": occ, "overflow": occ > k_cells,
+    }

@@ -715,10 +715,16 @@ class Simulator:
                         config.tree_leaf_cap,
                     )
                 self.fmm_sparse = True
-                # The as-run sizing, for audits (cli --debug-check):
-                # an audit must measure THIS solver, not one re-sized
-                # from the evolved final state (review finding).
-                self.sfmm_sizing = (depth_s, cap_s, k_cells)
+                # The as-run sizing, for audits (cli --debug-check,
+                # post-run occupancy): an audit must measure THIS
+                # solver — the EFFECTIVE chunk-rounded k it runs with,
+                # not a re-size from the evolved final state or the
+                # nominal pre-rounding k (review findings).
+                from .ops.sfmm import effective_k_cells
+
+                self.sfmm_sizing = (
+                    depth_s, cap_s, effective_k_cells(k_cells)
+                )
                 return lambda pos, m: sfmm_accelerations(
                     pos, m, depth=depth_s, leaf_cap=cap_s,
                     k_cells=k_cells, ws=config.tree_ws, **common,
@@ -1167,6 +1173,32 @@ class Simulator:
             logger.final_positions(np.asarray(self.final_state().positions))
             logger.completed()
         stats["final_state"] = self.final_state()
+        if self.fmm_sparse:
+            # Occupancy drift audit: the sparse sizing was fixed from
+            # the INITIAL state; a run whose structure spread out can
+            # exceed k_cells mid-flight, silently degrading the
+            # rank-overflow cells to the monopole fallback. Eager
+            # host-side count on the concrete final state — cheap, and
+            # the honest signal the jitted path cannot emit.
+            from .ops.sfmm import final_occupancy_check
+
+            # The FULL padded array — the same point set the solver
+            # binned (mesh padding starts coincident with particle 0
+            # and drifts as zero-mass test bodies; excluding it could
+            # under-count vs the solver's own occupancy).
+            note = final_occupancy_check(
+                stats["final_state"].positions, self.sfmm_sizing
+            )
+            stats["sfmm_final_occupancy"] = note
+            if note["overflow"] and logger is not None:
+                logger.log_print(
+                    "WARNING: sparse-FMM occupancy grew past k_cells "
+                    f"during the run ({note['occupied']} occupied vs "
+                    f"k_cells={note['k_cells']} at depth "
+                    f"{note['depth']}); rank-overflow cells degraded "
+                    "to the monopole fallback — re-run with a larger "
+                    "k_cells (or let auto re-size from a later state)"
+                )
         return stats
 
     def run_adaptive(
