@@ -126,6 +126,10 @@ def _save_tpu_line(result: dict) -> None:
 
 def main() -> int:
     steps = int(os.environ.get("BENCH_STEPS", 20))
+    # BENCH_BACKEND lets the chip battery A/B formulations on the same
+    # harness (e.g. BENCH_BACKEND=pallas-mxu); the default "direct"
+    # routes to the measured-fastest exact kernel per platform.
+    backend = os.environ.get("BENCH_BACKEND", "direct")
 
     import jax
 
@@ -152,7 +156,7 @@ def main() -> int:
         integrator="leapfrog",
         # "direct": pallas on TPU; on the CPU fallback the native FFI
         # kernel (~2x the chunked jnp path) when the toolchain built it.
-        force_backend="direct",
+        force_backend=backend,
         dtype="float32",
     )
     stats = run_benchmark(config, warmup_steps=3, bench_steps=steps)
@@ -166,6 +170,14 @@ def main() -> int:
         "avg_step_s": stats["avg_step_s"],
         "backend": stats["backend"],
         "platform": stats["platform"],
+        # Roofline position (docs/scaling.md "MXU formulation &
+        # roofline"): how much of the chip the headline rate actually
+        # uses — the answer "vs_baseline" cannot give. mfu/peak are
+        # null off-TPU.
+        "flops_per_pair": stats.get("flops_per_pair"),
+        "achieved_tflops": stats.get("achieved_tflops"),
+        "peak_tflops": stats.get("peak_tflops"),
+        "mfu": stats.get("mfu"),
     }
 
     if result["platform"] == "tpu":
@@ -190,6 +202,8 @@ def main() -> int:
                     "avg_step_s",
                     "backend",
                     "platform",
+                    "flops_per_pair",
+                    "achieved_tflops",
                 )
             }
         else:

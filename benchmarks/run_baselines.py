@@ -44,6 +44,14 @@ CONFIGS = {
              integrator="leapfrog", force_backend="pallas"),
         dict(bench_steps=5),
     ),
+    "262k-mxu": (
+        "262,144-body cold collapse, MXU matmul-formulation direct sum "
+        "(A/B against the 262k VPU row; docs/scaling.md 'MXU "
+        "formulation & roofline')",
+        dict(model="cold_collapse", n=262_144, dt=3600.0, eps=1.0e9,
+             integrator="leapfrog", force_backend="pallas-mxu"),
+        dict(bench_steps=5),
+    ),
     "1m-tree": (
         "1M-body Milky-Way disk, octree",
         dict(model="disk", n=1_048_576, g=1.0, dt=2.0e-3, eps=0.05,

@@ -1,12 +1,22 @@
-"""Sweep Pallas kernel tile sizes on the current platform.
+"""Sweep Pallas direct-sum kernels: tile sizes AND formulations.
 
-Finds the (tile_i, tile_j) maximizing pair-interactions/s for the
-direct-sum kernel at a given N, and reports the mask-free vs masked
-specialization split. Run on a real TPU chip; results feed the TILE_I /
-TILE_J defaults in ops/pallas_forces.py.
+Two kernels implement the same force contract with different hardware
+mappings — the VPU elementwise kernel (`ops/pallas_forces.py`) and the
+MXU matmul formulation (`ops/pallas_forces_mxu.py`, Gram-trick r^2 +
+matmul accumulation, fp32 or bf16-with-fp32-accumulation). This sweep
+finds the (tile_i, tile_j) maximizing pair-interactions/s for each
+formulation at a given N, reports every point's roofline position
+(achieved TFLOP/s and MFU against the detected chip's peak), and prints
+the formulation A/B verdict. Run on a real TPU chip; results feed the
+TILE_I / TILE_J defaults in the kernel modules and the A/B table in
+docs/scaling.md.
 
 Usage:
     python benchmarks/tune_pallas.py [N] [--eps EPS]
+        [--formulation vpu|mxu|both] [--precision fp32|bf16|both]
+
+--precision applies to the mxu formulation only (the VPU kernel runs in
+the state dtype); "both" A/Bs fp32 against bf16-input/fp32-accum.
 """
 
 from __future__ import annotations
@@ -23,7 +33,21 @@ ensure_live_backend()
 
 import jax  # noqa: E402
 
-from gravity_tpu.utils.timing import sync  # noqa: E402
+from gravity_tpu.utils.timing import roofline, sync  # noqa: E402
+
+TILES_I = (256, 512, 1024, 2048)
+TILES_J = (512, 1024, 2048)
+
+
+def _time_kernel(f, pos, n, iters=5):
+    out = f(pos)
+    sync(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = f(pos)
+    sync(out)
+    dt = (time.perf_counter() - t0) / iters
+    return dt, n * (n - 1) / dt
 
 
 def main(argv) -> int:
@@ -31,49 +55,89 @@ def main(argv) -> int:
     eps = 1.0e9
     if "--eps" in argv:
         eps = float(argv[argv.index("--eps") + 1])
+    which = "both"
+    if "--formulation" in argv:
+        which = argv[argv.index("--formulation") + 1]
+    prec = "fp32"
+    if "--precision" in argv:
+        prec = argv[argv.index("--precision") + 1]
+    if which not in ("vpu", "mxu", "both"):
+        print(f"unknown --formulation {which!r}", file=sys.stderr)
+        return 2
+    if prec not in ("fp32", "bf16", "both"):
+        print(f"unknown --precision {prec!r}", file=sys.stderr)
+        return 2
 
     from gravity_tpu.models import create_plummer
     from gravity_tpu.ops.pallas_forces import pallas_pairwise_accelerations
+    from gravity_tpu.ops.pallas_forces_mxu import (
+        pallas_pairwise_accelerations_mxu,
+    )
 
-    platform = jax.devices()[0].platform
+    device = jax.devices()[0]
+    platform = device.platform
     interpret = platform != "tpu"
     state = create_plummer(jax.random.PRNGKey(0), n)
     pos, masses = state.positions, state.masses
-    print(f"platform={platform} n={n} eps={eps:g}")
+    print(f"platform={platform} device_kind={device.device_kind} "
+          f"n={n} eps={eps:g}")
 
-    results = []
-    for tile_i in (256, 512, 1024, 2048):
-        for tile_j in (512, 1024, 2048):
-            try:
-                f = lambda p: pallas_pairwise_accelerations(  # noqa: E731
-                    p, masses, eps=eps, tile_i=tile_i, tile_j=tile_j,
-                    interpret=interpret,
+    # variant label -> (formulation key, dtype for the peak lookup, fn)
+    variants = {}
+    if which in ("vpu", "both"):
+        variants["vpu/fp32"] = ("vpu", "float32", lambda ti, tj: (
+            lambda p: pallas_pairwise_accelerations(
+                p, masses, eps=eps, tile_i=ti, tile_j=tj,
+                interpret=interpret,
+            )
+        ))
+    if which in ("mxu", "both"):
+        for p_ in (("fp32", "bf16") if prec == "both" else (prec,)):
+            dtype = "bfloat16" if p_ == "bf16" else "float32"
+            variants[f"mxu/{p_}"] = ("mxu", dtype, lambda ti, tj, p_=p_: (
+                lambda p: pallas_pairwise_accelerations_mxu(
+                    p, masses, eps=eps, tile_i=ti, tile_j=tj,
+                    precision=p_, interpret=interpret,
                 )
-                out = f(pos)
-                sync(out)
-                t0 = time.perf_counter()
-                iters = 5
-                for _ in range(iters):
-                    out = f(pos)
-                sync(out)
-                dt = (time.perf_counter() - t0) / iters
-                pairs = n * (n - 1) / dt
-                results.append((pairs, tile_i, tile_j))
+            ))
+
+    best = {}  # label -> (pairs/s, tile_i, tile_j, mfu)
+    for label, (form, dtype, make) in variants.items():
+        print(f"\n== {label} ==")
+        for tile_i in TILES_I:
+            for tile_j in TILES_J:
+                try:
+                    dt, pairs = _time_kernel(make(tile_i, tile_j), pos, n)
+                except Exception as e:
+                    print(f"tile_i={tile_i:5d} tile_j={tile_j:5d}: "
+                          f"FAILED {type(e).__name__}")
+                    continue
+                roof = roofline(
+                    pairs, formulation=form,
+                    device_kind=device.device_kind, dtype=dtype,
+                )
+                mfu = roof["mfu"]
+                mfu_s = f"mfu={mfu:6.2%}" if mfu is not None else "mfu=n/a"
                 print(
                     f"tile_i={tile_i:5d} tile_j={tile_j:5d}: "
-                    f"{dt * 1e3:8.2f} ms  {pairs:.3e} pairs/s"
+                    f"{dt * 1e3:8.2f} ms  {pairs:.3e} pairs/s  "
+                    f"{roof['achieved_tflops']:7.2f} TFLOP/s  {mfu_s}"
                 )
-            except Exception as e:
-                print(
-                    f"tile_i={tile_i:5d} tile_j={tile_j:5d}: "
-                    f"FAILED {type(e).__name__}"
-                )
-    if results:
-        best = max(results)
-        print(
-            f"\nbest: tile_i={best[1]} tile_j={best[2]} "
-            f"{best[0]:.3e} pairs/s"
-        )
+                prev = best.get(label)
+                if prev is None or pairs > prev[0]:
+                    best[label] = (pairs, tile_i, tile_j, mfu)
+
+    if best:
+        print("\n== best per formulation ==")
+        for label, (pairs, ti, tj, mfu) in best.items():
+            mfu_s = f"mfu={mfu:.2%}" if mfu is not None else "mfu=n/a"
+            print(f"{label:10s} tile_i={ti} tile_j={tj} "
+                  f"{pairs:.3e} pairs/s  {mfu_s}")
+        if "vpu/fp32" in best:
+            for label, (pairs, *_rest) in best.items():
+                if label.startswith("mxu"):
+                    ratio = pairs / best["vpu/fp32"][0]
+                    print(f"A/B {label} vs vpu/fp32: {ratio:.2f}x")
     return 0
 
 

@@ -24,7 +24,7 @@ import jax
 
 from .config import SimulationConfig
 from .simulation import Simulator
-from .utils.timing import throughput
+from .utils.timing import backend_formulation, roofline, throughput
 
 
 def run_benchmark(
@@ -67,4 +67,24 @@ def run_benchmark(
         dtype=config.dtype,
         platform=jax.devices()[0].platform,
     )
+    # Roofline position (docs/scaling.md "MXU formulation & roofline"):
+    # achieved TFLOP/s from the per-formulation flops-per-pair model,
+    # MFU against the detected chip's peak (None off-TPU). Only the
+    # direct-sum backends evaluate the full N*(N-1) pair set the rate
+    # is counted over, so only they get an honest roofline; fast
+    # solvers report the fields as None.
+    if sim.backend in ("pallas", "pallas-mxu", "dense", "chunked", "cpp"):
+        stats.update(roofline(
+            stats["pairs_per_sec_per_chip"],
+            formulation=backend_formulation(sim.backend),
+            device_kind=jax.devices()[0].device_kind,
+            dtype=config.dtype,
+        ))
+    else:
+        stats.update(
+            flops_per_pair=None, achieved_tflops=None,
+            peak_tflops=None, mfu=None,
+            device_kind=jax.devices()[0].device_kind,
+            formulation=None,
+        )
     return stats

@@ -52,8 +52,12 @@ def _add_config_args(p: argparse.ArgumentParser) -> None:
                    choices=["float32", "float64", "bfloat16"], default=None)
     p.add_argument("--force-backend", dest="force_backend",
                    choices=["auto", "direct", "dense", "chunked", "pallas",
-                            "cpp", "tree", "fmm", "sfmm", "pm", "p3m"],
-                   default=None)
+                            "pallas-mxu", "cpp", "tree", "fmm", "sfmm",
+                            "pm", "p3m"],
+                   default=None,
+                   help="pallas-mxu = MXU matmul-formulation direct sum "
+                        "(Gram-trick r^2 + matmul accumulation; see "
+                        "docs/scaling.md)")
     p.add_argument("--fmm-mode", dest="fmm_mode",
                    choices=["auto", "dense", "sparse"], default=None,
                    help="fmm layout: sparse = occupied-cell compaction "
@@ -302,13 +306,17 @@ def cmd_run(args: argparse.Namespace) -> int:
             # make_local_kernel's rectangular audit measured a bogus
             # 51% "error", and re-sizing from the evolved final state
             # would audit a different solver than the one that
-            # produced the trajectory).
+            # produced the trajectory). The as-run k_chunk rides along:
+            # replaying k_eff through the default chunk rounding would
+            # re-inflate the audit's rank capacity past the solver's
+            # (sharded runs shrink the chunk to divide the mesh).
             from .ops.sfmm import sfmm_accelerations
 
-            s_depth, s_cap, s_k = sim.sfmm_sizing
+            s_depth, s_cap, s_k, s_kc = sim.sfmm_sizing
             full_acc = sfmm_accelerations(
                 final.positions, final.masses, depth=s_depth,
-                leaf_cap=s_cap, k_cells=s_k, ws=config.tree_ws,
+                leaf_cap=s_cap, k_cells=s_k, k_chunk=s_kc,
+                ws=config.tree_ws,
                 g=config.g, cutoff=config.cutoff, eps=config.eps,
             )
         elif sim.backend not in ("dense", "chunked"):
@@ -614,6 +622,29 @@ def _validate_tpu_battery(checks: dict) -> None:
     err_p = rel_err(acc_p, ref)
     checks["tpu_pallas_parity"] = {
         "n": n_par, "median_rel_err": err_p, "ok": err_p < 1e-3,
+    }
+
+    # MXU matmul-formulation kernel where it actually lowers to real
+    # MXU matmuls (the CPU suite only ever interprets it) — fp32 and
+    # the bf16-input/fp32-accum variant, at the documented budgets
+    # (docs/scaling.md "MXU formulation & roofline").
+    from .ops.pallas_forces_mxu import pallas_accelerations_vs_mxu
+
+    acc_mx = pallas_accelerations_vs_mxu(
+        state.positions, state.positions, state.masses, eps=eps,
+        interpret=not on_tpu,
+    )
+    err_mx = rel_err(acc_mx, ref)
+    checks["tpu_pallas_mxu_parity"] = {
+        "n": n_par, "median_rel_err": err_mx, "ok": err_mx < 1e-3,
+    }
+    acc_mxb = pallas_accelerations_vs_mxu(
+        state.positions, state.positions, state.masses, eps=eps,
+        precision="bf16", interpret=not on_tpu,
+    )
+    err_mxb = rel_err(acc_mxb, ref)
+    checks["tpu_pallas_mxu_bf16_parity"] = {
+        "n": n_par, "median_rel_err": err_mxb, "ok": err_mxb < 0.01,
     }
 
     # Octree vs exact on the 1m-tree baseline's model family (disk),

@@ -44,7 +44,10 @@ class SimulationConfig:
     dtype: str = "float32"
     # auto (scale-aware, may pick an approximate fast solver) | direct
     # (scale-aware among EXACT O(N^2) backends only) | dense | chunked |
-    # pallas (direct sum) | cpp (native XLA FFI host kernel, CPU
+    # pallas (direct sum, VPU formulation) | pallas-mxu (direct sum,
+    # MXU matmul formulation — Gram-trick r^2 + matmul accumulation;
+    # softened workloads, see docs/scaling.md) |
+    # cpp (native XLA FFI host kernel, CPU
     # platform) | tree (octree) | fmm (dense-grid gather-free FMM,
     # slab-sharded on a mesh) | sfmm (sparse cell-list FMM — forces the
     # clustered-state layout; fmm + fmm_mode is the usual entry) |

@@ -1,0 +1,317 @@
+"""MXU matmul-formulation Pallas kernel for direct-sum pairwise gravity.
+
+The headline VPU kernel (`pallas_forces.py`) carries its ~20-flop pair
+pipeline entirely on the 8x128 vector unit, leaving the 128x128 MXU —
+the overwhelming majority of a TPU's flops — idle. Following the dense
+tile-on-tile formulation the GPU N-body literature converged on (Nyland
+et al., *N-Body Simulations on GPUs*; Iwasawa et al., *Accelerated
+FDPS*), this kernel recasts the two O(TI*TJ*3) stages of each tile as
+matmuls:
+
+- **Pair distances via the Gram trick**: r_ij^2 = |x_i|^2 + |x_j|^2
+  - 2 x_i . x_j, where the cross term is one (TI, 3) x (3, TJ) matmul.
+- **Force accumulation**: a_i = sum_j w_ij (x_j - x_i)
+  = (W @ [X_j | 1])[:, :3] - (W @ [X_j | 1])[:, 3:] * x_i — one
+  (TI, TJ) x (TJ, 4) matmul per tile (the ones-column carries
+  sum_j w_ij), with the rank-1 x_i correction applied once in the
+  epilogue after all j-tiles have accumulated.
+
+Only the per-pair weight pipeline (threshold compare, rsqrt, three
+multiplies) stays on the VPU. Two precision variants:
+
+- ``precision="fp32"``: fp32 operands, HIGHEST-precision matmuls (the
+  multi-pass bf16 decomposition XLA uses for fp32 on the MXU).
+- ``precision="bf16"``: operands and weights quantized to bf16, all
+  matmul accumulation in fp32 (``preferred_element_type``) — the
+  MXU-native dtype whose force-field error is characterized in
+  `tests/test_bfloat16.py` (~0.4% median).
+
+Numerical contract (differs from the VPU kernel — documented in
+docs/scaling.md "MXU formulation & roofline"):
+
+- The Gram expansion subtracts O(|x|^2) quantities to produce r^2, so
+  close pairs lose precision: the absolute r^2 error is
+  ~eps_f32 * (|x_i|^2 + |x_j|^2). Pairs whose r^2 falls below a noise
+  floor ``tau * (|x_i|^2 + |x_j|^2)`` (tau = 16 * 2^-24) cannot be
+  distinguished from coincident and are zeroed — the cutoff contract's
+  "r < 1e-10 -> zero force" generalizes to "r below the formulation's
+  resolution -> zero force". This also kills self-pairs (whose Gram
+  r^2 is pure rounding residual) without any index bookkeeping, so the
+  kernel keeps the VPU kernel's targets-vs-sources LocalKernel shape.
+- Coordinates are centered on the source centroid in the wrapper
+  (translation-invariant physics; one O(N) pass) to minimize |x|^2 and
+  with it both the Gram cancellation and the accumulation-side
+  cancellation (sum w x_j - (sum w) x_i subtracts two large partial
+  sums where the VPU kernel sums small w*dx terms directly).
+- Production use is the softened large-N regime (eps well above the
+  resolution floor |x| * sqrt(tau) ~ 1e-3 |x|), where the error vs the
+  VPU kernel is at the 1e-6..1e-4 relative class (measured,
+  tests/test_pallas_mxu.py). The exact-cutoff eps=0 close-binary
+  regime stays on the VPU kernel.
+
+The wrapper pads exactly like the VPU kernel (zero-mass sources are
+exact no-ops) and the backend registry exposes this as
+``--force-backend pallas-mxu``.
+"""
+
+from __future__ import annotations
+
+import functools
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..constants import CUTOFF_RADIUS, G
+
+# Default tiles. The MXU wants both tile axes large (the (TI,TJ)x(TJ,4)
+# accumulation matmul amortizes over TJ); VMEM holds the (TI, TJ) f32
+# weight tile plus the two f32 matmul outputs — 512x1024 keeps the
+# working set ~4 MB. Sweep on chip with benchmarks/tune_pallas.py
+# --formulation mxu before trusting these.
+TILE_I = 512
+TILE_J = 1024
+
+# Gram-formulation noise floor: pairs with r^2 <= TAU * (|x_i|^2 +
+# |x_j|^2) are below the fp32 matmul's cancellation resolution and are
+# treated as coincident (zero weight). 16 ULP headroom over the fp32
+# epsilon 2^-24 covers the 3-term dot accumulation and the two squared
+# norms.
+GRAM_NOISE_TAU = 16.0 * 2.0**-24
+
+
+def _nbody_mxu_kernel(xi_ref, xjt_ref, xj4_ref, gmj_ref, acc_ref, *,
+                      cutoff, eps, bf16):
+    """One (i-tile, j-tile) block: Gram r^2 + matmul accumulation.
+
+    ``bf16`` is a trace-time Python bool: operands arrive pre-quantized
+    to bf16 and the weight tile is quantized before the accumulation
+    matmul; every matmul accumulates fp32 either way.
+    """
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    f32 = jnp.float32
+    xi = xi_ref[...]  # (TI, 3) targets, compute dtype
+    xjt = xjt_ref[...]  # (3, TJ) sources, transposed
+    xj4 = xj4_ref[...]  # (TJ, 4) sources with a ones column
+    gmj = gmj_ref[...]  # (1, TJ) pre-multiplied G*m_j, f32
+
+    # Squared norms in fp32 regardless of operand dtype: O(tile * 3)
+    # work, and the Gram cancellation budget is set by these.
+    xi32 = xi.astype(f32)
+    xjt32 = xjt.astype(f32)
+    ni = jnp.sum(xi32 * xi32, axis=1, keepdims=True)  # (TI, 1)
+    nj = jnp.sum(xjt32 * xjt32, axis=0, keepdims=True)  # (1, TJ)
+
+    # The Gram cross term: (TI, 3) x (3, TJ) on the MXU. fp32 operands
+    # use the multi-pass decomposition (HIGHEST) — without it the
+    # default-precision bf16 pass would put the noise floor at bf16
+    # scale and the resolution-floor mask would zero real pairs.
+    cross = jax.lax.dot_general(
+        xi, xjt, (((1,), (0,)), ((), ())),
+        preferred_element_type=f32,
+        precision=None if bf16 else jax.lax.Precision.HIGHEST,
+    )  # (TI, TJ)
+
+    r2 = jnp.maximum(ni + nj - 2.0 * cross, 0.0)
+    r2_soft = r2 + jnp.asarray(eps * eps, f32)
+    # Validity is two-fold, and the noise-floor test runs on the RAW
+    # r^2: below tau*(|x_i|^2+|x_j|^2) the Gram value is cancellation
+    # residue, not a distance — the pair is treated as coincident and
+    # zeroed. This must NOT use the softened r^2: a softened self-pair
+    # passes any floor (r2_soft = eps^2), and while its contribution
+    # w*(x_j - x_i) is exactly zero in the dx-form kernel, here it
+    # would enter the accumulation matmuls as two LARGE w*x partial
+    # sums whose imperfect cancellation poisons every row (measured 3%
+    # median error at bench scale before this mask). Zeroing is exact
+    # for the physics: coincident pairs contribute zero force under
+    # both the cutoff and the softened contract.
+    noise = jnp.asarray(GRAM_NOISE_TAU, f32) * (ni + nj)
+    valid = jnp.logical_and(
+        r2 > noise,
+        r2_soft > jnp.asarray(cutoff * cutoff, f32),
+    )
+    safe = jnp.where(valid, r2_soft, jnp.asarray(1.0, f32))
+    inv_r = jax.lax.rsqrt(safe)
+    # Same fp32 ordering as ops/forces._pair_weights: fold G*m_j in
+    # before the reciprocal factors so distant pairs don't underflow.
+    w = jnp.where(valid, ((gmj * inv_r) * inv_r) * inv_r,
+                  jnp.asarray(0.0, f32))  # (TI, TJ)
+
+    if bf16:
+        w = w.astype(jnp.bfloat16)
+    # Accumulation matmul: (TI, TJ) x (TJ, 4) -> [sum w*x_j | sum w],
+    # fp32 accumulation. The - (sum w) * x_i correction happens once in
+    # the wrapper epilogue.
+    acc_ref[...] += jax.lax.dot_general(
+        w, xj4, (((1,), (0,)), ((), ())),
+        preferred_element_type=f32,
+        precision=None if bf16 else jax.lax.Precision.HIGHEST,
+    )  # (TI, 4)
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "g", "cutoff", "eps", "tile_i", "tile_j", "precision", "interpret",
+    ),
+)
+def pallas_accelerations_vs_mxu(
+    pos_i: jax.Array,
+    pos_j: jax.Array,
+    masses_j: jax.Array,
+    *,
+    g: float = G,
+    cutoff: float = CUTOFF_RADIUS,
+    eps: float = 0.0,
+    tile_i: int = TILE_I,
+    tile_j: int = TILE_J,
+    precision: str = "dtype",
+    interpret: bool = False,
+) -> jax.Array:
+    """Accelerations on targets `pos_i` (M, 3) from sources `pos_j` (K, 3).
+
+    Same contract as :func:`gravity_tpu.ops.forces.accelerations_vs`
+    and the VPU kernel's :func:`pallas_accelerations_vs` (drop-in for
+    the sharded strategies), computed in the MXU matmul formulation.
+
+    ``precision``: "fp32" | "bf16" | "dtype" (follow the input dtype —
+    bf16 state runs the bf16 variant, anything else fp32). Results are
+    returned in the input dtype; bf16 matmuls always accumulate fp32.
+    """
+    if precision not in ("dtype", "fp32", "bf16"):
+        raise ValueError(
+            f"precision must be 'dtype', 'fp32' or 'bf16'; got "
+            f"{precision!r}"
+        )
+    m, k = pos_i.shape[0], pos_j.shape[0]
+    out_dtype = pos_i.dtype
+    bf16 = (
+        precision == "bf16"
+        or (precision == "dtype" and out_dtype == jnp.bfloat16)
+    )
+    compute = jnp.bfloat16 if bf16 else jnp.float32
+
+    # Center on the source centroid (translation invariant): the Gram
+    # noise floor and the accumulation cancellation both scale with
+    # |x|^2, so an off-center system would pay for its offset.
+    center = jnp.mean(pos_j.astype(jnp.float32), axis=0)
+    pos_i_c = (pos_i.astype(jnp.float32) - center).astype(compute)
+    pos_j_c = (pos_j.astype(jnp.float32) - center).astype(compute)
+
+    # bf16 min sublane tile is 16 (fp32: 8); lanes always 128.
+    tile_i = min(tile_i, _round_up(m, 16 if bf16 else 8))
+    tile_j = min(tile_j, _round_up(k, 128))
+    mp = _round_up(m, tile_i)
+    kp = _round_up(k, tile_j)
+
+    xi_p = jnp.zeros((mp, 3), compute).at[:m].set(pos_i_c)
+    # Zero-mass padded sources are exact no-ops (w = 0) regardless of
+    # position, exactly as in the VPU kernel.
+    xjt = jnp.zeros((3, kp), compute).at[:, :k].set(pos_j_c.T)
+    xj4 = (
+        jnp.zeros((kp, 4), compute)
+        .at[:k, :3].set(pos_j_c)
+        .at[:, 3].set(jnp.ones((kp,), compute))
+    )
+    gmj = jnp.zeros((1, kp), jnp.float32).at[0, :k].set(
+        jnp.asarray(g, jnp.float32) * masses_j.astype(jnp.float32)
+    )
+
+    grid = (mp // tile_i, kp // tile_j)
+    kernel = functools.partial(
+        _nbody_mxu_kernel, cutoff=cutoff, eps=eps, bf16=bf16,
+    )
+    # ~22 flops/pair: 6 (Gram matmul) + 8 (accumulation matmul, width
+    # 4) on the MXU, ~8 (threshold + weight pipeline) on the VPU — the
+    # model utils/timing.FLOPS_PER_PAIR["mxu"] documents.
+    flops_per_pair = 22
+    acc4 = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((tile_i, 3), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((3, tile_j), lambda i, j: (0, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile_j, 4), lambda i, j: (j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, tile_j), lambda i, j: (0, j),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((tile_i, 4), lambda i, j: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((mp, 4), jnp.float32),
+        cost_estimate=pl.CostEstimate(
+            flops=flops_per_pair * mp * kp,
+            bytes_accessed=(mp * 3 + kp * 8) * 4 + mp * 16,
+            transcendentals=mp * kp,  # rsqrt
+        ),
+        interpret=interpret,
+    )(xi_p, xjt, xj4, gmj)
+    # Epilogue: a_i = sum_j w x_j - (sum_j w) x_i, in the SAME centered
+    # (and, for bf16, quantized) frame the matmuls used, so the
+    # subtraction is consistent with the accumulated partial sums.
+    acc = acc4[:m, :3] - acc4[:m, 3:4] * xi_p[:m].astype(jnp.float32)
+    return acc.astype(out_dtype)
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "g", "cutoff", "eps", "tile_i", "tile_j", "precision", "interpret",
+    ),
+)
+def pallas_pairwise_accelerations_mxu(
+    positions: jax.Array,
+    masses: jax.Array,
+    *,
+    g: float = G,
+    cutoff: float = CUTOFF_RADIUS,
+    eps: float = 0.0,
+    tile_i: int = TILE_I,
+    tile_j: int = TILE_J,
+    precision: str = "dtype",
+    interpret: bool = False,
+) -> jax.Array:
+    """All-pairs accelerations (targets == sources), MXU formulation."""
+    return pallas_accelerations_vs_mxu(
+        positions, positions, masses,
+        g=g, cutoff=cutoff, eps=eps,
+        tile_i=tile_i, tile_j=tile_j, precision=precision,
+        interpret=interpret,
+    )
+
+
+def make_pallas_mxu_local_kernel(
+    *, g: float = G, cutoff: float = CUTOFF_RADIUS, eps: float = 0.0,
+    tile_i: int = TILE_I, tile_j: int = TILE_J, precision: str = "dtype",
+    interpret: bool = False,
+):
+    """A LocalKernel closure for the sharded strategies.
+
+    Differentiable via :func:`ops.forces.wrap_with_dense_vjp` exactly
+    like the VPU Pallas kernel: the backward runs the dense jnp math of
+    the shared force contract.
+    """
+    from .forces import wrap_with_dense_vjp
+
+    def _forward(pos_i, pos_j, masses_j):
+        return pallas_accelerations_vs_mxu(
+            pos_i, pos_j, masses_j,
+            g=g, cutoff=cutoff, eps=eps,
+            tile_i=tile_i, tile_j=tile_j, precision=precision,
+            interpret=interpret,
+        )
+
+    return wrap_with_dense_vjp(_forward, g=g, cutoff=cutoff, eps=eps)

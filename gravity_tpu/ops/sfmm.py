@@ -81,6 +81,12 @@ from .tree import (
 
 _I0 = np.int32(0)
 
+# The single-host chunk width for the per-cell stages. The ONE named
+# default, so audits that must replay the as-run chunking (cli
+# --debug-check via Simulator.sfmm_sizing) reference the same value the
+# solver ran with instead of re-assuming 8192.
+DEFAULT_K_CHUNK = 8192
+
 
 def _linear_ids(coords, side: int):
     return (coords[..., 0] * side + coords[..., 1]) * side + coords[..., 2]
@@ -687,7 +693,7 @@ def sfmm_accelerations(
     eps: float = 0.0,
     order: int = 2,
     quad: bool = True,
-    k_chunk: int = 8192,
+    k_chunk: int = DEFAULT_K_CHUNK,
     far_mode: str = "auto",
 ) -> jax.Array:
     """Sparse cell-list FMM accelerations for all N particles (targets =
@@ -811,7 +817,7 @@ def _sfmm_core(
     return acc_sorted[inv]
 
 
-def effective_k_cells(k_cells: int, k_chunk: int = 8192) -> int:
+def effective_k_cells(k_cells: int, k_chunk: int = DEFAULT_K_CHUNK) -> int:
     """The k the single-host solver ACTUALLY runs with: k_cells rounded
     up to a k_chunk multiple (the chunked stages need equal chunks).
     One definition shared by sfmm_accelerations and audits — comparing
@@ -902,19 +908,21 @@ def recommended_sparse_params(
     int32 table — 512^3 = 537 MB at depth 9, the default cap."""
     pos = np.asarray(positions)
     n = pos.shape[0]
-    lo = pos.min(axis=0)
-    hi = pos.max(axis=0)
-    span = float((hi - lo).max()) * 1.0001 + 1e-30
-    origin = 0.5 * (hi + lo) - 0.5 * span
+    # (Binning is delegated to _host_cell_ids, which derives its own
+    # bounding box — no geometry precompute needed here.)
     best = None  # (cost, depth, cap, occ)
     deepest = None
-    lo = max(1, min(min_depth, max_depth))
-    for depth in range(lo, max_depth + 1):
+    d_lo = max(1, min(min_depth, max_depth))
+    # Caps are powers of two; the doubling loop below must never exceed
+    # the caller's bound even when cap_max itself is not a power of two
+    # (e.g. cap_max=48 with p95=40 used to yield 64 — review finding).
+    cap_ceiling = 1 << (max(int(cap_max), 4).bit_length() - 1)
+    for depth in range(d_lo, max_depth + 1):
         side = 1 << depth
         # Always record at least the first depth: a forced shallow
         # depth (min_depth == max_depth < 4) or a tiny table budget
         # must yield a sizing, not an unpack crash (review finding).
-        if depth > lo and side**3 * 4 > table_budget_bytes:
+        if depth > d_lo and side**3 * 4 > table_budget_bytes:
             break
         _, counts = np.unique(
             _host_cell_ids(pos, depth), return_counts=True
@@ -924,6 +932,7 @@ def recommended_sparse_params(
         cap = 4
         while cap < min(cap_max, max(4, int(np.ceil(p95)))):
             cap *= 2
+        cap = min(cap, cap_ceiling)
         over_frac = float(
             np.maximum(counts - cap, 0).sum()
         ) / max(n, 1)
@@ -954,7 +963,7 @@ def make_sharded_sfmm_accel(
     eps: float = 0.0,
     order: int = 2,
     quad: bool = True,
-    k_chunk: int = 8192,
+    k_chunk: int = DEFAULT_K_CHUNK,
     far_mode: str = "auto",
 ):
     """(positions, masses) -> accelerations with the sparse FMM's
@@ -1018,11 +1027,11 @@ def make_sharded_sfmm_accel(
 
 def final_occupancy_check(positions, sizing):
     """Host-side occupancy count of ``positions`` at an as-run sparse
-    sizing (depth, cap, k_cells_effective) — the Simulator's post-run
-    drift audit: occupancy beyond the effective k means rank-overflow
-    cells degraded to the monopole fallback mid-run (the jitted path
-    cannot warn)."""
-    depth, cap, k_cells = sizing
+    sizing (depth, cap, k_cells_effective[, k_chunk_eff]) — the
+    Simulator's post-run drift audit: occupancy beyond the effective k
+    means rank-overflow cells degraded to the monopole fallback mid-run
+    (the jitted path cannot warn)."""
+    depth, cap, k_cells = sizing[:3]
     ids = _host_cell_ids(np.asarray(positions), depth)
     occ = int(len(np.unique(ids)))
     return {

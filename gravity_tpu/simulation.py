@@ -173,15 +173,15 @@ def _resolve_backend(config: SimulationConfig, on_tpu=None) -> str:
     if backend not in ("auto", "direct"):
         _warn_n = DIRECT_SUM_WARN_N
         if (
-            backend == "pallas"
+            backend in ("pallas", "pallas-mxu")
             and jax.devices()[0].platform == "tpu"
         ):
-            # On the chip the Pallas kernel IS the measured fast path up
-            # to the tree crossover (docs/scaling.md) — only warn where
-            # the tree would actually win.
+            # On the chip the Pallas kernels ARE the measured fast path
+            # up to the tree crossover (docs/scaling.md) — only warn
+            # where the tree would actually win.
             _warn_n = TREE_CROSSOVER_TPU
         if (
-            backend in ("dense", "chunked", "pallas", "cpp")
+            backend in ("dense", "chunked", "pallas", "pallas-mxu", "cpp")
             and config.n >= _warn_n
             # A ring shard streams sources and can never assemble the
             # full set a global tree build needs, so there is no faster
@@ -335,6 +335,16 @@ def make_local_kernel(config: SimulationConfig, backend: str,
 
         interpret = jax.devices()[0].platform != "tpu"
         return make_pallas_local_kernel(interpret=interpret, **common)
+    if backend == "pallas-mxu":
+        # The MXU matmul-formulation direct sum (Gram-trick r^2 + matmul
+        # force accumulation; precision follows the state dtype — bf16
+        # states run bf16 operands with fp32 accumulation). Explicit
+        # opt-in until the chip A/B (benchmarks/tune_pallas.py) crowns
+        # it: 'direct'/'auto' keep routing to the measured VPU kernel.
+        from .ops.pallas_forces_mxu import make_pallas_mxu_local_kernel
+
+        interpret = jax.devices()[0].platform != "tpu"
+        return make_pallas_mxu_local_kernel(interpret=interpret, **common)
     if backend == "cpp":
         if jax.devices()[0].platform != "cpu":
             raise ValueError(
@@ -541,9 +551,16 @@ class Simulator:
                 k_cells=k_cells, ws=config.tree_ws, g=config.g,
                 cutoff=config.cutoff, eps=config.eps,
             )
-            # Audits read the EFFECTIVE (device-divisible) k the solver
-            # runs with, not the nominal sizing (review finding).
-            self.sfmm_sizing = (depth_s, cap_s, self._accel2.k_eff)
+            # Audits read the EFFECTIVE (device-divisible) k AND the
+            # as-run chunk width the solver runs with, not the nominal
+            # sizing: replaying k_eff through the default 8192-chunk
+            # rounding would re-inflate it (e.g. 20000 -> 24576) and
+            # audit a solver with more rank capacity than the one that
+            # produced the trajectory (review findings).
+            self.sfmm_sizing = (
+                depth_s, cap_s, self._accel2.k_eff,
+                self._accel2.k_chunk_eff,
+            )
         elif self.mesh is not None and self.backend == "fmm":
             # Sharded fmm splits the dominant slab passes over the mesh
             # (replicated build, one (cells, cap, 3) all_gather) — work
@@ -670,7 +687,7 @@ class Simulator:
             return lambda pos, m: pairwise_accelerations_chunked(
                 pos, m, chunk=chunk, **common
             )
-        if self.backend in ("pallas", "cpp"):
+        if self.backend in ("pallas", "pallas-mxu", "cpp"):
             kernel = make_local_kernel(config, self.backend)
             return lambda pos, m: kernel(pos, pos, m)
         if self.backend == "tree":
@@ -720,10 +737,11 @@ class Simulator:
                 # solver — the EFFECTIVE chunk-rounded k it runs with,
                 # not a re-size from the evolved final state or the
                 # nominal pre-rounding k (review findings).
-                from .ops.sfmm import effective_k_cells
+                from .ops.sfmm import DEFAULT_K_CHUNK, effective_k_cells
 
                 self.sfmm_sizing = (
-                    depth_s, cap_s, effective_k_cells(k_cells)
+                    depth_s, cap_s, effective_k_cells(k_cells),
+                    DEFAULT_K_CHUNK,
                 )
                 return lambda pos, m: sfmm_accelerations(
                     pos, m, depth=depth_s, leaf_cap=cap_s,

@@ -61,6 +61,7 @@ def _med(a, b, scale):
     return float(np.median(np.linalg.norm(a - b, axis=-1) / scale))
 
 
+@pytest.mark.nightly
 def test_tree_p3m_exact_three_way_agreement_65k(x64):
     """65k disk: the octree at near-field-resolving depth matches the
     exact sample at the 0.1% class even on the cancellation metric
@@ -111,6 +112,7 @@ def test_fmm_joins_the_agreement_8k(x64):
     assert _med(acc_fmm, exact, rms) < 0.03      # scaled
 
 
+@pytest.mark.nightly
 def test_sfmm_joins_the_agreement_8k(x64):
     """The sparse cell-list FMM at its occupancy-resolving depth joins
     the cross-solver web: agreement with the exact sample at the tree's

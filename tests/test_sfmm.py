@@ -72,6 +72,8 @@ def test_sfmm_matches_dense_fmm_exactly(key, model, far_mode):
     assert float(np.max(err)) < 1e-3
 
 
+@pytest.mark.slow
+@pytest.mark.nightly
 def test_sfmm_accuracy_class_at_resolving_depth(key):
     """At the occupancy-resolving depth the sparse FMM hits the dense
     contract's accuracy class (~0.2-0.3% median) on the clustered disk
@@ -144,6 +146,22 @@ def test_sfmm_rank_overflow_degrades_finite(key):
     assert bool(jnp.all(jnp.isfinite(out)))
     err = _rel_err(out, exact)
     assert float(np.median(err)) < 0.3
+
+
+@pytest.mark.fast
+def test_recommended_params_cap_never_exceeds_cap_max(key):
+    """The cap-doubling loop must respect a non-power-of-two cap_max:
+    cap_max=48 with a p95 load of ~40 used to double 32 -> 64, breaking
+    the user's tree_leaf_cap bound and mis-pricing the (depth, cap)
+    cost ranking (ADVICE r5). The clamp lands on the largest power of
+    two <= cap_max."""
+    rng = np.random.default_rng(0)
+    pos = rng.normal(size=(4096, 3)).astype(np.float32)
+    pos[:3500] *= 0.01  # dense clump so p95 occupied load is high
+    for cap_max in (48, 33, 100, 7):
+        _, cap, _, _ = recommended_sparse_params(pos, cap_max=cap_max)
+        assert cap <= max(cap_max, 4), (cap_max, cap)
+        assert cap & (cap - 1) == 0  # still a power of two
 
 
 @pytest.mark.fast
