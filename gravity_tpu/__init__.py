@@ -14,14 +14,18 @@ from . import constants
 from .config import PRESETS, SimulationConfig
 from .simulation import Simulator
 from .state import ParticleState
+from .supervisor import RunSupervisor, SupervisorPolicy, supervise
 
 __version__ = "0.1.0"
 
 __all__ = [
     "PRESETS",
     "ParticleState",
+    "RunSupervisor",
     "SimulationConfig",
     "Simulator",
+    "SupervisorPolicy",
     "constants",
+    "supervise",
     "__version__",
 ]

@@ -146,6 +146,22 @@ def _add_config_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--no-nan-check", dest="nan_check", action="store_false",
                    default=None,
                    help="disable the per-block divergence watchdog")
+    p.add_argument("--auto-recover", dest="auto_recover",
+                   action="store_true", default=None,
+                   help="self-healing supervision: divergence rolls back "
+                        "to the last verified checkpoint and retries at "
+                        "halved dt, transient errors retry with backoff, "
+                        "unbuildable kernels degrade pallas-mxu -> pallas "
+                        "-> chunked (docs/robustness.md)")
+    p.add_argument("--max-retries", dest="max_retries", type=int,
+                   default=None,
+                   help="recovery attempts per failure class under "
+                        "--auto-recover (default 3)")
+    p.add_argument("--on-diverge", dest="on_diverge",
+                   choices=["halve-dt", "abort"], default=None,
+                   help="divergence policy under --auto-recover: "
+                        "halve-dt = rollback + retry the bad interval at "
+                        "halved dt; abort = checkpoint and exit 2")
     p.add_argument("--config-json", default=None,
                    help="path to a SimulationConfig JSON file")
     p.add_argument("--distributed", action="store_true", default=False,
@@ -177,6 +193,25 @@ def _maybe_distributed(args) -> None:
         initialize_distributed()
 
 
+def _print_failure_json(e) -> int:
+    """One clean stderr JSON line + exit 2 for a recovery-subsystem
+    failure — `run` and `resume` share it so both surfaces keep the
+    same operator contract (docs/robustness.md exit codes)."""
+    from .simulation import SimulationDiverged
+    from .supervisor import EXIT_FAILED
+    from .utils.faults import BackendUnavailable
+
+    if isinstance(e, SimulationDiverged):
+        payload = {"error": "diverged", "last_finite_step": e.step,
+                   "message": str(e)}
+    elif isinstance(e, BackendUnavailable):
+        payload = {"error": "backend_unavailable", "message": str(e)}
+    else:
+        payload = {"error": "transient", "message": str(e)}
+    print(json.dumps(payload), file=sys.stderr)
+    return EXIT_FAILED
+
+
 def cmd_run(args: argparse.Namespace) -> int:
     from .simulation import Simulator
     from .utils.logging import RunLogger
@@ -184,8 +219,6 @@ def cmd_run(args: argparse.Namespace) -> int:
 
     _maybe_distributed(args)
     config = build_config(args)
-    logger = RunLogger(config.log_dir)
-    sim = Simulator(config)
 
     if config.adaptive and config.merge_radius > 0.0:
         print(
@@ -194,6 +227,33 @@ def cmd_run(args: argparse.Namespace) -> int:
             file=sys.stderr,
         )
         return 1
+
+    from .simulation import SimulationDiverged, SimulationPreempted
+    from .supervisor import EXIT_FAILED, EXIT_PREEMPTED
+    from .utils.faults import BackendUnavailable, TransientFault
+
+    logger = RunLogger(config.log_dir)
+    sim = None
+    state0 = None
+    if not config.auto_recover:
+        # Kernel build happens at construction time — an unsupervised
+        # run's backend failure must exit cleanly, not traceback.
+        try:
+            sim = Simulator(config)
+        except BackendUnavailable as e:
+            return _print_failure_json(e)
+        n_real = sim.n_real
+    else:
+        # Under --auto-recover the supervisor owns Simulator
+        # construction (building one here would die on the very backend
+        # failure the degrade ladder exists to survive) — but the
+        # trajectory writer still needs the MODEL's real particle
+        # count, so realize the initial state via the shared derivation
+        # and hand it to the supervisor.
+        from .simulation import make_initial_state
+
+        state0 = make_initial_state(config)
+        n_real = state0.n
 
     writer = None
     if config.record_trajectories:
@@ -210,7 +270,7 @@ def cmd_run(args: argparse.Namespace) -> int:
                     config.log_dir,
                     f"trajectories_{logger.timestamp}.gtrj",
                 ),
-                sim.n_real,
+                n_real,
                 every=1,
             )
         else:
@@ -218,11 +278,14 @@ def cmd_run(args: argparse.Namespace) -> int:
                 os.path.join(
                     config.log_dir, f"trajectories_{logger.timestamp}"
                 ),
-                sim.n_real,
+                n_real,
                 every=1,
             )
     ckpt_mgr = None
-    if config.checkpoint_every:
+    if config.checkpoint_every or config.auto_recover:
+        # The supervisor always needs a manager: the watchdog's
+        # emergency save of the last finite state is its rollback point
+        # even when no cadence checkpointing was requested.
         from .utils.checkpoint import make_checkpoint_manager
 
         ckpt_mgr = make_checkpoint_manager(config.checkpoint_dir)
@@ -235,8 +298,26 @@ def cmd_run(args: argparse.Namespace) -> int:
         metrics_logger = MetricsLogger(
             os.path.join(config.log_dir, f"metrics_{logger.timestamp}.jsonl")
         )
+    sup = None
+    if config.auto_recover:
+        import os
+
+        from .supervisor import RunSupervisor
+        from .utils.logging import RecoveryEventLogger
+
+        events = RecoveryEventLogger(
+            os.path.join(config.log_dir,
+                         f"recovery_{logger.timestamp}.jsonl")
+        )
+        sup = RunSupervisor(
+            config, logger=logger, events=events,
+            checkpoint_manager=ckpt_mgr, trajectory_writer=writer,
+            metrics_logger=metrics_logger, state=state0,
+        )
 
     def _go():
+        if sup is not None:
+            return sup.run()
         if config.adaptive:
             return sim.run_adaptive(logger, trajectory_writer=writer,
                                     checkpoint_manager=ckpt_mgr,
@@ -245,7 +326,12 @@ def cmd_run(args: argparse.Namespace) -> int:
                        checkpoint_manager=ckpt_mgr,
                        metrics_logger=metrics_logger)
 
-    from .simulation import SimulationDiverged
+    def _close_writer():
+        # The run loop only closes the writer on normal completion;
+        # error exits must flush buffered frames themselves (a native
+        # GTRJ file left unterminated drops its tail).
+        if writer is not None:
+            writer.close()
 
     try:
         if config.profile:
@@ -258,13 +344,32 @@ def cmd_run(args: argparse.Namespace) -> int:
                 stats = _go()
         else:
             stats = _go()
-    except SimulationDiverged as e:
-        # Clean failure: the watchdog already checkpointed the last
-        # finite state (when checkpointing is on); resume with a smaller
-        # dt via `gravity_tpu resume --dt ...`.
-        print(json.dumps({"error": "diverged", "last_finite_step": e.step,
-                          "message": str(e)}), file=sys.stderr)
-        return 2
+    except SimulationPreempted:
+        # Preemption (SIGTERM): the run loop already checkpointed on its
+        # interrupt path. Exit with the dedicated resumable code so
+        # schedulers requeue instead of burying the run. "resumable"
+        # reports whether a snapshot actually EXISTS (a SIGTERM in the
+        # first block may have had nothing to save).
+        _close_writer()
+        resumable = (
+            ckpt_mgr is not None and ckpt_mgr.latest_step() is not None
+        )
+        print(json.dumps({
+            "preempted": True,
+            "resumable": resumable,
+            "resume": "gravity_tpu resume --checkpoint-dir "
+                      + config.checkpoint_dir,
+        }), file=sys.stderr)
+        return EXIT_PREEMPTED
+    except (SimulationDiverged, TransientFault, BackendUnavailable) as e:
+        # Clean failure (divergence past the retry budget, exhausted
+        # transient backoff, or a fully-failed backend ladder): the
+        # watchdog/cadence checkpoints hold the last good state; a
+        # one-line JSON error + exit 2 instead of a traceback.
+        _close_writer()
+        return _print_failure_json(e)
+    if sup is not None:
+        sim = sup.last_sim  # the simulator of the completed final leg
 
     if config.debug_check and config.periodic_box > 0.0:
         logger.log_print(
@@ -383,16 +488,29 @@ def cmd_resume(args: argparse.Namespace) -> int:
     """Resume a checkpointed run: restore the latest (or --step) snapshot
     and continue to the configured total step count — recovery the
     reference has no story for (SURVEY §5: any rank death kills the run)."""
-    from .simulation import Simulator
+    from .simulation import (
+        SimulationDiverged,
+        SimulationPreempted,
+        Simulator,
+    )
+    from .supervisor import EXIT_FAILED, EXIT_PREEMPTED
     from .utils.checkpoint import (
+        CheckpointCorrupt,
         make_checkpoint_manager,
         restore_checkpoint_with_extra,
     )
+    from .utils.faults import BackendUnavailable, TransientFault
     from .utils.logging import RunLogger
 
     config = build_config(args)
     mgr = make_checkpoint_manager(config.checkpoint_dir)
-    state, step, extra = restore_checkpoint_with_extra(mgr, args.step)
+    try:
+        state, step, extra = restore_checkpoint_with_extra(mgr, args.step)
+    except (FileNotFoundError, CheckpointCorrupt) as e:
+        # A missing/unreadable checkpoint is an operator-facing condition,
+        # not a bug: clean one-line error on stderr, exit 2, no traceback.
+        print(f"error: {e}", file=sys.stderr)
+        return EXIT_FAILED
     if config.adaptive:
         # Adaptive checkpoints carry simulated time; the target is
         # t_end = steps * dt, not a step count.
@@ -415,11 +533,25 @@ def cmd_resume(args: argparse.Namespace) -> int:
             f"Resuming adaptive run from checkpoint at step {step} "
             f"(t={t0:.6g})"
         )
-        sim = Simulator(config, state=state)
-        stats = sim.run_adaptive(
-            logger, checkpoint_manager=mgr, start_t=t0,
-            start_comp=extra.get("comp", 0.0), start_steps=step,
-        )
+        try:
+            if config.auto_recover:
+                stats = _supervised_resume(
+                    config, mgr, logger, state=state, start_step=step,
+                    start_t=t0, start_comp=extra.get("comp", 0.0),
+                )
+            else:
+                sim = Simulator(config, state=state)
+                stats = sim.run_adaptive(
+                    logger, checkpoint_manager=mgr, start_t=t0,
+                    start_comp=extra.get("comp", 0.0), start_steps=step,
+                )
+        except SimulationPreempted:
+            print(json.dumps({"preempted": True, "resumable": True}),
+                  file=sys.stderr)
+            return EXIT_PREEMPTED
+        except (SimulationDiverged, TransientFault,
+                BackendUnavailable) as e:
+            return _print_failure_json(e)
         stats.pop("final_state", None)
         stats["resumed_at"] = step
         print(json.dumps(stats))
@@ -430,12 +562,42 @@ def cmd_resume(args: argparse.Namespace) -> int:
         return 0
     logger = RunLogger(config.log_dir)
     logger.log_print(f"Resuming from checkpoint at step {step}")
-    sim = Simulator(config, state=state)
-    stats = sim.run(logger, checkpoint_manager=mgr, start_step=step)
+    try:
+        if config.auto_recover:
+            stats = _supervised_resume(
+                config, mgr, logger, state=state, start_step=step,
+            )
+        else:
+            sim = Simulator(config, state=state)
+            stats = sim.run(logger, checkpoint_manager=mgr,
+                            start_step=step)
+    except SimulationPreempted:
+        print(json.dumps({"preempted": True, "resumable": True}),
+              file=sys.stderr)
+        return EXIT_PREEMPTED
+    except (SimulationDiverged, TransientFault, BackendUnavailable) as e:
+        return _print_failure_json(e)
     stats.pop("final_state", None)
     stats["resumed_at"] = step
     print(json.dumps(stats))
     return 0
+
+
+def _supervised_resume(config, mgr, logger, **kwargs) -> dict:
+    """`resume --auto-recover`: continue under the self-healing
+    supervisor, recovery events landing next to the run log."""
+    import os
+
+    from .supervisor import RunSupervisor
+    from .utils.logging import RecoveryEventLogger
+
+    events = RecoveryEventLogger(
+        os.path.join(config.log_dir, f"recovery_{logger.timestamp}.jsonl")
+    )
+    return RunSupervisor(
+        config, logger=logger, events=events, checkpoint_manager=mgr,
+        **kwargs,
+    ).run()
 
 
 def cmd_validate(args: argparse.Namespace) -> int:
@@ -1012,9 +1174,18 @@ def cmd_cosmo(args: argparse.Namespace) -> int:
 
         ckpt_mgr = make_checkpoint_manager(args.checkpoint_dir)
     if args.resume:
-        from .utils.checkpoint import restore_checkpoint_with_extra
+        from .utils.checkpoint import (
+            CheckpointCorrupt,
+            restore_checkpoint_with_extra,
+        )
 
-        st, start_step, extra = restore_checkpoint_with_extra(ckpt_mgr)
+        try:
+            st, start_step, extra = restore_checkpoint_with_extra(
+                ckpt_mgr
+            )
+        except (FileNotFoundError, CheckpointCorrupt) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
         if "a" not in extra:
             print(
                 "error: checkpoint has no scale-factor metadata (not a "
@@ -1123,41 +1294,76 @@ def cmd_cosmo(args: argparse.Namespace) -> int:
     if args.li_check:
         li_sample(float(edges[start_step]), st)
 
+    # Preemption safety: SIGTERM checkpoints the current epoch (scale
+    # factor included, so the resume grid-validation still applies) and
+    # exits with the dedicated resumable code — same contract as `run`.
+    from .simulation import SimulationPreempted, preemption_guard
+    from .supervisor import EXIT_PREEMPTED
+
     t0 = time.perf_counter()
     step_i = start_step
-    while step_i < args.steps:
-        hi = min(step_i + block, args.steps)
-        k1s, drs, k2s = comoving_kdk_factors(
-            edges[step_i:hi + 1], h0, args.omega_m, **cosmo,
-            dtype=st.positions.dtype,
-        )
-        st = comoving_kdk_scan(st, k1s, drs, k2s, accel_fn=accel)
-        sync(st.positions)
-        prev_i, step_i = step_i, hi
-        a_now = float(edges[step_i])
-        # Output cadences are gated independently of the block size:
-        # --li-check shrinks the blocks for its quadrature, and that
-        # must not densify the progress lines or trajectory frames the
-        # user asked for.
-        if (
-            args.progress_every
-            and crossed_cadence(prev_i, step_i, args.progress_every)
-            and step_i < args.steps
-        ):
-            print(f"Step {step_i}/{args.steps} (a={a_now:.6g})",
-                  file=sys.stderr)
-        if args.li_check:
-            li_sample(a_now, st)
-        if writer is not None and crossed_cadence(
-            prev_i, step_i, user_block
-        ):
-            writer.record(step_i, np.asarray(st.positions))
-        if ckpt_mgr is not None and crossed_cadence(
-            prev_i, step_i, args.checkpoint_every
-        ):
+    # One consistent (state, step) pair, updated in a SINGLE assignment
+    # once a block is fully committed — the only source the preemption
+    # handler reads, so SIGTERM landing mid-bookkeeping (e.g. inside
+    # sync) can never pair a new state with an old step/scale factor
+    # (review finding; same pattern as the adaptive loop's snap tuple).
+    snap = (st, step_i)
+    try:
+      with preemption_guard():
+        while step_i < args.steps:
+            hi = min(step_i + block, args.steps)
+            k1s, drs, k2s = comoving_kdk_factors(
+                edges[step_i:hi + 1], h0, args.omega_m, **cosmo,
+                dtype=st.positions.dtype,
+            )
+            st_new = comoving_kdk_scan(st, k1s, drs, k2s, accel_fn=accel)
+            sync(st_new.positions)
+            st = st_new
+            prev_i, step_i = step_i, hi
+            snap = (st, step_i)
+            a_now = float(edges[step_i])
+            # Output cadences are gated independently of the block size:
+            # --li-check shrinks the blocks for its quadrature, and that
+            # must not densify the progress lines or trajectory frames
+            # the user asked for.
+            if (
+                args.progress_every
+                and crossed_cadence(prev_i, step_i, args.progress_every)
+                and step_i < args.steps
+            ):
+                print(f"Step {step_i}/{args.steps} (a={a_now:.6g})",
+                      file=sys.stderr)
+            if args.li_check:
+                li_sample(a_now, st)
+            if writer is not None and crossed_cadence(
+                prev_i, step_i, user_block
+            ):
+                writer.record(step_i, np.asarray(st.positions))
+            if ckpt_mgr is not None and crossed_cadence(
+                prev_i, step_i, args.checkpoint_every
+            ):
+                from .utils.checkpoint import save_checkpoint
+
+                save_checkpoint(ckpt_mgr, step_i, st,
+                                extra={"a": a_now})
+    except SimulationPreempted:
+        st_snap, step_snap = snap
+        if ckpt_mgr is not None and step_snap > start_step:
             from .utils.checkpoint import save_checkpoint
 
-            save_checkpoint(ckpt_mgr, step_i, st, extra={"a": a_now})
+            save_checkpoint(ckpt_mgr, step_snap, st_snap,
+                            extra={"a": float(edges[step_snap])})
+        if writer is not None:
+            writer.close()
+        print(json.dumps({
+            "preempted": True,
+            "resumable": (
+                ckpt_mgr is not None
+                and ckpt_mgr.latest_step() is not None
+            ),
+            "step": step_snap,
+        }), file=sys.stderr)
+        return EXIT_PREEMPTED
     elapsed = time.perf_counter() - t0
     if writer is not None:
         writer.close()

@@ -109,6 +109,17 @@ class SimulationConfig:
     # logging knob.
     merge_every: int = 100
 
+    # Self-healing supervision (gravity_tpu.supervisor; CLI
+    # --auto-recover). When on, the run is wrapped in a recovery loop:
+    # divergence rolls back to the last verified checkpoint and retries
+    # the bad interval at halved dt (restoring the original cadence once
+    # past it), transient device errors retry with exponential backoff,
+    # and an unbuildable kernel backend degrades down the ladder
+    # pallas-mxu -> pallas -> chunked (jnp). docs/robustness.md.
+    auto_recover: bool = False
+    max_retries: int = 3  # per failure class (diverge / transient)
+    on_diverge: str = "halve-dt"  # halve-dt | abort
+
     # Parallelism
     sharding: str = "none"  # none | allgather | ring
     mesh_shape: Optional[tuple] = None  # e.g. (8,); None = all local devices

@@ -12,6 +12,7 @@ broadcast+collect at `pyspark.py:66-78`).
 
 from __future__ import annotations
 
+import contextlib
 import math
 from functools import partial
 from typing import Optional
@@ -26,6 +27,7 @@ from .ops.forces import accelerations_vs, pairwise_accelerations_chunked
 from .ops.integrators import FORCE_EVALS_PER_STEP, init_carry, make_step_fn
 from .ops import diagnostics
 from .state import ParticleState
+from .utils import faults as _faults
 from .utils.logging import RunLogger
 from .utils.timing import StepTimer, sync, throughput
 from .utils.trajectory import TrajectoryWriter
@@ -325,6 +327,10 @@ def make_local_kernel(config: SimulationConfig, backend: str,
     for the dense (K, N) kick budget short-circuits to the exact dense
     kernel (review finding).
     """
+    # Injection point for the supervisor's degrade ladder: a platform
+    # that cannot build this kernel surfaces here as BackendUnavailable
+    # (utils/faults.py makes that failure exercisable on CPU).
+    _faults.check_backend(backend)
     common = dict(g=config.g, cutoff=config.cutoff, eps=config.eps)
     if backend in ("dense", "chunked"):
         # "chunked" differs only in the unsharded full-N path below; as a
@@ -359,9 +365,8 @@ def make_local_kernel(config: SimulationConfig, backend: str,
         from .ops.ffi_forces import ffi_forces_available, make_ffi_local_kernel
 
         if not ffi_forces_available():
-            raise RuntimeError(
-                "native FFI force kernel unavailable (g++ toolchain or "
-                "jax.ffi headers missing)"
+            raise _faults.BackendUnavailable(
+                "cpp", "g++ toolchain or jax.ffi headers missing"
             )
         return make_ffi_local_kernel(**common)
     if backend == "tree":
@@ -460,6 +465,61 @@ class SimulationDiverged(RuntimeError):
         self.step = step
 
 
+class SimulationPreempted(KeyboardInterrupt):
+    """SIGTERM (scheduler preemption) converted to an exception.
+
+    Subclasses :class:`KeyboardInterrupt` deliberately: the run loops'
+    interrupt handler already checkpoints-and-reraises on
+    KeyboardInterrupt, and preemption must take the exact same
+    checkpoint-and-exit path (ISSUE 2 satellite). Callers that care
+    (CLI, supervisor) catch this subclass first and exit with the
+    dedicated resumable code (supervisor.EXIT_PREEMPTED) so schedulers
+    can distinguish "requeue me" from failure.
+    """
+
+
+@contextlib.contextmanager
+def preemption_guard():
+    """Convert SIGTERM into :class:`SimulationPreempted` for the enclosed
+    block, restoring the previous handler on exit.
+
+    No-op outside the main thread (CPython only delivers signals there)
+    and wherever the interpreter refuses handler installation — the run
+    then keeps its default SIGTERM behavior instead of crashing.
+    """
+    import signal
+    import threading
+
+    if threading.current_thread() is not threading.main_thread():
+        yield
+        return
+
+    def _handler(signum, frame):
+        raise SimulationPreempted("SIGTERM received (preemption)")
+
+    try:
+        prev = signal.signal(signal.SIGTERM, _handler)
+    except ValueError:  # embedded interpreters without signal support
+        yield
+        return
+    try:
+        yield
+    finally:
+        signal.signal(signal.SIGTERM, prev)
+
+
+def make_initial_state(config: SimulationConfig) -> ParticleState:
+    """THE derivation of a run's initial model state from its config
+    (key, model, dtype, box) — shared by :class:`Simulator` and the
+    CLI's supervised path, so a supervised run can size its trajectory
+    writer before any (possibly failing) kernel build without ever
+    disagreeing with what the legs integrate."""
+    return create_model(
+        config.model, jax.random.PRNGKey(config.seed), config.n,
+        resolve_dtype(config.dtype), periodic_box=config.periodic_box,
+    )
+
+
 class Simulator:
     """Orchestrates a full run for a :class:`SimulationConfig`."""
 
@@ -473,11 +533,7 @@ class Simulator:
         self.fmm_sparse = False
 
         if state is None:
-            key = jax.random.PRNGKey(config.seed)
-            state = create_model(
-                config.model, key, config.n, self.dtype,
-                periodic_box=config.periodic_box,
-            )
+            state = make_initial_state(config)
         else:
             state = state.astype(self.dtype)
         self.n_real = state.n
@@ -674,6 +730,7 @@ class Simulator:
 
     def _unsharded_accel2(self):
         """(positions, masses) -> accelerations for the resolved backend."""
+        _faults.check_backend(self.backend)
         config = self.config
         n = self.state.n
         common = dict(g=config.g, cutoff=config.cutoff, eps=config.eps)
@@ -936,7 +993,28 @@ class Simulator:
         CLI did this already, but a Python-API caller setting
         ``adaptive=True`` and calling ``run()`` must not silently get a
         fixed-dt integration (review finding).
+
+        SIGTERM during the run raises :class:`SimulationPreempted`
+        through the same checkpoint-and-exit path as Ctrl-C, so
+        preempted runs are resumable.
         """
+        with preemption_guard():
+            return self._run_impl(
+                logger, steps=steps, trajectory_writer=trajectory_writer,
+                checkpoint_manager=checkpoint_manager,
+                metrics_logger=metrics_logger, start_step=start_step,
+            )
+
+    def _run_impl(
+        self,
+        logger: Optional[RunLogger] = None,
+        *,
+        steps: Optional[int] = None,
+        trajectory_writer: Optional[TrajectoryWriter] = None,
+        checkpoint_manager=None,
+        metrics_logger=None,
+        start_step: int = 0,
+    ) -> dict:
         config = self.config
         if config.adaptive:
             if steps is not None or start_step:
@@ -981,9 +1059,14 @@ class Simulator:
         # block boundary.
         steps_since_merge_check = 0
         # self.state/self._last_step stay current per block so the
-        # KeyboardInterrupt handler below can checkpoint mid-run.
+        # interrupt/preemption handler below can checkpoint mid-run.
+        self._last_step = step
         try:
           while step < total_steps:
+            # Injected transient device errors surface at block start
+            # (utils/faults.py); the supervisor retries them with
+            # exponential backoff from the last finite in-memory state.
+            _faults.maybe_raise_transient(step)
             remaining = total_steps - step
             if record and remaining >= every:
                 # Whole strides only; any sub-stride tail runs unrecorded.
@@ -998,15 +1081,30 @@ class Simulator:
                 record_every=every if do_record else 1,
             )
             sync(state.positions)
+            # Injected divergence (utils/faults.py): NaN the state so the
+            # watchdog below trips through its REAL detection path.
+            state = _faults.maybe_corrupt_state(
+                state, prev_step, prev_step + n_steps
+            )
             if config.nan_check and not self._state_finite(state):
                 # Divergence watchdog: abort with the last finite state
                 # persisted rather than integrating garbage to the end.
+                # The emergency save is best-effort — a failing save
+                # (e.g. a foreign conflicting snapshot in the dir) must
+                # not mask the SimulationDiverged being raised.
                 if checkpoint_manager is not None:
                     from .utils.checkpoint import save_checkpoint
 
-                    save_checkpoint(
-                        checkpoint_manager, prev_step, prev_state
-                    )
+                    try:
+                        save_checkpoint(
+                            checkpoint_manager, prev_step, prev_state
+                        )
+                    except Exception as ce:  # noqa: BLE001
+                        if logger is not None:
+                            logger.log_print(
+                                f"WARNING: emergency checkpoint at step "
+                                f"{prev_step} failed: {ce}"
+                            )
                 if logger is not None:
                     logger.log_print(
                         f"DIVERGED within steps {prev_step + 1}.."
@@ -1021,6 +1119,10 @@ class Simulator:
             block_prev = now
             step += n_steps
             self.state, self._last_step = state, step
+            # Injected preemption: a real SIGTERM to this process, so the
+            # handler -> SimulationPreempted -> checkpoint path below is
+            # what actually gets exercised.
+            _faults.maybe_preempt(prev_step, step)
             if logger is not None:
                 logger.progress(step, total_steps)
             steps_since_merge_check += n_steps
@@ -1128,17 +1230,33 @@ class Simulator:
                     step - n_steps, step, config.checkpoint_every
                 ):
                     save_checkpoint(checkpoint_manager, step, state)
-        except KeyboardInterrupt:
-            # Graceful interrupt: persist what we have so `resume` works
-            # (the reference loses everything on any interruption).
+        except KeyboardInterrupt as e:
+            # Graceful interrupt OR preemption (SimulationPreempted is a
+            # KeyboardInterrupt subclass): persist what we have so
+            # `resume` works (the reference loses everything on any
+            # interruption).
             if checkpoint_manager is not None and step > start_step:
                 from .utils.checkpoint import save_checkpoint
 
-                save_checkpoint(checkpoint_manager, step, self.state)
-                if logger is not None:
-                    logger.log_print(
-                        f"Interrupted at step {step}; checkpoint saved"
-                    )
+                word = (
+                    "Preempted (SIGTERM)"
+                    if isinstance(e, SimulationPreempted)
+                    else "Interrupted"
+                )
+                try:
+                    save_checkpoint(checkpoint_manager, step, self.state)
+                except Exception as ce:  # noqa: BLE001 — best-effort:
+                    # a failed save must not mask the interrupt itself.
+                    if logger is not None:
+                        logger.log_print(
+                            f"WARNING: {word} at step {step} but the "
+                            f"checkpoint save failed: {ce}"
+                        )
+                else:
+                    if logger is not None:
+                        logger.log_print(
+                            f"{word} at step {step}; checkpoint saved"
+                        )
             raise
         timer.mark()
 
@@ -1239,8 +1357,29 @@ class Simulator:
         Trajectory frames land at block boundaries (irregular simulated
         times; the metrics JSONL records t per block). Checkpoints store
         (t, kahan comp) as extras; ``resume`` passes them back via
-        ``start_t``/``start_steps``.
+        ``start_t``/``start_steps``. SIGTERM raises
+        :class:`SimulationPreempted` through the same checkpoint-and-exit
+        path as Ctrl-C.
         """
+        with preemption_guard():
+            return self._run_adaptive_impl(
+                logger, trajectory_writer=trajectory_writer,
+                checkpoint_manager=checkpoint_manager,
+                metrics_logger=metrics_logger, start_t=start_t,
+                start_comp=start_comp, start_steps=start_steps,
+            )
+
+    def _run_adaptive_impl(
+        self,
+        logger: Optional[RunLogger] = None,
+        *,
+        trajectory_writer: Optional[TrajectoryWriter] = None,
+        checkpoint_manager=None,
+        metrics_logger=None,
+        start_t: float = 0.0,
+        start_comp: float = 0.0,
+        start_steps: int = 0,
+    ) -> dict:
         from .ops.adaptive import adaptive_run
 
         config = self.config
@@ -1378,11 +1517,17 @@ class Simulator:
         # source for checkpoints, so an interrupt or divergence can
         # never pair a stale state with a newer simulated time.
         snap = (state, steps_taken, t, comp)
+        # Mirrored on self per block so the supervisor can resume a
+        # transient-failed adaptive run from the in-memory state instead
+        # of rolling back to (or past) the last checkpoint.
+        self._snap = snap
+        self._last_step = steps_taken
         try:
           while (
               t < t_end_cast
               and steps_taken < config.adaptive_max_steps
           ):
+            _faults.maybe_raise_transient(steps_taken)
             prev_steps = steps_taken
             budget = min(block_cap,
                          config.adaptive_max_steps - steps_taken)
@@ -1392,6 +1537,9 @@ class Simulator:
             state, acc = res.state, res.acc
             t, comp = float(res.t), float(res.comp)
             block_steps = int(res.steps)
+            state = _faults.maybe_corrupt_state(
+                state, prev_steps, prev_steps + block_steps
+            )
             if block_steps > 0:
                 dt_min = min(dt_min, float(res.dt_min))
                 dt_max_used = max(dt_max_used, float(res.dt_max_used))
@@ -1399,10 +1547,18 @@ class Simulator:
                 if checkpoint_manager is not None and snap[1] > 0:
                     from .utils.checkpoint import save_checkpoint
 
-                    save_checkpoint(
-                        checkpoint_manager, snap[1], snap[0],
-                        extra={"t": snap[2], "comp": snap[3]},
-                    )
+                    try:
+                        save_checkpoint(
+                            checkpoint_manager, snap[1], snap[0],
+                            extra={"t": snap[2], "comp": snap[3]},
+                        )
+                    except Exception as ce:  # noqa: BLE001 — must not
+                        # mask the SimulationDiverged being raised.
+                        if logger is not None:
+                            logger.log_print(
+                                f"WARNING: emergency checkpoint at "
+                                f"step {snap[1]} failed: {ce}"
+                            )
                 if logger is not None:
                     logger.log_print(
                         f"DIVERGED during adaptive run (after "
@@ -1414,7 +1570,9 @@ class Simulator:
             block_prev = now
             steps_taken += block_steps
             snap = (state, steps_taken, t, comp)
+            self._snap = snap
             self.state, self._last_step = state, steps_taken
+            _faults.maybe_preempt(prev_steps, steps_taken)
             if logger is not None:
                 logger.log_print(
                     f"t={t:.6g}/{t_end:.6g} ({steps_taken} adaptive "
@@ -1456,19 +1614,34 @@ class Simulator:
                 )
             if block_steps == 0:
                 break  # t >= t_end in state dtype; nothing advanced
-        except KeyboardInterrupt:
+        except KeyboardInterrupt as e:
             if checkpoint_manager is not None and snap[1] > start_steps:
                 from .utils.checkpoint import save_checkpoint
 
-                save_checkpoint(
-                    checkpoint_manager, snap[1], snap[0],
-                    extra={"t": snap[2], "comp": snap[3]},
+                word = (
+                    "Preempted (SIGTERM)"
+                    if isinstance(e, SimulationPreempted)
+                    else "Interrupted"
                 )
-                if logger is not None:
-                    logger.log_print(
-                        f"Interrupted at adaptive step {snap[1]} "
-                        f"(t={snap[2]:.6g}); checkpoint saved"
+                try:
+                    save_checkpoint(
+                        checkpoint_manager, snap[1], snap[0],
+                        extra={"t": snap[2], "comp": snap[3]},
                     )
+                except Exception as ce:  # noqa: BLE001 — best-effort:
+                    # a failed save must not mask the interrupt itself.
+                    if logger is not None:
+                        logger.log_print(
+                            f"WARNING: {word} at adaptive step "
+                            f"{snap[1]} but the checkpoint save "
+                            f"failed: {ce}"
+                        )
+                else:
+                    if logger is not None:
+                        logger.log_print(
+                            f"{word} at adaptive step {snap[1]} "
+                            f"(t={snap[2]:.6g}); checkpoint saved"
+                        )
             raise
         timer.mark()
 

@@ -1,13 +1,21 @@
-"""Checkpoint / resume via Orbax.
+"""Checkpoint / resume via Orbax, with content-integrity verification.
 
 The reference has no checkpointing whatsoever — state lives in memory for
 the whole run (SURVEY §5). Here: periodic Orbax snapshots of
 (positions, velocities, masses, step), restorable onto any mesh (Orbax
 re-shards on restore), enabling resume and elastic re-layout.
+
+Every snapshot carries a SHA-256 content checksum stored alongside the
+payload; restore recomputes and verifies it, and the latest-checkpoint
+restore path falls back step-by-step to older snapshots when the newest
+one is corrupt or unreadable (docs/robustness.md) — a half-written
+checkpoint from a kill -9 mid-save must not brick the whole run
+directory.
 """
 
 from __future__ import annotations
 
+import hashlib
 import os
 from typing import Optional
 
@@ -16,6 +24,13 @@ import numpy as np
 import orbax.checkpoint as ocp
 
 from ..state import ParticleState
+
+_INTEGRITY_KEY = "integrity_sha256"
+
+
+class CheckpointCorrupt(RuntimeError):
+    """A checkpoint whose payload does not match its stored checksum (or
+    cannot be read back at all)."""
 
 
 def make_checkpoint_manager(
@@ -35,6 +50,21 @@ def crossed_cadence(prev_step: int, step: int, every: int) -> bool:
     return every > 0 and (step // every) > (prev_step // every)
 
 
+def payload_checksum(payload: dict) -> np.ndarray:
+    """SHA-256 over the payload's canonical bytes (sorted keys; each key
+    hashed with its name, dtype, shape, and raw array bytes) as a
+    (32,) uint8 array — storable inside the Orbax payload itself, so the
+    checksum rides every snapshot and is garbage-collected with it."""
+    h = hashlib.sha256()
+    for k in sorted(payload):
+        a = np.ascontiguousarray(np.asarray(payload[k]))
+        h.update(k.encode())
+        h.update(str(a.dtype).encode())
+        h.update(repr(a.shape).encode())
+        h.update(a.tobytes())
+    return np.frombuffer(h.digest(), dtype=np.uint8).copy()
+
+
 def save_checkpoint(
     manager: ocp.CheckpointManager,
     step: int,
@@ -43,6 +73,18 @@ def save_checkpoint(
     extra: Optional[dict] = None,
 ) -> None:
     """Snapshot (positions, velocities, masses) at ``step``.
+
+    Idempotent per step: Orbax refuses to overwrite an existing step, and
+    the divergence watchdog's emergency save can land on the exact step
+    the cadence path just snapshotted (same state, same step) — raising
+    there would mask the SimulationDiverged being handled, so an
+    already-saved identical step is a no-op. A colliding step with
+    DIFFERENT content raises (stale/foreign directory), and a colliding
+    step that cannot be read back (torn write) is replaced. Note Orbax
+    also silently DROPS saves at steps below its latest — a directory
+    polluted by a previous longer run cannot accept emergency saves,
+    which callers handle by failing loudly rather than adopting the
+    foreign state (supervisor's bounded rollback).
 
     ``extra`` holds scalar run metadata beyond the step counter — e.g.
     adaptive runs store the simulated time ``t`` (float64, since fp32
@@ -58,6 +100,56 @@ def save_checkpoint(
     }
     for k, v in (extra or {}).items():
         payload[f"extra_{k}"] = np.asarray(v, np.float64)
+    digest = None
+    if all(
+        getattr(v, "is_fully_addressable", True) for v in payload.values()
+    ):
+        # Multi-host meshes can't gather the global array to one host for
+        # hashing; those snapshots save unchecksummed (and restore
+        # unverified), same as pre-integrity checkpoints.
+        digest = payload_checksum(payload)
+    if step in set(manager.all_steps() or []):
+        # The one legitimate collision is the watchdog/interrupt
+        # emergency save landing on the exact step the cadence path
+        # (possibly in an earlier process of the SAME run) already
+        # snapshotted — identical content, a no-op. A DIFFERENT state at
+        # the same step means a stale or foreign checkpoint directory;
+        # fail as loudly as Orbax always did rather than silently keep
+        # the old run's snapshots (review finding).
+        if digest is not None:
+            readable = True
+            try:
+                old = dict(
+                    manager.restore(step, args=ocp.args.StandardRestore())
+                )
+                old_digest = old.pop(_INTEGRITY_KEY, None)
+            except Exception:  # noqa: BLE001 — assorted Orbax/tensorstore
+                old_digest, readable = None, False  # damage errors
+            if not readable:
+                # A corrupt snapshot occupying our step (torn write from
+                # a killed process). The save in hand is a healthy
+                # replacement — e.g. the supervisor persisting the
+                # endpoint of the recovery segment that healed around
+                # exactly this snapshot; skipping would silently redo or
+                # lose the recovered interval (review finding).
+                manager.delete(step)
+                manager.save(step, args=ocp.args.StandardSave(
+                    {**payload, _INTEGRITY_KEY: digest}
+                ))
+                manager.wait_until_finished()
+                return
+            if old_digest is not None and not np.array_equal(
+                np.asarray(old_digest, np.uint8).reshape(-1), digest
+            ):
+                raise ValueError(
+                    f"checkpoint directory {manager.directory} already "
+                    f"holds a DIFFERENT state at step {step} — stale or "
+                    "foreign checkpoints; point checkpoint_dir at a "
+                    "clean directory (or delete the old one)"
+                )
+        return
+    if digest is not None:
+        payload[_INTEGRITY_KEY] = digest
     manager.save(step, args=ocp.args.StandardSave(payload))
     manager.wait_until_finished()
 
@@ -70,15 +162,75 @@ def restore_checkpoint(
 
 
 def restore_checkpoint_with_extra(
-    manager: ocp.CheckpointManager, step: Optional[int] = None
+    manager: ocp.CheckpointManager, step: Optional[int] = None,
+    *, max_step: Optional[int] = None,
 ) -> tuple[ParticleState, int, dict]:
     """Like :func:`restore_checkpoint` but also returns the ``extra``
-    scalar metadata dict ({} for checkpoints saved without extras)."""
-    if step is None:
-        step = manager.latest_step()
-        if step is None:
-            raise FileNotFoundError("no checkpoint found")
-    restored = manager.restore(step)
+    scalar metadata dict ({} for checkpoints saved without extras).
+
+    With ``step=None`` (latest), snapshots are tried newest-first: one
+    that fails to read back or fails its checksum is skipped in favor of
+    the next older one, so a corrupted latest checkpoint degrades the
+    resume point by one cadence instead of killing recovery outright.
+    ``max_step`` bounds that walk — the supervisor's divergence rollback
+    passes the last finite step so a stale snapshot from a PREVIOUS run
+    sharing the directory can never be adopted as the rollback point.
+    An explicit ``step`` is restored strictly — corruption there raises
+    :class:`CheckpointCorrupt`.
+    """
+    if step is not None:
+        try:
+            return _restore_verified(manager, step)
+        except (FileNotFoundError, CheckpointCorrupt):
+            raise
+        except Exception as e:  # noqa: BLE001 — normalize Orbax's /
+            # tensorstore's assorted on-disk-damage errors into the one
+            # type the strict explicit-step contract promises.
+            raise CheckpointCorrupt(
+                f"checkpoint at step {step} in {manager.directory} "
+                f"failed to restore: {type(e).__name__}: {e}"
+            ) from e
+    steps = sorted(set(manager.all_steps() or []), reverse=True)
+    if max_step is not None:
+        steps = [s for s in steps if s <= max_step]
+    if not steps:
+        bound = "" if max_step is None else f" at step <= {max_step}"
+        raise FileNotFoundError(
+            f"no checkpoint found{bound} in {manager.directory}"
+        )
+    failures = []
+    for s in steps:
+        try:
+            state, _, extra = _restore_verified(manager, s)
+            return state, s, extra
+        except Exception as e:  # noqa: BLE001 — tensorstore/Orbax raise
+            # assorted types for on-disk damage; any unreadable snapshot
+            # means "fall back one step", never "crash the restore".
+            failures.append(f"step {s}: {type(e).__name__}: {e}")
+    raise CheckpointCorrupt(
+        f"all {len(steps)} checkpoint(s) in {manager.directory} failed "
+        "to restore: " + "; ".join(failures)
+    )
+
+
+def _restore_verified(
+    manager: ocp.CheckpointManager, step: int
+) -> tuple[ParticleState, int, dict]:
+    # Explicit StandardRestore: inferring the handler from per-step
+    # metadata would make a CORRUPTED metadata file unrestorable-looking
+    # for every step, defeating the older-snapshot fallback.
+    restored = dict(
+        manager.restore(step, args=ocp.args.StandardRestore())
+    )
+    digest = restored.pop(_INTEGRITY_KEY, None)
+    if digest is not None:
+        expected = payload_checksum(restored)
+        got = np.asarray(digest, np.uint8).reshape(-1)
+        if not np.array_equal(got, expected):
+            raise CheckpointCorrupt(
+                f"checkpoint at step {step} in {manager.directory} "
+                "failed its content checksum (payload corrupted on disk)"
+            )
     state = ParticleState(
         positions=jax.numpy.asarray(np.asarray(restored["positions"])),
         velocities=jax.numpy.asarray(np.asarray(restored["velocities"])),

@@ -1,0 +1,195 @@
+"""Deterministic fault injection — makes every recovery path testable on CPU.
+
+None of the failure modes the supervisor heals (divergence, transient
+device errors, backend compile failures, preemption) occurs naturally in
+a 30-step CPU test, so each one is injectable here and consumed at the
+same code points where the real fault would surface: state corruption at
+a block boundary (the divergence watchdog then fires exactly as it would
+for a genuine blow-up), a raised :class:`TransientFault` at block start,
+a :class:`BackendUnavailable` at kernel-build time, and a real SIGTERM
+delivered to this process (exercising the actual signal handler).
+
+The plan comes from the ``GRAVITY_TPU_FAULTS`` env var (so subprocess CLI
+tests inherit it) or from :func:`install` (in-process tests). Spec
+grammar — comma-separated items:
+
+    diverge@STEP        NaN the state at the first block boundary
+                        crossing STEP (fires once)
+    transient@STEP      raise TransientFault at the first block starting
+                        at or after STEP; ``transient@STEPxCOUNT``
+                        repeats COUNT times
+    preempt@STEP        deliver SIGTERM to this process at the first
+                        block boundary crossing STEP (fires once)
+    backend:NAME        force-backend NAME raises BackendUnavailable at
+                        build time (persistent, like a platform that
+                        cannot compile the kernel)
+
+Example: ``GRAVITY_TPU_FAULTS="transient@10x2,diverge@20"``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+ENV_KNOB = "GRAVITY_TPU_FAULTS"
+
+
+class TransientFault(RuntimeError):
+    """An injected (or wrapped) transient device/runtime error — the class
+    the supervisor retries with exponential backoff."""
+
+
+class BackendUnavailable(RuntimeError):
+    """A force backend that cannot be built on this platform (injected, or
+    raised by a real failed kernel compile) — the class the supervisor
+    degrades down the backend ladder."""
+
+    def __init__(self, backend: str, reason: str = "fault injection"):
+        super().__init__(
+            f"force backend {backend!r} unavailable ({reason})"
+        )
+        self.backend = backend
+
+
+@dataclasses.dataclass
+class _Fault:
+    kind: str  # diverge | transient | preempt | backend
+    step: int = 0
+    count: int = 1
+    backend: str = ""
+
+
+class FaultPlan:
+    """A parsed, stateful injection plan (counts decrement as faults fire)."""
+
+    def __init__(self, faults: list[_Fault]):
+        self._faults = faults
+
+    @staticmethod
+    def parse(spec: str) -> "FaultPlan":
+        faults = []
+        for item in spec.split(","):
+            item = item.strip()
+            if not item:
+                continue
+            if item.startswith("backend:"):
+                faults.append(
+                    _Fault(kind="backend", backend=item.split(":", 1)[1])
+                )
+                continue
+            if "@" not in item:
+                raise ValueError(
+                    f"bad fault spec {item!r}: expected KIND@STEP[xCOUNT] "
+                    "or backend:NAME"
+                )
+            kind, arg = item.split("@", 1)
+            count = 1
+            if "x" in arg:
+                arg, cnt = arg.split("x", 1)
+                count = int(cnt)
+            if kind not in ("diverge", "transient", "preempt"):
+                raise ValueError(f"unknown fault kind {kind!r}")
+            faults.append(_Fault(kind=kind, step=int(arg), count=count))
+        return FaultPlan(faults)
+
+    def _take(self, kind: str, due) -> Optional[_Fault]:
+        """Consume one occurrence of the first matching armed fault."""
+        for f in self._faults:
+            if f.kind == kind and f.count > 0 and due(f):
+                f.count -= 1
+                return f
+        return None
+
+    def corrupt_due(self, prev_step: int, step: int) -> bool:
+        return self._take(
+            "diverge", lambda f: prev_step < f.step <= step
+        ) is not None
+
+    def transient_due(self, step: int) -> bool:
+        return self._take("transient", lambda f: step >= f.step) is not None
+
+    def preempt_due(self, prev_step: int, step: int) -> bool:
+        return self._take(
+            "preempt", lambda f: prev_step < f.step <= step
+        ) is not None
+
+    def backend_down(self, backend: str) -> bool:
+        # Persistent (no count decrement): a platform that cannot compile
+        # a kernel fails every attempt, which is what the degrade ladder
+        # must survive.
+        return any(
+            f.kind == "backend" and f.backend == backend
+            for f in self._faults
+        )
+
+
+_active: Optional[FaultPlan] = None
+_parsed_env = False
+
+
+def active() -> Optional[FaultPlan]:
+    """The process-wide plan (lazy env parse; None = no injection)."""
+    global _active, _parsed_env
+    if _active is None and not _parsed_env:
+        _parsed_env = True
+        spec = os.environ.get(ENV_KNOB, "")
+        if spec:
+            _active = FaultPlan.parse(spec)
+    return _active
+
+
+def install(spec: str) -> FaultPlan:
+    """Install a plan programmatically (in-process tests)."""
+    global _active, _parsed_env
+    _active = FaultPlan.parse(spec)
+    _parsed_env = True
+    return _active
+
+
+def reset() -> None:
+    """Drop the plan; the next :func:`active` re-reads the env knob."""
+    global _active, _parsed_env
+    _active = None
+    _parsed_env = False
+
+
+# --- hooks called from the simulation loop ---
+
+
+def maybe_corrupt_state(state, prev_step: int, step: int):
+    """NaN one coordinate when a diverge fault crosses — the watchdog then
+    trips through its real detection path."""
+    plan = active()
+    if plan is None or not plan.corrupt_due(prev_step, step):
+        return state
+    import jax.numpy as jnp
+
+    return state.replace(
+        positions=state.positions.at[0, 0].set(jnp.nan)
+    )
+
+
+def maybe_raise_transient(step: int) -> None:
+    plan = active()
+    if plan is not None and plan.transient_due(step):
+        raise TransientFault(
+            f"injected transient device error at step {step}"
+        )
+
+
+def maybe_preempt(prev_step: int, step: int) -> None:
+    """Deliver a real SIGTERM so the preemption handler itself is what the
+    test exercises."""
+    plan = active()
+    if plan is not None and plan.preempt_due(prev_step, step):
+        import signal
+
+        os.kill(os.getpid(), signal.SIGTERM)
+
+
+def check_backend(backend: str) -> None:
+    plan = active()
+    if plan is not None and plan.backend_down(backend):
+        raise BackendUnavailable(backend)

@@ -14,7 +14,9 @@ completed successfully`` line.
 from __future__ import annotations
 
 import datetime
+import json
 import os
+import time
 from typing import Optional
 
 import numpy as np
@@ -92,3 +94,38 @@ class RunLogger:
 
     def completed(self) -> None:
         self.log_print("\nSimulation completed successfully")
+
+
+class RecoveryEventLogger:
+    """Append-only JSONL stream of structured recovery events — the
+    machine-readable audit trail of the self-healing supervisor
+    (docs/robustness.md has the schema).
+
+    One JSON object per line: ``{"ts": <unix seconds>, "event": <kind>,
+    ...}`` where kind is one of ``diverged``, ``rolled_back``, ``retry``,
+    ``degraded``, ``preempted``; remaining keys are event-specific
+    (step, dt, backend, backoff_s, ...).
+    """
+
+    KINDS = ("diverged", "rolled_back", "retry", "degraded", "preempted")
+
+    def __init__(self, path: str):
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self.path = path
+
+    def event(self, kind: str, /, **fields) -> None:
+        if kind not in self.KINDS:
+            # The stream is an audit trail consumers filter by kind; a
+            # typo must fail the writer, not silently vanish downstream.
+            raise ValueError(
+                f"unknown recovery event kind {kind!r}; one of {self.KINDS}"
+            )
+        record = {"ts": round(time.time(), 3), "event": kind, **fields}
+        with open(self.path, "a") as f:
+            f.write(json.dumps(record, default=str) + "\n")
+
+    def read(self) -> list[dict]:
+        if not os.path.exists(self.path):
+            return []
+        with open(self.path) as f:
+            return [json.loads(line) for line in f if line.strip()]

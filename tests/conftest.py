@@ -33,8 +33,13 @@ REPO_ROOT = str(pathlib.Path(__file__).resolve().parents[1])
 
 def subprocess_env():
     """Env for running repo entry points in a subprocess on CPU."""
-    return {"JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO_ROOT,
-            "PATH": "/usr/bin:/bin:/usr/local/bin"}
+    env = {"JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO_ROOT,
+           "PATH": "/usr/bin:/bin:/usr/local/bin"}
+    if os.environ.get("GRAVITY_TPU_FAULTS"):
+        # The faults fixture arms injection via this knob; subprocess
+        # CLI tests inherit it so recovery paths fire there too.
+        env["GRAVITY_TPU_FAULTS"] = os.environ["GRAVITY_TPU_FAULTS"]
+    return env
 
 # The axon sitecustomize registers the tunneled TPU backend in every Python
 # process and force-overrides jax_platforms to "axon,cpu" — the env var
@@ -62,6 +67,25 @@ def _release_compiled_programs():
 @pytest.fixture
 def key():
     return jax.random.PRNGKey(0)
+
+
+@pytest.fixture
+def faults(monkeypatch):
+    """Deterministic fault injection (gravity_tpu.utils.faults).
+
+    Yields an installer: ``faults("diverge@20")`` arms the plan both
+    in-process (programmatic install) and for subprocesses (the
+    GRAVITY_TPU_FAULTS env knob, inherited through subprocess_env()).
+    Everything is undone after the test.
+    """
+    from gravity_tpu.utils import faults as fmod
+
+    def install(spec: str):
+        monkeypatch.setenv(fmod.ENV_KNOB, spec)
+        return fmod.install(spec)
+
+    yield install
+    fmod.reset()
 
 
 @pytest.fixture

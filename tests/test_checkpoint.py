@@ -3,12 +3,14 @@
 import dataclasses
 
 import numpy as np
+import pytest
 
 from gravity_tpu.config import SimulationConfig
 from gravity_tpu.simulation import Simulator
 from gravity_tpu.utils.checkpoint import (
     make_checkpoint_manager,
     restore_checkpoint,
+    restore_checkpoint_with_extra,
     save_checkpoint,
 )
 
@@ -52,6 +54,70 @@ def test_resume_matches_uninterrupted(tmp_path):
         np.asarray(resumed.positions), np.asarray(straight.positions),
         rtol=1e-6,
     )
+
+
+def test_save_same_step_is_idempotent(tmp_path):
+    """The divergence watchdog can try to save the exact step the cadence
+    path just snapshotted; Orbax refuses overwrites, so the second save
+    must be a silent no-op (not an error masking SimulationDiverged)."""
+    sim = Simulator(_cfg())
+    mgr = make_checkpoint_manager(str(tmp_path / "ckpt"))
+    save_checkpoint(mgr, 7, sim.state)
+    save_checkpoint(mgr, 7, sim.state)  # must not raise
+    assert sorted(mgr.all_steps()) == [7]
+
+
+def test_save_different_state_same_step_raises(tmp_path):
+    """A stale/foreign checkpoint directory (different content at an
+    existing step) fails loudly instead of silently keeping the old
+    run's snapshots (review-finding regression)."""
+    sim_a = Simulator(_cfg(seed=1))
+    sim_b = Simulator(_cfg(seed=2))
+    mgr = make_checkpoint_manager(str(tmp_path / "ckpt"))
+    save_checkpoint(mgr, 7, sim_a.state)
+    with pytest.raises(ValueError, match="DIFFERENT state at step 7"):
+        save_checkpoint(mgr, 7, sim_b.state)
+
+
+def test_restore_missing_names_directory(tmp_path):
+    """No checkpoint at all: the error says WHERE it looked."""
+    mgr = make_checkpoint_manager(str(tmp_path / "empty_ckpt"))
+    with pytest.raises(FileNotFoundError, match="empty_ckpt"):
+        restore_checkpoint(mgr)
+
+
+def test_integrity_checksum_roundtrip(tmp_path):
+    """Snapshots carry a content checksum and verify clean on restore,
+    extras included."""
+    sim = Simulator(_cfg())
+    mgr = make_checkpoint_manager(str(tmp_path / "ckpt"))
+    save_checkpoint(mgr, 5, sim.state, extra={"t": 123.5})
+    state, step, extra = restore_checkpoint_with_extra(mgr)
+    assert step == 5 and extra["t"] == 123.5
+    np.testing.assert_array_equal(
+        np.asarray(state.positions), np.asarray(sim.state.positions)
+    )
+
+
+def test_explicit_step_corruption_raises(tmp_path):
+    """An explicitly requested step is restored strictly: corruption is
+    an error, not a silent fallback."""
+    import os
+
+    from gravity_tpu.utils.checkpoint import CheckpointCorrupt
+
+    sim = Simulator(_cfg())
+    ckpt = str(tmp_path / "ckpt")
+    mgr = make_checkpoint_manager(ckpt)
+    save_checkpoint(mgr, 5, sim.state)
+    for dirpath, _, files in os.walk(ckpt):
+        for fn in files:
+            path = os.path.join(dirpath, fn)
+            with open(path, "wb") as f:
+                f.write(b"\x00" * max(os.path.getsize(path), 16))
+    mgr2 = make_checkpoint_manager(ckpt)
+    with pytest.raises(CheckpointCorrupt):
+        restore_checkpoint_with_extra(mgr2, 5)
 
 
 def test_checkpoint_cadence_not_divisible(tmp_path):

@@ -1,0 +1,121 @@
+"""Fault-injection layer (utils/faults.py): the machinery that makes
+every recovery path exercisable in tier-1 CPU tests."""
+
+import pytest
+
+pytestmark = pytest.mark.fast
+
+from gravity_tpu.config import SimulationConfig
+from gravity_tpu.simulation import SimulationDiverged, Simulator
+from gravity_tpu.utils.faults import (
+    BackendUnavailable,
+    FaultPlan,
+    TransientFault,
+)
+
+
+def _cfg(**kw):
+    base = dict(model="random", n=32, steps=30, dt=3600.0, seed=3,
+                force_backend="dense", progress_every=10)
+    base.update(kw)
+    return SimulationConfig(**base)
+
+
+def test_parse_spec():
+    plan = FaultPlan.parse("diverge@20,transient@10x2,backend:pallas-mxu")
+    assert plan.backend_down("pallas-mxu")
+    assert not plan.backend_down("pallas")
+    assert plan.transient_due(10)
+    assert plan.transient_due(15)
+    assert not plan.transient_due(99)  # count exhausted
+    assert not plan.corrupt_due(0, 19)
+    assert plan.corrupt_due(10, 20)
+    assert not plan.corrupt_due(10, 20)  # fires once
+
+
+def test_parse_rejects_garbage():
+    with pytest.raises(ValueError):
+        FaultPlan.parse("meteor@10")
+    with pytest.raises(ValueError):
+        FaultPlan.parse("diverge")
+
+
+def test_injected_divergence_trips_watchdog(faults, tmp_path):
+    """diverge@N NaNs the state so the REAL watchdog raises, with the
+    last finite state checkpointed at the block boundary before N."""
+    from gravity_tpu.utils.checkpoint import (
+        make_checkpoint_manager,
+        restore_checkpoint,
+    )
+
+    faults("diverge@20")
+    mgr = make_checkpoint_manager(str(tmp_path / "ckpt"))
+    sim = Simulator(_cfg())
+    with pytest.raises(SimulationDiverged) as ei:
+        sim.run(checkpoint_manager=mgr)
+    assert ei.value.step == 10  # blocks of 10; corruption lands in (10, 20]
+    state, step = restore_checkpoint(mgr)
+    assert step == 10
+    import numpy as np
+
+    assert np.isfinite(np.asarray(state.positions)).all()
+
+
+def test_injected_transient_raises(faults):
+    faults("transient@10")
+    sim = Simulator(_cfg())
+    with pytest.raises(TransientFault):
+        sim.run()
+
+
+def test_injected_backend_failure(faults):
+    faults("backend:pallas-mxu")
+    with pytest.raises(BackendUnavailable):
+        Simulator(_cfg(force_backend="pallas-mxu"))
+    # Uninjected backends still build.
+    Simulator(_cfg(force_backend="dense"))
+
+
+def test_unsupervised_backend_failure_clean_cli_exit(faults, tmp_path,
+                                                     capsys):
+    """Without --auto-recover a kernel-build failure still exits 2 with
+    a clean JSON error, not a traceback (review-finding regression)."""
+    from gravity_tpu.cli import main
+
+    faults("backend:dense")
+    rc = main([
+        "run", "--model", "random", "--n", "16", "--steps", "5",
+        "--force-backend", "dense",
+        "--log-dir", str(tmp_path / "logs"),
+    ])
+    assert rc == 2
+    err = capsys.readouterr().err
+    import json
+
+    assert json.loads(err.strip().splitlines()[-1])["error"] == (
+        "backend_unavailable"
+    )
+
+
+def test_no_injection_is_free(faults):
+    """An armed-but-unmatched plan must not perturb a clean run."""
+    import numpy as np
+
+    ref = Simulator(_cfg()).run()["final_state"]
+    faults("diverge@999,transient@999")
+    out = Simulator(_cfg()).run()["final_state"]
+    np.testing.assert_array_equal(
+        np.asarray(ref.positions), np.asarray(out.positions)
+    )
+
+
+def test_env_knob_parsed_lazily(monkeypatch):
+    from gravity_tpu.utils import faults as fmod
+
+    monkeypatch.setenv(fmod.ENV_KNOB, "transient@0")
+    fmod.reset()
+    with pytest.raises(TransientFault):
+        fmod.maybe_raise_transient(0)
+    fmod.reset()
+    monkeypatch.delenv(fmod.ENV_KNOB)
+    fmod.maybe_raise_transient(0)  # no plan, no raise

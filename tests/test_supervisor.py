@@ -1,0 +1,307 @@
+"""Self-healing supervisor (gravity_tpu/supervisor.py): every recovery
+path — rollback+retry on divergence, backoff on transients, the backend
+degrade ladder, preemption, and corrupted-checkpoint fallback — driven
+end-to-end on CPU via fault injection (ISSUE 2 acceptance)."""
+
+import os
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.fast
+
+from gravity_tpu.config import SimulationConfig
+from gravity_tpu.simulation import (
+    SimulationDiverged,
+    SimulationPreempted,
+    Simulator,
+)
+from gravity_tpu.supervisor import RunSupervisor, SupervisorPolicy
+from gravity_tpu.utils.checkpoint import make_checkpoint_manager
+from gravity_tpu.utils.faults import TransientFault
+from gravity_tpu.utils.logging import RecoveryEventLogger
+
+
+def _cfg(**kw):
+    base = dict(model="random", n=32, steps=40, dt=3600.0, seed=3,
+                force_backend="dense", progress_every=10)
+    base.update(kw)
+    return SimulationConfig(**base)
+
+
+def _sup(cfg, tmp_path, **kw):
+    events = RecoveryEventLogger(str(tmp_path / "recovery.jsonl"))
+    mgr = make_checkpoint_manager(str(tmp_path / "ckpt"), max_to_keep=10)
+    return RunSupervisor(cfg, events=events, checkpoint_manager=mgr,
+                         **kw), events
+
+
+def _rel_diff(a, b):
+    a, b = np.asarray(a), np.asarray(b)
+    return float(np.linalg.norm(a - b) / np.linalg.norm(b))
+
+
+def test_self_healing_divergence_roundtrip(faults, tmp_path):
+    """The acceptance round-trip: run -> injected mid-run divergence ->
+    watchdog checkpoint -> rollback + dt-halving retry -> completion,
+    with the final state finite and within tolerance of an uninjected
+    run, and the recovery audit trail on disk."""
+    truth = Simulator(_cfg()).run()["final_state"]
+
+    faults("diverge@20")
+    sup, events = _sup(_cfg(), tmp_path)
+    stats = sup.run()
+
+    final = stats["final_state"]
+    assert np.isfinite(np.asarray(final.positions)).all()
+    # The healed run re-integrated steps 10..20 at dt/2 (more accurate,
+    # not identical); everything else ran the original cadence.
+    assert _rel_diff(final.positions, truth.positions) < 1e-3
+    assert stats["supervisor"]["diverge_retries"] == 1
+
+    kinds = [e["event"] for e in events.read()]
+    assert kinds == ["diverged", "rolled_back", "retry"]
+    recs = events.read()
+    assert recs[0]["step"] == 10  # last finite state
+    assert recs[1]["to_step"] == 10
+    assert recs[2]["kind"] == "diverge"
+    assert recs[2]["dt"] == pytest.approx(1800.0)  # halved
+
+
+def test_divergence_abort_policy(faults, tmp_path):
+    faults("diverge@20")
+    sup, events = _sup(_cfg(on_diverge="abort"), tmp_path)
+    with pytest.raises(SimulationDiverged):
+        sup.run()
+    assert [e["event"] for e in events.read()] == ["diverged"]
+
+
+def test_retries_bounded(faults, tmp_path):
+    """Max-retries exhausts: 3 injected divergences against a budget of
+    2 propagate the third."""
+    faults("diverge@20,diverge@20,diverge@20")
+    sup, _ = _sup(_cfg(max_retries=2), tmp_path)
+    with pytest.raises(SimulationDiverged):
+        sup.run()
+    assert sup.diverge_retries == 2
+
+
+def test_transient_backoff_retry(faults, tmp_path):
+    truth = Simulator(_cfg()).run()["final_state"]
+    faults("transient@10x2")
+    sup, events = _sup(
+        _cfg(), tmp_path,
+        policy=SupervisorPolicy(backoff_s=0.01),
+    )
+    stats = sup.run()
+    assert stats["supervisor"]["transient_retries"] == 2
+    # Transient retries resume from the in-memory state at the same dt:
+    # the trajectory is unchanged.
+    np.testing.assert_allclose(
+        np.asarray(stats["final_state"].positions),
+        np.asarray(truth.positions), rtol=1e-6,
+    )
+    retries = [e for e in events.read() if e["event"] == "retry"]
+    assert [r["kind"] for r in retries] == ["transient", "transient"]
+    # Exponential backoff: second delay doubles the first.
+    assert retries[1]["backoff_s"] == pytest.approx(
+        2 * retries[0]["backoff_s"]
+    )
+
+
+def test_transient_budget_exhausts(faults, tmp_path):
+    faults("transient@10x5")
+    sup, _ = _sup(
+        _cfg(), tmp_path,
+        policy=SupervisorPolicy(max_retries=2, backoff_s=0.01),
+    )
+    with pytest.raises(TransientFault):
+        sup.run()
+
+
+def test_backend_degrade_ladder(faults, tmp_path):
+    """pallas-mxu and pallas both unbuildable: the run degrades two
+    rungs and completes on the pure-jnp chunked direct sum."""
+    faults("backend:pallas-mxu,backend:pallas")
+    sup, events = _sup(_cfg(force_backend="pallas-mxu"), tmp_path)
+    stats = sup.run()
+    assert stats["supervisor"]["backend"] == "chunked"
+    assert stats["supervisor"]["degraded_from"] == "pallas-mxu"
+    degr = [e for e in events.read() if e["event"] == "degraded"]
+    assert [(d["from_backend"], d["to_backend"]) for d in degr] == [
+        ("pallas-mxu", "pallas"), ("pallas", "chunked"),
+    ]
+    assert np.isfinite(np.asarray(stats["final_state"].positions)).all()
+
+
+def test_degrade_outside_explicit_ladder(faults, tmp_path):
+    """The ladder keys off the RESOLVED backend, not only the literal
+    config string: an unbuildable 'cpp' kernel degrades to the jnp
+    chunked direct sum (review-finding regression)."""
+    faults("backend:cpp")
+    sup, events = _sup(_cfg(force_backend="cpp"), tmp_path)
+    stats = sup.run()
+    assert stats["supervisor"]["backend"] == "chunked"
+    degr = [e for e in events.read() if e["event"] == "degraded"]
+    assert [(d["from_backend"], d["to_backend"]) for d in degr] == [
+        ("cpp", "chunked"),
+    ]
+
+
+def test_preemption_checkpoints_and_resumes(faults, tmp_path):
+    """SIGTERM mid-run lands on the checkpoint-and-exit path; the saved
+    snapshot resumes to completion."""
+    mgr = make_checkpoint_manager(str(tmp_path / "ckpt"))
+    faults("preempt@20")
+    sim = Simulator(_cfg())
+    with pytest.raises(SimulationPreempted):
+        sim.run(checkpoint_manager=mgr)
+    from gravity_tpu.utils.checkpoint import restore_checkpoint
+
+    state, step = restore_checkpoint(mgr)
+    assert step == 20
+    resumed = Simulator(_cfg(), state=state).run(
+        steps=40, start_step=step
+    )["final_state"]
+    truth = Simulator(_cfg()).run()["final_state"]
+    np.testing.assert_allclose(
+        np.asarray(resumed.positions), np.asarray(truth.positions),
+        rtol=1e-6,
+    )
+
+
+def test_preempted_event_emitted(faults, tmp_path):
+    faults("preempt@20")
+    sup, events = _sup(_cfg(), tmp_path)
+    with pytest.raises(SimulationPreempted):
+        sup.run()
+    assert [e["event"] for e in events.read()] == ["preempted"]
+    assert events.read()[0]["step"] == 20
+
+
+def test_adaptive_transient_keeps_progress(faults, tmp_path):
+    """An adaptive transient retry resumes from the in-memory snapshot
+    (state, steps, t, comp) — no rollback to t=0 when no checkpoint
+    exists yet (review-finding regression)."""
+    cfg = _cfg(
+        model="plummer", n=32, eps=1e10, steps=10, adaptive=True,
+        integrator="leapfrog", progress_every=5, eta=0.05,
+    )
+    faults("transient@5")
+    sup, events = _sup(
+        cfg, tmp_path, policy=SupervisorPolicy(backoff_s=0.01),
+    )
+    stats = sup.run()
+    assert stats["t_reached"] == pytest.approx(stats["t_end"], rel=1e-5)
+    assert stats["supervisor"]["transient_retries"] == 1
+    # The retried leg started at the in-memory step count (5), so it
+    # only integrated the REMAINING 5 steps — a rollback to t=0 would
+    # have re-run all 10.
+    assert stats["steps"] == 5
+    assert stats["adaptive_steps"] == 10
+
+
+def test_adaptive_supervised_recovery(faults, tmp_path):
+    """Adaptive runs heal by eta-halving from the last checkpoint (or
+    the start when none exists yet) and still land on t_end."""
+    cfg = _cfg(
+        model="plummer", n=32, eps=1e10, steps=10, adaptive=True,
+        integrator="leapfrog", progress_every=5, eta=0.05,
+    )
+    faults("diverge@5")
+    sup, events = _sup(cfg, tmp_path)
+    stats = sup.run()
+    assert stats["t_reached"] == pytest.approx(
+        stats["t_end"], rel=1e-5
+    )
+    assert stats["supervisor"]["diverge_retries"] == 1
+    kinds = [e["event"] for e in events.read()]
+    assert kinds[0] == "diverged" and "retry" in kinds
+
+
+def _corrupt_step_dir(root: str, step: int) -> int:
+    """Zero out every file of one checkpoint step; returns files hit."""
+    hit = 0
+    for dirpath, _, files in os.walk(root):
+        parts = os.path.normpath(dirpath).split(os.sep)
+        if str(step) not in parts:
+            continue
+        for fn in files:
+            path = os.path.join(dirpath, fn)
+            size = os.path.getsize(path)
+            with open(path, "wb") as f:
+                f.write(b"\x00" * max(size, 16))
+            hit += 1
+    return hit
+
+
+def test_rollback_rejects_foreign_newer_snapshot(faults, tmp_path):
+    """A stale snapshot from a PREVIOUS run (newer step number) in a
+    shared checkpoint dir must never become the rollback point. Orbax
+    silently drops out-of-order saves, so the watchdog's step-10 save
+    vanishes too — the only safe outcome is a LOUD failure with the
+    original divergence, never a bogus 'completed' using the foreign
+    run's state (review-finding regression: pre-fix this exited 0 at
+    start_step=90 >= steps)."""
+    from gravity_tpu.utils.checkpoint import save_checkpoint
+
+    sup, events = _sup(_cfg(), tmp_path)
+    # Foreign leftovers: a different run's state at step 90 (> steps=40).
+    save_checkpoint(sup.mgr, 90, Simulator(_cfg(seed=9)).state)
+    faults("diverge@20")
+    with pytest.raises(SimulationDiverged):
+        sup.run()
+    assert [e["event"] for e in events.read()] == ["diverged"]
+
+
+def test_replaced_corrupt_step_on_recovery_save(tmp_path):
+    """A half-written snapshot occupying the step a recovery save needs
+    is REPLACED, not silently skipped (review-finding regression)."""
+    from gravity_tpu.utils.checkpoint import (
+        restore_checkpoint_with_extra,
+        save_checkpoint,
+    )
+
+    ckpt = str(tmp_path / "ckpt")
+    sim = Simulator(_cfg(steps=20))
+    mgr = make_checkpoint_manager(ckpt, max_to_keep=10)
+    save_checkpoint(mgr, 10, sim.state)
+    sim.run()
+    healthy = sim.final_state()
+    save_checkpoint(mgr, 20, healthy)
+    assert _corrupt_step_dir(ckpt, 20) > 0
+    mgr2 = make_checkpoint_manager(ckpt, max_to_keep=10)
+    save_checkpoint(mgr2, 20, healthy)  # replaces the torn snapshot
+    state, step, _ = restore_checkpoint_with_extra(mgr2)
+    assert step == 20
+    np.testing.assert_array_equal(
+        np.asarray(state.positions), np.asarray(healthy.positions)
+    )
+
+
+def test_restore_falls_back_past_corrupted_latest(tmp_path):
+    """Corrupt the newest snapshot ON DISK: latest-restore skips it and
+    lands on the previous step (checkpoint-integrity acceptance)."""
+    from gravity_tpu.utils.checkpoint import (
+        restore_checkpoint_with_extra,
+        save_checkpoint,
+    )
+
+    ckpt = str(tmp_path / "ckpt")
+    sim = Simulator(_cfg(steps=20))
+    mgr = make_checkpoint_manager(ckpt, max_to_keep=10)
+    sim.run(checkpoint_manager=None)
+    mid = sim.final_state()
+    save_checkpoint(mgr, 10, mid)
+    sim2 = Simulator(_cfg(steps=10), state=mid)
+    sim2.run()
+    save_checkpoint(mgr, 20, sim2.final_state())
+
+    assert _corrupt_step_dir(ckpt, 20) > 0
+    # Fresh manager: no in-memory cache of the poisoned step.
+    mgr2 = make_checkpoint_manager(ckpt, max_to_keep=10)
+    state, step, _ = restore_checkpoint_with_extra(mgr2)
+    assert step == 10
+    np.testing.assert_array_equal(
+        np.asarray(state.positions), np.asarray(mid.positions)
+    )
