@@ -1,0 +1,21 @@
+# Developer entry points. All targets run on CPU (JAX_PLATFORMS=cpu);
+# chip validation goes through `gravity_tpu validate --tpu`.
+
+PYTEST := env JAX_PLATFORMS=cpu python -m pytest
+
+.PHONY: smoke fast test nightly
+
+# The documented pre-push check: the -m fast contract lane plus a
+# 2-job ensemble serving e2e through the real CLI daemon (docs/serving.md).
+smoke:
+	bash scripts/smoke.sh
+
+fast:
+	$(PYTEST) tests/ -q -m fast
+
+# The tier-1 lane (what CI gates on).
+test:
+	$(PYTEST) tests/ -q -m 'not slow'
+
+nightly:
+	$(PYTEST) tests/ -q -m nightly

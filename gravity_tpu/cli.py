@@ -443,18 +443,139 @@ def cmd_run(args: argparse.Namespace) -> int:
 
 
 def cmd_sweep(args: argparse.Namespace) -> int:
-    """The pyspark.py benchmark sweep (`/root/reference/pyspark.py:168-198`):
-    run the reference configurations back-to-back in one log file."""
-    from .simulation import Simulator
-    from .utils.logging import RunLogger
-
+    """The pyspark.py benchmark sweep (`/root/reference/pyspark.py:168-198`)
+    — the first consumer of the ensemble serving engine: every size is
+    submitted as a job to an in-process bucketed scheduler, so the
+    sizes integrate as vmap-batched device programs instead of
+    recompile-and-run one at a time (docs/serving.md). Configs outside
+    the ensemble envelope (fast solvers, adaptive, merging, ...) fall
+    back to the original solo loop. Log shape stays drop-in comparable
+    with the reference."""
     import os
+    import time
 
+    import numpy as np
+
+    from .utils.logging import RunLogger, ServingEventLogger
+    from .utils.timing import pairs_per_step
     from .utils.trajectory import TrajectoryWriter
 
     config = build_config(args)
     logger = RunLogger(config.log_dir)
     sizes = args.sizes or [10, 100, 500, 1000]
+
+    from .serve import EnsembleScheduler, batch_key_for
+
+    slots = args.slots or 4
+    try:
+        for n in sizes:
+            batch_key_for(
+                dataclasses.replace(config, n=n), slots=slots
+            )
+    except ValueError as e:
+        logger.log_print(
+            f"(ensemble sweep unavailable for this config: {e}; "
+            "running sizes solo)"
+        )
+        return _sweep_solo(config, sizes, logger)
+
+    events = ServingEventLogger(
+        os.path.join(config.log_dir,
+                     f"serving_{logger.timestamp}.jsonl")
+    )
+    sched = EnsembleScheduler(
+        slots=slots,
+        slice_steps=max(1, min(config.progress_every, config.steps)),
+        events=events,
+    )
+    job_ids = {}
+    for n in sizes:
+        logger.log_print(
+            f"\nStarting gravity simulation with {n} particles"
+        )
+        logger.log_print("Configuration:")
+        logger.log_print(f"- Number of steps: {config.steps}")
+        logger.log_print(f"- Time step: {config.dt:g} seconds")
+        job_ids[n] = sched.submit(dataclasses.replace(config, n=n))
+
+    writers = {}
+    if config.record_trajectories:
+        for n in sizes:
+            writers[n] = TrajectoryWriter(
+                os.path.join(
+                    config.log_dir,
+                    f"trajectories_{logger.timestamp}_n{n}",
+                ),
+                n, every=1,
+            )
+
+    t0 = time.perf_counter()
+    last_frame: dict = {}
+    while sched.has_work():
+        if sched.run_round() is None and not sched.has_work():
+            break
+        for n, w in writers.items():
+            job = sched.jobs[job_ids[n]]
+            state = sched.peek_state(job_ids[n])
+            if (
+                job.status in ("running", "completed")
+                and state is not None
+                and last_frame.get(n) != job.steps_done
+            ):
+                # Round-boundary frames (the block-streaming cadence of
+                # `run`, at the scheduler's slice granularity); only
+                # when the job actually advanced this round.
+                last_frame[n] = job.steps_done
+                w.record(job.steps_done, np.asarray(state.positions))
+    wall = time.perf_counter() - t0
+    for w in writers.values():
+        w.close()
+
+    failed = []
+    for n in sizes:
+        st = sched.status(job_ids[n])
+        if st["status"] != "completed":
+            failed.append((n, st))
+            logger.log_print(
+                f"\nSweep job n={n} {st['status']}: "
+                f"{st.get('error') or 'not completed'}"
+            )
+            continue
+        # active_s counts only rounds THIS job was resident in —
+        # submission-to-completion latency would also span the other
+        # buckets' interleaved rounds and misreport per-size throughput.
+        job_s = st["active_s"]
+        logger.performance(
+            job_s, config.steps,
+            pairs_per_sec=(
+                pairs_per_step(n) * config.steps / job_s
+                if job_s > 0 else None
+            ),
+        )
+        final = sched.result(job_ids[n])
+        logger.final_positions(np.asarray(final.positions))
+    logger.log_print(
+        f"\nEnsemble sweep: {len(sizes)} jobs in {wall:.2f}s over "
+        f"{sched.rounds_run} rounds "
+        f"({len(sched.engine.compile_counts)} compiled batch programs); "
+        f"serving events: {events.path}"
+    )
+    if failed:
+        return 1
+    logger.completed()
+    return 0
+
+
+def _sweep_solo(config, sizes, logger) -> int:
+    """The pre-ensemble sweep loop: one Simulator per size, back to
+    back — the fallback for configs the ensemble engine cannot serve."""
+    import os
+
+    import numpy as np
+
+    from .simulation import Simulator
+    from .utils.trajectory import TrajectoryWriter
+
     for n in sizes:
         logger.log_print(
             f"\nStarting gravity simulation with {n} particles"
@@ -477,8 +598,6 @@ def cmd_sweep(args: argparse.Namespace) -> int:
         stats = sim.run(trajectory_writer=writer)
         logger.performance(stats["total_time_s"], cfg.steps,
                            pairs_per_sec=stats["pairs_per_sec"])
-        import numpy as np
-
         logger.final_positions(np.asarray(stats["final_state"].positions))
     logger.completed()
     return 0
@@ -1434,6 +1553,129 @@ def cmd_traj(args: argparse.Namespace) -> int:
     return subprocess.run(cmd).returncode
 
 
+def cmd_serve(args: argparse.Namespace) -> int:
+    """Start the ensemble serving daemon: a localhost HTTP/JSON job API
+    over the vmap-batched multi-simulation engine (docs/serving.md).
+    Jobs and results persist under --spool-dir, so a restarted daemon
+    resumes its queue."""
+    import os
+
+    from .serve import GravityDaemon
+
+    daemon = GravityDaemon(
+        args.spool_dir, host=args.host, port=args.port,
+        slots=args.slots, slice_steps=args.slice_steps,
+        yield_rounds=args.yield_rounds,
+    )
+    host, port = daemon.start()
+    print(json.dumps({
+        "serving": True, "host": host, "port": port,
+        "spool_dir": args.spool_dir, "pid": os.getpid(),
+        "slots": args.slots, "slice_steps": args.slice_steps,
+    }), flush=True)
+    daemon.serve_blocking()
+    return 0
+
+
+def cmd_submit(args: argparse.Namespace) -> int:
+    """Submit one job (the usual config flags describe it) to the
+    daemon advertised under --spool-dir; prints the job id, or — with
+    --wait — polls to the terminal status."""
+    from .serve import DaemonUnreachable, request, wait_for
+
+    config = build_config(args)
+    try:
+        resp = request(args.spool_dir, "POST", "/submit", {
+            "config": json.loads(config.to_json()),
+            "priority": args.priority,
+            "deadline_s": args.deadline_s,
+        })
+    except DaemonUnreachable as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if "job" not in resp:
+        print(json.dumps(resp), file=sys.stderr)
+        return 1
+    if args.wait:
+        try:
+            statuses = wait_for(
+                args.spool_dir, [resp["job"]], timeout=args.timeout
+            )
+        except (DaemonUnreachable, TimeoutError) as e:
+            print(json.dumps({"job": resp["job"], "error": str(e)}),
+                  file=sys.stderr)
+            return 2
+        st = statuses[resp["job"]]
+        print(json.dumps(st))
+        return 0 if st["status"] == "completed" else 1
+    print(json.dumps(resp))
+    return 0
+
+
+def cmd_job_status(args: argparse.Namespace) -> int:
+    from .serve import DaemonUnreachable, request
+
+    path = f"/status?job={args.job}" if args.job else "/status"
+    try:
+        resp = request(args.spool_dir, "GET", path)
+    except DaemonUnreachable as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if "error" in resp:
+        # Unknown job id etc.: scripts must see a nonzero exit, not a
+        # 0 with the error payload on stdout.
+        print(json.dumps(resp), file=sys.stderr)
+        return 1
+    print(json.dumps(resp, indent=2))
+    return 0
+
+
+def cmd_result(args: argparse.Namespace) -> int:
+    """Fetch a completed job's final state; --out saves it as .npz."""
+    import numpy as np
+
+    from .serve import DaemonUnreachable, request
+
+    try:
+        resp = request(args.spool_dir, "GET", f"/result?job={args.job}")
+    except DaemonUnreachable as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if "positions" not in resp:
+        print(json.dumps(resp), file=sys.stderr)
+        return 1
+    if args.out:
+        # No dtype coercion: fp64 job results must not silently lose
+        # half their mantissa in the archive (fp32 values round-trip
+        # through float64 exactly).
+        np.savez(
+            args.out,
+            positions=np.asarray(resp["positions"]),
+            velocities=np.asarray(resp["velocities"]),
+            masses=np.asarray(resp["masses"]),
+        )
+    summary = {k: v for k, v in resp.items()
+               if k not in ("positions", "velocities", "masses")}
+    summary["n"] = len(resp["positions"])
+    if args.out:
+        summary["saved_to"] = args.out
+    print(json.dumps(summary))
+    return 0
+
+
+def cmd_cancel(args: argparse.Namespace) -> int:
+    from .serve import DaemonUnreachable, request
+
+    try:
+        resp = request(args.spool_dir, "POST", "/cancel",
+                       {"job": args.job})
+    except DaemonUnreachable as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    print(json.dumps(resp))
+    return 0 if resp.get("cancelled") else 1
+
+
 def cmd_bench(args: argparse.Namespace) -> int:
     from .bench import run_benchmark
 
@@ -1454,11 +1696,82 @@ def main(argv=None) -> int:
     p_run.set_defaults(fn=cmd_run)
 
     p_sweep = sub.add_parser(
-        "sweep", help="reference pyspark.py-style benchmark sweep"
+        "sweep", help="reference pyspark.py-style benchmark sweep "
+                      "(batched through the ensemble engine)"
     )
     _add_config_args(p_sweep)
     p_sweep.add_argument("--sizes", type=int, nargs="*", default=None)
+    p_sweep.add_argument("--slots", type=int, default=None,
+                         help="batch slots per bucket (default 4)")
     p_sweep.set_defaults(fn=cmd_sweep)
+
+    def _add_spool_arg(p):
+        p.add_argument("--spool-dir", dest="spool_dir",
+                       default="gravity_spool",
+                       help="daemon spool directory (jobs, results, "
+                            "daemon.json endpoint file)")
+
+    p_serve = sub.add_parser(
+        "serve",
+        help="start the ensemble serving daemon (HTTP/JSON job API "
+             "over the vmap-batched engine; docs/serving.md)",
+    )
+    _add_spool_arg(p_serve)
+    p_serve.add_argument("--host", default="127.0.0.1")
+    p_serve.add_argument("--port", type=int, default=0,
+                         help="0 = any free port (clients discover it "
+                              "via the spool's daemon.json)")
+    p_serve.add_argument("--slots", type=int, default=4,
+                         help="batch slots per bucket")
+    p_serve.add_argument("--slice-steps", dest="slice_steps", type=int,
+                         default=100,
+                         help="steps per scheduling round (the "
+                              "starvation bound: short jobs wait at "
+                              "most ~yield-rounds slices)")
+    p_serve.add_argument("--yield-rounds", dest="yield_rounds", type=int,
+                         default=2,
+                         help="consecutive rounds a resident job may "
+                              "hold a contended slot before yielding")
+    p_serve.set_defaults(fn=cmd_serve)
+
+    p_submit = sub.add_parser(
+        "submit", help="submit a job to the serving daemon"
+    )
+    _add_config_args(p_submit)
+    _add_spool_arg(p_submit)
+    p_submit.add_argument("--priority", type=int, default=0,
+                          help="higher preempts lower in a full batch")
+    p_submit.add_argument("--deadline-s", dest="deadline_s", type=float,
+                          default=None,
+                          help="wall-clock budget from submission; "
+                               "expired jobs fail instead of queueing "
+                               "forever")
+    p_submit.add_argument("--wait", action="store_true",
+                          help="poll until the job is terminal")
+    p_submit.add_argument("--timeout", type=float, default=600.0,
+                          help="--wait poll budget in seconds")
+    p_submit.set_defaults(fn=cmd_submit)
+
+    p_status = sub.add_parser(
+        "status", help="job status (all jobs when no id is given)"
+    )
+    _add_spool_arg(p_status)
+    p_status.add_argument("job", nargs="?", default=None)
+    p_status.set_defaults(fn=cmd_job_status)
+
+    p_result = sub.add_parser(
+        "result", help="fetch a completed job's final state"
+    )
+    _add_spool_arg(p_result)
+    p_result.add_argument("job")
+    p_result.add_argument("--out", default=None,
+                          help="save the final state as this .npz")
+    p_result.set_defaults(fn=cmd_result)
+
+    p_cancel = sub.add_parser("cancel", help="cancel a queued/running job")
+    _add_spool_arg(p_cancel)
+    p_cancel.add_argument("job")
+    p_cancel.set_defaults(fn=cmd_cancel)
 
     p_resume = sub.add_parser(
         "resume", help="resume from the latest checkpoint"
@@ -1606,7 +1919,11 @@ def main(argv=None) -> int:
     p_bench.set_defaults(fn=cmd_bench)
 
     args = parser.parse_args(argv)
-    if args.command != "traj" and not getattr(args, "distributed", False):
+    # traj and the serving CLIENT verbs never touch the device (they
+    # talk JSON to files / the daemon) — skip the backend probe there.
+    if args.command not in (
+        "traj", "submit", "status", "result", "cancel"
+    ) and not getattr(args, "distributed", False):
         # Every device-touching command would hang forever on a wedged
         # axon tunnel; bound that with a subprocess probe + CPU fallback.
         # Multi-host runs skip the probe: a sibling process initializing

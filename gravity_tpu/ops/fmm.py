@@ -67,6 +67,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..constants import CUTOFF_RADIUS, G
+from ..utils.compat import axis_size as _axis_size
+from ..utils.compat import shard_map as _shard_map
 from .cells import bin_to_cells, grid_coords
 from .tree import (
     _near_offsets,
@@ -1167,7 +1169,7 @@ def make_sharded_fmm_accel(
         # the P(axes) block partitioning of the particle axis).
         idx = jax.lax.axis_index(axes[0])
         for a in axes[1:]:
-            idx = idx * jax.lax.axis_size(a) + jax.lax.axis_index(a)
+            idx = idx * _axis_size(a) + jax.lax.axis_index(a)
         slab_ids = (
             idx * local_slabs + jnp.arange(local_slabs, dtype=jnp.int32)
         ) * slab_eff
@@ -1181,7 +1183,7 @@ def make_sharded_fmm_accel(
             acc, (idx * n_local, _I0), (n_local, 3)
         )
 
-    return jax.shard_map(
+    return _shard_map(
         body, mesh=mesh, in_specs=(spec, spec), out_specs=spec,
         check_vma=False,
     )

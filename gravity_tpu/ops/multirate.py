@@ -158,24 +158,26 @@ def two_rung_step_sharded(
 
     from jax.sharding import NamedSharding, PartitionSpec
 
+    from ..utils.compat import reshard, scatter_set_sharded
+
     rep = NamedSharding(mesh, PartitionSpec())
 
     # Fast-rung selection happens on replicated copies: top_k's (K,)
     # output cannot keep a particle partition (K < shard count is the
-    # common case) and GSPMD refuses the layout. jax.sharding.reshard
-    # is the explicit-sharding-mode API (with_sharding_constraint does
-    # not relayout explicit-axis operands). The replicated copies are
-    # reused for the fast-rung gathers below — one all-gather each per
-    # outer step.
-    acc_rep = jax.sharding.reshard(acc, rep)
-    masses_rep = jax.sharding.reshard(masses, rep)
+    # common case) and GSPMD refuses the layout. reshard (the compat
+    # wrapper: jax.sharding.reshard in explicit mode, a sharding
+    # constraint on 0.4.x auto mode) relays out. The replicated copies
+    # are reused for the fast-rung gathers below — one all-gather each
+    # per outer step.
+    acc_rep = reshard(acc, rep)
+    masses_rep = reshard(masses, rep)
     fast_idx = select_fast(acc_rep, masses_rep, k=k)
 
     part = PartitionSpec(mesh.axis_names)
-    fast_mask_rep = jnp.zeros((state.n,), bool).at[fast_idx].set(
-        True, out_sharding=rep
+    fast_mask_rep = scatter_set_sharded(
+        jnp.zeros((state.n,), bool), fast_idx, True, rep
     )
-    fast_mask = jax.sharding.reshard(
+    fast_mask = reshard(
         fast_mask_rep, NamedSharding(mesh, part)
     )
     slow_w = jnp.where(fast_mask, 0.0, 1.0).astype(dtype)[:, None]
@@ -183,8 +185,8 @@ def two_rung_step_sharded(
     x, v = state.positions, state.velocities
 
     # Pull the fast rung into replicated K-sized arrays.
-    x_rep = jax.sharding.reshard(x, rep)
-    v_rep = jax.sharding.reshard(v, rep)
+    x_rep = reshard(x, rep)
+    v_rep = reshard(v, rep)
     x_f = x_rep[fast_idx]
     v_f = v_rep[fast_idx]
     a_f = acc_rep[fast_idx]
@@ -213,16 +215,12 @@ def two_rung_step_sharded(
     # scatter goes through a replicated copy (explicit-mode scatter
     # into a particle-sharded operand with replicated indices has no
     # unambiguous layout), then reshards to the particle partition.
-    x = jax.sharding.reshard(
-        jax.sharding.reshard(x, rep).at[fast_idx].set(
-            x_f, out_sharding=rep
-        ),
+    x = reshard(
+        scatter_set_sharded(reshard(x, rep), fast_idx, x_f, rep),
         NamedSharding(mesh, part),
     )
-    v = jax.sharding.reshard(
-        jax.sharding.reshard(v, rep).at[fast_idx].set(
-            v_f, out_sharding=rep
-        ),
+    v = reshard(
+        scatter_set_sharded(reshard(v, rep), fast_idx, v_f, rep),
         NamedSharding(mesh, part),
     )
 
@@ -433,26 +431,28 @@ def rung_ladder_step_sharded(
 
     from jax.sharding import NamedSharding, PartitionSpec
 
+    from ..utils.compat import reshard, scatter_set_sharded
+
     rep = NamedSharding(mesh, PartitionSpec())
     part = PartitionSpec(mesh.axis_names)
 
-    acc_rep = jax.sharding.reshard(acc, rep)
-    masses_rep = jax.sharding.reshard(masses, rep)
+    acc_rep = reshard(acc, rep)
+    masses_rep = reshard(masses, rep)
     # Union fast set, fastest block first (the assign_rungs layout).
     union_idx = select_fast(acc_rep, masses_rep, k=sum(capacities))
 
-    fast_mask_rep = jnp.zeros((state.n,), bool).at[union_idx].set(
-        True, out_sharding=rep
+    fast_mask_rep = scatter_set_sharded(
+        jnp.zeros((state.n,), bool), union_idx, True, rep
     )
-    fast_mask = jax.sharding.reshard(
+    fast_mask = reshard(
         fast_mask_rep, NamedSharding(mesh, part)
     )
     slow_w = jnp.where(fast_mask, 0.0, 1.0).astype(dtype)[:, None]
     masses_slow = jnp.where(fast_mask, jnp.asarray(0.0, dtype), masses)
     x, v = state.positions, state.velocities
 
-    x_rep = jax.sharding.reshard(x, rep)
-    v_rep = jax.sharding.reshard(v, rep)
+    x_rep = reshard(x, rep)
+    v_rep = reshard(v, rep)
     x_f = x_rep[union_idx]
     v_f = v_rep[union_idx]
     a_f = acc_rep[union_idx]
@@ -485,16 +485,12 @@ def rung_ladder_step_sharded(
                 v_f = v_f.at[s:s + cap].add(a_r * factor)
 
     # Write the union back, then the closing slow half-kick.
-    x = jax.sharding.reshard(
-        jax.sharding.reshard(x, rep).at[union_idx].set(
-            x_f, out_sharding=rep
-        ),
+    x = reshard(
+        scatter_set_sharded(reshard(x, rep), union_idx, x_f, rep),
         NamedSharding(mesh, part),
     )
-    v = jax.sharding.reshard(
-        jax.sharding.reshard(v, rep).at[union_idx].set(
-            v_f, out_sharding=rep
-        ),
+    v = reshard(
+        scatter_set_sharded(reshard(v, rep), union_idx, v_f, rep),
         NamedSharding(mesh, part),
     )
     new_acc = accel_full(x, masses)

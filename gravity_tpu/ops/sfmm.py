@@ -38,11 +38,16 @@ a mostly-empty grid:
   than ``k_cells`` occupied) get the complete per-point monopole
   evaluation (leaf 7^3 neighborhood through the rank table + every
   coarse ancestor list via fmm._monopole_coarse_levels), cond-gated so
-  well-sized runs never pay it. Rank-overflow cells' particles also
-  DROP OUT of the near/finest source set (their mass still reaches the
-  coarse levels through the dense octree grids) — size ``k_cells``
-  from data with :func:`recommended_sparse_params`, which doubles the
-  observed occupancy.
+  well-sized runs never pay it. As a SOURCE, a rank-overflow cell's
+  leaf-range mass degrades to a cell-size-softened monopole at its
+  COM (the rank table keeps every occupied cell's rank; per-rank
+  mass/COM channels carry the tail beyond ``k_cells``) — the same
+  degradation class as cap overflow, instead of the cell silently
+  dropping out of its neighbors' near/finest sums (ADVICE r5). Its
+  far-range mass reaches the coarse levels through the dense octree
+  grids as before. Size ``k_cells`` from data with
+  :func:`recommended_sparse_params`, which doubles the observed
+  occupancy.
 
 Because the interaction sets and expansion math are identical to
 ops/fmm.py, sparse-vs-dense parity is testable to float-reordering
@@ -67,6 +72,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..constants import CUTOFF_RADIUS, G
+from ..utils.compat import axis_size as _axis_size
+from ..utils.compat import shard_map as _shard_map
 from .cells import _scatter_cells, grid_coords
 from .fmm import (
     _monopole_coarse_levels,
@@ -145,15 +152,18 @@ def _build_sparse(positions, masses, depth, k_cells, leaf_cap, quad):
     k_occ = occ_rank[-1] + 1
 
     # Occupied-cell id table (ascending; sentinel n_leaves beyond k_occ)
-    # and the dense rank table (-1 = unoccupied or rank-overflow).
+    # and the dense rank table (-1 = unoccupied; EVERY occupied cell's
+    # rank is stored, so consumers can tell a rank-overflow neighbor
+    # (rank >= k_cells — degrade to its softened monopole) from empty
+    # space (drop)).
     occ_ids = jnp.full((k_cells,), n_leaves, jnp.int32)
     occ_ids = occ_ids.at[
         jnp.where(is_first, occ_rank, k_cells)
     ].set(sorted_ids, mode="drop")
     table = jnp.full((n_leaves,), -1, jnp.int32)
-    table = table.at[occ_ids].set(
-        jnp.arange(k_cells, dtype=jnp.int32), mode="drop"
-    )
+    table = table.at[
+        jnp.where(is_first, sorted_ids, n_leaves)
+    ].set(occ_rank, mode="drop")
     occ_coords = _decode_ids(occ_ids, side)
 
     # Slot layout: rank-within-cell via the running first-index.
@@ -207,6 +217,19 @@ def _build_sparse(positions, masses, depth, k_cells, leaf_cap, quad):
             q6, seg, num_segments=k_cells + 1
         )[:k_cells]
 
+    # Per-RANK monopoles over EVERY occupied cell (rank-indexed, n-sized
+    # — rank < k_occ <= n). Ranks < k_cells duplicate occ_mhat/occ_com;
+    # the tail holds the rank-overflow cells' mass/COM, which used to be
+    # collapsed into the dropped catch-all segment — the source data for
+    # their leaf-range softened-monopole degradation (ADVICE r5).
+    all_mhat = jax.ops.segment_sum(m_hat, occ_rank, num_segments=n)
+    all_mw = jax.ops.segment_sum(
+        m_hat[:, None] * sorted_pos, occ_rank, num_segments=n
+    )
+    all_com = all_mw / jnp.maximum(
+        all_mhat, jnp.asarray(1e-37, dtype)
+    )[:, None]
+
     # Overflow remainder per occupied cell (mass beyond the cap prefix).
     count = jax.ops.segment_sum(
         jnp.ones((n,), jnp.int32), seg, num_segments=k_cells + 1
@@ -232,6 +255,7 @@ def _build_sparse(positions, masses, depth, k_cells, leaf_cap, quad):
         cells_mass=cells_mass, occ_mhat=occ_mhat, occ_com=occ_com,
         occ_qhat=occ_qhat, over=over, rem_mhat=rem_mhat,
         rem_com=rem_com, m_scale=m_scale,
+        all_mhat=all_mhat, all_com=all_com,
     )
 
 
@@ -485,8 +509,10 @@ def _sparse_near_finest(
         b["occ_mhat"], b["occ_com"], b["occ_qhat"],
     )
     over, rem_mhat, rem_com = b["over"], b["rem_mhat"], b["rem_com"]
+    all_mhat, all_com = b["all_mhat"], b["all_com"]
     m_scale = b["m_scale"]
     k_cells = occ_coords.shape[0]
+    n_ranks = all_mhat.shape[0]
 
     offsets = jnp.asarray(_offsets(ws), jnp.int32)
     pmask_t = jnp.asarray(_parity_mask_table(ws))
@@ -502,8 +528,9 @@ def _sparse_near_finest(
         chunk_ids = jnp.arange(n_chunks, dtype=jnp.int32) * bsz
 
     def lookup(coords_c, off):
-        """Rank of the neighbor cell coords_c + off (-1 if unoccupied,
-        rank-overflow, or out of the cube)."""
+        """Rank of the neighbor cell coords_c + off (-1 if unoccupied or
+        out of the cube; >= k_cells marks a rank-overflow cell, which
+        contributes its softened monopole instead of slot data)."""
         cell = coords_c + off[None, :]
         in_b = jnp.all(
             jnp.logical_and(cell >= 0, cell < side), axis=-1
@@ -526,8 +553,9 @@ def _sparse_near_finest(
         def finest_body(acc, xs):
             off, pm_row = xs
             t = lookup(tcoords, off)
-            ok = jnp.logical_and(pm_row[parity], t >= 0)
-            tc = jnp.maximum(t, 0)
+            in_list = jnp.logical_and(pm_row[parity], t >= 0)
+            ok = jnp.logical_and(in_list, t < k_cells)
+            tc = jnp.clip(t, 0, k_cells - 1)
             sm = jnp.where(ok, occ_mhat[tc] * m_scale, 0.0)
             sc = occ_com[tc]
             ok = jnp.logical_and(ok, sm > 0)
@@ -556,6 +584,30 @@ def _sparse_near_finest(
                     diff, inv_r, sq[:, None, :], ok[:, None], g,
                     m_scale, h_leaf, dtype,
                 )
+            # Rank-overflow list cells: monopole from the per-rank
+            # channels (no quadrupole — the cap-overflow degradation
+            # class) instead of silently dropping the cell's mass.
+            ov = jnp.logical_and(in_list, t >= k_cells)
+            tv = jnp.clip(t, 0, n_ranks - 1)
+            vm = jnp.where(ov, all_mhat[tv] * m_scale, 0.0)
+            diff_v = jnp.where(
+                ov[:, None, None],
+                all_com[tv][:, None, :] - tpos,
+                jnp.asarray(0.0, dtype),
+            )
+            r2v = jnp.sum(diff_v * diff_v, axis=-1) + jnp.asarray(
+                eps * eps, dtype
+            )
+            inv_rv = jax.lax.rsqrt(
+                jnp.where(ov[:, None], r2v, jnp.asarray(1.0, dtype))
+            )
+            w_v = jnp.where(
+                ov[:, None],
+                ((jnp.asarray(g, dtype) * vm[:, None]) * inv_rv)
+                * inv_rv * inv_rv,
+                jnp.asarray(0.0, dtype),
+            )
+            acc = acc + w_v[..., None] * diff_v
             return acc, None
 
         acc0 = jnp.zeros((bsz, leaf_cap, 3), dtype)
@@ -566,8 +618,8 @@ def _sparse_near_finest(
         # ---- near field: rank-gathered blocks, exact pairs ----
         def near_body(acc, off):
             t = lookup(tcoords, off)
-            ok = t >= 0
-            tc = jnp.maximum(t, 0)
+            ok = jnp.logical_and(t >= 0, t < k_cells)
+            tc = jnp.clip(t, 0, k_cells - 1)
             spos = cells_pos[tc]  # (B, capS, 3)
             smass = jnp.where(
                 ok[:, None], cells_mass[tc], jnp.asarray(0.0, dtype)
@@ -605,6 +657,28 @@ def _sparse_near_finest(
                 jnp.asarray(0.0, dtype),
             )
             acc = acc + w_o[..., None] * diff_o
+
+            # Rank-overflow neighbor cell: its ENTIRE mass as the same
+            # cell-size-softened monopole (the cell has no slot data,
+            # but its per-rank mass/COM survive the compaction) — the
+            # neighbor-target side of the ADVICE r5 degradation fix.
+            ov = t >= k_cells
+            tv = jnp.clip(t, 0, n_ranks - 1)
+            v_m = jnp.where(ov, all_mhat[tv], 0.0)
+            diff_v = jnp.where(
+                ov[:, None, None],
+                all_com[tv][:, None, :] - tpos,
+                jnp.asarray(0.0, dtype),
+            )
+            r2v = jnp.sum(diff_v * diff_v, axis=-1) + eps_over * eps_over
+            inv_rv = jax.lax.rsqrt(r2v)
+            w_v = jnp.where(
+                ov[:, None],
+                ((jnp.asarray(g, dtype) * (v_m * m_scale))[:, None]
+                 * inv_rv) * inv_rv * inv_rv,
+                jnp.asarray(0.0, dtype),
+            )
+            acc = acc + w_v[..., None] * diff_v
             return acc, None
 
         acc, _ = jax.lax.scan(near_body, acc, near)
@@ -623,12 +697,14 @@ def _sparse_monopole_neighborhood(
     through the rank table: the 7^3 neighborhood of each eval point's
     leaf as softened cell monopoles at its OWN position (near 3^3 with
     cell-size softening; list cells with the run's eps). Replaces the
-    whole near + finest sum for fallback targets. Rank-overflow
-    neighbor cells are invisible here (table -1) — their mass reaches
-    the coarse levels only; see the module docstring."""
+    whole near + finest sum for fallback targets. Monopoles come from
+    the per-RANK channels, which cover every occupied cell — so
+    rank-overflow neighbors contribute their mass here too instead of
+    being invisible (ADVICE r5; see the module docstring)."""
     side, span = b["side"], b["span"]
     table = b["table"]
-    occ_mhat, occ_com = b["occ_mhat"], b["occ_com"]
+    all_mhat, all_com = b["all_mhat"], b["all_com"]
+    n_ranks = all_mhat.shape[0]
     m_scale = b["m_scale"]
     m = eval_pos.shape[0]
     offsets = jnp.asarray(_offsets(ws), jnp.int32)
@@ -648,12 +724,12 @@ def _sparse_monopole_neighborhood(
         ok = jnp.logical_and(
             t >= 0, jnp.logical_or(is_near, pm_row[parity])
         )
-        tc = jnp.maximum(t, 0)
-        sm = jnp.where(ok, occ_mhat[tc] * m_scale, 0.0)
+        tc = jnp.clip(t, 0, n_ranks - 1)
+        sm = jnp.where(ok, all_mhat[tc] * m_scale, 0.0)
         ok = jnp.logical_and(ok, sm > 0)
         diff = jnp.where(
             ok[:, None],
-            occ_com[tc] - eval_pos,
+            all_com[tc] - eval_pos,
             jnp.asarray(0.0, dtype),
         )
         eps_here = jnp.where(is_near, eps_over, jnp.asarray(eps, dtype))
@@ -999,7 +1075,7 @@ def make_sharded_sfmm_accel(
         m = jax.lax.all_gather(m_l, axes, tiled=True)
         idx = jax.lax.axis_index(axes[0])
         for a in axes[1:]:
-            idx = idx * jax.lax.axis_size(a) + jax.lax.axis_index(a)
+            idx = idx * _axis_size(a) + jax.lax.axis_index(a)
         chunk_sel = idx * local_chunks + jnp.arange(
             local_chunks, dtype=jnp.int32
         )
@@ -1014,7 +1090,7 @@ def make_sharded_sfmm_accel(
             acc, (idx * n_local, _I0), (n_local, 3)
         )
 
-    fn = jax.shard_map(
+    fn = _shard_map(
         body, mesh=mesh, in_specs=(spec, spec), out_specs=spec,
         check_vma=False,
     )

@@ -21,13 +21,15 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from ..utils.compat import axis_size
+
 
 def hierarchical_ring_accel(pos_l, m_l, *, outer_axis, inner_axis, local_kernel):
     # Gather the source shards across slices (DCN) once: (S, n_local, 3).
     src_pos = jax.lax.all_gather(pos_l, outer_axis)
     src_m = jax.lax.all_gather(m_l, outer_axis)
 
-    p = jax.lax.axis_size(inner_axis)
+    p = axis_size(inner_axis)
     perm = [(i, (i + 1) % p) for i in range(p)]
 
     def hop(carry, _):

@@ -32,6 +32,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..constants import CUTOFF_RADIUS, G
 from ..ops.forces import accelerations_vs
+from ..utils.compat import axis_size, shard_map
 
 # local_kernel(pos_targets (M,3), pos_sources (K,3), masses_sources (K,))
 # -> (M,3). Dense jnp and the Pallas kernel both implement this signature.
@@ -46,7 +47,7 @@ def _allgather_accel(pos_l, m_l, *, axes, local_kernel):
 
 def _ring_accel(pos_l, m_l, *, axis, local_kernel):
     """Systolic ring over one mesh axis: P hops, one source shard per hop."""
-    p = jax.lax.axis_size(axis)
+    p = axis_size(axis)
     perm = [(i, (i + 1) % p) for i in range(p)]
 
     def hop(carry, _):
@@ -102,7 +103,7 @@ def make_sharded_accel2(
     else:
         raise ValueError(f"unknown sharding strategy {strategy!r}")
 
-    return jax.shard_map(
+    return shard_map(
         body,
         mesh=mesh,
         in_specs=(spec, spec),
@@ -133,7 +134,7 @@ def make_sharded_rect_accel(
         partial_acc = local_kernel(targets, pos_l, m_l)
         return jax.lax.psum(partial_acc, axes)
 
-    return jax.shard_map(
+    return shard_map(
         body,
         mesh=mesh,
         in_specs=(P(), spec, spec),

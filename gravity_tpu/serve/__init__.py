@@ -1,0 +1,31 @@
+"""Ensemble serving: many independent simulations as one device program.
+
+Three layers (docs/serving.md):
+
+- :mod:`.engine` — the vmap-batched multi-simulation engine: B systems,
+  zero-mass-padded to one power-of-two bucket, integrate inside a
+  single jit-compiled scan slice; one compile per
+  (bucket, slots, backend, dtype, integrator, physics) key.
+- :mod:`.scheduler` — bucketed continuous batching: admission queue,
+  slot backfill, priority preemption, anti-starvation yields, per-slot
+  divergence isolation, occupancy/latency metrics, spool persistence.
+- :mod:`.service` — the localhost HTTP/JSON daemon (`gravity_tpu
+  serve`) and the submit/status/result/cancel client verbs.
+"""
+
+from .engine import (  # noqa: F401
+    ENGINE_BACKENDS,
+    BatchKey,
+    EnsembleBatch,
+    EnsembleEngine,
+    batch_key_for,
+    bucket_size,
+)
+from .scheduler import EnsembleScheduler, Job, Spool  # noqa: F401
+from .service import (  # noqa: F401
+    DaemonUnreachable,
+    GravityDaemon,
+    find_daemon,
+    request,
+    wait_for,
+)

@@ -1,0 +1,396 @@
+"""Batched ensemble engine: B independent simulations as ONE program.
+
+Every existing entry point integrates exactly one system per compiled
+program; serving many small requests that way pays a dispatch + (on a
+real chip) kernel-launch round-trip per job and leaves the vector units
+mostly idle — the same shape as unbatched inference serving. Here the
+single-system step function is ``vmap``-ed over a leading batch axis:
+B systems, each zero-mass-padded to one power-of-two bucket size (the
+``ParticleState.pad_to`` contract — padding exerts no force), integrate
+inside a single ``jit``-compiled ``lax.scan`` slice. The vmapped direct
+sum is a (B, n, n) batched contraction — exactly the regime the MXU
+batches well — and one compiled program serves every job that hashes to
+the same :class:`BatchKey` for the daemon's lifetime.
+
+Per-slot isolation: lanes of a ``vmap`` never mix across the batch
+axis, so one diverging system NaNs only its own lane. The round
+function returns a per-slot finite flag (checked over each job's REAL
+particles only — padding lanes are test bodies and may do anything);
+the scheduler freezes and fails flagged slots while their batchmates
+keep integrating — the supervisor's watchdog semantics applied per
+slot instead of per run.
+
+Jobs in one batch share (bucket, backend, dtype, integrator, physics
+constants) — the compile key — while dt and the remaining-step budget
+are per-slot TRACED operands, so mixed-dt / mixed-length jobs share one
+program: each scan iteration advances only slots whose budget is not
+exhausted (a masked ``where``), which also lets the scheduler run
+bounded step-slices without a per-length recompile.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import SimulationConfig
+from ..ops.integrators import make_step_fn
+from ..state import ParticleState
+
+# Force backends the vmapped hot loop supports. The jnp forms batch
+# trivially; the Pallas kernels batch through pallas_call's vmap rule
+# (an extra grid axis). Fast solvers (tree/fmm/pm/...) are per-system
+# programs with data-dependent builds — out of scope for the ensemble
+# path (jobs big enough to want them should run solo anyway).
+ENGINE_BACKENDS = ("dense", "chunked", "pallas", "pallas-mxu")
+
+MIN_BUCKET = 16
+# Largest padded bucket the engine accepts. Every engine backend is a
+# direct sum whose vmapped form materializes (slots, n, n) pair
+# intermediates — past this n the right tool is a solo run (whose auto
+# router can pick chunked/tree/fmm), not a batched lane; without the
+# bound a 50k-body 'auto' submission would build an O(slots * n^2)
+# program and OOM where the solo path completes (review finding).
+MAX_BUCKET = 8192
+
+
+def bucket_size(n: int, min_bucket: int = MIN_BUCKET) -> int:
+    """Power-of-two padding bucket for an n-body job (>= min_bucket).
+    Bucketing bounds compile count at log2(n_max) programs while capping
+    padding waste at <2x; the occupancy metric makes the actual waste
+    visible per round."""
+    if n < 1:
+        raise ValueError(f"n must be >= 1, got {n}")
+    return max(min_bucket, 1 << (n - 1).bit_length())
+
+
+class BatchKey(NamedTuple):
+    """Everything that must be equal for two jobs to share a compiled
+    batch program (one compile per distinct key, cached for the engine's
+    lifetime). dt / steps / model / seed deliberately absent: traced or
+    host-side."""
+
+    bucket_n: int
+    slots: int
+    backend: str
+    dtype: str
+    integrator: str
+    g: float
+    eps: float
+    cutoff: float
+
+
+def batch_key_for(
+    config: SimulationConfig, *, slots: int, min_bucket: int = MIN_BUCKET
+) -> BatchKey:
+    """The batch a job with this config lands in. Raises ValueError for
+    configs outside the ensemble envelope (the caller surfaces it as a
+    submit-time rejection, not a mid-batch failure)."""
+    backend = config.force_backend
+    if backend in ("auto", "direct"):
+        # Ensemble jobs are small-N by design; the batched dense jnp
+        # form is the measured-right shape (one (B, n, n) contraction).
+        backend = "dense"
+    if backend not in ENGINE_BACKENDS:
+        raise ValueError(
+            f"force_backend {config.force_backend!r} is not servable by "
+            f"the ensemble engine (supported: auto/direct/"
+            f"{'/'.join(ENGINE_BACKENDS)}); run it solo via `run`"
+        )
+    if config.n > MAX_BUCKET:
+        raise ValueError(
+            f"n={config.n} exceeds the ensemble engine's bucket cap "
+            f"({MAX_BUCKET}): the batched direct sum materializes "
+            "(slots, n, n) pair intermediates; run this size solo via "
+            "`run` (its auto router picks a scale-appropriate backend)"
+        )
+    from ..models import MODELS
+
+    if config.model not in MODELS:
+        # Validate at submit time: an unknown model must be a clean
+        # 400-class rejection, not a deferred admission-time crash in
+        # the scheduling round (review finding).
+        raise ValueError(
+            f"unknown model {config.model!r}; one of {sorted(MODELS)}"
+        )
+    if config.integrator not in ("euler", "leapfrog", "verlet", "yoshida4"):
+        raise ValueError(
+            f"integrator {config.integrator!r} is not servable by the "
+            "ensemble engine (fixed-dt euler/leapfrog/verlet/yoshida4)"
+        )
+    for knob, val, default in (
+        ("adaptive", config.adaptive, False),
+        ("merge_radius", config.merge_radius, 0.0),
+        ("periodic_box", config.periodic_box, 0.0),
+        ("external", config.external, ""),
+        ("sharding", config.sharding, "none"),
+    ):
+        if val != default:
+            raise ValueError(
+                f"config.{knob}={val!r} is not servable by the ensemble "
+                "engine; run it solo via `run`"
+            )
+    return BatchKey(
+        bucket_n=bucket_size(config.n, min_bucket),
+        slots=slots,
+        backend=backend,
+        dtype=config.dtype,
+        integrator=config.integrator,
+        g=config.g,
+        eps=config.eps,
+        cutoff=config.cutoff,
+    )
+
+
+@dataclasses.dataclass
+class EnsembleBatch:
+    """Device-side slot arrays for one BatchKey. ``remaining``/``n_real``
+    live host-side (numpy) — the scheduler mutates them between rounds —
+    and are shipped as traced operands per slice."""
+
+    key: BatchKey
+    positions: jax.Array  # (B, n, 3)
+    velocities: jax.Array  # (B, n, 3)
+    masses: jax.Array  # (B, n)
+    acc: jax.Array  # (B, n, 3) carried accelerations
+    dt: np.ndarray  # (B,) float
+    remaining: np.ndarray  # (B,) int64 steps left in each slot's budget
+    n_real: np.ndarray  # (B,) int32 real (unpadded) particles per slot
+
+    @property
+    def slots(self) -> int:
+        return self.positions.shape[0]
+
+
+class SliceResult(NamedTuple):
+    advanced: np.ndarray  # (B,) steps actually taken this slice
+    finite: np.ndarray  # (B,) bool — real lanes finite after the slice
+
+
+class EnsembleEngine:
+    """Owner of the per-BatchKey compiled round programs.
+
+    ``compile_counts[key]`` increments at TRACE time of that key's round
+    function — the honest "did serving this job retrace?" signal the
+    e2e compile-once acceptance gate asserts on (a cache hit executes
+    the compiled program without touching the Python body).
+    """
+
+    def __init__(self):
+        self._round_fns: dict[BatchKey, object] = {}
+        self._kernels: dict[BatchKey, object] = {}
+        self._seed_fns: dict[BatchKey, object] = {}
+        self.compile_counts: dict[BatchKey, int] = {}
+
+    # --- kernel / program construction ---
+
+    def _kernel(self, key: BatchKey):
+        """(targets, sources, masses) -> acc for ONE system of the
+        batch — the same kernel builder the Simulator uses, so a job's
+        ensemble trajectory matches its solo run. Cached per key: the
+        time-slicing scheduler admits/evicts jobs every few rounds and
+        must not pay a kernel rebuild each time (review finding)."""
+        if key not in self._kernels:
+            from ..simulation import make_local_kernel
+
+            config = SimulationConfig(
+                n=key.bucket_n, force_backend=key.backend,
+                dtype=key.dtype, g=key.g, eps=key.eps, cutoff=key.cutoff,
+            )
+            self._kernels[key] = make_local_kernel(config, key.backend)
+        return self._kernels[key]
+
+    def _seed_accel(self, key: BatchKey, positions, masses):
+        """Jitted carried-acceleration seed for one admitted slot (a
+        pure function of state, so evict/resume round-trips reproduce
+        the exact carry a continuous run would have had)."""
+        if key not in self._seed_fns:
+            kernel = self._kernel(key)
+            self._seed_fns[key] = jax.jit(
+                lambda pos, m: kernel(pos, pos, m)
+            )
+        return self._seed_fns[key](positions, masses)
+
+    def _build_round_fn(self, key: BatchKey):
+        kernel = self._kernel(key)
+
+        def one_system(pos, vel, mass, acc, dt, remaining, n_real, n_steps):
+            state = ParticleState(pos, vel, mass)
+            accel = lambda p: kernel(p, p, mass)  # noqa: E731
+            step = make_step_fn(key.integrator, accel, dt)
+
+            def body(carry, i):
+                st, a = carry
+                new_st, new_a = step(st, a)
+                # Budget mask: slots whose job is done (or empty) freeze
+                # — same compiled slice serves mixed-length jobs.
+                take = i < remaining
+                st = jax.tree_util.tree_map(
+                    lambda old, new: jnp.where(take, new, old), st, new_st
+                )
+                a = jnp.where(take, new_a, a)
+                return (st, a), None
+
+            (state, acc), _ = jax.lax.scan(
+                body, (state, acc), jnp.arange(n_steps)
+            )
+            # Finite watchdog over the REAL lanes only: padding bodies
+            # are massless test particles whose fate is irrelevant.
+            real = jnp.arange(pos.shape[0]) < n_real
+            fin = jnp.all(
+                jnp.where(real[:, None], jnp.isfinite(state.positions), True)
+            ) & jnp.all(
+                jnp.where(
+                    real[:, None], jnp.isfinite(state.velocities), True
+                )
+            )
+            return state.positions, state.velocities, acc, fin
+
+        def round_fn(pos, vel, mass, acc, dt, remaining, n_real, *, n_steps):
+            # Trace-time side effect: executions of the compiled program
+            # skip this line, so the count is exactly the retrace count.
+            self.compile_counts[key] = self.compile_counts.get(key, 0) + 1
+            return jax.vmap(
+                partial(one_system, n_steps=n_steps)
+            )(pos, vel, mass, acc, dt, remaining, n_real)
+
+        return jax.jit(round_fn, static_argnames=("n_steps",))
+
+    def round_fn(self, key: BatchKey):
+        if key not in self._round_fns:
+            self._round_fns[key] = self._build_round_fn(key)
+        return self._round_fns[key]
+
+    # --- batch lifecycle ---
+
+    def new_batch(self, key: BatchKey) -> EnsembleBatch:
+        """All-empty batch: zero-mass states, zero budgets."""
+        b, n = key.slots, key.bucket_n
+        from ..simulation import resolve_dtype
+
+        dtype = resolve_dtype(key.dtype)
+        zeros3 = jnp.zeros((n, 3), dtype)
+        empty = ParticleState(
+            positions=zeros3, velocities=zeros3,
+            masses=jnp.zeros((n,), dtype),
+        )
+        stacked = ParticleState.stack([empty] * b)
+        return EnsembleBatch(
+            key=key,
+            positions=stacked.positions,
+            velocities=stacked.velocities,
+            masses=stacked.masses,
+            acc=jnp.zeros((b, n, 3), dtype),
+            dt=np.zeros((b,), np.float64),
+            remaining=np.zeros((b,), np.int64),
+            n_real=np.zeros((b,), np.int32),
+        )
+
+    def load_slot(
+        self,
+        batch: EnsembleBatch,
+        slot: int,
+        state: ParticleState,
+        *,
+        dt: float,
+        steps: int,
+    ) -> EnsembleBatch:
+        """Admit a job into ``slot``: pad its state to the bucket, seed
+        the carried acceleration (the deterministic accel-at-positions
+        the integrators carry — identical at admission and re-admission,
+        so evict/resume round-trips preserve solo parity)."""
+        key = batch.key
+        from ..simulation import resolve_dtype
+
+        n_real = state.n
+        padded, _ = state.astype(resolve_dtype(key.dtype)).pad_to(
+            key.bucket_n
+        )
+        acc0 = self._seed_accel(key, padded.positions, padded.masses)
+        dt_arr = batch.dt.copy()
+        rem = batch.remaining.copy()
+        nr = batch.n_real.copy()
+        dt_arr[slot], rem[slot], nr[slot] = dt, steps, n_real
+        return dataclasses.replace(
+            batch,
+            positions=batch.positions.at[slot].set(padded.positions),
+            velocities=batch.velocities.at[slot].set(padded.velocities),
+            masses=batch.masses.at[slot].set(padded.masses),
+            acc=batch.acc.at[slot].set(acc0),
+            dt=dt_arr,
+            remaining=rem,
+            n_real=nr,
+        )
+
+    def clear_slot(self, batch: EnsembleBatch, slot: int) -> EnsembleBatch:
+        """Free a slot (job completed/failed/evicted). Only the budget
+        and mass need zeroing — a zero-mass slot exerts no force and a
+        zero budget freezes its lanes."""
+        rem = batch.remaining.copy()
+        nr = batch.n_real.copy()
+        rem[slot], nr[slot] = 0, 0
+        return dataclasses.replace(
+            batch,
+            masses=batch.masses.at[slot].set(
+                jnp.zeros_like(batch.masses[slot])
+            ),
+            remaining=rem,
+            n_real=nr,
+        )
+
+    def slot_state(
+        self, batch: EnsembleBatch, slot: int,
+        n_real: Optional[int] = None,
+    ) -> ParticleState:
+        """The (unpadded) current state of one slot's job."""
+        n = int(batch.n_real[slot]) if n_real is None else n_real
+        st = ParticleState(
+            positions=batch.positions, velocities=batch.velocities,
+            masses=batch.masses,
+        ).slot(slot)
+        return ParticleState(
+            positions=st.positions[:n],
+            velocities=st.velocities[:n],
+            masses=st.masses[:n],
+        )
+
+    # --- the hot path ---
+
+    def run_slice(
+        self, batch: EnsembleBatch, slice_steps: int
+    ) -> tuple[EnsembleBatch, SliceResult]:
+        """Advance every occupied slot by up to ``slice_steps`` steps in
+        one device program. Callers keep ``slice_steps`` constant per
+        scheduler so each BatchKey compiles exactly once (the budget
+        mask absorbs shorter remainders)."""
+        fn = self.round_fn(batch.key)
+        dtype = batch.positions.dtype
+        pos, vel, acc, finite = fn(
+            batch.positions, batch.velocities, batch.masses, batch.acc,
+            jnp.asarray(batch.dt, dtype),
+            # int32 on device: the scan counter is int32 and budgets
+            # beyond 2^31 steps are not a serving shape.
+            jnp.asarray(
+                np.minimum(batch.remaining, np.iinfo(np.int32).max)
+                .astype(np.int32)
+            ),
+            jnp.asarray(batch.n_real, jnp.int32),
+            n_steps=slice_steps,
+        )
+        advanced = np.minimum(batch.remaining, slice_steps)
+        remaining = batch.remaining - advanced
+        new_batch = dataclasses.replace(
+            batch, positions=pos, velocities=vel, acc=acc,
+            remaining=remaining,
+        )
+        finite_np = np.asarray(finite)
+        # Empty slots are vacuously finite.
+        finite_np = np.where(batch.n_real > 0, finite_np, True)
+        return new_batch, SliceResult(
+            advanced=advanced, finite=finite_np
+        )

@@ -1,0 +1,626 @@
+"""Bucketed continuous batching over the ensemble engine.
+
+Admission model: jobs hash to a :class:`~gravity_tpu.serve.engine.
+BatchKey` (n-bucket + program shape); each key owns one resident
+:class:`EnsembleBatch` whose slots are filled as jobs arrive and
+backfilled the moment a slot frees — continuous batching, not
+gang-scheduling. Every round runs ONE bounded step-slice of one key's
+batch (keys rotate round-robin), so a 500k-step job can never starve a
+10-step job: short jobs ride along in free slots immediately, and when
+a batch is full, resident jobs yield their slot after ``yield_rounds``
+consecutive rounds while peers wait (their state is preserved and they
+re-queue — the carried-acceleration seed is a pure function of state,
+so evict/resume costs nothing in accuracy). Higher-priority arrivals
+preempt the lowest-priority resident job outright.
+
+Occupancy is reported per round (real particles / padded slot
+capacity) so bucket-padding waste is a visible serving metric, not a
+silent tax. Divergence is per-slot: a flagged slot rolls back to its
+round-start state, fails, and frees — its batchmates never notice
+(engine lanes are vmap-independent).
+
+With a spool directory attached, job specs and results persist as
+JSON/NPZ under it, so a restarted daemon re-queues every unfinished
+job (``respooled`` events; ICs are a pure function of the config, so
+a restarted job reproduces the same trajectory from step 0).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+import uuid
+from typing import Optional
+
+import numpy as np
+
+from ..config import SimulationConfig
+from ..state import ParticleState
+from ..utils.logging import ServingEventLogger
+from ..utils.timing import pairs_per_step
+from .engine import BatchKey, EnsembleBatch, EnsembleEngine, batch_key_for
+
+# Job lifecycle: pending -> running -> completed | failed | cancelled
+# (running -> pending again on a yield/preemption).
+TERMINAL = ("completed", "failed", "cancelled")
+
+
+@dataclasses.dataclass
+class Job:
+    id: str
+    config: SimulationConfig
+    priority: int = 0
+    deadline_s: Optional[float] = None
+    seq: int = 0
+    status: str = "pending"
+    steps_done: int = 0
+    error: Optional[str] = None
+    submitted_ts: float = 0.0
+    started_ts: Optional[float] = None
+    finished_ts: Optional[float] = None
+    # Wall-clock seconds of scheduling rounds this job was resident in —
+    # the honest per-job execution time under continuous batching
+    # (submission-to-completion latency spans OTHER buckets' interleaved
+    # rounds; review finding).
+    active_s: float = 0.0
+    # Evict/resume snapshot (unpadded). None = not yet started -> the
+    # deterministic ICs from the config.
+    state: Optional[ParticleState] = None
+    resident_rounds: int = 0
+
+    @property
+    def steps(self) -> int:
+        return self.config.steps
+
+    def to_dict(self) -> dict:
+        return {
+            "id": self.id,
+            "status": self.status,
+            "n": self.config.n,
+            "steps": self.config.steps,
+            "steps_done": self.steps_done,
+            "priority": self.priority,
+            "deadline_s": self.deadline_s,
+            "error": self.error,
+            "submitted_ts": self.submitted_ts,
+            "started_ts": self.started_ts,
+            "finished_ts": self.finished_ts,
+            "active_s": self.active_s,
+        }
+
+
+class Spool:
+    """Directory-backed persistence: ``jobs/<id>.json`` specs + status,
+    ``results/<id>.npz`` final states. Everything a restarted daemon
+    needs to resume its queue and keep serving old results."""
+
+    def __init__(self, root: str):
+        self.root = root
+        self.jobs_dir = os.path.join(root, "jobs")
+        self.results_dir = os.path.join(root, "results")
+        os.makedirs(self.jobs_dir, exist_ok=True)
+        os.makedirs(self.results_dir, exist_ok=True)
+
+    def write_job(self, job: Job) -> None:
+        record = job.to_dict()
+        record["config"] = json.loads(job.config.to_json())
+        path = os.path.join(self.jobs_dir, f"{job.id}.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(record, f)
+        os.replace(tmp, path)  # atomic: a crash never tears a job file
+
+    def load_jobs(self) -> list[dict]:
+        out = []
+        for name in sorted(os.listdir(self.jobs_dir)):
+            if not name.endswith(".json"):
+                continue
+            try:
+                with open(os.path.join(self.jobs_dir, name)) as f:
+                    out.append(json.load(f))
+            except (OSError, ValueError):
+                continue  # torn write from a crash; the job re-runs
+        return out
+
+    def result_path(self, job_id: str) -> str:
+        return os.path.join(self.results_dir, f"{job_id}.npz")
+
+    def write_result(self, job_id: str, state: ParticleState) -> str:
+        path = self.result_path(job_id)
+        tmp = path + ".tmp.npz"
+        np.savez(
+            tmp,
+            positions=np.asarray(state.positions),
+            velocities=np.asarray(state.velocities),
+            masses=np.asarray(state.masses),
+        )
+        os.replace(tmp, path)
+        return path
+
+    def load_result(self, job_id: str) -> Optional[dict]:
+        path = self.result_path(job_id)
+        if not os.path.exists(path):
+            return None
+        with np.load(path) as z:
+            return {k: z[k] for k in z.files}
+
+
+class EnsembleScheduler:
+    """The serving brain: admission queue, slot assignment, round
+    execution, metrics. Single-threaded by design — the daemon calls
+    :meth:`run_round` from one worker thread and guards job-table reads
+    with its own lock."""
+
+    def __init__(
+        self,
+        *,
+        slots: int = 4,
+        slice_steps: int = 100,
+        yield_rounds: int = 2,
+        engine: Optional[EnsembleEngine] = None,
+        events: Optional[ServingEventLogger] = None,
+        spool: Optional[Spool] = None,
+        min_bucket: int = 16,
+    ):
+        if slots < 1 or slice_steps < 1 or yield_rounds < 1:
+            raise ValueError(
+                "slots, slice_steps, and yield_rounds must be >= 1"
+            )
+        self.slots = slots
+        self.slice_steps = slice_steps
+        self.yield_rounds = yield_rounds
+        self.engine = engine or EnsembleEngine()
+        self.events = events
+        self.spool = spool
+        self.min_bucket = min_bucket
+        self.jobs: dict[str, Job] = {}
+        self._seq = 0
+        # Per-key pending job ids and resident batches.
+        self._pending: dict[BatchKey, list[str]] = {}
+        self._batches: dict[BatchKey, EnsembleBatch] = {}
+        self._slot_jobs: dict[BatchKey, list[Optional[str]]] = {}
+        self._rotation: list[BatchKey] = []
+        self._rotor = 0
+        # Sliding window: all-time percentiles stop reflecting current
+        # serving health and the list is a slow leak in a long-lived
+        # daemon (review finding).
+        from collections import deque
+
+        self._completed_latencies: deque = deque(maxlen=512)
+        self.rounds_run = 0
+        if spool is not None:
+            self._respool()
+
+    # --- submission / lifecycle API ---
+
+    def submit(
+        self,
+        config: SimulationConfig,
+        *,
+        priority: int = 0,
+        deadline_s: Optional[float] = None,
+        job_id: Optional[str] = None,
+    ) -> str:
+        """Validate + enqueue; returns the job id. Raises ValueError for
+        configs the ensemble engine cannot serve."""
+        key = batch_key_for(
+            config, slots=self.slots, min_bucket=self.min_bucket
+        )
+        if deadline_s is not None:
+            # Coerce at the boundary: the HTTP API is open, and a
+            # string deadline would TypeError inside _expire_deadlines
+            # EVERY round, wedging the whole daemon (review finding).
+            deadline_s = float(deadline_s)
+        job_id = job_id or f"job-{uuid.uuid4().hex[:12]}"
+        if job_id in self.jobs:
+            raise ValueError(f"duplicate job id {job_id!r}")
+        self._seq += 1
+        job = Job(
+            id=job_id, config=config, priority=priority,
+            deadline_s=deadline_s, seq=self._seq,
+            submitted_ts=time.time(),
+        )
+        self.jobs[job_id] = job
+        self._enqueue(key, job_id)
+        self._event("submitted", job=job_id, n=config.n,
+                    bucket=key.bucket_n, priority=priority)
+        self._persist(job)
+        return job_id
+
+    def cancel(self, job_id: str) -> bool:
+        job = self.jobs.get(job_id)
+        if job is None or job.status in TERMINAL:
+            return False
+        if job.status == "running":
+            key = self._job_key(job)
+            slots = self._slot_jobs.get(key, [])
+            if job_id in slots:
+                self._free_slot(key, slots.index(job_id))
+        else:
+            key = self._job_key(job)
+            if job_id in self._pending.get(key, []):
+                self._pending[key].remove(job_id)
+        self._finish(job, "cancelled")
+        return True
+
+    def status(self, job_id: str) -> Optional[dict]:
+        job = self.jobs.get(job_id)
+        return None if job is None else job.to_dict()
+
+    def result(self, job_id: str) -> Optional[ParticleState]:
+        job = self.jobs.get(job_id)
+        if job is None or job.status != "completed":
+            return None
+        if job.state is not None:
+            return job.state
+        if self.spool is not None:
+            data = self.spool.load_result(job_id)
+            if data is not None:
+                return ParticleState.create(
+                    data["positions"], data["velocities"], data["masses"]
+                )
+        return None
+
+    def peek_state(self, job_id: str) -> Optional[ParticleState]:
+        """Current (unpadded) state of a job wherever it lives: its
+        resident slot while running, its evict/terminal snapshot
+        otherwise — round-boundary observability (sweep trajectory
+        frames) without disturbing the batch."""
+        job = self.jobs.get(job_id)
+        if job is None:
+            return None
+        if job.status == "running":
+            key = self._job_key(job)
+            slots = self._slot_jobs.get(key, [])
+            if job_id in slots:
+                return self.engine.slot_state(
+                    self._batches[key], slots.index(job_id)
+                )
+        return job.state
+
+    @property
+    def queue_depth(self) -> int:
+        return sum(len(q) for q in self._pending.values())
+
+    @property
+    def active_count(self) -> int:
+        return sum(
+            1 for slots in self._slot_jobs.values()
+            for j in slots if j is not None
+        )
+
+    def has_work(self) -> bool:
+        return self.queue_depth > 0 or self.active_count > 0
+
+    def latency_percentiles(self) -> dict:
+        lat = list(self._completed_latencies)
+        if not lat:
+            return {"p50_s": None, "p95_s": None}
+        return {
+            "p50_s": float(np.percentile(lat, 50)),
+            "p95_s": float(np.percentile(lat, 95)),
+        }
+
+    # --- internals ---
+
+    def _event(self, kind: str, /, **fields) -> None:
+        if self.events is not None:
+            self.events.event(kind, **fields)
+
+    def _persist(self, job: Job) -> None:
+        if self.spool is not None:
+            self.spool.write_job(job)
+
+    def _job_key(self, job: Job) -> BatchKey:
+        return batch_key_for(
+            job.config, slots=self.slots, min_bucket=self.min_bucket
+        )
+
+    def _enqueue(self, key: BatchKey, job_id: str) -> None:
+        if key not in self._pending:
+            self._pending[key] = []
+        if key not in self._rotation:
+            self._rotation.append(key)
+        self._pending[key].append(job_id)
+        # Priority (desc) then submission order: one sort per admission
+        # keeps the head of the queue always the next-due job.
+        self._pending[key].sort(
+            key=lambda j: (-self.jobs[j].priority, self.jobs[j].seq)
+        )
+
+    def _batch_for(self, key: BatchKey) -> EnsembleBatch:
+        if key not in self._batches:
+            self._batches[key] = self.engine.new_batch(key)
+            self._slot_jobs[key] = [None] * key.slots
+        return self._batches[key]
+
+    def _finish(
+        self, job: Job, status: str, error: Optional[str] = None
+    ) -> None:
+        job.status = status
+        job.error = error
+        job.finished_ts = time.time()
+        if status == "completed":
+            self._completed_latencies.append(
+                job.finished_ts - job.submitted_ts
+            )
+        self._event(
+            status if status in ServingEventLogger.KINDS else "failed",
+            job=job.id, steps_done=job.steps_done, error=error,
+        )
+        self._persist(job)
+
+    def _admit(self, key: BatchKey, slot: int, job: Job) -> None:
+        from ..simulation import make_initial_state
+
+        try:
+            state = job.state
+            if state is None:
+                state = make_initial_state(job.config)
+        except Exception as e:  # noqa: BLE001 — a bad config must fail
+            # THIS job, not crash the scheduling round for its peers
+            # (submit-time validation covers the known cases; this is
+            # the backstop for the rest).
+            self._finish(job, "failed", error=f"admission failed: {e}")
+            return
+        batch = self._batch_for(key)
+        self._batches[key] = self.engine.load_slot(
+            batch, slot, state,
+            dt=job.config.dt, steps=job.steps - job.steps_done,
+        )
+        self._slot_jobs[key][slot] = job.id
+        job.status = "running"
+        job.resident_rounds = 0
+        if job.started_ts is None:
+            job.started_ts = time.time()
+        self._event("admitted", job=job.id, slot=slot,
+                    bucket=key.bucket_n)
+        self._persist(job)
+
+    def _free_slot(self, key: BatchKey, slot: int) -> None:
+        self._batches[key] = self.engine.clear_slot(
+            self._batches[key], slot
+        )
+        self._slot_jobs[key][slot] = None
+
+    def _evict(self, key: BatchKey, slot: int, *, reason: str) -> None:
+        """Pull a running job out of its slot, preserving state, and
+        re-queue it (continuous-batching time slicing / preemption)."""
+        job_id = self._slot_jobs[key][slot]
+        job = self.jobs[job_id]
+        job.state = self.engine.slot_state(self._batches[key], slot)
+        self._free_slot(key, slot)
+        job.status = "pending"
+        self._enqueue(key, job_id)
+        self._event("yielded", job=job_id, reason=reason,
+                    steps_done=job.steps_done)
+
+    def _fill_slots(self, key: BatchKey) -> None:
+        """Admission for one key: free slots first, then priority
+        preemption, then the anti-starvation yield."""
+        pending = self._pending.get(key, [])
+        slots = self._slot_jobs.setdefault(key, [None] * key.slots)
+        # 1. Backfill free slots.
+        for slot in range(key.slots):
+            if not pending:
+                break
+            if slots[slot] is None:
+                self._admit(key, slot, self.jobs[pending.pop(0)])
+        if not pending:
+            return
+        # 2. Priority preemption: a strictly-higher-priority arrival
+        # takes the lowest-priority resident's slot.
+        for waiting_id in list(pending):
+            waiter = self.jobs[waiting_id]
+            resident = [
+                (self.jobs[slots[s]].priority, -s, s)
+                for s in range(key.slots) if slots[s] is not None
+            ]
+            if not resident:
+                break
+            low_prio, _, low_slot = min(resident)
+            if waiter.priority > low_prio:
+                self._evict(key, low_slot, reason="preempted")
+                pending.remove(waiting_id)
+                self._admit(key, low_slot, waiter)
+            else:
+                break  # pending is priority-sorted; no further winners
+        if not pending:
+            return
+        # 3. Anti-starvation time slicing: residents that have held a
+        # slot for yield_rounds consecutive rounds give it up to equal-
+        # priority waiters (bounded wait: a short job admitted behind a
+        # full batch of long jobs runs within yield_rounds+1 rounds).
+        for waiting_id in list(pending):
+            ripe = [
+                (-self.jobs[slots[s]].resident_rounds,
+                 self.jobs[slots[s]].priority, s)
+                for s in range(key.slots)
+                if slots[s] is not None
+                and self.jobs[slots[s]].resident_rounds
+                >= self.yield_rounds
+                and self.jobs[slots[s]].priority
+                <= self.jobs[waiting_id].priority
+            ]
+            if not ripe:
+                break
+            _, _, slot = min(ripe)
+            self._evict(key, slot, reason="yield")
+            self._pending[key].remove(waiting_id)
+            self._admit(key, slot, self.jobs[waiting_id])
+
+    def _next_key(self) -> Optional[BatchKey]:
+        """Round-robin over keys that have work."""
+        n = len(self._rotation)
+        for i in range(n):
+            key = self._rotation[(self._rotor + i) % n]
+            if self._pending.get(key) or any(
+                j is not None for j in self._slot_jobs.get(key, [])
+            ):
+                self._rotor = (self._rotor + i + 1) % n
+                return key
+        return None
+
+    def run_round(self) -> Optional[dict]:
+        """One scheduling round: pick a key, fill its slots, advance its
+        batch one step-slice, retire finished/diverged/expired jobs.
+        Returns the round's metrics (also streamed as a ``round``
+        event), or None when there is no work at all."""
+        key = self._next_key()
+        if key is None:
+            return None
+        self._expire_deadlines()
+        self._fill_slots(key)
+        batch = self._batches.get(key)
+        slots = self._slot_jobs.get(key, [])
+        occupied = [s for s in range(key.slots) if slots[s] is not None]
+        if batch is None or not occupied:
+            return None
+
+        prev_batch = batch  # round-start snapshot: divergence rollback
+        # Occupancy is what the round INTEGRATED — snapshot it before
+        # finished jobs free their slots below.
+        occ_particles = sum(
+            self.jobs[slots[s]].config.n for s in occupied
+        )
+        t0 = time.perf_counter()
+        batch, res = self.engine.run_slice(batch, self.slice_steps)
+        round_s = time.perf_counter() - t0
+        self._batches[key] = batch
+        self.rounds_run += 1
+
+        real_pairs = 0.0
+        for slot in occupied:
+            job = self.jobs[slots[slot]]
+            advanced = int(res.advanced[slot])
+            job.steps_done += advanced
+            job.resident_rounds += 1
+            job.active_s += round_s
+            real_pairs += pairs_per_step(job.config.n) * advanced
+            if not bool(res.finite[slot]):
+                # Per-slot watchdog: roll the slot back to its round-
+                # start state (the last finite one), fail the job, free
+                # the slot. Batchmates are untouched — vmap lanes are
+                # independent.
+                job.steps_done -= advanced
+                job.state = self.engine.slot_state(prev_batch, slot)
+                self._free_slot(key, slot)
+                self._finish(
+                    job, "failed",
+                    error=f"diverged within steps "
+                          f"{job.steps_done + 1}..{job.steps_done + advanced} "
+                          f"(non-finite state; last finite step "
+                          f"{job.steps_done})",
+                )
+            elif job.steps_done >= job.steps:
+                job.state = self.engine.slot_state(batch, slot)
+                if self.spool is not None:
+                    self.spool.write_result(job.id, job.state)
+                    # The spool now owns the arrays (result() reloads
+                    # from it); keeping every finished job's state
+                    # in-memory is an unbounded leak in a long-lived
+                    # daemon (review finding). In-process schedulers
+                    # (no spool) keep it — result() has no other source.
+                    job.state = None
+                self._free_slot(key, slot)
+                self._finish(job, "completed")
+
+        metrics = {
+            "bucket": key.bucket_n,
+            "slots_used": len(occupied),
+            "slots_total": key.slots,
+            "occupancy": occ_particles / (key.bucket_n * key.slots),
+            "queue_depth": self.queue_depth,
+            "active": self.active_count,
+            "round_s": round_s,
+            "slice_steps": self.slice_steps,
+            "pairs_per_sec": (
+                real_pairs / round_s if round_s > 0 else None
+            ),
+            **self.latency_percentiles(),
+        }
+        self._event("round", **metrics)
+        return metrics
+
+    def run_until_idle(self, max_rounds: int = 100_000) -> int:
+        """Drive rounds until every job is terminal; returns rounds run
+        (the in-process consumers: cmd_sweep, tests, `serve --drain`)."""
+        rounds = 0
+        while self.has_work():
+            if rounds >= max_rounds:
+                raise RuntimeError(
+                    f"run_until_idle exceeded {max_rounds} rounds with "
+                    f"{self.queue_depth} queued / {self.active_count} "
+                    "active jobs"
+                )
+            if self.run_round() is None and not self.has_work():
+                break
+            rounds += 1
+        return rounds
+
+    def _expire_deadlines(self) -> None:
+        now = time.time()
+        for job in list(self.jobs.values()):
+            if job.status in TERMINAL or job.deadline_s is None:
+                continue
+            if now - job.submitted_ts > job.deadline_s:
+                key = self._job_key(job)
+                if job.status == "running":
+                    slots = self._slot_jobs.get(key, [])
+                    if job.id in slots:
+                        self._free_slot(key, slots.index(job.id))
+                elif job.id in self._pending.get(key, []):
+                    self._pending[key].remove(job.id)
+                self._finish(
+                    job, "failed",
+                    error=f"deadline of {job.deadline_s}s exceeded",
+                )
+
+    def _respool(self) -> None:
+        """Reload the spool after a restart: unfinished jobs re-queue
+        (their ICs are a pure function of the config, so they reproduce
+        the same trajectory); terminal jobs stay queryable."""
+        for record in self.spool.load_jobs():
+            try:
+                config = SimulationConfig.from_json(
+                    json.dumps(record["config"])
+                )
+            except (KeyError, TypeError, ValueError):
+                continue
+            self._seq += 1
+            job = Job(
+                id=record["id"], config=config,
+                priority=record.get("priority", 0),
+                deadline_s=record.get("deadline_s"),
+                seq=self._seq,
+                status=record.get("status", "pending"),
+                steps_done=record.get("steps_done", 0),
+                error=record.get("error"),
+                submitted_ts=record.get("submitted_ts", time.time()),
+                started_ts=record.get("started_ts"),
+                finished_ts=record.get("finished_ts"),
+            )
+            self.jobs[job.id] = job
+            if job.status in TERMINAL:
+                continue
+            # Interrupted mid-flight or never started: restart clean.
+            job.status = "pending"
+            job.steps_done = 0
+            job.started_ts = None
+            job.active_s = 0.0
+            try:
+                key = self._job_key(job)
+            except ValueError as e:
+                # A stale spool record the current envelope rejects
+                # (model renamed, caps lowered, ...) must fail THAT job,
+                # not crash daemon startup and strand its peers
+                # (review finding).
+                self._finish(
+                    job, "failed", error=f"respool rejected: {e}"
+                )
+                continue
+            self._enqueue(key, job.id)
+            self._event("respooled", job=job.id)
+            self._persist(job)

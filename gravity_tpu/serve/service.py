@@ -1,0 +1,394 @@
+"""The serving daemon and its HTTP/JSON client.
+
+``gravity_tpu serve`` hosts an :class:`EnsembleScheduler` behind a
+localhost HTTP/JSON API (stdlib ``http.server`` — no new dependency);
+``gravity_tpu submit/status/result/cancel`` are the client verbs. The
+daemon advertises itself by writing ``daemon.json`` (host, port, pid)
+into its spool directory, so clients only need ``--spool-dir`` to find
+it. Jobs and results persist under the same spool (see
+scheduler.Spool), which is what makes a daemon restart resume its
+queue; serving metrics stream to ``serving_events.jsonl`` next to the
+job files, in the same JSONL event style as the run supervisor's
+recovery log.
+
+Endpoints (all JSON):
+
+==========  ======  ================================================
+path        method  body / query
+==========  ======  ================================================
+/healthz    GET     liveness + queue counters
+/submit     POST    {"config": {...SimulationConfig...},
+                    "priority": int, "deadline_s": float|null}
+/status     GET     ?job=<id> (omit for every job)
+/result     GET     ?job=<id> -> final state arrays + spool path
+/cancel     POST    {"job": <id>}
+/metrics    GET     queue depth, latency p50/p95, compile counts,
+                    rounds run
+/shutdown   POST    graceful stop (drains nothing; jobs respool on
+                    the next start)
+==========  ======  ================================================
+
+Threading model: one worker thread drives scheduler rounds; HTTP
+handler threads only touch the scheduler under the daemon's lock.
+Device work happens exclusively on the worker thread.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+import numpy as np
+
+from ..config import SimulationConfig
+from ..utils.logging import ServingEventLogger
+from .scheduler import EnsembleScheduler, Spool
+
+DAEMON_FILE = "daemon.json"
+
+
+class GravityDaemon:
+    """Own the scheduler, the spool, and the HTTP front end."""
+
+    def __init__(
+        self,
+        spool_dir: str,
+        *,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        slots: int = 4,
+        slice_steps: int = 100,
+        yield_rounds: int = 2,
+        idle_sleep_s: float = 0.02,
+    ):
+        self.spool_dir = spool_dir
+        self.host = host
+        self.port = port
+        self.idle_sleep_s = idle_sleep_s
+        os.makedirs(spool_dir, exist_ok=True)
+        self.spool = Spool(spool_dir)
+        self.events = ServingEventLogger(
+            os.path.join(spool_dir, "serving_events.jsonl")
+        )
+        self.scheduler = EnsembleScheduler(
+            slots=slots, slice_steps=slice_steps,
+            yield_rounds=yield_rounds, events=self.events,
+            spool=self.spool,
+        )
+        self.lock = threading.Lock()
+        self._stop = threading.Event()
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._threads: list[threading.Thread] = []
+
+    # --- lifecycle ---
+
+    def start(self) -> tuple[str, int]:
+        """Bind the HTTP server, start the worker + server threads, and
+        advertise the endpoint in the spool. Returns (host, port)."""
+        daemon = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # quiet by default
+                pass
+
+            def _reply(self, code: int, payload: dict) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _body(self) -> dict:
+                length = int(self.headers.get("Content-Length") or 0)
+                if not length:
+                    return {}
+                return json.loads(self.rfile.read(length) or b"{}")
+
+            def do_GET(self):
+                try:
+                    path, _, query = self.path.partition("?")
+                    params = dict(
+                        kv.split("=", 1)
+                        for kv in query.split("&") if "=" in kv
+                    )
+                    code, payload = daemon.handle_get(path, params)
+                except Exception as e:  # noqa: BLE001 — API boundary
+                    code, payload = 500, {"error": str(e)}
+                self._reply(code, payload)
+
+            def do_POST(self):
+                try:
+                    body = self._body()
+                    path = self.path.partition("?")[0]
+                    code, payload = daemon.handle_post(path, body)
+                except Exception as e:  # noqa: BLE001 — API boundary
+                    code, payload = 500, {"error": str(e)}
+                self._reply(code, payload)
+
+        self._server = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.host, self.port = self._server.server_address[:2]
+        with open(os.path.join(self.spool_dir, DAEMON_FILE), "w") as f:
+            json.dump(
+                {"host": self.host, "port": self.port, "pid": os.getpid()},
+                f,
+            )
+        t_http = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name="gravity-serve-http",
+        )
+        t_work = threading.Thread(
+            target=self._worker, daemon=True, name="gravity-serve-worker"
+        )
+        self._threads = [t_http, t_work]
+        for t in self._threads:
+            t.start()
+        return self.host, self.port
+
+    def _worker(self) -> None:
+        """The ONLY thread that touches the device: scheduler rounds
+        while there is work, short sleeps while idle. A round that
+        throws must not kill the thread — the daemon would then report
+        healthy while every job hangs forever (review finding); log the
+        error and keep serving (per-job failures are already absorbed
+        inside the scheduler; this is the backstop)."""
+        import traceback
+
+        while not self._stop.is_set():
+            try:
+                with self.lock:
+                    worked = (
+                        self.scheduler.run_round() is not None
+                        if self.scheduler.has_work() else False
+                    )
+            except Exception:  # noqa: BLE001 — keep the daemon alive
+                traceback.print_exc()
+                worked = False
+                # Back off: a persistent error must not hot-spin.
+                self._stop.wait(max(self.idle_sleep_s, 0.5))
+            if not worked:
+                self._stop.wait(self.idle_sleep_s)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+        for t in self._threads:
+            t.join(timeout=5)
+        try:
+            os.remove(os.path.join(self.spool_dir, DAEMON_FILE))
+        except OSError:
+            pass
+
+    def serve_blocking(self) -> None:
+        """CLI entry: run until SIGINT/SIGTERM."""
+        import signal
+
+        def _sig(signum, frame):
+            self._stop.set()
+
+        for s in (signal.SIGINT, signal.SIGTERM):
+            try:
+                signal.signal(s, _sig)
+            except ValueError:
+                pass
+        try:
+            while not self._stop.is_set():
+                time.sleep(0.2)
+        finally:
+            self.stop()
+
+    # --- request handling (shared by HTTP and tests) ---
+
+    def handle_get(self, path: str, params: dict) -> tuple[int, dict]:
+        if path == "/healthz":
+            # Deliberately lock-free: the worker holds the lock through
+            # whole rounds (minutes on a first compile), and a liveness
+            # probe that blocks exactly then would misreport a healthy
+            # daemon as dead (review finding). The counters are plain
+            # attribute reads — racy by a round at worst.
+            return 200, {
+                "ok": True,
+                "queue_depth": self.scheduler.queue_depth,
+                "active": self.scheduler.active_count,
+                "rounds": self.scheduler.rounds_run,
+            }
+        with self.lock:
+            if path == "/status":
+                job_id = params.get("job")
+                if job_id is None:
+                    return 200, {
+                        "jobs": [
+                            j.to_dict()
+                            for j in self.scheduler.jobs.values()
+                        ]
+                    }
+                st = self.scheduler.status(job_id)
+                if st is None:
+                    return 404, {"error": f"unknown job {job_id!r}"}
+                return 200, st
+            if path == "/result":
+                job_id = params.get("job", "")
+                st = self.scheduler.status(job_id)
+                if st is None:
+                    return 404, {"error": f"unknown job {job_id!r}"}
+                if st["status"] != "completed":
+                    return 409, {
+                        "error": f"job {job_id!r} is {st['status']}",
+                        **st,
+                    }
+                state = self.scheduler.result(job_id)
+                payload = dict(st)
+                payload["path"] = self.spool.result_path(job_id)
+                if state is not None:
+                    payload["positions"] = np.asarray(
+                        state.positions
+                    ).tolist()
+                    payload["velocities"] = np.asarray(
+                        state.velocities
+                    ).tolist()
+                    payload["masses"] = np.asarray(state.masses).tolist()
+                return 200, payload
+            if path == "/metrics":
+                return 200, {
+                    "queue_depth": self.scheduler.queue_depth,
+                    "active": self.scheduler.active_count,
+                    "rounds": self.scheduler.rounds_run,
+                    "latency": self.scheduler.latency_percentiles(),
+                    "compile_counts": {
+                        f"bucket={k.bucket_n},slots={k.slots},"
+                        f"backend={k.backend}": v
+                        for k, v in
+                        self.scheduler.engine.compile_counts.items()
+                    },
+                    "events_path": self.events.path,
+                }
+        return 404, {"error": f"unknown path {path!r}"}
+
+    def handle_post(self, path: str, body: dict) -> tuple[int, dict]:
+        if path == "/submit":
+            try:
+                config = SimulationConfig.from_json(
+                    json.dumps(body.get("config") or {})
+                )
+            except TypeError as e:
+                return 400, {"error": f"bad config: {e}"}
+            with self.lock:
+                try:
+                    job_id = self.scheduler.submit(
+                        config,
+                        priority=int(body.get("priority") or 0),
+                        deadline_s=body.get("deadline_s"),
+                        job_id=body.get("job_id"),
+                    )
+                except (ValueError, TypeError) as e:
+                    # TypeError too: dataclasses don't type-check, so a
+                    # wrong-typed field (n="10") surfaces inside
+                    # batch_key_for — still client input, still 400.
+                    return 400, {"error": str(e)}
+            return 200, {"job": job_id}
+        if path == "/cancel":
+            with self.lock:
+                ok = self.scheduler.cancel(str(body.get("job")))
+            return (200 if ok else 409), {"cancelled": ok}
+        if path == "/shutdown":
+            self._stop.set()
+            return 200, {"stopping": True}
+        return 404, {"error": f"unknown path {path!r}"}
+
+
+# --- client side ---
+
+
+class DaemonUnreachable(RuntimeError):
+    pass
+
+
+def find_daemon(spool_dir: str) -> tuple[str, int]:
+    path = os.path.join(spool_dir, DAEMON_FILE)
+    try:
+        with open(path) as f:
+            info = json.load(f)
+        return info["host"], int(info["port"])
+    except (OSError, KeyError, ValueError) as e:
+        raise DaemonUnreachable(
+            f"no running daemon advertised under {spool_dir!r} "
+            f"(missing/unreadable {path}); start one with "
+            "`gravity_tpu serve --spool-dir " + spool_dir + "`"
+        ) from e
+
+
+def request(
+    spool_dir: str,
+    method: str,
+    path: str,
+    payload: Optional[dict] = None,
+    *,
+    # The worker holds the daemon lock for a whole scheduling round —
+    # a first compile can take minutes — and handlers queue behind it,
+    # so the client must outwait a round, not a socket RTT (review
+    # finding; wait_for additionally retries on transient timeouts).
+    timeout: float = 300.0,
+) -> dict:
+    """One client call against the daemon advertised in ``spool_dir``."""
+    host, port = find_daemon(spool_dir)
+    url = f"http://{host}:{port}{path}"
+    data = None
+    headers = {}
+    if method == "POST":
+        data = json.dumps(payload or {}).encode()
+        headers["Content-Type"] = "application/json"
+    req = urllib.request.Request(
+        url, data=data, headers=headers, method=method
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        try:
+            return json.loads(e.read())
+        except ValueError:
+            return {"error": f"HTTP {e.code}"}
+    except (urllib.error.URLError, OSError) as e:
+        raise DaemonUnreachable(
+            f"daemon at {url} not responding: {e}"
+        ) from e
+
+
+def wait_for(
+    spool_dir: str, job_ids: list[str], *, timeout: float = 300.0,
+    poll_s: float = 0.1,
+) -> dict[str, dict]:
+    """Poll until every job is terminal; returns {job_id: status}."""
+    deadline = time.monotonic() + timeout
+    out: dict[str, dict] = {}
+    remaining = list(job_ids)
+    while remaining:
+        if time.monotonic() > deadline:
+            raise TimeoutError(
+                f"jobs still unfinished after {timeout}s: {remaining}"
+            )
+        for job_id in list(remaining):
+            try:
+                st = request(
+                    spool_dir, "GET", f"/status?job={job_id}",
+                    timeout=min(60.0, timeout),
+                )
+            except DaemonUnreachable:
+                # A poll that lands while the worker holds the lock
+                # through a long compile is not a dead daemon — keep
+                # polling until OUR deadline decides.
+                break
+            if st.get("status") in ("completed", "failed", "cancelled"):
+                out[job_id] = st
+                remaining.remove(job_id)
+        if remaining:
+            time.sleep(poll_s)
+    return out

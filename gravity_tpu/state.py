@@ -73,6 +73,42 @@ class ParticleState:
             masses=jnp.concatenate([s.masses for s in states], axis=0),
         )
 
+    @staticmethod
+    def stack(states: list["ParticleState"]) -> "ParticleState":
+        """Stack B equal-N states along a new leading batch axis —
+        the ensemble engine's (B, N, ...) layout (``vmap`` over axis 0
+        integrates the B systems as one device program; see
+        gravity_tpu.serve). All states must share N and dtype; pad each
+        to a common bucket with :meth:`pad_to` first."""
+        ns = {s.n for s in states}
+        if len(ns) != 1:
+            raise ValueError(
+                f"stack needs equal particle counts, got {sorted(ns)}; "
+                "pad_to a common bucket first"
+            )
+        dtypes = {str(s.dtype) for s in states}
+        if len(dtypes) != 1:
+            # Silent promotion would change every lane's numerics.
+            raise ValueError(
+                f"stack needs one dtype, got {sorted(dtypes)}; "
+                "astype() to the batch dtype first"
+            )
+        return ParticleState(
+            positions=jnp.stack([s.positions for s in states], axis=0),
+            velocities=jnp.stack([s.velocities for s in states], axis=0),
+            masses=jnp.stack([s.masses for s in states], axis=0),
+        )
+
+    def slot(self, i: int) -> "ParticleState":
+        """Slice batch entry ``i`` out of a :meth:`stack`-ed state."""
+        if self.positions.ndim != 3:
+            raise ValueError("slot() needs a (B, N, 3) batched state")
+        return ParticleState(
+            positions=self.positions[i],
+            velocities=self.velocities[i],
+            masses=self.masses[i],
+        )
+
     def pad_to(self, n_target: int) -> tuple["ParticleState", jax.Array]:
         """Pad with zero-mass particles at rest; returns (state, valid mask).
 

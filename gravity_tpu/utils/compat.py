@@ -1,0 +1,79 @@
+"""Version compatibility shims for the jax API surface.
+
+One module owns every "which jax is this?" probe so call sites stay
+clean and the answer is computed once. The only current entry is
+:func:`shard_map`: jax promoted ``shard_map`` out of
+``jax.experimental`` (and renamed ``check_rep`` to ``check_vma``)
+around 0.6; this repo runs on both sides of that line — the baked
+container ships 0.4.37, where ``jax.shard_map`` does not exist and
+every sharded entry point used to die with AttributeError at build
+time (the pre-existing tier-1 sharded-path failures, VERDICT r5).
+"""
+
+from __future__ import annotations
+
+import jax
+
+try:  # jax >= 0.6-ish: the public API, check_vma keyword
+    _new_shard_map = jax.shard_map
+except AttributeError:  # jax 0.4.x: experimental home, check_rep keyword
+    _new_shard_map = None
+    from jax.experimental.shard_map import shard_map as _old_shard_map
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None):
+    """``jax.shard_map`` on new jax, the experimental fallback on old.
+
+    ``check_vma`` follows the new-API name; on old jax it is forwarded
+    as ``check_rep`` (the same switch under its previous name). ``None``
+    leaves each version's default in place.
+    """
+    kwargs = {}
+    if _new_shard_map is not None:
+        if check_vma is not None:
+            kwargs["check_vma"] = check_vma
+        return _new_shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs
+        )
+    if check_vma is not None:
+        kwargs["check_rep"] = check_vma
+    return _old_shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs
+    )
+
+
+def reshard(x, sharding):
+    """``jax.sharding.reshard`` (the explicit-sharding-mode relayout)
+    where it exists; ``lax.with_sharding_constraint`` on jax 0.4.x,
+    whose auto mode has no explicit axes to refuse — GSPMD inserts the
+    collectives the constraint implies."""
+    if hasattr(jax.sharding, "reshard"):
+        return jax.sharding.reshard(x, sharding)
+    return jax.lax.with_sharding_constraint(x, sharding)
+
+
+def scatter_set_sharded(arr, idx, vals, sharding):
+    """``arr.at[idx].set(vals, out_sharding=...)``; on jax 0.4.x the
+    kwarg does not exist, so scatter first and constrain after (same
+    resulting layout, auto-mode GSPMD)."""
+    try:
+        return arr.at[idx].set(vals, out_sharding=sharding)
+    except TypeError:
+        return jax.lax.with_sharding_constraint(
+            arr.at[idx].set(vals), sharding
+        )
+
+
+def axis_size(axis_name) -> int:
+    """Static mesh-axis size inside a shard-mapped body.
+
+    ``jax.lax.axis_size`` where it exists; on jax 0.4.x
+    ``jax.core.axis_frame(name)`` already returns the size as a plain
+    int. Both are trace-time constants, so callers may build
+    ``range(p)`` / ``scan(length=p)`` from the result.
+    """
+    if hasattr(jax.lax, "axis_size"):
+        return int(jax.lax.axis_size(axis_name))
+    import jax.core as _core
+
+    return int(_core.axis_frame(axis_name))

@@ -96,18 +96,16 @@ class RunLogger:
         self.log_print("\nSimulation completed successfully")
 
 
-class RecoveryEventLogger:
-    """Append-only JSONL stream of structured recovery events — the
-    machine-readable audit trail of the self-healing supervisor
-    (docs/robustness.md has the schema).
+class JsonlEventLogger:
+    """Append-only JSONL stream of structured events.
 
     One JSON object per line: ``{"ts": <unix seconds>, "event": <kind>,
-    ...}`` where kind is one of ``diverged``, ``rolled_back``, ``retry``,
-    ``degraded``, ``preempted``; remaining keys are event-specific
-    (step, dt, backend, backoff_s, ...).
+    ...}`` with ``kind`` restricted to the subclass's ``KINDS`` —
+    the streams are audit trails consumers filter by kind, so a typo
+    must fail the writer, not silently vanish downstream.
     """
 
-    KINDS = ("diverged", "rolled_back", "retry", "degraded", "preempted")
+    KINDS: tuple = ()
 
     def __init__(self, path: str):
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
@@ -115,10 +113,8 @@ class RecoveryEventLogger:
 
     def event(self, kind: str, /, **fields) -> None:
         if kind not in self.KINDS:
-            # The stream is an audit trail consumers filter by kind; a
-            # typo must fail the writer, not silently vanish downstream.
             raise ValueError(
-                f"unknown recovery event kind {kind!r}; one of {self.KINDS}"
+                f"unknown event kind {kind!r}; one of {self.KINDS}"
             )
         record = {"ts": round(time.time(), 3), "event": kind, **fields}
         with open(self.path, "a") as f:
@@ -129,3 +125,30 @@ class RecoveryEventLogger:
             return []
         with open(self.path) as f:
             return [json.loads(line) for line in f if line.strip()]
+
+
+class RecoveryEventLogger(JsonlEventLogger):
+    """Recovery events — the machine-readable audit trail of the
+    self-healing supervisor (docs/robustness.md has the schema).
+    Event-specific keys ride along (step, dt, backend, backoff_s, ...).
+    """
+
+    KINDS = ("diverged", "rolled_back", "retry", "degraded", "preempted")
+
+
+class ServingEventLogger(JsonlEventLogger):
+    """Serving events — the ensemble scheduler/daemon's metrics stream
+    (docs/serving.md has the schema), in the same JSONL event style as
+    :class:`RecoveryEventLogger` so run and serve logs are read by one
+    tooling path.
+
+    ``round`` events carry the serving health metrics: queue depth,
+    batch occupancy (real particles / padded capacity — padding waste
+    made visible), per-round pairs/s, and p50/p95 completed-job
+    latency. Job lifecycle transitions get their own kinds.
+    """
+
+    KINDS = (
+        "submitted", "admitted", "yielded", "round", "completed",
+        "failed", "cancelled", "respooled",
+    )

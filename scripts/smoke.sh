@@ -1,0 +1,66 @@
+#!/usr/bin/env bash
+# The documented pre-push check (`make smoke`): the fast contract lane
+# plus a 2-job ensemble serving e2e through the real CLI daemon on CPU.
+# Exits nonzero on any failure. ~6 min on a laptop-class CPU.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+
+echo "== smoke 1/2: pytest -m fast (contract + oracle-parity lane) =="
+python -m pytest tests/ -q -m fast -p no:cacheprovider
+
+echo "== smoke 2/2: 2-job ensemble serving e2e (CLI daemon) =="
+SPOOL="$(mktemp -d /tmp/gravity_smoke.XXXXXX)"
+cleanup() {
+    # Best-effort daemon shutdown + spool removal.
+    python - "$SPOOL" <<'EOF' 2>/dev/null || true
+import json, sys, urllib.request
+info = json.load(open(f"{sys.argv[1]}/daemon.json"))
+req = urllib.request.Request(
+    f"http://{info['host']}:{info['port']}/shutdown", data=b"{}",
+    method="POST")
+urllib.request.urlopen(req, timeout=5).read()
+EOF
+    [ -n "${SERVE_PID:-}" ] && kill "$SERVE_PID" 2>/dev/null || true
+    rm -rf "$SPOOL"
+}
+trap cleanup EXIT
+
+python -m gravity_tpu serve --spool-dir "$SPOOL" --slots 2 \
+    --slice-steps 20 >"$SPOOL/serve.stdout" 2>&1 &
+SERVE_PID=$!
+for _ in $(seq 1 100); do
+    [ -f "$SPOOL/daemon.json" ] && break
+    sleep 0.2
+done
+[ -f "$SPOOL/daemon.json" ] || {
+    echo "daemon never came up"; cat "$SPOOL/serve.stdout"; exit 1;
+}
+
+JOB1=$(python -m gravity_tpu submit --spool-dir "$SPOOL" \
+    --model random --n 12 --steps 40 --dt 3600 \
+    --integrator leapfrog | python -c \
+    'import json,sys; print(json.load(sys.stdin)["job"])')
+JOB2=$(python -m gravity_tpu submit --spool-dir "$SPOOL" \
+    --model plummer --n 24 --steps 40 --dt 3600 --eps 1e9 \
+    --integrator leapfrog | python -c \
+    'import json,sys; print(json.load(sys.stdin)["job"])')
+
+python - "$SPOOL" "$JOB1" "$JOB2" <<'EOF'
+import sys
+from gravity_tpu.serve import request, wait_for
+
+spool, jobs = sys.argv[1], sys.argv[2:]
+statuses = wait_for(spool, jobs, timeout=180)
+for jid, st in statuses.items():
+    assert st["status"] == "completed", (jid, st)
+    resp = request(spool, "GET", f"/result?job={jid}")
+    assert len(resp["positions"]) == st["n"], jid
+metrics = request(spool, "GET", "/metrics")
+assert all(v == 1 for v in metrics["compile_counts"].values()), metrics
+print("ensemble e2e OK:", {j: s["status"] for j, s in statuses.items()},
+      "| compiles:", metrics["compile_counts"])
+EOF
+
+echo "== smoke: all green =="

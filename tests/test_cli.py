@@ -189,6 +189,7 @@ def test_analyze_checkpoint(tmp_path, capsys):
     assert out["kinetic_energy"] > 0
 
 
+@pytest.mark.slow
 def test_validate_command_with_tpu_battery(capsys):
     """One pass of `validate --tpu` covers the base physics battery AND
     the on-chip smoke gate (CPU-shrunk sizes) — a regression in either

@@ -73,13 +73,20 @@ def test_p3m_kernel_hoisted_out_of_scan():
         if " fft(" in line and "/while/body/" in line
     )
     total_ffts = sum(1 for line in hlo.splitlines() if " fft(" in line)
-    assert body_ffts == 4, (
-        f"{body_ffts} FFTs in the while body (expected 4: rho rfftn + "
-        "3 irfftn); the kernel hoist regressed"
+    # Per step: rho rfftn + 3 irfftn. XLA versions differ in whether the
+    # 3 same-shape inverse transforms stay separate ops or batch into
+    # fewer fft() instructions (observed 4 on the round-3 toolchain, 3
+    # on the 0.4.37 container), so the hoist contract is pinned as a
+    # BOUND on the body plus kernel FFTs strictly outside it — a
+    # regressed hoist puts the 3 kernel transforms (however batched)
+    # back in the body and empties the prologue.
+    assert 0 < body_ffts <= 4, (
+        f"{body_ffts} FFTs in the while body (expected <=4: rho rfftn "
+        "+ the inverse transforms); the kernel hoist regressed"
     )
-    assert total_ffts >= 7, (
-        f"only {total_ffts} FFTs total — the in-graph kernel build is "
-        "missing from the block prologue"
+    assert total_ffts > body_ffts, (
+        f"all {total_ffts} FFTs sit in the while body — the in-graph "
+        "kernel build is missing from the block prologue"
     )
 
 

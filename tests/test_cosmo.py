@@ -295,6 +295,7 @@ def test_blockwise_scan_matches_single_shot(x64):
     )
 
 
+@pytest.mark.slow
 def test_cli_cosmo_streaming_and_resume(tmp_path, capsys):
     """cosmo streams trajectories + checkpoints at block boundaries, and
     --resume continues from the latest checkpoint to the same final
@@ -351,6 +352,7 @@ def test_layzer_irvine_residual_helper(x64):
         layzer_irvine_residual([(0.1, 1.0, -1.0)])
 
 
+@pytest.mark.slow
 def test_cli_cosmo_layzer_irvine(capsys):
     """End-to-end cosmic-energy health check: with a resolved spectrum
     the LI residual is sub-percent and the kinetic/potential ratio sits

@@ -156,6 +156,7 @@ def test_grad_through_block_timestep_schemes(key, x64):
                                    err_msg=name)
 
 
+@pytest.mark.slow
 def test_fmm_rollout_grad_matches_finite_difference(key, x64):
     """jax.grad flows through the dense-grid FMM's full pipeline —
     octree segment_sums, argsort/scatter cell binning, shifted-slice
@@ -241,6 +242,7 @@ def test_pm_rollout_grad_matches_finite_difference(key, x64):
     np.testing.assert_allclose(float(g), float(fd), rtol=1e-6)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("mode", ["gather", "slice"])
 @pytest.mark.parametrize("eps", [0.05, 0.0])
 def test_p3m_rollout_grad_matches_finite_difference(key, x64, mode, eps):

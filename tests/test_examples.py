@@ -3,6 +3,8 @@
 import subprocess
 import sys
 
+import pytest
+
 from conftest import REPO_ROOT, subprocess_env
 
 
@@ -26,12 +28,14 @@ def test_galaxy_merger_example():
     assert "energy drift" in out.stdout
 
 
+@pytest.mark.slow
 def test_cosmology_example():
     out = _run(["examples/cosmology.py", "--steps", "20"])
     assert out.returncode == 0, out.stderr[-2000:]
     assert "GROWTH OK" in out.stdout
 
 
+@pytest.mark.slow
 def test_field_probe_example():
     out = _run(["examples/field_probe.py", "--n", "2048", "--grid", "8"])
     assert out.returncode == 0, out.stderr[-2000:]

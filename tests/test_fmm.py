@@ -46,6 +46,7 @@ def _make_model(key, n, model):
     return state.positions, state.masses, 0.05, 1.0
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("model", ["uniform", "cold", "disk"])
 def test_fmm_matches_tree_expansion(key, model):
     """Shifted-slice FMM == gather-based tree far="expansion", to float
@@ -66,6 +67,7 @@ def test_fmm_matches_tree_expansion(key, model):
     )
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("model", ["uniform", "cold", "disk"])
 def test_fmm_accuracy(key, model):
     """Default fmm (p=2 target expansions + source quadrupoles) lands at
@@ -98,6 +100,7 @@ def test_fmm_all_finite_overflowing_cells(key):
     assert float(jnp.median(jnp.linalg.norm(out[1024:], axis=1))) > 0.0
 
 
+@pytest.mark.slow
 def test_fmm_slab_invariance(key):
     """The slab chunking is a memory knob, not a math knob."""
     n = 1024
@@ -159,6 +162,7 @@ def test_fmm_overflow_targets_feel_neighbors(key):
     assert bool(jnp.all(out[:24, 0] > 0))
 
 
+@pytest.mark.slow
 def test_fmm_composes_with_multirate(key):
     """fmm supplies the once-per-outer-step full evaluation AND the
     (K, N) fast kicks (rectangular fmm_accelerations_vs, VERDICT r3
@@ -187,6 +191,7 @@ def test_fmm_composes_with_multirate(key):
     assert float(np.median(rel)) < 1e-3, float(np.median(rel))
 
 
+@pytest.mark.slow
 def test_fmm_overflow_at_astronomical_masses(key):
     """Overflowing cells with astronomical masses: the remainder-mass
     bookkeeping must use normalized-mass ordering (raw m * x is ~1e41,
@@ -209,6 +214,7 @@ def test_fmm_overflow_at_astronomical_masses(key):
         assert np.median(rel) < bound, (depth, float(np.median(rel)))
 
 
+@pytest.mark.slow
 def test_fmm_ws2_tightens_accuracy(key):
     """The accuracy dial is fully generic in the shifted-slice
     machinery (offset cubes and parity tables parameterize by ws):
@@ -229,6 +235,7 @@ def test_fmm_ws2_tightens_accuracy(key):
     assert med[2] < 0.002, med
 
 
+@pytest.mark.slow
 def test_fmm_vs_equals_self_on_same_points(key):
     """fmm_accelerations_vs(targets=sources) == fmm_accelerations to
     float roundoff: the target binning reproduces the source binning
@@ -253,6 +260,7 @@ def test_fmm_vs_equals_self_on_same_points(key):
     )
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("model", ["uniform", "disk"])
 def test_fmm_vs_accuracy_at_arbitrary_targets(key, model):
     """The rectangular evaluation holds the documented accuracy envelope
@@ -279,6 +287,7 @@ def test_fmm_vs_accuracy_at_arbitrary_targets(key, model):
     )
 
 
+@pytest.mark.slow
 def test_fmm_vs_subset_targets_match_dense_rect(key):
     """Targets = a subset of the sources (the multirate fast-rung call
     shape): the rectangular fmm matches the dense rectangular kick it
@@ -323,6 +332,7 @@ def test_fmm_vs_target_overflow_fallback(key):
     assert bool(jnp.all(out[:, 0] > 0))  # all pulled toward +x heavy
 
 
+@pytest.mark.slow
 def test_fmm_potential_energy_matches_dense(key, x64):
     """The gather-free FMM potential (-0.5 sum m_i phi_i, scalar channel
     riding the force passes) matches the fp64 dense pair scan within
@@ -347,6 +357,7 @@ def test_fmm_potential_energy_matches_dense(key, x64):
         assert rel < 0.02, (name, rel, e_fmm, e_dense)
 
 
+@pytest.mark.slow
 def test_fmm_potential_energy_tracks_tree_on_concentrated_core(key, x64):
     """On the Plummer core (where the capped near field is resolution-
     limited by design — the tree PE errs ~14% at data-driven depth) the
@@ -403,6 +414,7 @@ def test_fmm_vs_external_targets(key):
     assert float(rel[2]) < 0.5, float(rel[2])
 
 
+@pytest.mark.slow
 def test_sharded_fmm_matches_unsharded(key):
     """Slab-sharded fmm == single-host fmm to float roundoff on the
     8-device mesh (flat and hierarchical): replicated build, split
@@ -433,6 +445,7 @@ def test_sharded_fmm_matches_unsharded(key):
         assert np.median(rel) < 1e-6, (shape, float(np.median(rel)))
 
 
+@pytest.mark.slow
 def test_sharded_multirate_fmm_rect_kick(key, monkeypatch):
     """The sharded multirate fast rung with the REAL fmm rectangular
     kernel (not the tiny-K dense shortcut, forced off by zeroing the
@@ -467,6 +480,7 @@ def test_sharded_multirate_fmm_rect_kick(key, monkeypatch):
     assert err < 5e-3 * scale, (err, scale)
 
 
+@pytest.mark.slow
 def test_sharded_fmm_realistic_occupancy_with_overflow(key):
     """Slab-sharded fmm at REALISTIC scale (n=65,536 on the 8-device
     mesh, ~8k particles/device) with leaf-cap overflow FORCED (cap=16 at
@@ -509,6 +523,7 @@ def test_sharded_fmm_realistic_occupancy_with_overflow(key):
     assert counts.max() > 16, "test geometry failed to overflow the cap"
 
 
+@pytest.mark.slow
 def test_sharded_fmm_hierarchical_mesh_merger_run():
     """The 2x1M merger's fast-solver route (VERDICT r4 item 4), at test
     scale: a Simulator run with force_backend=fmm over the hierarchical

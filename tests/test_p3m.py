@@ -29,7 +29,18 @@ def test_binning_side_static():
     assert binning_side(8, 4.0, 8.0) >= 2  # floor
 
 
-@pytest.mark.parametrize("model", ["uniform", "cold", "disk", "plummer"])
+@pytest.mark.parametrize(
+    "model",
+    # Tier-1 keeps one geometry (plummer, the preset family); the other
+    # three repeat the same sub-percent contract and ride tier-2
+    # (VERDICT r5 weak-4: the lane must fit its window).
+    [
+        pytest.param("uniform", marks=pytest.mark.slow),
+        pytest.param("cold", marks=pytest.mark.slow),
+        pytest.param("disk", marks=pytest.mark.slow),
+        "plummer",
+    ],
+)
 def test_accuracy_vs_direct(key, model):
     """Sub-percent median force error, including on the centrally
     concentrated Plummer profile (which the uniform-depth tree cannot
@@ -97,6 +108,7 @@ def test_overflow_cells_degrade_gracefully(key):
     assert np.percentile(mag_ratio, 99) < 3.0, np.percentile(mag_ratio, 99)
 
 
+@pytest.mark.slow
 def test_slice_mode_matches_gather(key):
     """short_mode="slice" (the fmm-style gather-free shifted-slice pass,
     the TPU default) computes the same physics as the gather path —

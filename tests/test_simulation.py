@@ -89,6 +89,7 @@ def test_sharded_run_matches_unsharded(strategy):
     assert final_sharded.positions.shape == (96, 3)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("backend", ["tree", "pm", "p3m"])
 def test_fast_backend_sharded_matches_unsharded(backend):
     """Fast solvers under allgather sharding: replicated tree/mesh build,
@@ -109,6 +110,7 @@ def test_fast_backend_sharded_matches_unsharded(backend):
     assert final_sharded.positions.shape == (96, 3)
 
 
+@pytest.mark.slow
 def test_fast_backend_sharded_padded_matches_unsharded():
     """n NOT divisible by the device count: the zero-mass padding must not
     perturb the bounding cube / cell list the fast solvers derive from

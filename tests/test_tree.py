@@ -284,6 +284,7 @@ def test_potential_energy_parity(key, model):
     assert rel < 0.01, f"{model}: rel {rel:.2e}"
 
 
+@pytest.mark.slow
 def test_energy_drift_tree_matches_dense_16k(key):
     """Energy DRIFT measured with the tree potential tracks the dense
     measurement (the tree's systematic PE offset is ~constant in time, so
