@@ -11,7 +11,7 @@ smoke:
 	bash scripts/smoke.sh
 
 fast:
-	$(PYTEST) tests/ -q -m fast
+	$(PYTEST) tests/ -q -m 'fast and not slow'
 
 # The tier-1 lane (what CI gates on).
 test:

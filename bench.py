@@ -124,6 +124,52 @@ def _save_tpu_line(result: dict) -> None:
         pass  # benching must never fail on a cache write
 
 
+def _cadence_main(steps: int, backend: str) -> int:
+    """BENCH_CADENCE=1: the cadence-on end-to-end A/B (record_every
+    small, checkpointing on). BENCH_IO_PIPELINE=on|off picks the side;
+    the line reports steps_per_sec + host_gap_frac + donated. Separate
+    metric family from the headline pair rate, so it never touches the
+    TPU line cache."""
+    import jax
+
+    from gravity_tpu.bench import run_cadence_benchmark
+    from gravity_tpu.config import SimulationConfig
+
+    n = int(os.environ.get("BENCH_N", 2048))
+    pipeline = os.environ.get("BENCH_IO_PIPELINE", "on")
+    config = SimulationConfig(
+        model="plummer",
+        n=n,
+        steps=steps,
+        dt=3600.0,
+        eps=1.0e9,
+        integrator="leapfrog",
+        force_backend=backend,
+        dtype="float32",
+        record_trajectories=True,
+        trajectory_every=int(os.environ.get("BENCH_RECORD_EVERY", 1)),
+        progress_every=int(os.environ.get("BENCH_BLOCK", 25)),
+        checkpoint_every=int(os.environ.get("BENCH_CKPT_EVERY", 100)),
+        io_pipeline=pipeline,
+    )
+    stats = run_cadence_benchmark(config)
+    print(json.dumps({
+        "metric": "cadence_steps_per_sec",
+        "value": stats["steps_per_sec"],
+        "unit": "steps/s",
+        "n": stats["n"],
+        "steps": stats["steps"],
+        "backend": stats["backend"],
+        "platform": jax.devices()[0].platform,
+        "io_pipeline": stats["io_pipeline"],
+        "host_gap_frac": stats["host_gap_frac"],
+        "donated": stats["donated"],
+        "record_every": stats["record_every"],
+        "checkpoint_every": stats["checkpoint_every"],
+    }))
+    return 0
+
+
 def main() -> int:
     steps = int(os.environ.get("BENCH_STEPS", 20))
     # BENCH_BACKEND lets the chip battery A/B formulations on the same
@@ -136,6 +182,11 @@ def main() -> int:
     from gravity_tpu.utils.platform import ensure_live_backend
 
     ensure_live_backend()
+
+    if os.environ.get("BENCH_CADENCE"):
+        return _cadence_main(
+            int(os.environ.get("BENCH_STEPS", 500)), backend
+        )
 
     from gravity_tpu.bench import run_benchmark
     from gravity_tpu.config import SimulationConfig
@@ -178,6 +229,12 @@ def main() -> int:
         "achieved_tflops": stats.get("achieved_tflops"),
         "peak_tflops": stats.get("peak_tflops"),
         "mfu": stats.get("mfu"),
+        # Host-pipeline facts (docs/scaling.md "Host pipeline &
+        # donation"): the headline harness times bare _run_block calls
+        # (no cadence I/O to hide -> no gap to report, nothing donated);
+        # BENCH_CADENCE=1 runs the cadence-on A/B where both are live.
+        "host_gap_frac": stats.get("host_gap_frac"),
+        "donated": bool(stats.get("donated", False)),
     }
 
     if result["platform"] == "tpu":

@@ -32,10 +32,12 @@ import jax  # noqa: E402
 
 
 def timed_eval(fn, pos, masses, iters):
-    from gravity_tpu.utils.timing import sync
+    from gravity_tpu.utils.timing import sync, warm_sync
 
     out = fn(pos, masses)
-    sync(out)
+    # warm_sync: the fence's own per-shape jit compiles here, outside
+    # the timed region (it would otherwise bill as device time below).
+    warm_sync(out)
     t0 = time.perf_counter()
     for _ in range(iters):
         out = fn(pos, masses)

@@ -33,7 +33,7 @@ ensure_live_backend()
 
 import jax  # noqa: E402
 
-from gravity_tpu.utils.timing import roofline, sync  # noqa: E402
+from gravity_tpu.utils.timing import roofline, sync, warm_sync  # noqa: E402
 
 TILES_I = (256, 512, 1024, 2048)
 TILES_J = (512, 1024, 2048)
@@ -41,7 +41,9 @@ TILES_J = (512, 1024, 2048)
 
 def _time_kernel(f, pos, n, iters=5):
     out = f(pos)
-    sync(out)
+    # warm_sync: compiles the fence's per-shape reduction outside the
+    # timed region (a cold fence would bill its compile below).
+    warm_sync(out)
     t0 = time.perf_counter()
     for _ in range(iters):
         out = f(pos)

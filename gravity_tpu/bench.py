@@ -24,7 +24,12 @@ import jax
 
 from .config import SimulationConfig
 from .simulation import Simulator
-from .utils.timing import backend_formulation, roofline, throughput
+from .utils.timing import (
+    DIRECT_SUM_BACKENDS,
+    backend_formulation,
+    roofline,
+    throughput,
+)
 
 
 def run_benchmark(
@@ -40,7 +45,10 @@ def run_benchmark(
     # Compile + warm up with the SAME static n_steps as the timed block:
     # _run_block retraces per distinct n_steps, so a different warmup shape
     # would leave the timed call paying compilation inside the timer.
-    # sync() is the true value-fetch fence (see utils/timing.sync).
+    # sync() is the true value-fetch fence (see utils/timing.sync); this
+    # warmup fence also compiles sync's own per-shape jit OUTSIDE the
+    # timed region (utils/timing.warm_sync is the same warm for call
+    # sites without a warmup block).
     del warmup_steps
     state, acc, _ = sim._run_block(state, acc, n_steps=bench_steps, record=False)
     sync(state.positions)
@@ -73,7 +81,7 @@ def run_benchmark(
     # direct-sum backends evaluate the full N*(N-1) pair set the rate
     # is counted over, so only they get an honest roofline; fast
     # solvers report the fields as None.
-    if sim.backend in ("pallas", "pallas-mxu", "dense", "chunked", "cpp"):
+    if sim.backend in DIRECT_SUM_BACKENDS:
         stats.update(roofline(
             stats["pairs_per_sec_per_chip"],
             formulation=backend_formulation(sim.backend),
@@ -87,4 +95,59 @@ def run_benchmark(
             device_kind=jax.devices()[0].device_kind,
             formulation=None,
         )
+    return stats
+
+
+def run_cadence_benchmark(config: SimulationConfig) -> dict:
+    """Cadence-heavy end-to-end benchmark: a full ``Simulator.run`` with
+    trajectory recording + checkpointing into a throwaway directory —
+    the workload whose host tax the async pipeline exists to hide. The
+    A/B axis is ``config.io_pipeline`` ('on' vs 'off'); the headline
+    numbers are end-to-end ``steps_per_sec`` and the measured
+    ``host_gap_frac`` (fraction of wall-clock with no device block in
+    flight — utils/timing.HostGapTimer). Artifacts are bitwise identical
+    across the A/B (tests/test_io_pipeline.py pins that), so the speed
+    difference is pure overlap."""
+    import shutil
+    import tempfile
+
+    from .utils.checkpoint import make_checkpoint_manager
+    from .utils.timing import warm_sync
+    from .utils.trajectory import TrajectoryWriter
+
+    sim = Simulator(config)
+    warm_sync(sim.state.positions)
+    root = tempfile.mkdtemp(prefix="gravity_bench_cadence_")
+    try:
+        writer = None
+        if config.record_trajectories:
+            import os
+
+            writer = TrajectoryWriter(
+                os.path.join(root, "traj"), sim.n_real, every=1
+            )
+        mgr = None
+        if config.checkpoint_every:
+            import os
+
+            mgr = make_checkpoint_manager(os.path.join(root, "ckpt"))
+        stats = sim.run(
+            trajectory_writer=writer, checkpoint_manager=mgr
+        )
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    stats.pop("final_state", None)
+    stats["steps_per_sec"] = (
+        stats["steps"] / stats["total_time_s"]
+        if stats["total_time_s"] > 0 else float("inf")
+    )
+    stats.update(
+        model=config.model,
+        integrator=config.integrator,
+        backend=sim.backend,
+        dtype=config.dtype,
+        platform=jax.devices()[0].platform,
+        record_every=config.trajectory_every,
+        checkpoint_every=config.checkpoint_every,
+    )
     return stats

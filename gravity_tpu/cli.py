@@ -122,6 +122,15 @@ def _add_config_args(p: argparse.ArgumentParser) -> None:
                    default=None,
                    help="device mesh shape, e.g. 8 or 2,4 (outer axis = "
                         "DCN for multi-slice)")
+    p.add_argument("--io-pipeline", dest="io_pipeline",
+                   choices=["auto", "on", "off"], default=None,
+                   help="double-buffered host pipeline: dispatch block "
+                        "k+1, then run block k's watchdog/metrics/"
+                        "trajectory/checkpoint I/O on a background "
+                        "writer while k+1 computes; donates the step-"
+                        "loop carry. off = the serial debug loop "
+                        "(artifacts are bitwise identical either way; "
+                        "docs/scaling.md)")
     p.add_argument("--log-dir", dest="log_dir", default=None)
     p.add_argument("--trajectories", dest="record_trajectories",
                    action="store_true", default=None)
@@ -1677,12 +1686,26 @@ def cmd_cancel(args: argparse.Namespace) -> int:
 
 
 def cmd_bench(args: argparse.Namespace) -> int:
-    from .bench import run_benchmark
+    from .bench import run_benchmark, run_cadence_benchmark
 
     _maybe_distributed(args)
     config = build_config(args)
-    result = run_benchmark(config, warmup_steps=args.warmup,
-                           bench_steps=args.bench_steps)
+    if args.cadence:
+        # Cadence-on mode: end-to-end run with recording + checkpointing
+        # — the sync-vs-async host-pipeline A/B (pass --io-pipeline
+        # on|off; docs/scaling.md "Host pipeline & donation").
+        import dataclasses as _dc
+
+        config = _dc.replace(
+            config,
+            record_trajectories=True,
+            checkpoint_every=config.checkpoint_every
+            or max(1, config.progress_every),
+        )
+        result = run_cadence_benchmark(config)
+    else:
+        result = run_benchmark(config, warmup_steps=args.warmup,
+                               bench_steps=args.bench_steps)
     print(json.dumps(result))
     return 0
 
@@ -1916,6 +1939,12 @@ def main(argv=None) -> int:
     p_bench.add_argument("--warmup", type=int, default=3)
     p_bench.add_argument("--bench-steps", dest="bench_steps", type=int,
                          default=20)
+    p_bench.add_argument("--cadence", action="store_true",
+                         help="cadence-on end-to-end mode: full run with "
+                              "trajectory recording + checkpointing; "
+                              "reports steps_per_sec + host_gap_frac "
+                              "(A/B the host pipeline via --io-pipeline "
+                              "on|off)")
     p_bench.set_defaults(fn=cmd_bench)
 
     args = parser.parse_args(argv)

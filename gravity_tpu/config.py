@@ -124,6 +124,21 @@ class SimulationConfig:
     sharding: str = "none"  # none | allgather | ring
     mesh_shape: Optional[tuple] = None  # e.g. (8,); None = all local devices
 
+    # Host I/O pipeline (docs/scaling.md "Host pipeline & donation").
+    # "on"/"auto": the block loop double-buffers — block k+1 is
+    # dispatched before block k's host consumption (watchdog verdict,
+    # metrics/energy, trajectory D2H + chunk writes, checkpoint
+    # checksum+save, all moved onto a bounded-queue background writer),
+    # so the device never idles through recording/checkpointing; the
+    # step-loop carry is donated to XLA for in-place HBM reuse, and the
+    # divergence watchdog verifies block k while k+1 computes (one-block
+    # lag; rollback-to-last-verified-checkpoint absorbs the in-flight
+    # block — docs/robustness.md). Artifacts are bitwise identical to
+    # the serial loop. "off" = the serial debug loop. "auto" degrades to
+    # serial where the pipeline cannot apply (collision merging edits
+    # the live state at block boundaries).
+    io_pipeline: str = "auto"  # auto | on | off
+
     # I/O & observability
     log_dir: str = "gravity_logs_tpu"
     record_trajectories: bool = False  # per-step positions (Spark capability)

@@ -175,6 +175,17 @@ class EnsembleScheduler:
         self.events = events
         self.spool = spool
         self.min_bucket = min_bucket
+        # Background spool writer (docs/scaling.md "Host pipeline &
+        # donation", serving half): completed-job result fetch (the D2H
+        # of the final state) and the .npz write run off the round
+        # loop, overlapping the next round's device compute. One
+        # bounded FIFO thread — results land in completion order, and
+        # a failed write surfaces at the next submit/drain.
+        self._io = None
+        if spool is not None:
+            from ..utils.hostio import HostWriter
+
+            self._io = HostWriter(max_queue=8, name="gravity-spool-io")
         self.jobs: dict[str, Job] = {}
         self._seq = 0
         # Per-key pending job ids and resident batches.
@@ -253,8 +264,13 @@ class EnsembleScheduler:
         job = self.jobs.get(job_id)
         if job is None or job.status != "completed":
             return None
-        if job.state is not None:
-            return job.state
+        # Single read: the background spool writer sets job.state = None
+        # (without a lock) once the .npz is durably down — reading the
+        # attribute twice races it into returning None for a job whose
+        # result exists both in memory and on disk.
+        state = job.state
+        if state is not None:
+            return state
         if self.spool is not None:
             data = self.spool.load_result(job_id)
             if data is not None:
@@ -312,6 +328,63 @@ class EnsembleScheduler:
     def _persist(self, job: Job) -> None:
         if self.spool is not None:
             self.spool.write_job(job)
+
+    def _spool_result_async(self, job: Job, state: ParticleState) -> None:
+        def _write() -> None:
+            # Errors are handled HERE, per job, not left in the
+            # HostWriter: its sticky first-error would otherwise
+            # re-raise on every later submit mid-run_round — before
+            # _free_slot/_finish — leaking the slot and zombifying the
+            # whole daemon over one failed write (review finding). A
+            # failed write keeps job.state in memory, so result() still
+            # serves it for this process's lifetime; only a restart
+            # loses it (and then respools the job).
+            try:
+                self.spool.write_result(job.id, state)
+            except Exception as e:  # noqa: BLE001
+                try:
+                    self._event("spool_error", job=job.id, error=str(e))
+                except Exception:  # noqa: BLE001 — the event log likely
+                    pass  # shares the failing disk; stay un-sticky
+                return
+            # Only after the bytes are durably down: result() now
+            # reloads from the spool instead of the in-memory copy.
+            job.state = None
+
+        if self._io is None:  # after close_io: degrade to a sync write
+            _write()
+        else:
+            self._io.submit(_write)
+
+    def drain_io(self) -> None:
+        """Block until every queued spool write has finished. Result-
+        write FAILURES do not surface here — they are absorbed per job
+        inside ``_spool_result_async`` (``spool_error`` event, state
+        kept in memory) so one bad write cannot poison the writer and
+        zombify the daemon; only writer-infrastructure errors (a dead
+        thread) would raise. In-process consumers call it at
+        end-of-queue; the daemon calls it on shutdown."""
+        if self._io is not None:
+            self._io.barrier()
+
+    def close_io(self) -> None:
+        """Drain and STOP the background writer thread (the scheduler
+        is done serving). drain_io only barriers — without this, every
+        spool-backed scheduler leaks one idle 'gravity-spool-io' thread
+        for the process lifetime (the daemon calls it from stop();
+        Simulator closes its HostWriter the same way)."""
+        if self._io is not None:
+            self._io.close(raise_errors=False)
+            self._io = None
+
+    def __enter__(self) -> "EnsembleScheduler":
+        return self
+
+    def __exit__(self, *exc_info) -> None:
+        # In-process consumers (tests, embedders): `with` releases the
+        # writer thread; without it the thread idles until process exit
+        # (it is a daemon thread, so exit itself is clean either way).
+        self.close_io()
 
     def _job_key(self, job: Job) -> BatchKey:
         return batch_key_for(
@@ -479,14 +552,44 @@ class EnsembleScheduler:
         if batch is None or not occupied:
             return None
 
-        prev_batch = batch  # round-start snapshot: divergence rollback
         # Occupancy is what the round INTEGRATED — snapshot it before
         # finished jobs free their slots below.
         occ_particles = sum(
             self.jobs[slots[s]].config.n for s in occupied
         )
         t0 = time.perf_counter()
-        batch, res = self.engine.run_slice(batch, self.slice_steps)
+        try:
+            batch, res = self.engine.run_slice(batch, self.slice_steps)
+        except Exception:
+            # run_slice DONATES the batch carry: after a throw mid-slice
+            # (e.g. a transient device error at the finite fetch) the
+            # resident states are unrecoverable — the old batch's
+            # buffers are consumed, and leaving it in _batches would
+            # brick this bucket forever ("Array has been deleted" every
+            # round) while the daemon reports healthy. Treat it as a
+            # bucket crash: drop the batch and re-queue residents clean
+            # from step 0 (ICs are a pure function of the config — the
+            # same contract as a daemon-restart respool), then re-raise
+            # for the caller's backstop.
+            self._batches.pop(key, None)
+            resident = [j for j in self._slot_jobs.pop(key, []) if j]
+            for job_id in resident:
+                job = self.jobs[job_id]
+                job.status = "pending"
+                job.steps_done = 0
+                job.state = None
+                # Same "restart clean" reset as _respool: the dead
+                # attempt's compute time and timestamps would otherwise
+                # double-count in /status once the job re-runs.
+                job.started_ts = None
+                job.finished_ts = None
+                job.error = None
+                job.active_s = 0.0
+                self._enqueue(key, job_id)
+                self._event("respooled", job=job_id,
+                            reason="round failed; restarting clean")
+                self._persist(job)
+            raise
         round_s = time.perf_counter() - t0
         self._batches[key] = batch
         self.rounds_run += 1
@@ -500,12 +603,14 @@ class EnsembleScheduler:
             job.active_s += round_s
             real_pairs += pairs_per_step(job.config.n) * advanced
             if not bool(res.finite[slot]):
-                # Per-slot watchdog: roll the slot back to its round-
-                # start state (the last finite one), fail the job, free
+                # Per-slot watchdog: the engine already rolled the lane
+                # back to its round-start state IN-program (run_slice
+                # donates the previous round's buffers, so there is no
+                # host snapshot to read) — record it, fail the job, free
                 # the slot. Batchmates are untouched — vmap lanes are
                 # independent.
                 job.steps_done -= advanced
-                job.state = self.engine.slot_state(prev_batch, slot)
+                job.state = self.engine.slot_state(batch, slot)
                 self._free_slot(key, slot)
                 self._finish(
                     job, "failed",
@@ -517,13 +622,14 @@ class EnsembleScheduler:
             elif job.steps_done >= job.steps:
                 job.state = self.engine.slot_state(batch, slot)
                 if self.spool is not None:
-                    self.spool.write_result(job.id, job.state)
-                    # The spool now owns the arrays (result() reloads
-                    # from it); keeping every finished job's state
-                    # in-memory is an unbounded leak in a long-lived
-                    # daemon (review finding). In-process schedulers
-                    # (no spool) keep it — result() has no other source.
-                    job.state = None
+                    # Result fetch + .npz write on the background
+                    # writer: the D2H of the final state overlaps the
+                    # next round's compute. job.state keeps serving
+                    # result() from memory until the bytes are down,
+                    # then ownership passes to the spool (keeping every
+                    # finished state in-memory is an unbounded leak in
+                    # a long-lived daemon — review finding).
+                    self._spool_result_async(job, job.state)
                 self._free_slot(key, slot)
                 self._finish(job, "completed")
 
@@ -558,6 +664,7 @@ class EnsembleScheduler:
             if self.run_round() is None and not self.has_work():
                 break
             rounds += 1
+        self.drain_io()
         return rounds
 
     def _expire_deadlines(self) -> None:
@@ -603,12 +710,25 @@ class EnsembleScheduler:
                 finished_ts=record.get("finished_ts"),
             )
             self.jobs[job.id] = job
-            if job.status in TERMINAL:
+            # A "completed" record without its result bytes on disk is
+            # not durable: _finish persists terminal status while the
+            # .npz write rides the background writer, so a crash (or a
+            # spool_error'd write) in that window leaves result() with
+            # nothing to serve after restart. Re-run it — ICs are a
+            # pure function of the config, so it reproduces the same
+            # trajectory (same semantics as a pre-completion crash).
+            lost_result = job.status == "completed" and not os.path.exists(
+                self.spool.result_path(job.id)
+            )
+            if job.status in TERMINAL and not lost_result:
                 continue
-            # Interrupted mid-flight or never started: restart clean.
+            # Interrupted mid-flight, never started, or completed with
+            # its result lost: restart clean.
             job.status = "pending"
             job.steps_done = 0
             job.started_ts = None
+            job.finished_ts = None
+            job.error = None
             job.active_s = 0.0
             try:
                 key = self._job_key(job)

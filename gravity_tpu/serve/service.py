@@ -183,6 +183,17 @@ class GravityDaemon:
         for t in self._threads:
             t.join(timeout=5)
         try:
+            # Hard barrier on the background spool writer: queued result
+            # writes must finish before the daemon exits (a restarted
+            # daemon respools jobs whose results never hit disk). Write
+            # failures were already absorbed per job (spool_error
+            # events); this guard only covers writer-infrastructure
+            # errors during shutdown.
+            self.scheduler.drain_io()
+        except Exception:  # noqa: BLE001 — shutdown is best-effort
+            pass
+        self.scheduler.close_io()
+        try:
             os.remove(os.path.join(self.spool_dir, DAEMON_FILE))
         except OSError:
             pass
@@ -246,7 +257,14 @@ class GravityDaemon:
                     }
                 state = self.scheduler.result(job_id)
                 payload = dict(st)
-                payload["path"] = self.spool.result_path(job_id)
+                # The .npz rides the background writer, so "completed"
+                # no longer implies bytes on disk: advertise the path
+                # only once it exists (the inline arrays below serve
+                # the in-flight window; after a spool_error the path
+                # would never exist at all).
+                result_path = self.spool.result_path(job_id)
+                if os.path.exists(result_path):
+                    payload["path"] = result_path
                 if state is not None:
                     payload["positions"] = np.asarray(
                         state.positions

@@ -29,7 +29,12 @@ from .ops import diagnostics
 from .state import ParticleState
 from .utils import faults as _faults
 from .utils.logging import RunLogger
-from .utils.timing import StepTimer, sync, throughput
+from .utils.timing import (
+    DIRECT_SUM_BACKENDS,
+    StepTimer,
+    sync,
+    throughput,
+)
 from .utils.trajectory import TrajectoryWriter
 
 _DTYPES = {
@@ -183,7 +188,7 @@ def _resolve_backend(config: SimulationConfig, on_tpu=None) -> str:
             # where the tree would actually win.
             _warn_n = TREE_CROSSOVER_TPU
         if (
-            backend in ("dense", "chunked", "pallas", "pallas-mxu", "cpp")
+            backend in DIRECT_SUM_BACKENDS
             and config.n >= _warn_n
             # A ring shard streams sources and can never assemble the
             # full set a global tree build needs, so there is no faster
@@ -450,6 +455,32 @@ def make_local_kernel(config: SimulationConfig, backend: str,
             short_mode=config.p3m_short, t_cap=t_cap, **common,
         )
     raise ValueError(f"unknown force backend {backend!r}")
+
+
+_DONATION_PROBE: Optional[bool] = None
+
+
+def donation_supported() -> bool:
+    """Whether ``donate_argnums`` actually reuses buffers in place on
+    this platform, probed once per process: jit a trivial donating op
+    and check the output aliases the donated input. XLA's donation
+    support varies by backend AND version (current jaxlib aliases on
+    CPU too), so a hardcoded platform list goes stale. The BENCH line
+    reports this as ``donated`` so an A/B reader knows whether in-place
+    buffer reuse was in effect."""
+    global _DONATION_PROBE
+    if _DONATION_PROBE is None:
+        try:
+            probe = jax.jit(lambda x: x + 1.0, donate_argnums=(0,))
+            x = jnp.zeros((8,), jnp.float32)
+            ptr = x.unsafe_buffer_pointer()
+            _DONATION_PROBE = bool(
+                probe(x).unsafe_buffer_pointer() == ptr
+            )
+        except Exception:  # noqa: BLE001 — exotic backends without
+            # unsafe_buffer_pointer: fall back to the classic list
+            _DONATION_PROBE = jax.devices()[0].platform in ("tpu", "gpu")
+    return _DONATION_PROBE
 
 
 class SimulationDiverged(RuntimeError):
@@ -727,6 +758,30 @@ class Simulator:
             self._block_fn,
             static_argnames=("n_steps", "record", "record_every"),
         )
+        # Donated twin for the pipelined driver (docs/scaling.md "Host
+        # pipeline & donation"): the (state, acc) carry is donated so
+        # XLA reuses its HBM in place across blocks. Legal only in the
+        # pipelined loop, which consumes the previous block through the
+        # non-aliased snapshot below — the serial loop reads its block
+        # inputs after the call (emergency saves) and must not donate.
+        self._run_block_donated = jax.jit(
+            self._block_fn,
+            static_argnames=("n_steps", "record", "record_every"),
+            donate_argnums=(0, 1),
+        )
+        # Pipeline companions, dispatched on a block's outputs BEFORE
+        # the next block donates them: the watchdog's finiteness verdict
+        # (a scalar — fetching it is the block's completion fence) and a
+        # non-aliased deep copy the host consumers (checkpoint saves,
+        # energy metrics, interrupt handlers) can read while the next
+        # block overwrites the donated original.
+        self._finite_fn = jax.jit(
+            lambda st: jnp.all(jnp.isfinite(st.positions))
+            & jnp.all(jnp.isfinite(st.velocities))
+        )
+        self._snapshot_fn = jax.jit(
+            lambda st: jax.tree_util.tree_map(jnp.copy, st)
+        )
 
     def _unsharded_accel2(self):
         """(positions, masses) -> accelerations for the resolved backend."""
@@ -977,6 +1032,71 @@ class Simulator:
         )
         return wrap(state), acc, traj
 
+    def _make_host_pipeline(self, trajectory_writer, checkpoint_manager,
+                            enabled: bool):
+        """The background-writer half of the host pipeline, shared by
+        the fixed-dt and adaptive drivers: returns ``(host_writer,
+        trajectory_writer, submit_save)``. With ``enabled`` and any I/O
+        consumer present, trajectory records and checkpoint saves route
+        through one bounded-queue :class:`~gravity_tpu.utils.hostio.
+        HostWriter`; otherwise ``host_writer`` is None and
+        ``submit_save`` saves inline (the serial path)."""
+        host_writer = None
+        if enabled and (
+            trajectory_writer is not None or checkpoint_manager is not None
+        ):
+            from .utils.hostio import HostWriter
+            from .utils.trajectory import AsyncTrajectoryWriter
+
+            host_writer = HostWriter()
+            if trajectory_writer is not None:
+                trajectory_writer = AsyncTrajectoryWriter(
+                    trajectory_writer, host_writer
+                )
+
+        def submit_save(at_step, at_state, extra=None):
+            from .utils.checkpoint import save_checkpoint
+
+            # The background writer runs the SHA-256 payload checksum
+            # and the Orbax save off the critical path.
+            if host_writer is not None:
+                host_writer.submit(
+                    save_checkpoint, checkpoint_manager, at_step,
+                    at_state, extra=extra,
+                )
+            else:
+                save_checkpoint(
+                    checkpoint_manager, at_step, at_state, extra=extra
+                )
+
+        return host_writer, trajectory_writer, submit_save
+
+    def _resolve_io_pipeline(self) -> bool:
+        """True when this run drives the depth-1 async host pipeline
+        (docs/scaling.md "Host pipeline & donation"): dispatch block k+1,
+        then consume block k's outputs while k+1 runs on device."""
+        mode = self.config.io_pipeline
+        if mode not in ("auto", "on", "off"):
+            raise ValueError(
+                f"io_pipeline must be 'auto', 'on', or 'off'; got {mode!r}"
+            )
+        if mode == "off":
+            return False
+        if self.config.merge_radius > 0.0:
+            # The merge pass reads AND edits the live state at block
+            # boundaries — the in-flight next block would integrate the
+            # pre-merge state it was dispatched from.
+            if mode == "on":
+                raise ValueError(
+                    "io_pipeline='on' does not compose with collision "
+                    "merging (merge_radius > 0): the merge pass edits "
+                    "the live state at block boundaries, which the "
+                    "in-flight block would ignore; use io_pipeline="
+                    "'auto' (degrades to the serial loop) or 'off'"
+                )
+            return False
+        return True
+
     def run(
         self,
         logger: Optional[RunLogger] = None,
@@ -1043,13 +1163,30 @@ class Simulator:
             # Block size must be a multiple of the recording stride.
             block = max(1, block // every) * every
 
+        # Host pipeline resolution (docs/scaling.md "Host pipeline &
+        # donation"): pipelined runs dispatch block k+1 before consuming
+        # block k — the watchdog verdict, metrics, trajectory D2H +
+        # writes, and checkpoint saves all overlap k+1's device compute,
+        # and the (state, acc) carry is donated for in-place HBM reuse.
+        pipelined = self._resolve_io_pipeline()
+        self.io_pipelined = pipelined
+        self.donated = pipelined and donation_supported()
+        host_writer, trajectory_writer, _save_cadence = (
+            self._make_host_pipeline(
+                trajectory_writer, checkpoint_manager, pipelined
+            )
+        )
+
         self._banner(logger, total_steps, config.integrator)
+
+        from .utils.timing import HostGapTimer, pairs_metric_name
 
         state = self.state
         acc = init_carry(self.accel_fn, state)
         self._e0 = None
         timer = StepTimer()
         timer.start()
+        gap = HostGapTimer()
         block_prev = 0.0
         step = start_step
         merged_total = 0
@@ -1058,46 +1195,132 @@ class Simulator:
         # so count steps since the last check instead of checking every
         # block boundary.
         steps_since_merge_check = 0
-        # self.state/self._last_step stay current per block so the
-        # interrupt/preemption handler below can checkpoint mid-run.
+        # self.state/self._last_step stay current per CONSUMED block so
+        # the interrupt/preemption handler below can checkpoint mid-run
+        # (pipelined runs drop the unconsumed in-flight block — `resume`
+        # re-integrates it).
         self._last_step = step
+        run_block = self._run_block_donated if pipelined else self._run_block
+        # The state at `step`, readable by emergency saves.
+        last_good = state
+        if pipelined:
+            # Never donate the caller-visible initial state: jax marks
+            # donated arrays deleted on EVERY platform, Simulator
+            # accepts prebuilt states whose buffers the caller still
+            # owns (and same-dtype astype aliases them), and self.state
+            # must stay readable if an error fires before the first
+            # consume (the supervisor's transient resume reads it). The
+            # first dispatch consumes a private copy instead.
+            state = self._snapshot_fn(state)
+        pending = None  # pipelined: dispatched block awaiting consumption
+
         try:
-          while step < total_steps:
-            # Injected transient device errors surface at block start
-            # (utils/faults.py); the supervisor retries them with
-            # exponential backoff from the last finite in-memory state.
-            _faults.maybe_raise_transient(step)
-            remaining = total_steps - step
-            if record and remaining >= every:
-                # Whole strides only; any sub-stride tail runs unrecorded.
-                n_steps = min(block, (remaining // every) * every)
-                do_record = True
+          while step < total_steps or pending is not None:
+            if step < total_steps:
+                # Injected transient device errors surface at block start
+                # (utils/faults.py); the supervisor retries them with
+                # exponential backoff from the last finite in-memory state.
+                _faults.maybe_raise_transient(step)
+                remaining = total_steps - step
+                if record and remaining >= every:
+                    # Whole strides only; any sub-stride tail runs
+                    # unrecorded.
+                    n_steps = min(block, (remaining // every) * every)
+                    do_record = True
+                else:
+                    n_steps = min(block, remaining)
+                    do_record = False
+                gap.dispatched()
+                if pipelined:
+                    # JAX async dispatch: these return futures; block
+                    # k+1 runs on device while the host consumes k.
+                    # Companions on the outputs (watchdog verdict +
+                    # non-aliased snapshot) are dispatched NOW, before
+                    # the next iteration donates new_state.
+                    new_state, acc, traj = run_block(
+                        state, acc, n_steps=n_steps, record=do_record,
+                        record_every=every if do_record else 1,
+                    )
+                    finite = (
+                        self._finite_fn(new_state)
+                        if config.nan_check else None
+                    )
+                    snap = self._snapshot_fn(new_state)
+                    state = new_state
+                    step += n_steps
+                    blk, pending = pending, (
+                        step - n_steps, n_steps, snap, finite, traj
+                    )
+                    if blk is None:
+                        continue  # depth-1 pipeline priming: no block
+                        # to consume until the second dispatch
+                else:
+                    prev_state = state
+                    state, acc, traj = run_block(
+                        state, acc, n_steps=n_steps, record=do_record,
+                        record_every=every if do_record else 1,
+                    )
+                    sync(state.positions)
+                    gap.completed()
+                    # Injected divergence (utils/faults.py): NaN the
+                    # state so the watchdog below trips through its REAL
+                    # detection path.
+                    state = _faults.maybe_corrupt_state(
+                        state, step, step + n_steps
+                    )
+                    last_good = prev_state
+                    step += n_steps
+                    blk = (step - n_steps, n_steps, state, None, traj)
             else:
-                n_steps = min(block, remaining)
-                do_record = False
-            prev_state, prev_step = state, step
-            state, acc, traj = self._run_block(
-                state, acc, n_steps=n_steps, record=do_record,
-                record_every=every if do_record else 1,
-            )
-            sync(state.positions)
-            # Injected divergence (utils/faults.py): NaN the state so the
-            # watchdog below trips through its REAL detection path.
-            state = _faults.maybe_corrupt_state(
-                state, prev_step, prev_step + n_steps
-            )
-            if config.nan_check and not self._state_finite(state):
-                # Divergence watchdog: abort with the last finite state
+                # Dispatching is done; drain the final in-flight block.
+                blk, pending = pending, None
+
+            # --- consume one finished block (k, while k+1 computes) ---
+            prev_step, blk_steps, bstate, finite, traj = blk
+            end_step = prev_step + blk_steps
+            finite_ok = True
+            if pipelined:
+                # Completion fence: a genuine value fetch (see
+                # utils/timing.sync) — the watchdog verdict when the
+                # watchdog is on, a scalar fence on the snapshot
+                # otherwise. This is where the one-block watchdog lag
+                # lives: block k's verdict is read while k+1 computes.
+                if finite is not None:
+                    finite_ok = bool(finite)
+                else:
+                    sync(bstate.positions)
+                gap.completed()
+                if _faults.active() is not None:
+                    # Injected divergence under the pipeline: the fault
+                    # fires on the consumed snapshot (the forward state
+                    # is already in flight), and the watchdog below
+                    # aborts exactly as it would for a real NaN verdict.
+                    corrupted = _faults.maybe_corrupt_state(
+                        bstate, prev_step, end_step
+                    )
+                    if corrupted is not bstate:
+                        finite_ok = False
+                if not config.nan_check:
+                    finite_ok = True
+            elif config.nan_check:
+                finite_ok = self._state_finite(bstate)
+            if config.nan_check and not finite_ok:
+                # Divergence watchdog (one block lagged under the
+                # pipeline): abort with the last VERIFIED state
                 # persisted rather than integrating garbage to the end.
-                # The emergency save is best-effort — a failing save
-                # (e.g. a foreign conflicting snapshot in the dir) must
-                # not mask the SimulationDiverged being raised.
+                # Queued cadence saves drain first — Orbax drops
+                # out-of-order steps. The emergency save stays
+                # best-effort: a failing save (e.g. a foreign
+                # conflicting snapshot in the dir) must not mask the
+                # SimulationDiverged being raised.
                 if checkpoint_manager is not None:
                     from .utils.checkpoint import save_checkpoint
 
                     try:
+                        if host_writer is not None:
+                            host_writer.barrier()
                         save_checkpoint(
-                            checkpoint_manager, prev_step, prev_state
+                            checkpoint_manager, prev_step, last_good
                         )
                     except Exception as ce:  # noqa: BLE001
                         if logger is not None:
@@ -1108,7 +1331,7 @@ class Simulator:
                 if logger is not None:
                     logger.log_print(
                         f"DIVERGED within steps {prev_step + 1}.."
-                        f"{prev_step + n_steps}; last finite state is at "
+                        f"{end_step}; last finite state is at "
                         f"step {prev_step}"
                         + (" (checkpoint saved)"
                            if checkpoint_manager is not None else "")
@@ -1117,23 +1340,26 @@ class Simulator:
             now = timer.mark()
             block_elapsed = now - block_prev
             block_prev = now
-            step += n_steps
-            self.state, self._last_step = state, step
+            self.state, self._last_step = bstate, end_step
+            if pipelined:
+                last_good = bstate
             # Injected preemption: a real SIGTERM to this process, so the
             # handler -> SimulationPreempted -> checkpoint path below is
             # what actually gets exercised.
-            _faults.maybe_preempt(prev_step, step)
+            _faults.maybe_preempt(prev_step, end_step)
             if logger is not None:
-                logger.progress(step, total_steps)
-            steps_since_merge_check += n_steps
+                logger.progress(end_step, total_steps)
+            steps_since_merge_check += blk_steps
             # The final block always checks: the returned state must not
             # contain never-examined colliding pairs just because the
-            # run length is not a multiple of merge_every.
+            # run length is not a multiple of merge_every. (merge_radius
+            # > 0 resolves the pipeline off, so `state` is the live
+            # consumed state here.)
             if (
                 config.merge_radius > 0.0
                 and (
                     steps_since_merge_check >= config.merge_every
-                    or step >= total_steps
+                    or end_step >= total_steps
                 )
             ):
                 steps_since_merge_check = 0
@@ -1177,7 +1403,7 @@ class Simulator:
                     if logger is not None:
                         logger.log_print(
                             f"merged {int(res.n_merged)} pair(s) at step "
-                            f"{step} ({merged_total} total)"
+                            f"{end_step} ({merged_total} total)"
                         )
                     # Masses are traced through the block, so no retrace —
                     # just reseed the force carry from the merged state.
@@ -1193,7 +1419,11 @@ class Simulator:
                 if config.metrics_energy:
                     # self.energy() includes the external field's
                     # potential energy, keeping drift meaningful under
-                    # --external.
+                    # --external. (It reads self.state — the consumed
+                    # block's snapshot under the pipeline. Known limit:
+                    # dispatched at consume time it queues behind the
+                    # in-flight block and partially re-serializes the
+                    # pipeline — docs/scaling.md.)
                     e = float(self.energy())
                     if self._e0 is None:
                         self._e0 = e
@@ -1202,40 +1432,53 @@ class Simulator:
                         abs((e - self._e0) / self._e0)
                         if self._e0 else None
                     )
+                # Only direct-sum backends report pairs_per_sec; fast
+                # solvers do asymptotically less work than the dense
+                # N*(N-1) count, so their rate carries the honest
+                # dense_equiv_ label (utils/timing.pairs_metric_name).
+                rate = (
+                    pairs_per_step(self.n_real) * blk_steps / block_elapsed
+                    if block_elapsed > 0 else None
+                )
+                extra[pairs_metric_name(self.backend)] = rate
                 metrics_logger.log(
-                    step=step,
-                    block_steps=n_steps,
+                    step=end_step,
+                    block_steps=blk_steps,
                     block_s=block_elapsed,
-                    pairs_per_sec=(
-                        pairs_per_step(self.n_real) * n_steps / block_elapsed
-                        if block_elapsed > 0 else None
-                    ),
                     **extra,
                 )
             if trajectory_writer is not None and traj is not None:
                 # Host transfer before slicing: slicing a sharded array on
-                # device would force a resharding gather.
+                # device would force a resharding gather. Pipelined runs
+                # block here on block k's D2H while k+1 computes; the
+                # chunk writes themselves land on the background writer.
                 traj_np = np.asarray(traj)[:, : self.n_real]
                 for k in range(traj_np.shape[0]):
                     trajectory_writer.record(
-                        step - n_steps + (k + 1) * every, traj_np[k]
+                        prev_step + (k + 1) * every, traj_np[k]
                     )
             if checkpoint_manager is not None:
-                from .utils.checkpoint import (
-                    crossed_cadence,
-                    save_checkpoint,
-                )
+                from .utils.checkpoint import crossed_cadence
 
                 if crossed_cadence(
-                    step - n_steps, step, config.checkpoint_every
+                    prev_step, end_step, config.checkpoint_every
                 ):
-                    save_checkpoint(checkpoint_manager, step, state)
+                    _save_cadence(end_step, bstate)
+          # Normal completion: drain the background writer INSIDE the
+          # try so a failed trajectory/checkpoint write fails the run
+          # instead of vanishing with the thread.
+          if host_writer is not None:
+            host_writer.barrier()
         except KeyboardInterrupt as e:
             # Graceful interrupt OR preemption (SimulationPreempted is a
             # KeyboardInterrupt subclass): persist what we have so
             # `resume` works (the reference loses everything on any
-            # interruption).
-            if checkpoint_manager is not None and step > start_step:
+            # interruption). self.state/_last_step name the last
+            # CONSUMED block — a pipelined run's in-flight block is
+            # dropped and re-integrated on resume. The queued cadence
+            # saves drain first (Orbax drops out-of-order steps).
+            if checkpoint_manager is not None and \
+                    self._last_step > start_step:
                 from .utils.checkpoint import save_checkpoint
 
                 word = (
@@ -1244,20 +1487,31 @@ class Simulator:
                     else "Interrupted"
                 )
                 try:
-                    save_checkpoint(checkpoint_manager, step, self.state)
+                    if host_writer is not None:
+                        host_writer.barrier()
+                    save_checkpoint(
+                        checkpoint_manager, self._last_step, self.state
+                    )
                 except Exception as ce:  # noqa: BLE001 — best-effort:
                     # a failed save must not mask the interrupt itself.
                     if logger is not None:
                         logger.log_print(
-                            f"WARNING: {word} at step {step} but the "
-                            f"checkpoint save failed: {ce}"
+                            f"WARNING: {word} at step {self._last_step} "
+                            f"but the checkpoint save failed: {ce}"
                         )
                 else:
                     if logger is not None:
                         logger.log_print(
-                            f"{word} at step {step}; checkpoint saved"
+                            f"{word} at step {self._last_step}; "
+                            "checkpoint saved"
                         )
             raise
+        finally:
+            if host_writer is not None:
+                # Error paths land here with an exception already
+                # propagating: drain and stop the thread without
+                # raising over it (barrier above covers success).
+                host_writer.close(raise_errors=False)
         timer.mark()
 
         self.state = state
@@ -1270,10 +1524,18 @@ class Simulator:
             num_devices=self.mesh.size if self.mesh else 1,
             force_evals_per_step=evals,
         )
-        if config.merge_radius > 0.0:
-            stats["merged_pairs"] = merged_total
         if trajectory_writer is not None:
             trajectory_writer.close()
+        # Close the gap accounting AFTER the final trajectory flush: the
+        # tail-end host work (last block's writes, manifest, writer
+        # drain) is device-idle time too.
+        gap.finish()
+        stats["io_pipeline"] = "on" if pipelined else "off"
+        stats["donated"] = bool(self.donated)
+        stats["host_gap_frac"] = gap.host_gap_frac
+        self.last_host_gap_frac = gap.host_gap_frac
+        if config.merge_radius > 0.0:
+            stats["merged_pairs"] = merged_total
         return self._finish(logger, total_time, total_steps - start_step,
                             stats)
 
@@ -1472,6 +1734,18 @@ class Simulator:
             f"{mode} ({criterion}, eta={config.eta})",
         )
 
+        # Adaptive blocks make host-side control-flow decisions from each
+        # block's (t, steps) result, so the compute loop stays serial —
+        # but the host-I/O half of the pipeline still applies: trajectory
+        # frames and checkpoint saves run on the background writer, with
+        # the same hard barrier on divergence/interrupt/SIGTERM.
+        host_writer, trajectory_writer, _submit_save = (
+            self._make_host_pipeline(
+                trajectory_writer, checkpoint_manager,
+                self._resolve_io_pipeline(),
+            )
+        )
+
         block_cap = max(1, min(config.progress_every,
                                config.adaptive_max_steps))
         # max_steps is a static (trace-time) bound, so a shrunken final
@@ -1548,6 +1822,10 @@ class Simulator:
                     from .utils.checkpoint import save_checkpoint
 
                     try:
+                        if host_writer is not None:
+                            # Queued cadence saves land first (Orbax
+                            # drops out-of-order steps).
+                            host_writer.barrier()
                         save_checkpoint(
                             checkpoint_manager, snap[1], snap[0],
                             extra={"t": snap[2], "comp": snap[3]},
@@ -1580,7 +1858,7 @@ class Simulator:
                     f"{float(res.dt_max_used):.3g}])"
                 )
             if metrics_logger is not None:
-                from .utils.timing import pairs_per_step
+                from .utils.timing import pairs_metric_name, pairs_per_step
 
                 metrics_logger.log(
                     step=steps_taken,
@@ -1589,11 +1867,11 @@ class Simulator:
                     t=t,
                     dt_min=float(res.dt_min) if block_steps else None,
                     dt_max=float(res.dt_max_used) if block_steps else None,
-                    pairs_per_sec=(
+                    **{pairs_metric_name(self.backend): (
                         pairs_per_step(self.n_real) * block_steps
                         / block_elapsed
                         if block_elapsed > 0 else None
-                    ),
+                    )},
                 )
             if trajectory_writer is not None and block_steps > 0:
                 frame = np.asarray(
@@ -1601,19 +1879,17 @@ class Simulator:
                 )[: self.n_real]
                 trajectory_writer.record(steps_taken, frame)
             if checkpoint_manager is not None:
-                from .utils.checkpoint import (
-                    crossed_cadence,
-                    save_checkpoint,
-                )
+                from .utils.checkpoint import crossed_cadence
             if checkpoint_manager is not None and crossed_cadence(
                 prev_steps, steps_taken, config.checkpoint_every
             ):
-                save_checkpoint(
-                    checkpoint_manager, steps_taken, state,
-                    extra={"t": t, "comp": comp},
-                )
+                _submit_save(steps_taken, state, {"t": t, "comp": comp})
             if block_steps == 0:
                 break  # t >= t_end in state dtype; nothing advanced
+          # Normal completion: surface background I/O failures while
+          # still inside the try (the finally below only cleans up).
+          if host_writer is not None:
+            host_writer.barrier()
         except KeyboardInterrupt as e:
             if checkpoint_manager is not None and snap[1] > start_steps:
                 from .utils.checkpoint import save_checkpoint
@@ -1624,6 +1900,8 @@ class Simulator:
                     else "Interrupted"
                 )
                 try:
+                    if host_writer is not None:
+                        host_writer.barrier()
                     save_checkpoint(
                         checkpoint_manager, snap[1], snap[0],
                         extra={"t": snap[2], "comp": snap[3]},
@@ -1643,6 +1921,9 @@ class Simulator:
                             f"(t={snap[2]:.6g}); checkpoint saved"
                         )
             raise
+        finally:
+            if host_writer is not None:
+                host_writer.close(raise_errors=False)
         timer.mark()
 
         if config.periodic_box > 0.0:

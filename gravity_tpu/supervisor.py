@@ -289,6 +289,13 @@ class RunSupervisor:
                     steps=span * factor,
                     checkpoint_every=0,
                     record_trajectories=False,
+                    # Recovery segments re-integrate a KNOWN-bad
+                    # interval: run them serial (no host pipeline) so
+                    # the watchdog verdict lands at the exact diverging
+                    # block instead of one pipelined block late — the
+                    # segment is short and stream-detached, so there is
+                    # no host tax to hide anyway.
+                    io_pipeline="off",
                 )
                 self._event(
                     "retry", kind="diverge", step=step, span=span,

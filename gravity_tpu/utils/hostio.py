@@ -1,0 +1,92 @@
+"""Bounded-queue background host I/O — the writer half of the block
+pipeline (docs/scaling.md "Host pipeline & donation").
+
+The block loop's host tax (trajectory chunk writes, checkpoint
+checksum+save, result spooling) used to run serially between device
+blocks, idling the chip through every flush. :class:`HostWriter` moves
+that work onto one background thread behind a bounded queue:
+
+- **Ordering**: one FIFO queue, one worker — tasks execute exactly in
+  submission order, so checkpoint steps stay monotone (Orbax silently
+  drops out-of-order saves) and trajectory frames land in step order.
+- **Backpressure**: the queue is bounded; a producer outrunning the
+  disk blocks in :meth:`submit` instead of buffering frames without
+  limit (at 1M bodies a frame is 12 MB — an unbounded queue is an OOM).
+- **Failure**: the first task exception is captured, every later task
+  is skipped (never write past a failure), and the error re-raises on
+  the main thread at the next :meth:`submit`/:meth:`barrier` — a full
+  disk fails the run, it does not vanish into a daemon thread.
+- **Hard barrier**: :meth:`barrier` drains the queue and surfaces any
+  pending error. The run loop barriers before every emergency
+  checkpoint (divergence / Ctrl-C / SIGTERM) so the crash-safety
+  contracts of docs/robustness.md — emergency save ordering,
+  torn-write detection, exit 75 — hold unchanged under the pipeline.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Optional
+
+_SENTINEL = object()
+
+
+class HostWriter:
+    """One background thread executing submitted callables in order."""
+
+    def __init__(self, max_queue: int = 4, name: str = "gravity-hostio"):
+        if max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+        self._q: queue.Queue = queue.Queue(maxsize=max_queue)
+        self._error: Optional[BaseException] = None
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._loop, name=name, daemon=True
+        )
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while True:
+            task = self._q.get()
+            try:
+                if task is _SENTINEL:
+                    return
+                if self._error is None:
+                    fn, args, kwargs = task
+                    try:
+                        fn(*args, **kwargs)
+                    except BaseException as e:  # noqa: BLE001 — captured
+                        self._error = e  # and re-raised on the producer
+            finally:
+                self._q.task_done()
+
+    def _raise_pending(self) -> None:
+        if self._error is not None:
+            raise self._error
+
+    def submit(self, fn, *args, **kwargs) -> None:
+        """Enqueue ``fn(*args, **kwargs)``; blocks when the queue is full
+        (backpressure). Raises any earlier background failure."""
+        self._raise_pending()
+        if self._closed:
+            raise RuntimeError("HostWriter is closed")
+        self._q.put((fn, args, kwargs))
+
+    def barrier(self) -> None:
+        """Block until every submitted task has run; raise the first
+        background failure if one occurred."""
+        self._q.join()
+        self._raise_pending()
+
+    def close(self, raise_errors: bool = True) -> None:
+        """Drain remaining tasks, stop the thread. With
+        ``raise_errors=False`` (finally blocks: an exception may already
+        be propagating) background failures are swallowed here — the
+        earlier submit/barrier calls have surfaced them already."""
+        if not self._closed:
+            self._closed = True
+            self._q.put(_SENTINEL)
+            self._thread.join()
+        if raise_errors:
+            self._raise_pending()

@@ -150,5 +150,5 @@ class ServingEventLogger(JsonlEventLogger):
 
     KINDS = (
         "submitted", "admitted", "yielded", "round", "completed",
-        "failed", "cancelled", "respooled",
+        "failed", "cancelled", "respooled", "spool_error",
     )

@@ -54,7 +54,20 @@ def device_memory_stats() -> list[dict]:
 
 
 class MetricsLogger:
-    """Append-only JSONL metrics stream."""
+    """Append-only JSONL metrics stream.
+
+    Per-block records carry ``step``, ``block_steps``, ``block_s``, and
+    a pair rate whose KEY is honest about what was computed
+    (utils/timing.pairs_metric_name): ``pairs_per_sec`` for direct-sum
+    backends, ``dense_equiv_pairs_per_sec`` for fast solvers — the
+    dense N*(N-1) count over a tree/fmm/pm block's wall-clock is the
+    rate a dense sum would have NEEDED, not work done, and the old
+    unqualified label overstated fast-solver throughput. Under the
+    async host pipeline (docs/scaling.md) ``block_s`` measures
+    consumption-to-consumption wall-clock, which still sums to the run
+    total but no longer isolates device time per block — use
+    ``host_gap_frac`` in the run stats for the device-idle picture.
+    """
 
     def __init__(self, path: str):
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)

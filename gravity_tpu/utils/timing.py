@@ -51,6 +51,37 @@ def sync(x) -> None:
     jax.device_get(scalars)
 
 
+def warm_sync(x) -> None:
+    """Pre-compile :func:`sync`'s per-(shape, dtype, sharding) fence
+    reduction OUTSIDE any timed region. The fence's ``jnp.sum`` is
+    jit-cached per shape; without a warm call the first fence of each
+    new shape compiles inside the measurement and masquerades as device
+    time. Call on a representative array before starting any timer
+    (bench.py and benchmarks/* do)."""
+    sync(x)
+
+
+# Backends that actually evaluate the dense N*(N-1) directed pair set
+# pairs_per_step() counts — the only ones whose pair rate is a real
+# throughput. Fast solvers (tree/fmm/sfmm/pm/p3m) do asymptotically
+# less work per force evaluation.
+DIRECT_SUM_BACKENDS = ("dense", "chunked", "pallas", "pallas-mxu", "cpp")
+
+
+def pairs_metric_name(backend: str) -> str:
+    """Metrics-JSONL key for the per-block pair rate. Direct-sum
+    backends report ``pairs_per_sec`` (they evaluate every pair); a fast
+    solver's rate is the same N*(N-1) count over ITS wall-clock — the
+    rate a dense sum would have needed to match it, not work done — so
+    it is labeled ``dense_equiv_pairs_per_sec`` instead of overstating
+    tree/fmm/pm throughput."""
+    return (
+        "pairs_per_sec"
+        if backend in DIRECT_SUM_BACKENDS
+        else "dense_equiv_pairs_per_sec"
+    )
+
+
 def pairs_per_step(n: int, *, direct_sum: bool = True) -> int:
     """Pair interactions evaluated per force evaluation.
 
@@ -183,6 +214,69 @@ class StepTimer:
 
     def avg_step(self, steps: int) -> float:
         return self.total / max(steps, 1)
+
+
+@dataclass
+class HostGapTimer:
+    """Device-idle ("host gap") accounting for the block pipeline.
+
+    Definition (docs/scaling.md "Host pipeline & donation"):
+    ``host_gap_frac`` is the fraction of run wall-clock during which the
+    driver held NO dispatched-and-unconsumed device block — i.e. time
+    the device is provably idle because nothing was in flight. The
+    serial loop (``--io-pipeline off``) exposes its whole host tax here
+    (watchdog fetch, energy, trajectory D2H + writes, checkpoint saves
+    all happen with nothing dispatched); the depth-1 pipeline keeps a
+    block in flight through consumption, driving the gap to ~dispatch
+    overhead. Completion is only ever *observed* (a blocking value
+    fetch), never assumed, so the metric cannot undercount the serial
+    tax; in pipelined mode it reports the driver-serialized residue.
+    """
+
+    inflight: int = 0
+    gap_s: float = 0.0
+    _first_dispatch: float | None = None
+    _last_complete: float | None = None
+    _last_event: float | None = None
+
+    def dispatched(self) -> None:
+        now = time.perf_counter()
+        if self._first_dispatch is None:
+            self._first_dispatch = now
+        if self.inflight == 0 and self._last_complete is not None:
+            self.gap_s += now - self._last_complete
+        self.inflight += 1
+        self._last_event = now
+
+    def completed(self) -> None:
+        now = time.perf_counter()
+        self.inflight = max(0, self.inflight - 1)
+        self._last_complete = now
+        self._last_event = now
+
+    def finish(self) -> None:
+        """Close the accounting window at end-of-run: host work after
+        the LAST block's observed completion (its trajectory writes,
+        the final cadence checkpoint, the writer drain) is idle time
+        with nothing in flight — without this call it would fall
+        outside both gap_s and span_s and bias the serial tax low
+        (review finding)."""
+        now = time.perf_counter()
+        if self.inflight == 0 and self._last_complete is not None:
+            self.gap_s += now - self._last_complete
+            self._last_complete = now
+        self._last_event = now
+
+    @property
+    def span_s(self) -> float:
+        if self._first_dispatch is None or self._last_event is None:
+            return 0.0
+        return self._last_event - self._first_dispatch
+
+    @property
+    def host_gap_frac(self) -> float | None:
+        span = self.span_s
+        return self.gap_s / span if span > 0 else None
 
 
 def throughput(

@@ -68,6 +68,32 @@ class TrajectoryWriter:
             json.dump(manifest, f, indent=2)
 
 
+class AsyncTrajectoryWriter:
+    """Hands ``record`` calls to a shared :class:`~gravity_tpu.utils.
+    hostio.HostWriter` so frame serialization + .npy/.gtrj flushes run
+    off the block loop's critical path (docs/scaling.md "Host pipeline
+    & donation"). Pure ordering-preserving wrapper around any writer
+    with the ``record``/``close`` interface: the single background
+    thread replays calls FIFO, so the artifacts are bitwise identical
+    to the wrapped writer's serial output. ``close`` drains the queue
+    (surfacing any background write failure) before closing the inner
+    writer — an unterminated GTRJ tail or missing manifest cannot be
+    hidden by the queue."""
+
+    def __init__(self, inner, writer):
+        self._inner = inner
+        self._writer = writer
+
+    def record(self, step: int, positions) -> None:
+        # ``positions`` must be host data the caller no longer mutates
+        # (the run loop hands over freshly fetched frame arrays).
+        self._writer.submit(self._inner.record, step, positions)
+
+    def close(self) -> None:
+        self._writer.barrier()
+        self._inner.close()
+
+
 class NativeTrajectoryWriter:
     """Trajectory sink backed by the C++ async writer (runtime/ GTRJ format).
 

@@ -1,16 +1,20 @@
 #!/usr/bin/env bash
-# The documented pre-push check (`make smoke`): the fast contract lane
-# plus a 2-job ensemble serving e2e through the real CLI daemon on CPU.
-# Exits nonzero on any failure. ~6 min on a laptop-class CPU.
+# The documented pre-push check (`make smoke`): the fast contract lane,
+# a 2-job ensemble serving e2e through the real CLI daemon, and the async
+# host-pipeline e2e (cadence run + SIGTERM + resume), all on CPU.
+# Exits nonzero on any failure. ~7 min on a laptop-class CPU.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 export JAX_PLATFORMS=cpu
 
-echo "== smoke 1/2: pytest -m fast (contract + oracle-parity lane) =="
-python -m pytest tests/ -q -m fast -p no:cacheprovider
+echo "== smoke 1/3: pytest -m 'fast and not slow' (contract + oracle-parity lane) =="
+# "fast and not slow": module-level fast marks would otherwise pull a
+# file's slow-marked wall-clock tests into the lane (pytest -m fast
+# selects anything CARRYING the mark; it does not exclude slow).
+python -m pytest tests/ -q -m "fast and not slow" -p no:cacheprovider
 
-echo "== smoke 2/2: 2-job ensemble serving e2e (CLI daemon) =="
+echo "== smoke 2/3: 2-job ensemble serving e2e (CLI daemon) =="
 SPOOL="$(mktemp -d /tmp/gravity_smoke.XXXXXX)"
 cleanup() {
     # Best-effort daemon shutdown + spool removal.
@@ -61,6 +65,42 @@ metrics = request(spool, "GET", "/metrics")
 assert all(v == 1 for v in metrics["compile_counts"].values()), metrics
 print("ensemble e2e OK:", {j: s["status"] for j, s in statuses.items()},
       "| compiles:", metrics["compile_counts"])
+EOF
+
+echo "== smoke 3/3: async host pipeline e2e (cadence run + SIGTERM + resume) =="
+IODIR="$(mktemp -d /tmp/gravity_smoke_io.XXXXXX)"
+trap 'cleanup; rm -rf "$IODIR"' EXIT
+# Cadence-on pipelined run; preempt@500 delivers a real SIGTERM to the
+# process mid-flight (utils/faults.py) -> checkpoint + exit 75.
+RC=0
+GRAVITY_TPU_FAULTS="preempt@500" python -m gravity_tpu run \
+    --model plummer --n 64 --steps 1000 --dt 3600 --eps 1e9 \
+    --integrator leapfrog --force-backend dense --io-pipeline on \
+    --trajectories --trajectory-every 5 --progress-every 50 \
+    --checkpoint-every 200 --checkpoint-dir "$IODIR/ckpt" \
+    --log-dir "$IODIR/logs" >"$IODIR/run.out" 2>&1 || RC=$?
+[ "$RC" -eq 75 ] || {
+    echo "expected preemption exit 75, got $RC"; cat "$IODIR/run.out";
+    exit 1;
+}
+python -m gravity_tpu resume --checkpoint-dir "$IODIR/ckpt" \
+    --model plummer --n 64 --steps 1000 --dt 3600 --eps 1e9 \
+    --integrator leapfrog --force-backend dense --io-pipeline on \
+    --log-dir "$IODIR/logs" >"$IODIR/resume.out" 2>&1 || {
+    echo "resume after preemption failed"; cat "$IODIR/resume.out";
+    exit 1;
+}
+python - "$IODIR" <<'EOF'
+import glob, json, sys
+root = sys.argv[1]
+line = [l for l in open(f"{root}/resume.out") if l.startswith("{")][-1]
+stats = json.loads(line)
+assert stats["io_pipeline"] == "on", stats
+assert stats["host_gap_frac"] is not None, stats
+manifests = glob.glob(f"{root}/logs/trajectories_*/manifest.json")
+assert manifests, "preempted run left no trajectory manifest"
+print("io-pipeline e2e OK: resumed", stats["steps"], "steps,",
+      "host_gap_frac", round(stats["host_gap_frac"], 3))
 EOF
 
 echo "== smoke: all green =="
