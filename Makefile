@@ -11,7 +11,7 @@ smoke:
 	bash scripts/smoke.sh
 
 fast:
-	$(PYTEST) tests/ -q -m 'fast and not slow'
+	$(PYTEST) tests/ -q -m 'fast and not slow and not heavy'
 
 # The tier-1 lane (what CI gates on).
 test:

@@ -166,6 +166,8 @@ def _cadence_main(steps: int, backend: str) -> int:
         "donated": stats["donated"],
         "record_every": stats["record_every"],
         "checkpoint_every": stats["checkpoint_every"],
+        "autotune_cache": stats.get("autotune_cache"),
+        "autotune_probe_ms": stats.get("autotune_probe_ms"),
     }))
     return 0
 
@@ -235,6 +237,12 @@ def main() -> int:
         # BENCH_CADENCE=1 runs the cadence-on A/B where both are live.
         "host_gap_frac": stats.get("host_gap_frac"),
         "donated": bool(stats.get("donated", False)),
+        # Routing facts (docs/scaling.md "Autotuned routing"): 'auto'
+        # runs report hit/miss against the tuning cache and the probe
+        # cost; explicit backends (incl. the default 'direct') say
+        # "off".
+        "autotune_cache": stats.get("autotune_cache"),
+        "autotune_probe_ms": stats.get("autotune_probe_ms"),
     }
 
     if result["platform"] == "tpu":
@@ -261,6 +269,8 @@ def main() -> int:
                     "platform",
                     "flops_per_pair",
                     "achieved_tflops",
+                    "autotune_cache",
+                    "autotune_probe_ms",
                 )
             }
         else:

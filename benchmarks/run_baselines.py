@@ -113,6 +113,15 @@ CONFIGS = {
              integrator="leapfrog", force_backend="sfmm"),
         dict(bench_steps=3),
     ),
+    "2m-pallas": (
+        "2x1M-body galaxy merger, Pallas direct sum (the baseline-2m "
+        "preset: the 2M direct-sum datum at the largest BASELINE scale "
+        "— VERDICT r5 item 6; TPU-only at useful speed, `validate "
+        "--tpu` runs its 3-step form when a chip is reachable)",
+        dict(model="merger", n=2_097_152, g=1.0, dt=2.0e-3, eps=0.05,
+             integrator="leapfrog", force_backend="pallas"),
+        dict(bench_steps=3),
+    ),
     "2m-fmm": (
         "2x1M-body galaxy merger, dense-grid FMM (single-chip, "
         "gather-free)",

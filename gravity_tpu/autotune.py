@@ -1,0 +1,499 @@
+"""Measurement-driven backend autotuner: probe, persist, route.
+
+``--force-backend auto`` means "the measured-fastest eligible solver",
+not "whatever a static n-threshold guesses" (VERDICT r5 item 4). On the
+first encounter of a configuration key — ``(backend candidates, n,
+dtype, mesh shape, strategy, platform, device kind, occupancy
+signature)`` — this module times every *eligible* candidate on the real
+compiled step (the Simulator's own jitted 1-step block, warm-up and the
+sync fence's per-shape jit excluded via :func:`~gravity_tpu.utils.
+timing.warm_sync`, 2 timed steps each), picks the winner, and persists
+the verdict in an on-disk tuning cache so every later run of the same
+configuration routes instantly — probe-on-miss, instant-on-hit. This is
+the runtime-autotuning pattern HOOMD-blue uses to hold peak throughput
+across problem shapes (PAPERS: "General-purpose molecular dynamics
+simulations on GPU-based clusters"); FDPS's accelerator work shows the
+same lesson for solver selection (PAPERS: "Accelerated FDPS").
+
+Cache layout (docs/scaling.md "Autotuned routing"): one JSON file per
+key under :func:`tuning_dir` (default ``~/.cache/gravity_tpu/tuning/``,
+override with ``GRAVITY_TPU_TUNE_DIR``), named by a stable SHA-256 of
+the canonical key. Each record carries the producing environment's
+jax/jaxlib/libtpu versions — a version change invalidates the entry
+(the ranking may have moved with the compiler), and the next run simply
+re-probes and overwrites.
+
+Candidates that raise :class:`~gravity_tpu.utils.faults.
+BackendUnavailable` (missing toolchain, injected fault) or fail their
+own sizing/build checks are skipped and the skip reason recorded.
+Direct-sum candidates are skipped entirely above a per-platform pair
+budget (probing a 1M-body O(N^2) sum on CPU would cost minutes to
+conclude what the budget already knows); the fast solvers join the
+candidate set from ``FAST_PROBE_MIN`` up, where the measured CPU tree
+crossover (~32k) and every chip crossover live comfortably above the
+probe's own cost.
+
+Consumers:
+
+- ``Simulator`` (``gravity_tpu/simulation.py``): plain ``auto`` routes
+  through :func:`resolve_backend_measured`; the decision lands in run
+  stats (``autotune_cache``, ``autotune_probe_ms``) and the BENCH JSON
+  line.
+- The serve scheduler routes every submitted job through the same cache
+  at admission via :func:`resolve_engine_backend` (probing happens at
+  submit time, never inside a scheduling round).
+- ``gravity_tpu tune`` pre-warms the cache over a size ladder (the
+  measured-routing analog of ``benchmarks/crossover.py``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import math
+import os
+import time
+from typing import NamedTuple, Optional
+
+import numpy as np
+
+from .utils.faults import BackendUnavailable
+
+# Timed steps per candidate (after one untimed warm-up step that also
+# compiles the block and the sync fence). 2-3 steps is enough: the
+# candidates differ by integer factors, not percent (docs/scaling.md).
+PROBE_STEPS = 2
+
+# Below this n the fast solvers never enter the candidate set: the
+# exact direct-sum ladder is already measurement-backed there (BASELINE
+# 1k/16k rows; CPU tree crossover measured at ~32k), and probing
+# tree/fmm/sfmm on every small run would cost more in compiles than the
+# routing could ever return. GRAVITY_TPU_AUTOTUNE_MIN_N overrides (the
+# smoke round-trip and tests lower it to exercise real probes at
+# seconds-cheap sizes).
+FAST_PROBE_MIN = 16_384
+
+
+def fast_probe_min() -> int:
+    try:
+        return int(os.environ["GRAVITY_TPU_AUTOTUNE_MIN_N"])
+    except (KeyError, ValueError):
+        return FAST_PROBE_MIN
+
+# Pair budget above which a direct-sum candidate is skipped rather than
+# probed (n*(n-1) directed pairs per force evaluation). CPU: ~3.4e10
+# pairs is already ~10 s/eval on host cores — past it the budget, not a
+# probe, rules direct out. TPU: the Pallas kernel holds ~1.8e11
+# pairs/s/chip, so even the 8M tree-crossover region probes in seconds.
+DIRECT_PROBE_PAIR_BUDGET = {"cpu": 1 << 35, "tpu": 1 << 46}
+
+_mem_cache: dict[str, dict] = {}
+_counters = {"probes": 0, "probe_steps": 0}
+
+
+def tuning_dir() -> str:
+    """The on-disk tuning cache directory. ``GRAVITY_TPU_TUNE_DIR``
+    overrides the default (tests and the smoke round-trip point it at a
+    throwaway dir)."""
+    return os.environ.get("GRAVITY_TPU_TUNE_DIR") or os.path.join(
+        os.path.expanduser("~"), ".cache", "gravity_tpu", "tuning"
+    )
+
+
+def probe_counters() -> dict:
+    """Process-lifetime probe counters: ``probes`` (candidates timed)
+    and ``probe_steps`` (timed steps run). The serve acceptance test
+    asserts ``probe_steps`` stays flat across scheduling rounds, and
+    the smoke round-trip asserts a cache-hit run leaves it at zero."""
+    return dict(_counters)
+
+
+def versions() -> dict:
+    """The environment facts that invalidate a tuning record: a jax /
+    jaxlib / libtpu upgrade can reorder the candidates, so a record from
+    another version is a miss, not a stale hit."""
+    import jax
+
+    v = {"jax": jax.__version__}
+    try:
+        import jaxlib
+
+        v["jaxlib"] = (
+            getattr(jaxlib, "__version__", None)
+            or jaxlib.version.__version__
+        )
+    except Exception:  # noqa: BLE001
+        v["jaxlib"] = "unknown"
+    try:
+        import importlib.metadata as _md
+
+        v["libtpu"] = _md.version("libtpu")
+    except Exception:  # noqa: BLE001
+        v["libtpu"] = "none"
+    return v
+
+
+def occupancy_signature(positions, side: int = 16) -> str:
+    """Coarse clustering bucket for the cache key: the occupied
+    fraction of a ``side``^3 grid over the bounding cube, quantized to
+    powers of two. A clustered merger and a uniform cube must not share
+    a tuning verdict (the sparse-FMM cost is occupancy-proportional),
+    but per-seed jitter must not force a re-probe — hence the log2
+    bucketing. ``"na"`` when positions are unavailable or not fully
+    addressable (multi-host shards)."""
+    from .utils.platform import host_positions
+
+    pos = host_positions(positions)
+    if pos is None:
+        return "na"
+    lo = pos.min(axis=0)
+    span = float(np.max(pos.max(axis=0) - lo)) or 1.0
+    u = np.clip(
+        ((pos - lo[None, :]) / span * side).astype(np.int64), 0, side - 1
+    )
+    ids = (u[:, 0] * side + u[:, 1]) * side + u[:, 2]
+    occ = np.unique(ids).size / float(side**3)
+    return f"occ2^{int(round(math.log2(max(occ, side ** -3.0))))}"
+
+
+def eligible_candidates(config, on_tpu: bool) -> tuple[tuple, dict]:
+    """(candidates, skipped): the backends worth timing for this
+    configuration, plus the reasons anything obvious was excluded.
+
+    - The exact direct-sum ladder contributes its scale-appropriate
+      member (``_resolve_direct``) — plus the MXU formulation on TPU,
+      where the VPU-vs-MXU ranking is exactly what a measurement should
+      decide — unless the pair count is over the probe budget.
+    - The fast solvers (tree / dense-grid fmm / sparse fmm) join from
+      ``FAST_PROBE_MIN`` up. The ring strategy excludes them (a ring
+      over source shards can never assemble the global tree/mesh), and
+      a periodic box never reaches here (pm is the only periodic
+      solver).
+    """
+    from .simulation import _resolve_direct
+
+    skipped: dict[str, str] = {}
+    budget = DIRECT_PROBE_PAIR_BUDGET["tpu" if on_tpu else "cpu"]
+    pairs = config.n * (config.n - 1)
+    cands: list[str] = []
+    direct = _resolve_direct(config, on_tpu)
+    if pairs <= budget:
+        cands.append(direct)
+        if on_tpu and direct == "pallas":
+            cands.append("pallas-mxu")
+    else:
+        skipped[direct] = (
+            f"direct sum: {pairs:.3g} pairs/eval exceeds the "
+            f"{budget:.3g} probe budget on this platform"
+        )
+    if config.sharding == "ring":
+        skipped["tree/fmm/sfmm"] = (
+            "ring sharding streams sources and cannot build a global "
+            "tree/mesh"
+        )
+    elif config.n >= fast_probe_min():
+        cands += ["tree", "fmm", "sfmm"]
+    else:
+        skipped["tree/fmm/sfmm"] = (
+            f"n={config.n} below the fast-probe floor "
+            f"{fast_probe_min()} (direct ladder is measurement-backed "
+            "there)"
+        )
+    return tuple(cands), skipped
+
+
+def make_key(
+    config, *, candidates, platform: str, device_kind: str, occupancy: str
+) -> dict:
+    """The canonical configuration key — everything whose change should
+    re-open the question "which backend is fastest here". Besides the
+    shape facts, that includes the solver-tuning knobs: a forced tree
+    depth, a changed leaf cap, or a pinned fmm layout build materially
+    different candidate programs, so runs differing in any of them must
+    not share a persisted verdict."""
+    return {
+        "candidates": list(candidates),
+        "n": config.n,
+        "dtype": config.dtype,
+        "mesh_shape": (
+            list(config.mesh_shape) if config.mesh_shape else None
+        ),
+        "strategy": config.sharding,
+        "platform": platform,
+        "device_kind": device_kind,
+        "occupancy": occupancy,
+        "knobs": {
+            "tree_depth": config.tree_depth,
+            "tree_leaf_cap": config.tree_leaf_cap,
+            "tree_ws": config.tree_ws,
+            "tree_far": config.tree_far,
+            "fmm_mode": config.fmm_mode,
+            "chunk": config.chunk,
+            "fast_chunk": config.fast_chunk,
+            "cutoff": config.cutoff,
+        },
+    }
+
+
+def key_hash(key: dict) -> str:
+    return hashlib.sha256(
+        json.dumps(key, sort_keys=True).encode()
+    ).hexdigest()[:20]
+
+
+def _record_path(h: str) -> str:
+    return os.path.join(tuning_dir(), f"{h}.json")
+
+
+def _load_record(h: str, key: dict) -> Optional[dict]:
+    """A cached verdict, or None on miss. Stale entries — version
+    mismatch, winner no longer in the candidate set, unparseable —
+    are misses (the re-probe overwrites them)."""
+    rec = _mem_cache.get(h)
+    if rec is None:
+        try:
+            with open(_record_path(h)) as f:
+                rec = json.load(f)
+        except (OSError, ValueError):
+            return None
+    if not isinstance(rec, dict):
+        return None
+    if rec.get("versions") != versions():
+        return None
+    winner = rec.get("winner")
+    if winner not in key["candidates"]:
+        return None
+    _mem_cache[h] = rec
+    return rec
+
+
+def _store_record(h: str, rec: dict) -> None:
+    _mem_cache[h] = rec
+    try:
+        os.makedirs(tuning_dir(), exist_ok=True)
+        path = _record_path(h)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(rec, f, indent=1)
+        os.replace(tmp, path)  # atomic: concurrent probes race benignly
+    except OSError:
+        pass  # a read-only cache dir must never fail the run
+
+
+class AutotuneDecision(NamedTuple):
+    backend: str
+    # "hit" (cache), "miss" (probed + persisted), "static" (no timeable
+    # candidate — the static router's choice), "off" (autotune disabled
+    # or not applicable).
+    cache: str
+    probe_ms: float
+    timings_s: dict
+    skipped: dict
+    key_hash: str
+
+
+def _time_backend(config, backend: str, state, probe_steps: int) -> float:
+    """Seconds per step of THE REAL COMPILED STEP for one candidate:
+    build the candidate's Simulator around the shared initial state,
+    run one untimed step (compiles the block AND the fence's per-shape
+    jit — utils/timing.warm_sync), then time ``probe_steps`` steps
+    behind a genuine value-fetch fence."""
+    from .ops.integrators import init_carry
+    from .simulation import Simulator
+    from .utils.timing import sync, warm_sync
+
+    cfg = dataclasses.replace(config, force_backend=backend)
+    sim = Simulator(cfg, state=state)
+    st = sim.state
+    acc = init_carry(sim.accel_fn, st)
+    st, acc, _ = sim._run_block(st, acc, n_steps=1, record=False)
+    warm_sync(st.positions)
+    t0 = time.perf_counter()
+    for _ in range(probe_steps):
+        st, acc, _ = sim._run_block(st, acc, n_steps=1, record=False)
+        _counters["probe_steps"] += 1
+    sync(st.positions)
+    return (time.perf_counter() - t0) / max(1, probe_steps)
+
+
+def resolve_backend_measured(
+    config,
+    state,
+    *,
+    candidates: Optional[tuple] = None,
+    occupancy: Optional[str] = None,
+    probe_steps: int = PROBE_STEPS,
+    refresh: bool = False,
+    static_fallback: Optional[str] = None,
+) -> AutotuneDecision:
+    """The tentpole entry point: the measured-fastest backend for this
+    configuration — instantly from the cache when the key is known,
+    via a micro-probe of every eligible candidate when it is not.
+
+    ``state`` is the run's (unsharded, unpadded) initial state: every
+    candidate probes against the SAME bodies, and its positions feed
+    the occupancy signature. It may be a zero-arg thunk (the serve
+    admission path passes one): the thunk is only called when a probe
+    is actually needed, so a cache hit never pays the state build —
+    PROVIDED the caller also supplies ``occupancy`` (without it, the
+    signature needs the positions before the key can even be hashed,
+    and the thunk is materialized up front).
+    ``candidates``/``occupancy`` override the
+    derived values (the serve admission path and tests use this);
+    ``refresh`` forces a re-probe (``gravity_tpu tune --refresh``).
+    When no candidate survives, falls back to ``static_fallback`` (or
+    the static router) with ``cache="static"``.
+    """
+    import jax
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    skipped: dict[str, str] = {}
+    if candidates is None:
+        candidates, skipped = eligible_candidates(config, on_tpu)
+    if occupancy is None:
+        if callable(state):
+            state = state()
+        occupancy = occupancy_signature(
+            state.positions if state is not None else None
+        )
+    key = make_key(
+        config, candidates=candidates, platform=dev.platform,
+        device_kind=str(dev.device_kind), occupancy=occupancy,
+    )
+    h = key_hash(key)
+    if not refresh:
+        rec = _load_record(h, key)
+        if rec is not None:
+            return AutotuneDecision(
+                rec["winner"], "hit", 0.0,
+                rec.get("timings_s", {}), rec.get("skipped", {}), h,
+            )
+
+    def _static() -> str:
+        if static_fallback is not None:
+            return static_fallback
+        from .simulation import _resolve_backend
+
+        return _resolve_backend(config)
+
+    if not candidates:
+        return AutotuneDecision(_static(), "static", 0.0, {}, skipped, h)
+    if len(candidates) == 1:
+        # Nothing to choose between — timing the lone candidate would
+        # pay a second compile of the very program the run is about to
+        # build, to learn nothing. This is the common small-n case
+        # (every sub-floor run: only the direct ladder member), so it
+        # must stay free.
+        return AutotuneDecision(
+            candidates[0], "static", 0.0, {}, skipped, h
+        )
+
+    if callable(state):
+        # Lazy state (serve admission): the bucket-size ICs are only
+        # built on a confirmed miss — a cache hit must stay free.
+        try:
+            state = state()
+        except Exception as e:  # noqa: BLE001 — a config that cannot
+            # build ICs still gets the static route; the caller's own
+            # admission validates the real config.
+            skipped["state"] = f"{type(e).__name__}: {e}"
+            return AutotuneDecision(
+                _static(), "static", 0.0, {}, skipped, h
+            )
+
+    t0 = time.perf_counter()
+    timings: dict[str, float] = {}
+    for backend in candidates:
+        try:
+            timings[backend] = _time_backend(
+                config, backend, state, probe_steps
+            )
+            _counters["probes"] += 1
+        except BackendUnavailable as e:
+            skipped[backend] = str(e)
+        except Exception as e:  # noqa: BLE001 — a candidate that cannot
+            # build/size itself here is exactly a candidate to skip; the
+            # reason is persisted so the skip is auditable, and the run
+            # proceeds on whatever did probe.
+            skipped[backend] = f"{type(e).__name__}: {e}"
+    probe_ms = (time.perf_counter() - t0) * 1e3
+    if not timings:
+        return AutotuneDecision(
+            _static(), "static", probe_ms, {}, skipped, h
+        )
+    winner = min(timings, key=timings.get)
+    _store_record(h, {
+        "key": key,
+        "winner": winner,
+        "timings_s": timings,
+        "skipped": skipped,
+        "probe_steps": probe_steps,
+        "probe_ms": round(probe_ms, 3),
+        "versions": versions(),
+        "created_at": time.strftime(
+            "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+        ),
+    })
+    return AutotuneDecision(winner, "miss", probe_ms, timings, skipped, h)
+
+
+def engine_candidates(on_tpu: bool) -> tuple:
+    """The engine backends worth timing for a serve bucket — the cheap
+    deterministic subset of ``serve.engine.ENGINE_BACKENDS``. On CPU
+    the batched dense contraction is the only measured-sane shape, so
+    admission routing is free; on TPU the dense-vs-Pallas(-MXU) ranking
+    at each bucket is a genuine question the probe answers. Module-level
+    so tests can widen the CPU set and exercise real admission probes."""
+    return ("dense", "pallas", "pallas-mxu") if on_tpu else ("dense",)
+
+
+def resolve_engine_backend(config, *, min_bucket: int = 16) -> AutotuneDecision:
+    """Serve-admission routing: the measured-fastest ENGINE backend for
+    a job's padded bucket. Keyed on the bucket size (jobs sharing a
+    bucket share a verdict, exactly like they share a compiled batch
+    program) with the ``"serve"`` occupancy marker — the vmapped lanes
+    integrate many different models through one program, so per-model
+    occupancy would fragment the cache for no routing gain.
+
+    Candidates are the cheap deterministic subset of the engine's
+    backends: on CPU the batched dense contraction is the only
+    measured-sane shape (``serve/engine.py``); on TPU the
+    dense-vs-Pallas(-MXU) ranking at each bucket is a genuine question
+    the probe answers. The probe itself runs here — at SUBMIT time —
+    never inside a scheduling round.
+
+    What gets timed is the SOLO bucket-size kernel, a proxy for the
+    engine's vmapped ``(slots, n, n)`` program: the exact program
+    cannot exist at admission (``BatchKey`` includes the slot count,
+    which the scheduler only fixes when it packs the round), so the
+    probe ranks the per-lane kernels and assumes vmap preserves the
+    ordering. If a chip A/B ever shows the batched ranking inverting,
+    the fix is a slots axis in the key, probed lazily at first pack.
+    """
+    import jax
+
+    from .serve.engine import bucket_size
+    from .simulation import make_initial_state
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    bucket = bucket_size(config.n, min_bucket)
+    candidates = engine_candidates(on_tpu)
+    if len(candidates) == 1:
+        # One sane shape on this platform: admission routing is free —
+        # no probe state, no Simulator build, nothing to persist.
+        return AutotuneDecision(candidates[0], "static", 0.0, {}, {}, "")
+    cfg = dataclasses.replace(
+        config, n=bucket, force_backend="dense", sharding="none",
+        mesh_shape=None, integrator=(
+            config.integrator
+            if config.integrator in ("euler", "leapfrog", "verlet",
+                                     "yoshida4")
+            else "leapfrog"
+        ),
+    )
+    return resolve_backend_measured(
+        cfg, lambda: make_initial_state(cfg), candidates=candidates,
+        occupancy="serve", static_fallback="dense",
+    )

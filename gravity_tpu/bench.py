@@ -74,6 +74,11 @@ def run_benchmark(
         sharding=config.sharding,
         dtype=config.dtype,
         platform=jax.devices()[0].platform,
+        # Routing provenance: did 'auto' hit the tuning cache, and what
+        # did the probe cost (0 on hit / off)? docs/scaling.md
+        # "Autotuned routing".
+        autotune_cache=sim.autotune["cache"],
+        autotune_probe_ms=sim.autotune["probe_ms"],
     )
     # Roofline position (docs/scaling.md "MXU formulation & roofline"):
     # achieved TFLOP/s from the per-formulation flops-per-pair model,

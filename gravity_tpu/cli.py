@@ -62,6 +62,12 @@ def _add_config_args(p: argparse.ArgumentParser) -> None:
                    choices=["auto", "dense", "sparse"], default=None,
                    help="fmm layout: sparse = occupied-cell compaction "
                         "for clustered states (auto picks by occupancy)")
+    p.add_argument("--no-autotune", dest="autotune",
+                   action="store_false", default=None,
+                   help="disable measurement-driven routing for "
+                        "--force-backend auto (static n-threshold "
+                        "router only; docs/scaling.md 'Autotuned "
+                        "routing')")
     p.add_argument("--chunk", type=int, default=None)
     p.add_argument("--tree-depth", dest="tree_depth", type=int, default=None)
     p.add_argument("--tree-leaf-cap", dest="tree_leaf_cap", type=int,
@@ -1067,6 +1073,37 @@ def _validate_tpu_battery(checks: dict) -> None:
         "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
     }
 
+    # 2M direct-sum datum (VERDICT r5 item 6): 3 steps of the
+    # baseline-2m preset — the largest BASELINE scale on the backend
+    # the router sends it to. TPU-only: on CPU the battery skips it
+    # cleanly (4.4e12 pairs/step is hours on host cores). When this
+    # fires live, copy the row into BASELINE.md (`benchmarks/
+    # run_baselines.py 2m-pallas` prints the markdown form).
+    if on_tpu:
+        from .config import PRESETS
+
+        stats_2m = run_benchmark(PRESETS["baseline-2m"], bench_steps=3)
+        pps_2m = stats_2m["pairs_per_sec_per_chip"]
+        checks["tpu_2m_direct_3step"] = {
+            "n": stats_2m["n"],
+            "backend": stats_2m["backend"],
+            "pairs_per_sec_per_chip": pps_2m,
+            "avg_step_s": stats_2m["avg_step_s"],
+            # The kernel's measured rate barely moves 1M -> 2M (the
+            # j-stream only gets easier to amortize); half the 262k
+            # regression bar is a generous floor.
+            "ok": pps_2m > 5.0e10,
+            "measured_at": time.strftime(
+                "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+            ),
+            "note": "record in BASELINE.md (run_baselines.py 2m-pallas)",
+        }
+    else:
+        checks["tpu_2m_direct_3step"] = {
+            "skipped": "no TPU (2M direct sum is hours on CPU)",
+            "ok": True,
+        }
+
 
 def cmd_analyze(args: argparse.Namespace) -> int:
     """Structure + conserved-quantity report for a checkpointed state (or
@@ -1685,6 +1722,70 @@ def cmd_cancel(args: argparse.Namespace) -> int:
     return 0 if resp.get("cancelled") else 1
 
 
+def cmd_tune(args: argparse.Namespace) -> int:
+    """Pre-warm the autotune cache over a size ladder — the measured-
+    routing analog of ``benchmarks/crossover.py``'s sweep (same default
+    ladders, same one-JSON-line-per-point reporting), so a cluster
+    image or a long campaign pays every probe ONCE, up front, instead
+    of on the first real run of each size (docs/scaling.md "Autotuned
+    routing")."""
+    import dataclasses as _dc
+
+    import jax
+
+    from .autotune import (
+        probe_counters,
+        resolve_backend_measured,
+        tuning_dir,
+    )
+    from .simulation import _resolve_backend, make_initial_state
+
+    _maybe_distributed(args)
+    config = build_config(args)
+    # Mirror the Simulator's routing gate (_resolve_backend_for_run):
+    # a config the runtime router never tunes — autotuning disabled or
+    # periodic (pm is the only periodic solver) — has nothing to
+    # pre-warm; probing it would build doomed candidate Simulators and
+    # persist verdicts no run will ever consult.
+    if not config.autotune or config.periodic_box > 0.0:
+        reason = (
+            "autotuning disabled (--no-autotune)"
+            if not config.autotune
+            else "periodic runs route statically (pm is the only "
+            "periodic solver)"
+        )
+        print(f"error: nothing to tune: {reason}", file=sys.stderr)
+        return 2
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if args.sizes:
+        sizes = sorted({int(s) for s in args.sizes})
+    elif on_tpu:
+        sizes = [65_536, 131_072, 262_144, 524_288, 1_048_576]
+    else:
+        sizes = [8_192, 16_384, 32_768, 65_536]
+    for n in sizes:
+        cfg = _dc.replace(config, n=n, force_backend="auto")
+        state = make_initial_state(cfg)
+        before = probe_counters()["probe_steps"]
+        decision = resolve_backend_measured(
+            cfg, state, refresh=args.refresh,
+            static_fallback=_resolve_backend(cfg),
+        )
+        print(json.dumps({
+            "n": n,
+            "backend": decision.backend,
+            "cache": decision.cache,
+            "probe_ms": round(decision.probe_ms, 1),
+            "probe_steps": probe_counters()["probe_steps"] - before,
+            "timings_s": {
+                k: round(v, 6) for k, v in decision.timings_s.items()
+            },
+            "skipped": decision.skipped,
+            "tuning_dir": tuning_dir(),
+        }), flush=True)
+    return 0
+
+
 def cmd_bench(args: argparse.Namespace) -> int:
     from .bench import run_benchmark, run_cadence_benchmark
 
@@ -1933,6 +2034,21 @@ def main(argv=None) -> int:
     p_cosmo.add_argument("--out-dir", dest="out_dir",
                          default="gravity_logs_cosmo")
     p_cosmo.set_defaults(fn=cmd_cosmo)
+
+    p_tune = sub.add_parser(
+        "tune",
+        help="pre-warm the backend autotune cache over a size ladder "
+             "(probe-on-miss, instant-on-hit; docs/scaling.md "
+             "'Autotuned routing')",
+    )
+    _add_config_args(p_tune)
+    p_tune.add_argument("--sizes", type=int, nargs="+", default=None,
+                        help="N ladder to pre-warm (default: the "
+                             "crossover.py ladder for this platform)")
+    p_tune.add_argument("--refresh", action="store_true",
+                        help="re-probe even on a cache hit (overwrite "
+                             "the stored verdicts)")
+    p_tune.set_defaults(fn=cmd_tune)
 
     p_bench = sub.add_parser("bench", help="throughput benchmark")
     _add_config_args(p_bench)

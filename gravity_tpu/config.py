@@ -53,6 +53,14 @@ class SimulationConfig:
     # clustered-state layout; fmm + fmm_mode is the usual entry) |
     # pm (FFT mesh) | p3m (FFT mesh + cell-list pair correction)
     force_backend: str = "auto"
+    # Measurement-driven routing for force_backend='auto'
+    # (gravity_tpu/autotune.py; docs/scaling.md "Autotuned routing"):
+    # on the first encounter of a configuration key the eligible
+    # candidates are micro-probed on the real compiled step and the
+    # winner persisted to the on-disk tuning cache (probe-on-miss,
+    # instant-on-hit; GRAVITY_TPU_TUNE_DIR overrides the cache dir).
+    # False = the static n-threshold router only (--no-autotune).
+    autotune: bool = True
     # fmm layout: "dense" (shifted-slice grids, quasi-uniform states) |
     # "sparse" (occupied-cell compaction, ops/sfmm.py — clustered
     # states; chunk-sharded on a mesh) | "auto" = sparse when the
@@ -212,5 +220,14 @@ PRESETS = {
     "baseline-2m-merger": SimulationConfig(
         model="merger", n=2_097_152, integrator="leapfrog",
         force_backend="pallas", sharding="ring", g=1.0, dt=2.0e-3, eps=0.05,
+    ),
+    # Single-chip 2M direct sum (VERDICT r5 item 6): the largest
+    # BASELINE scale on the backend the measured router sends it to —
+    # the `validate --tpu` battery runs this 3 steps when a chip is
+    # reachable (and skips cleanly on CPU, where 4.4e12 pairs/step is
+    # hours) to record the 2M datum in BASELINE.md.
+    "baseline-2m": SimulationConfig(
+        model="merger", n=2_097_152, integrator="leapfrog",
+        force_backend="pallas", g=1.0, dt=2.0e-3, eps=0.05,
     ),
 }

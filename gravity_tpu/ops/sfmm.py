@@ -955,6 +955,24 @@ def resolve_sfmm_sizing(positions, tree_depth: int, tree_leaf_cap: int):
     return depth, cap, k_cells
 
 
+def sfmm_auto_decision(positions, tree_leaf_cap: int):
+    """``fmm_mode='auto'`` occupancy routing — the ONE decision shared
+    by the single-host and mesh accel builds (they drifted apart would
+    mean mesh and solo runs of the same state routing differently).
+    Returns ``(sparse, sizing)``: sparse when the state occupies <5% of
+    its resolving grid's cells — the regime where the dense design's
+    volume-priced passes are ~all empty space (measured: 16.71 s/eval
+    and a degraded error tail at 1M disk vs the sparse layout's
+    occupancy-proportional cost; BASELINE.md 2026-08-01). ``sizing`` is
+    the :func:`recommended_sparse_params` tuple the decision was priced
+    on, reusable by the build when no depth is forced."""
+    sizing = recommended_sparse_params(
+        positions, cap_max=max(32, tree_leaf_cap)
+    )
+    depth, _, _, occ = sizing
+    return occ < 0.05 * (1 << (3 * depth)), sizing
+
+
 def recommended_sparse_params(
     positions,
     cap_max: int = 64,

@@ -92,11 +92,7 @@ def batch_key_for(
     configs outside the ensemble envelope (the caller surfaces it as a
     submit-time rejection, not a mid-batch failure)."""
     backend = config.force_backend
-    if backend in ("auto", "direct"):
-        # Ensemble jobs are small-N by design; the batched dense jnp
-        # form is the measured-right shape (one (B, n, n) contraction).
-        backend = "dense"
-    if backend not in ENGINE_BACKENDS:
+    if backend not in ("auto", "direct") and backend not in ENGINE_BACKENDS:
         raise ValueError(
             f"force_backend {config.force_backend!r} is not servable by "
             f"the ensemble engine (supported: auto/direct/"
@@ -135,6 +131,21 @@ def batch_key_for(
                 f"config.{knob}={val!r} is not servable by the ensemble "
                 "engine; run it solo via `run`"
             )
+    if backend in ("auto", "direct"):
+        # 'auto'/'direct' route through the same measurement-driven
+        # tuning cache as a solo run, keyed on the job's padded bucket
+        # (probe-on-miss at SUBMIT time — admission — never inside a
+        # scheduling round; instant on the hits every later job in the
+        # bucket takes). With autotuning off, the static default is the
+        # batched dense jnp form — one (B, n, n) contraction, the
+        # measured-right small-N shape.
+        backend = "dense"
+        if getattr(config, "autotune", True):
+            from ..autotune import resolve_engine_backend
+
+            backend = resolve_engine_backend(
+                config, min_bucket=min_bucket
+            ).backend
     return BatchKey(
         bucket_n=bucket_size(config.n, min_bucket),
         slots=slots,

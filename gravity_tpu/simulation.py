@@ -223,6 +223,12 @@ def _resolve_backend(config: SimulationConfig, on_tpu=None) -> str:
     # slab-decomposed make_sharded_fmm_accel, multirate fast kicks the
     # rectangular fmm_accelerations_vs. A recorded chip sweep
     # (CROSSOVER_TPU.json) overrides both the threshold and the winner.
+    # (This static route is the probe-free fallback; a Simulator-owned
+    # 'auto' consults the measurement-driven autotune cache FIRST via
+    # _resolve_backend_for_run — gravity_tpu/autotune.py, docs/
+    # scaling.md "Autotuned routing" — so at runtime the crossover
+    # model below only decides when autotuning is off or no candidate
+    # could be probed.)
     crossover, fast_backend = _measured_fast_crossover(on_tpu)
     if config.n >= crossover and config.sharding != "ring":
         if fast_backend == "sfmm" and config.sharding != "none":
@@ -234,6 +240,48 @@ def _resolve_backend(config: SimulationConfig, on_tpu=None) -> str:
             return "fmm"
         return fast_backend
     return _resolve_direct(config, on_tpu)
+
+
+def _resolve_backend_for_run(config: SimulationConfig, state) -> tuple:
+    """(backend, autotune facts) for a Simulator about to run.
+
+    Plain ``force_backend='auto'`` consults the measurement-driven
+    tuning cache (gravity_tpu/autotune.py): instant on a cache hit,
+    a micro-probe of the eligible candidates on a miss — so 'auto'
+    means "measured fastest", not "modeled fastest". Everything else
+    (explicit backends, 'direct', periodic runs — pm is the only
+    periodic solver — or ``autotune=False``) keeps the static
+    resolution, reported as ``cache='off'``. The autotuner must never
+    kill a run: any resolution failure falls back to the static route
+    with a warning.
+    """
+    backend = _resolve_backend(config)
+    off = {"cache": "off", "probe_ms": 0.0}
+    if (
+        config.force_backend != "auto"
+        or not config.autotune
+        or config.periodic_box > 0.0
+    ):
+        return backend, off
+    from .autotune import resolve_backend_measured
+
+    try:
+        d = resolve_backend_measured(
+            config, state, static_fallback=backend
+        )
+    except Exception as e:  # noqa: BLE001 — routing is an optimization;
+        # a broken probe must degrade to the static router, not abort.
+        import warnings
+
+        warnings.warn(
+            f"backend autotune failed ({type(e).__name__}: {e}); "
+            f"falling back to the static route {backend!r}",
+            stacklevel=2,
+        )
+        return backend, off
+    return d.backend, {
+        "cache": d.cache, "probe_ms": round(d.probe_ms, 3)
+    }
 
 
 def _resolve_depth_and_warn(config: SimulationConfig, positions, where,
@@ -428,7 +476,7 @@ def make_local_kernel(config: SimulationConfig, backend: str,
 
         note = check_p3m_sizing(
             config.n, config.pm_grid, config.p3m_sigma_cells,
-            config.p3m_rcut_sigmas, config.p3m_cap,
+            config.p3m_rcut_sigmas, config.p3m_cap, positions=positions,
         )
         if note:
             warnings.warn(note, stacklevel=2)
@@ -558,16 +606,22 @@ class Simulator:
                  state: Optional[ParticleState] = None):
         self.config = config
         self.dtype = resolve_dtype(config.dtype)
-        self.backend = _resolve_backend(config)
         # Which fmm layout the build resolved to (False until an
         # fmm/sfmm accel builder runs; benchmarks introspect this).
         self.fmm_sparse = False
 
+        # State before backend resolution: plain 'auto' routes through
+        # the measurement-driven autotuner (gravity_tpu/autotune.py),
+        # which probes candidates against THIS initial state and keys
+        # its cache on the state's occupancy signature.
         if state is None:
             state = make_initial_state(config)
         else:
             state = state.astype(self.dtype)
         self.n_real = state.n
+        self.backend, self.autotune = _resolve_backend_for_run(
+            config, state
+        )
 
         # Sharding setup: pad N to a multiple of the mesh size, shard the
         # particle axis (the reference pads nothing; zero-mass padding is
@@ -617,21 +671,51 @@ class Simulator:
         # 500-step block would pay 3 extra grid-sized FFTs per step.
         self._accel_setup = None
         self._accel2_aux = None
-        if self.mesh is not None and (
+        mesh_sparse = self.mesh is not None and (
             self.backend == "sfmm"
             or (self.backend == "fmm" and config.fmm_mode == "sparse")
+        )
+        if (
+            self.mesh is not None
+            and not mesh_sparse
+            and self.backend == "fmm"
+            and config.fmm_mode == "auto"
+            and getattr(
+                self.state.positions, "is_fully_addressable", True
+            )
         ):
+            # Occupancy routing fires for EVERY fast-solver selection,
+            # mesh included (VERDICT r5 item 4): a clustered state whose
+            # occupied cells are <5% of the dense grid routes to the
+            # chunk-sharded sparse layout — the same threshold as the
+            # single-host auto decision below, on the same
+            # dryrun-validated make_sharded_sfmm_accel path. Multi-host
+            # meshes (positions not addressable from this host) keep
+            # the dense slab route: the occupancy count needs the
+            # global array.
+            from .ops.sfmm import sfmm_auto_decision
+
+            mesh_sparse, mesh_sizing = sfmm_auto_decision(
+                self.state.positions, config.tree_leaf_cap
+            )
+        else:
+            mesh_sizing = None
+        if mesh_sparse:
             # Chunk-sharded sparse FMM: replicated compaction/eval, the
             # dominant per-cell chunk stages split 1/P per device, one
-            # all_gather per channel. (fmm_mode='auto' on a mesh stays
-            # on the dense slab path below — the conservative default
-            # until the sparse chip numbers land.)
+            # all_gather per channel.
             from .ops.sfmm import make_sharded_sfmm_accel, resolve_sfmm_sizing
 
-            depth_s, cap_s, k_cells = resolve_sfmm_sizing(
-                self.state.positions, config.tree_depth,
-                config.tree_leaf_cap,
-            )
+            if mesh_sizing is not None and not config.tree_depth:
+                # The auto decision above already paid the host-side
+                # O(N) binning; reuse its sizing instead of re-running
+                # the identical pass (mirrors the single-host dedupe).
+                depth_s, cap_s, k_cells, _ = mesh_sizing
+            else:
+                depth_s, cap_s, k_cells = resolve_sfmm_sizing(
+                    self.state.positions, config.tree_depth,
+                    config.tree_leaf_cap,
+                )
             self.fmm_sparse = True
             self._accel2 = make_sharded_sfmm_accel(
                 self.mesh, depth=depth_s, leaf_cap=cap_s,
@@ -814,25 +898,18 @@ class Simulator:
                 chunk=config.fast_chunk, **common,
             )
         if self.backend in ("fmm", "sfmm"):
-            from .ops.sfmm import recommended_sparse_params
+            from .ops.sfmm import sfmm_auto_decision
 
             # Mode resolution (eager, from the initial state): sparse
-            # when explicitly asked, or — in auto — when the state
-            # occupies <5% of its resolving grid's cells, the regime
-            # where the dense design's volume-priced passes are ~all
-            # empty space and its depth rail (<=7) forces cap-overflow
-            # monopoles (measured: 16.71 s/eval and a degraded error
-            # tail at 1M disk on a v5 lite vs the sparse layout's
-            # occupancy-proportional cost; BASELINE.md 2026-08-01).
+            # when explicitly asked, or — in auto — by the shared
+            # occupancy decision (sfmm_auto_decision; same rule the
+            # mesh build applies).
             sizing = None
             sparse = self.backend == "sfmm" or config.fmm_mode == "sparse"
             if self.backend == "fmm" and config.fmm_mode == "auto":
-                sizing = recommended_sparse_params(
-                    self.state.positions,
-                    cap_max=max(32, config.tree_leaf_cap),
+                sparse, sizing = sfmm_auto_decision(
+                    self.state.positions, config.tree_leaf_cap
                 )
-                depth_s, _, _, occ = sizing
-                sparse = occ < 0.05 * (1 << (3 * depth_s))
             if sparse:
                 from .ops.sfmm import resolve_sfmm_sizing, sfmm_accelerations
 
@@ -892,6 +969,7 @@ class Simulator:
             note = check_p3m_sizing(
                 n, config.pm_grid, config.p3m_sigma_cells,
                 config.p3m_rcut_sigmas, config.p3m_cap,
+                positions=self.state.positions,
             )
             if note:
                 warnings.warn(note, stacklevel=2)
@@ -1532,6 +1610,13 @@ class Simulator:
         gap.finish()
         stats["io_pipeline"] = "on" if pipelined else "off"
         stats["donated"] = bool(self.donated)
+        # Routing observability (docs/scaling.md "Autotuned routing"):
+        # which backend actually ran, whether the autotune cache hit,
+        # and what the probe cost — the run-stats half of the
+        # acceptance contract (the BENCH JSON line carries the same).
+        stats["backend"] = self.backend
+        stats["autotune_cache"] = self.autotune["cache"]
+        stats["autotune_probe_ms"] = self.autotune["probe_ms"]
         stats["host_gap_frac"] = gap.host_gap_frac
         self.last_host_gap_frac = gap.host_gap_frac
         if config.merge_radius > 0.0:
@@ -1944,6 +2029,9 @@ class Simulator:
             num_devices=self.mesh.size if self.mesh else 1,
         )
         stats.update(
+            backend=self.backend,
+            autotune_cache=self.autotune["cache"],
+            autotune_probe_ms=self.autotune["probe_ms"],
             t_end=t_end,
             t_reached=t,
             adaptive_steps=steps_taken,

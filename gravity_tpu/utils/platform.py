@@ -131,3 +131,26 @@ def ensure_live_backend(probe_timeout_s: float = 60.0) -> bool:
     )
     _force_cpu()
     return False
+
+
+def host_positions(positions):
+    """Positions as a host fp64 ndarray, or ``None`` when they cannot be
+    read safely — the ONE degradation ladder shared by every host-side
+    geometry probe (the autotune occupancy signature, the P3M
+    thin-geometry check): ``None`` input, non-addressable multi-host
+    shards, exotic array types, wrong rank, empty, or non-finite all
+    degrade to ``None`` so the caller falls back to its neutral value
+    instead of crashing a run over a diagnostic."""
+    import numpy as np
+
+    if positions is None:
+        return None
+    if not getattr(positions, "is_fully_addressable", True):
+        return None
+    try:
+        pos = np.asarray(positions, dtype=np.float64)
+    except Exception:  # noqa: BLE001 — unreadable array type: degrade
+        return None
+    if pos.ndim != 2 or pos.shape[0] == 0 or not np.all(np.isfinite(pos)):
+        return None
+    return pos

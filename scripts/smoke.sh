@@ -1,20 +1,24 @@
 #!/usr/bin/env bash
 # The documented pre-push check (`make smoke`): the fast contract lane,
-# a 2-job ensemble serving e2e through the real CLI daemon, and the async
-# host-pipeline e2e (cadence run + SIGTERM + resume), all on CPU.
+# a 2-job ensemble serving e2e through the real CLI daemon, the async
+# host-pipeline e2e (cadence run + SIGTERM + resume), and the autotune
+# cache round-trip (probe-on-miss, instant-on-hit), all on CPU.
 # Exits nonzero on any failure. ~7 min on a laptop-class CPU.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 export JAX_PLATFORMS=cpu
 
-echo "== smoke 1/3: pytest -m 'fast and not slow' (contract + oracle-parity lane) =="
-# "fast and not slow": module-level fast marks would otherwise pull a
-# file's slow-marked wall-clock tests into the lane (pytest -m fast
-# selects anything CARRYING the mark; it does not exclude slow).
-python -m pytest tests/ -q -m "fast and not slow" -p no:cacheprovider
+echo "== smoke 1/4: pytest -m 'fast and not slow and not heavy' (contract + oracle-parity lane) =="
+# "fast and not slow and not heavy": module-level fast marks would
+# otherwise pull a file's slow-marked wall-clock tests into the lane
+# (pytest -m fast selects anything CARRYING the mark; it does not
+# exclude slow), and `heavy` demotes compile-heavy fast-marked tests
+# to tier-1-only so the contract lane holds <=4:30 (VERDICT r5
+# item 5).
+python -m pytest tests/ -q -m "fast and not slow and not heavy" -p no:cacheprovider
 
-echo "== smoke 2/3: 2-job ensemble serving e2e (CLI daemon) =="
+echo "== smoke 2/4: 2-job ensemble serving e2e (CLI daemon) =="
 SPOOL="$(mktemp -d /tmp/gravity_smoke.XXXXXX)"
 cleanup() {
     # Best-effort daemon shutdown + spool removal.
@@ -67,7 +71,7 @@ print("ensemble e2e OK:", {j: s["status"] for j, s in statuses.items()},
       "| compiles:", metrics["compile_counts"])
 EOF
 
-echo "== smoke 3/3: async host pipeline e2e (cadence run + SIGTERM + resume) =="
+echo "== smoke 3/4: async host pipeline e2e (cadence run + SIGTERM + resume) =="
 IODIR="$(mktemp -d /tmp/gravity_smoke_io.XXXXXX)"
 trap 'cleanup; rm -rf "$IODIR"' EXIT
 # Cadence-on pipelined run; preempt@500 delivers a real SIGTERM to the
@@ -101,6 +105,43 @@ manifests = glob.glob(f"{root}/logs/trajectories_*/manifest.json")
 assert manifests, "preempted run left no trajectory manifest"
 print("io-pipeline e2e OK: resumed", stats["steps"], "steps,",
       "host_gap_frac", round(stats["host_gap_frac"], 3))
+EOF
+
+echo "== smoke 4/4: autotune cache round-trip (probe-on-miss, instant-on-hit) =="
+TUNEDIR="$(mktemp -d /tmp/gravity_smoke_tune.XXXXXX)"
+trap 'cleanup; rm -rf "$IODIR" "$TUNEDIR"' EXIT
+# Fresh cache dir + lowered fast-probe floor so plain `auto` runs a
+# REAL multi-candidate probe at a seconds-cheap n. First run: cache
+# miss, probe cost > 0. Second run of the same configuration: cache
+# hit, zero probe steps — the acceptance contract, asserted via the
+# run-stats JSON both runs print.
+run_auto() {
+    GRAVITY_TPU_TUNE_DIR="$TUNEDIR/cache" \
+    GRAVITY_TPU_AUTOTUNE_MIN_N=256 \
+    python -m gravity_tpu run \
+        --model plummer --n 512 --steps 2 --dt 3600 --eps 1e9 \
+        --integrator leapfrog --force-backend auto \
+        --log-dir "$TUNEDIR/logs$1" >"$TUNEDIR/run$1.out" 2>&1
+}
+run_auto 1 || { echo "auto run 1 failed"; cat "$TUNEDIR/run1.out"; exit 1; }
+run_auto 2 || { echo "auto run 2 failed"; cat "$TUNEDIR/run2.out"; exit 1; }
+python - "$TUNEDIR" <<'EOF'
+import json, os, sys
+root = sys.argv[1]
+
+def stats(path):
+    return json.loads([l for l in open(path) if l.startswith("{")][-1])
+
+s1, s2 = stats(f"{root}/run1.out"), stats(f"{root}/run2.out")
+assert s1["autotune_cache"] == "miss", s1
+assert s1["autotune_probe_ms"] > 0.0, s1
+assert s2["autotune_cache"] == "hit", s2
+assert s2["autotune_probe_ms"] == 0.0, s2
+assert s2["backend"] == s1["backend"], (s1, s2)
+records = os.listdir(f"{root}/cache")
+assert len(records) == 1, records
+print("autotune round-trip OK: backend", s1["backend"],
+      "| probe", round(s1["autotune_probe_ms"], 1), "ms -> hit 0 ms")
 EOF
 
 echo "== smoke: all green =="

@@ -64,6 +64,19 @@ def _release_compiled_programs():
     jax.clear_caches()
 
 
+@pytest.fixture(autouse=True, scope="session")
+def _hermetic_tuning_cache(tmp_path_factory):
+    """Point the backend-autotune cache at a throwaway dir for the whole
+    session: the suite must never read verdicts from (or write probes
+    into) the operator's ~/.cache/gravity_tpu/tuning. test_autotune's
+    per-test fixture overrides this with its own fresh dir."""
+    if "GRAVITY_TPU_TUNE_DIR" not in os.environ:
+        os.environ["GRAVITY_TPU_TUNE_DIR"] = str(
+            tmp_path_factory.mktemp("tuning")
+        )
+    yield
+
+
 @pytest.fixture
 def key():
     return jax.random.PRNGKey(0)

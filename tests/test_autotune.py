@@ -1,0 +1,433 @@
+"""Measurement-driven backend autotuner (gravity_tpu/autotune.py).
+
+The routing contract (ISSUE 5 / VERDICT r5 item 4): plain
+``force_backend='auto'`` consults an on-disk tuning cache keyed on the
+full configuration — probe-on-miss, instant-on-hit — so 'auto' means
+"measured fastest", never "modeled fastest". These tests pin the cache
+mechanics (key sensitivity, version invalidation, atomic persistence),
+the eligibility rules (pair budget, fast-probe floor, ring exclusion),
+the never-kill-a-run fallback ladder, the Simulator / bench / CLI
+observability surface, and the serve-admission contract: probing
+happens at submit time and NEVER inside a scheduling round.
+
+Probes here are faked (a stubbed ``_time_backend`` with canned
+timings) so the lane stays milliseconds-cheap; one slow-marked e2e
+exercises the real compiled-step probe at a floor-lowered n.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import gravity_tpu.autotune as at
+from gravity_tpu.autotune import (
+    AutotuneDecision,
+    eligible_candidates,
+    key_hash,
+    make_key,
+    occupancy_signature,
+    probe_counters,
+    resolve_backend_measured,
+    versions,
+)
+from gravity_tpu.config import SimulationConfig
+from gravity_tpu.utils.faults import BackendUnavailable
+
+pytestmark = pytest.mark.fast
+
+
+@pytest.fixture(autouse=True)
+def _fresh_cache(tmp_path, monkeypatch):
+    """Every test gets a throwaway tuning dir and a clean in-memory
+    cache — the suite must never touch (or depend on) ~/.cache."""
+    monkeypatch.setenv("GRAVITY_TPU_TUNE_DIR", str(tmp_path / "tuning"))
+    at._mem_cache.clear()
+    yield
+
+
+def _cfg(n, **kw):
+    kw.setdefault("model", "plummer")
+    kw.setdefault("dt", 3600.0)
+    kw.setdefault("eps", 1.0e9)
+    kw.setdefault("integrator", "leapfrog")
+    return SimulationConfig(n=n, **kw)
+
+
+def _fake_probe(timings, unavailable=(), broken=()):
+    """A _time_backend stub with canned per-backend seconds that still
+    honors the probe-step counter contract (the serve test asserts on
+    it)."""
+
+    def fake(config, backend, state, probe_steps):
+        if backend in unavailable:
+            raise BackendUnavailable(f"{backend} not built here")
+        if backend in broken:
+            raise ValueError(f"{backend} sizing check failed")
+        at._counters["probe_steps"] += probe_steps
+        return timings[backend]
+
+    return fake
+
+
+# --- cache key -----------------------------------------------------------
+
+
+def test_occupancy_signature_separates_clustered_from_uniform(key):
+    """A clustered state and a uniform cube must not share a tuning
+    verdict (sparse-FMM cost is occupancy-proportional), while per-seed
+    jitter of the same distribution must not force a re-probe."""
+    from gravity_tpu.models import create_plummer
+
+    rng = np.random.default_rng(0)
+    uniform = rng.uniform(0.0, 1.0, (4096, 3))
+    clustered = np.asarray(create_plummer(key, 4096).positions)
+    assert occupancy_signature(uniform) != occupancy_signature(clustered)
+
+    jitter = rng.uniform(0.0, 1.0, (4096, 3))
+    assert occupancy_signature(uniform) == occupancy_signature(jitter)
+
+
+def test_occupancy_signature_degrades_to_na():
+    assert occupancy_signature(None) == "na"
+    assert occupancy_signature(np.full((8, 3), np.nan)) == "na"
+    assert occupancy_signature(np.zeros((0, 3))) == "na"
+
+
+def test_key_hash_stable_and_sensitive():
+    base = dict(candidates=("dense", "tree"), platform="cpu",
+                device_kind="cpu", occupancy="occ2^-3")
+    k1 = make_key(_cfg(4096), **base)
+    k2 = make_key(_cfg(4096), **base)
+    assert key_hash(k1) == key_hash(k2)
+    # Every key component re-opens the question.
+    assert key_hash(make_key(_cfg(8192), **base)) != key_hash(k1)
+    assert key_hash(
+        make_key(_cfg(4096, dtype="float64"), **base)
+    ) != key_hash(k1)
+    assert key_hash(
+        make_key(_cfg(4096), **{**base, "occupancy": "occ2^-6"})
+    ) != key_hash(k1)
+    assert key_hash(
+        make_key(_cfg(4096, sharding="allgather", mesh_shape=(8,)), **base)
+    ) != key_hash(k1)
+    # Solver-tuning knobs build materially different candidate programs
+    # (a forced depth changes the sfmm rank-overflow regime entirely):
+    # they must not share a persisted verdict with the defaults.
+    assert key_hash(
+        make_key(_cfg(4096, tree_depth=5), **base)
+    ) != key_hash(k1)
+    assert key_hash(
+        make_key(_cfg(4096, tree_leaf_cap=512), **base)
+    ) != key_hash(k1)
+    assert key_hash(
+        make_key(_cfg(4096, fmm_mode="sparse"), **base)
+    ) != key_hash(k1)
+
+
+# --- eligibility ---------------------------------------------------------
+
+
+def test_eligible_small_n_is_direct_only():
+    cands, skipped = eligible_candidates(_cfg(2048), on_tpu=False)
+    assert cands == ("dense",)
+    assert "tree/fmm/sfmm" in skipped
+
+
+def test_eligible_large_n_cpu_drops_direct_over_pair_budget():
+    """At 1M on CPU the direct sum is over the probe pair budget —
+    ruled out by arithmetic, not by a minutes-long probe."""
+    cands, skipped = eligible_candidates(_cfg(1_048_576), on_tpu=False)
+    assert set(cands) == {"tree", "fmm", "sfmm"}
+    assert any("pair" in v for v in skipped.values())
+
+
+def test_eligible_ring_excludes_fast_solvers():
+    cands, skipped = eligible_candidates(
+        _cfg(1 << 17, sharding="ring", mesh_shape=(8,)), on_tpu=False
+    )
+    assert all(c not in cands for c in ("tree", "fmm", "sfmm"))
+    assert "ring" in skipped["tree/fmm/sfmm"]
+
+
+def test_fast_probe_floor_env_override(monkeypatch):
+    monkeypatch.setenv("GRAVITY_TPU_AUTOTUNE_MIN_N", "256")
+    cands, _ = eligible_candidates(_cfg(512), on_tpu=False)
+    assert {"tree", "fmm", "sfmm"} <= set(cands)
+
+
+# --- resolve: probe / persist / hit --------------------------------------
+
+
+def test_single_candidate_short_circuits_without_probe(monkeypatch):
+    """The common small-n case must stay free: one candidate means
+    nothing to measure — no probe steps, no cache write."""
+    before = probe_counters()["probe_steps"]
+    d = resolve_backend_measured(_cfg(1024), None)
+    assert d.cache == "static"
+    assert d.backend == "dense"
+    assert probe_counters()["probe_steps"] == before
+    # Nothing persisted either: there was no measurement to store.
+    assert not os.path.isdir(at.tuning_dir()) or not os.listdir(
+        at.tuning_dir()
+    )
+
+
+def test_miss_probes_persists_then_hits(monkeypatch):
+    monkeypatch.setattr(at, "_time_backend", _fake_probe(
+        {"dense": 0.05, "tree": 0.01, "fmm": 0.02}
+    ))
+    cfg = _cfg(4096)
+    cands = ("dense", "tree", "fmm")
+    d = resolve_backend_measured(cfg, None, candidates=cands)
+    assert d.cache == "miss"
+    assert d.backend == "tree"  # measured-fastest, not first
+    assert d.probe_ms > 0.0
+    # Persisted: one JSON record keyed by the stable hash, with the
+    # environment versions that gate staleness.
+    rec = json.load(open(os.path.join(at.tuning_dir(), f"{d.key_hash}.json")))
+    assert rec["winner"] == "tree"
+    assert rec["versions"] == versions()
+    # Second resolve: instant hit, zero probe steps — even with the
+    # in-memory cache cleared (disk round-trip).
+    at._mem_cache.clear()
+    before = probe_counters()["probe_steps"]
+    d2 = resolve_backend_measured(cfg, None, candidates=cands)
+    assert d2.cache == "hit" and d2.backend == "tree"
+    assert d2.probe_ms == 0.0
+    assert probe_counters()["probe_steps"] == before
+
+
+def test_version_mismatch_invalidates(monkeypatch):
+    monkeypatch.setattr(at, "_time_backend", _fake_probe(
+        {"dense": 0.05, "tree": 0.01}
+    ))
+    cfg = _cfg(4096)
+    cands = ("dense", "tree")
+    d = resolve_backend_measured(cfg, None, candidates=cands)
+    assert d.cache == "miss"
+    # A jax/jaxlib upgrade may reorder candidates: doctor the stored
+    # record's versions and the next resolve must re-probe.
+    path = os.path.join(at.tuning_dir(), f"{d.key_hash}.json")
+    rec = json.load(open(path))
+    rec["versions"]["jax"] = "0.0.0-other"
+    json.dump(rec, open(path, "w"))
+    at._mem_cache.clear()
+    d2 = resolve_backend_measured(cfg, None, candidates=cands)
+    assert d2.cache == "miss"
+
+
+def test_refresh_reprobes_and_overwrites(monkeypatch):
+    cfg = _cfg(4096)
+    cands = ("dense", "tree")
+    monkeypatch.setattr(at, "_time_backend", _fake_probe(
+        {"dense": 0.05, "tree": 0.01}
+    ))
+    assert resolve_backend_measured(cfg, None, candidates=cands).backend \
+        == "tree"
+    # The ranking moved (new measurement): --refresh must re-probe.
+    monkeypatch.setattr(at, "_time_backend", _fake_probe(
+        {"dense": 0.001, "tree": 0.01}
+    ))
+    assert resolve_backend_measured(
+        cfg, None, candidates=cands
+    ).backend == "tree", "without refresh the stale hit stands"
+    d = resolve_backend_measured(
+        cfg, None, candidates=cands, refresh=True
+    )
+    assert d.cache == "miss" and d.backend == "dense"
+
+
+def test_unavailable_and_broken_candidates_are_skipped(monkeypatch):
+    monkeypatch.setattr(at, "_time_backend", _fake_probe(
+        {"dense": 0.05, "tree": 0.01, "fmm": 0.001},
+        unavailable=("fmm",), broken=("tree",),
+    ))
+    d = resolve_backend_measured(
+        _cfg(4096), None, candidates=("dense", "tree", "fmm")
+    )
+    assert d.backend == "dense"  # the only candidate that probed
+    assert "not built" in d.skipped["fmm"]
+    assert "sizing" in d.skipped["tree"]
+
+
+def test_all_candidates_fail_falls_back_static(monkeypatch):
+    monkeypatch.setattr(at, "_time_backend", _fake_probe(
+        {}, unavailable=("dense", "tree")
+    ))
+    d = resolve_backend_measured(
+        _cfg(4096), None, candidates=("dense", "tree"),
+        static_fallback="chunked",
+    )
+    assert d.cache == "static" and d.backend == "chunked"
+    assert set(d.skipped) == {"dense", "tree"}
+
+
+# --- Simulator / bench / CLI wiring --------------------------------------
+
+
+def test_simulator_reports_cache_off_for_explicit_and_disabled():
+    from gravity_tpu.simulation import Simulator
+
+    sim = Simulator(_cfg(64, force_backend="dense", steps=2))
+    assert sim.autotune == {"cache": "off", "probe_ms": 0.0}
+    sim2 = Simulator(_cfg(64, autotune=False, steps=2))
+    assert sim2.autotune["cache"] == "off"
+
+
+def test_simulator_auto_miss_then_hit_lands_in_run_stats(monkeypatch):
+    """The acceptance-contract observability: first 'auto' run probes
+    (cache=miss, probe_ms>0), the second run of the same configuration
+    performs ZERO probe steps and reports the hit — all via run stats."""
+    monkeypatch.setenv("GRAVITY_TPU_AUTOTUNE_MIN_N", "128")
+    monkeypatch.setattr(at, "_time_backend", _fake_probe(
+        {"dense": 0.05, "tree": 0.01, "fmm": 0.5, "sfmm": 0.5}
+    ))
+    from gravity_tpu.simulation import Simulator
+
+    cfg = _cfg(256, steps=2)
+    sim = Simulator(cfg)
+    assert sim.backend == "tree"
+    stats = sim.run()
+    assert stats["autotune_cache"] == "miss"
+    assert stats["autotune_probe_ms"] > 0.0
+    assert stats["backend"] == "tree"
+
+    before = probe_counters()["probe_steps"]
+    stats2 = Simulator(cfg).run()
+    assert stats2["autotune_cache"] == "hit"
+    assert stats2["autotune_probe_ms"] == 0.0
+    assert probe_counters()["probe_steps"] == before
+
+
+def test_probe_failure_never_kills_the_run(monkeypatch):
+    """The autotuner is an optimization: a resolver that throws must
+    degrade to the static route with a warning, not abort the run."""
+    monkeypatch.setenv("GRAVITY_TPU_AUTOTUNE_MIN_N", "128")
+
+    def boom(*a, **kw):
+        raise RuntimeError("probe harness exploded")
+
+    monkeypatch.setattr(at, "resolve_backend_measured", boom)
+    from gravity_tpu.simulation import Simulator
+
+    with pytest.warns(UserWarning, match="autotune failed"):
+        sim = Simulator(_cfg(256, steps=2))
+    assert sim.autotune["cache"] == "off"
+    assert sim.run()["steps"] == 2
+
+
+def test_bench_line_carries_routing_facts(monkeypatch):
+    from gravity_tpu.bench import run_benchmark
+
+    stats = run_benchmark(
+        _cfg(64, force_backend="dense"), warmup_steps=1, bench_steps=2
+    )
+    assert stats["autotune_cache"] == "off"
+    assert stats["autotune_probe_ms"] == 0.0
+
+
+def test_cli_tune_prewarns_the_cache(monkeypatch, capsys):
+    """`gravity_tpu tune --sizes ...`: one JSON line per size; a
+    second invocation is all hits with zero probe steps."""
+    monkeypatch.setenv("GRAVITY_TPU_AUTOTUNE_MIN_N", "128")
+    monkeypatch.setattr(at, "_time_backend", _fake_probe(
+        {"dense": 0.05, "tree": 0.01, "fmm": 0.5, "sfmm": 0.5}
+    ))
+    from gravity_tpu.cli import main
+
+    argv = ["tune", "--sizes", "160", "256", "--model", "plummer",
+            "--dt", "3600", "--eps", "1e9"]
+    assert main(argv) == 0
+    lines = [json.loads(x) for x in
+             capsys.readouterr().out.strip().splitlines()]
+    assert [x["n"] for x in lines] == [160, 256]
+    assert all(x["cache"] == "miss" for x in lines)
+    assert all(x["backend"] == "tree" for x in lines)
+
+    before = probe_counters()["probe_steps"]
+    assert main(argv) == 0
+    lines2 = [json.loads(x) for x in
+              capsys.readouterr().out.strip().splitlines()]
+    assert all(x["cache"] == "hit" for x in lines2)
+    assert all(x["probe_steps"] == 0 for x in lines2)
+    assert probe_counters()["probe_steps"] == before
+
+
+# --- serve admission -----------------------------------------------------
+
+
+def test_serve_jobs_route_via_cache_at_admission_never_in_rounds(
+    monkeypatch,
+):
+    """The serve acceptance contract: mixed-size jobs route through
+    the tuning cache at SUBMIT time; scheduling rounds perform zero
+    probe steps; same-bucket jobs share the verdict (one probe per
+    bucket key, exactly like one compile per BatchKey)."""
+    monkeypatch.setattr(at, "engine_candidates",
+                        lambda on_tpu: ("dense", "chunked"))
+    monkeypatch.setattr(at, "_time_backend", _fake_probe(
+        {"dense": 0.05, "chunked": 0.01}
+    ))
+    from gravity_tpu.serve import EnsembleScheduler, batch_key_for
+
+    sched = EnsembleScheduler(slots=4, slice_steps=20)
+    p0 = probe_counters()["probe_steps"]
+    a = sched.submit(_cfg(10, model="random", steps=10,
+                          force_backend="auto"))
+    p1 = probe_counters()["probe_steps"]
+    assert p1 > p0, "admission of a new bucket key must probe"
+    # Same bucket: verdict shared, no new probe. Different bucket: one
+    # more probe, still at submit.
+    b = sched.submit(_cfg(12, model="random", steps=10,
+                          force_backend="auto"))
+    assert probe_counters()["probe_steps"] == p1
+    c = sched.submit(_cfg(100, model="random", steps=10,
+                          force_backend="auto"))
+    p2 = probe_counters()["probe_steps"]
+    assert p2 > p1
+
+    # The measured winner (chunked, canned) is what the batch runs.
+    key_a = batch_key_for(sched.jobs[a].config, slots=4)
+    assert key_a.backend == "chunked"
+
+    # Rounds: zero probe steps, all jobs complete.
+    sched.run_until_idle()
+    assert probe_counters()["probe_steps"] == p2
+    for jid in (a, b, c):
+        assert sched.jobs[jid].status == "completed", sched.jobs[jid]
+
+
+def test_serve_autotune_off_keeps_static_dense():
+    from gravity_tpu.serve import batch_key_for
+
+    key = batch_key_for(
+        _cfg(10, model="random", force_backend="auto", autotune=False),
+        slots=4,
+    )
+    assert key.backend == "dense"
+
+
+# --- the real probe, end to end (slow lane) ------------------------------
+
+
+@pytest.mark.slow
+def test_real_probe_e2e_miss_then_hit(monkeypatch):
+    """No stubs: at a floor-lowered n the prober builds and times every
+    eligible candidate on the real compiled step, persists the verdict,
+    and the second Simulator resolves instantly."""
+    monkeypatch.setenv("GRAVITY_TPU_AUTOTUNE_MIN_N", "256")
+    from gravity_tpu.simulation import Simulator
+
+    cfg = _cfg(512, steps=2)
+    sim = Simulator(cfg)
+    assert sim.autotune["cache"] == "miss"
+    assert sim.autotune["probe_ms"] > 0.0
+    assert sim.backend in ("dense", "cpp", "chunked", "tree", "fmm",
+                           "sfmm")
+    before = probe_counters()["probe_steps"]
+    sim2 = Simulator(cfg)
+    assert sim2.autotune == {"cache": "hit", "probe_ms": 0.0}
+    assert sim2.backend == sim.backend
+    assert probe_counters()["probe_steps"] == before

@@ -203,6 +203,15 @@ def test_validate_command_with_tpu_battery(capsys):
     for name in ("tpu_pallas_parity", "tpu_tree_parity",
                  "tpu_sharded_mesh1", "tpu_bench_5step"):
         assert out["checks"][name]["ok"], out["checks"][name]
+    # The 2M direct-sum datum (VERDICT r5 item 6) is TPU-only: on CPU
+    # the battery must skip it cleanly, not attempt hours of O(N^2) —
+    # on an actual chip the row runs and reports the measured rate.
+    import jax
+
+    row_2m = out["checks"]["tpu_2m_direct_3step"]
+    assert row_2m["ok"], row_2m
+    if jax.devices()[0].platform != "tpu":
+        assert "skipped" in row_2m, row_2m
 
 
 def test_divergence_then_resume_with_smaller_dt(tmp_path, capsys):
@@ -274,6 +283,8 @@ def test_auto_recover_trajectories(tmp_path, capsys):
     assert np.isfinite(traj).all()
 
 
+@pytest.mark.heavy  # subprocess e2e twin; auto-recover stays in-lane
+# via test_run_auto_recover_divergence
 def test_run_auto_recover_subprocess_env_knob(tmp_path):
     """The GRAVITY_TPU_FAULTS env knob drives injection in a fresh
     process — recovery is testable through the real CLI entry point."""

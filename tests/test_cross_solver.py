@@ -44,17 +44,26 @@ def _exact_fp64_sample(positions, masses, idx, *, g, eps, chunk=256):
     return np.concatenate(out, axis=0)
 
 
+_setup_cache: dict = {}
+
+
 def _setup(n):
-    state = create_disk(jax.random.PRNGKey(42), n, dtype=jnp.float32)
-    idx = np.random.default_rng(0).choice(n, 256, replace=False)
-    idx.sort()
-    exact = _exact_fp64_sample(
-        state.positions, state.masses, idx, g=1.0, eps=0.05
-    )
-    norm = np.linalg.norm(exact, axis=-1)
-    norm = np.where(norm > 0, norm, 1.0)
-    rms = float(np.sqrt(np.mean(norm**2)))
-    return state, idx, exact, norm, rms
+    """ICs + the fp64-umpire sample for size ``n`` — built ONCE per
+    session and shared across every case at that size (VERDICT r5
+    item 5: the exact-sample umpire is the dominant per-test cost and
+    it is identical for identical (seed, n))."""
+    if n not in _setup_cache:
+        state = create_disk(jax.random.PRNGKey(42), n, dtype=jnp.float32)
+        idx = np.random.default_rng(0).choice(n, 256, replace=False)
+        idx.sort()
+        exact = _exact_fp64_sample(
+            state.positions, state.masses, idx, g=1.0, eps=0.05
+        )
+        norm = np.linalg.norm(exact, axis=-1)
+        norm = np.where(norm > 0, norm, 1.0)
+        rms = float(np.sqrt(np.mean(norm**2)))
+        _setup_cache[n] = (state, idx, exact, norm, rms)
+    return _setup_cache[n]
 
 
 def _med(a, b, scale):
@@ -62,16 +71,19 @@ def _med(a, b, scale):
 
 
 @pytest.mark.nightly
-def test_tree_p3m_exact_three_way_agreement_65k(x64):
-    """65k disk: the octree at near-field-resolving depth matches the
-    exact sample at the 0.1% class even on the cancellation metric
-    (measured 0.11%); P3M's thin-disk mesh error sits at the few-%
-    class on the SCALED metric (its raw median reads ~14% purely from
-    cancellation — same solver, same forces)."""
+def test_tree_p3m_exact_three_way_agreement_32k(x64):
+    """32k disk (shrunk from 65k, VERDICT r5 item 5 — same physics,
+    half the umpire and solver cost): the octree at near-field-
+    resolving depth matches the exact sample at the 0.1% class even on
+    the cancellation metric (measured 0.11% at 65k; depth 7 resolves
+    32k strictly finer); P3M's thin-disk mesh error sits at the few-%
+    class on the SCALED metric (mesh-side and geometry-driven, so
+    n-insensitive — its raw median reads ~14% purely from
+    cancellation; same solver, same forces)."""
     from gravity_tpu.ops.p3m import p3m_accelerations
     from gravity_tpu.ops.tree import tree_accelerations
 
-    state, idx, exact, norm, rms = _setup(65_536)
+    state, idx, exact, norm, rms = _setup(32_768)
     pos, masses = state.positions, state.masses
     acc_tree = np.asarray(tree_accelerations(
         pos, masses, depth=7, leaf_cap=64, g=1.0, eps=0.05
@@ -91,7 +103,7 @@ def test_fmm_joins_the_agreement_8k(x64):
     independent implementations of the same multipole class — agree at
     the 0.3% median (measured 2.7e-3) while both carry the same
     depth-limited error vs exact (measured 4.5% raw median; depth 7
-    drives the tree to 0.1%, see the 65k gate — depth is the accuracy
+    drives the tree to 0.1%, see the 32k gate — depth is the accuracy
     dial, tests/test_tree.py::test_recommended_depth_data_beats_count_only).
     Kept at 8k/depth 5 because the shifted-slice passes are single-core-
     CPU-slow while being the cheap path on TPU."""

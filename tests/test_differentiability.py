@@ -157,6 +157,8 @@ def test_grad_through_block_timestep_schemes(key, x64):
 
 
 @pytest.mark.slow
+@pytest.mark.nightly  # heaviest FD matrix row (~90s measured
+# 2026-08-03; VERDICT r5 item 5) — run with `pytest -m nightly`
 def test_fmm_rollout_grad_matches_finite_difference(key, x64):
     """jax.grad flows through the dense-grid FMM's full pipeline —
     octree segment_sums, argsort/scatter cell binning, shifted-slice

@@ -174,6 +174,7 @@ def test_solar_system_earth_acceleration(x64):
     np.testing.assert_allclose(float(acc[1, 0]), a_expected, rtol=1e-3)
 
 
+@pytest.mark.heavy  # compile-heavy diagnostics battery; tier-1 keeps it
 def test_structure_diagnostics(key):
     """Lagrangian radii / dispersion / density profile sanity on Plummer
     (half-mass radius of a Plummer sphere = 1.3048 a)."""

@@ -166,6 +166,7 @@ def test_tile_shape_independence(key):
     assert float(_rel_err(b, a).max()) < 1e-4
 
 
+@pytest.mark.heavy  # bf16 error bars also pinned in test_bfloat16
 def test_bf16_variant_characterized_error(key):
     """bf16 operands with fp32 accumulation on fp32 state: the error
     class characterized in tests/test_bfloat16.py (median well under
@@ -237,6 +238,7 @@ def test_local_kernel_is_differentiable(key):
     assert float(np.abs(ga - gr).max()) < 1e-3 * scale
 
 
+@pytest.mark.heavy  # compile-heavy e2e; tier-1 keeps it
 def test_simulator_backend_end_to_end(key):
     """`force_backend='pallas-mxu'` resolves, steps, and stays close to
     the dense-backend trajectory over a short leapfrog run."""

@@ -52,6 +52,7 @@ def test_backends_agree_with_dense(backend):
     )
 
 
+@pytest.mark.heavy  # compile-heavy; tier-1 keeps it, contract lane skips
 @pytest.mark.parametrize("backend", ["tree", "pm"])
 def test_fast_backends_run_and_approximate(backend):
     """tree/pm backends run end-to-end and stay near the dense result over
@@ -371,6 +372,7 @@ def test_ring_merger_preset_resolves_quietly():
     assert not w
 
 
+@pytest.mark.heavy
 def test_energy_routes_through_tree_above_threshold(monkeypatch):
     """Above ENERGY_TREE_THRESHOLD a tree-backend run prices its energy
     diagnostic with the O(N log N) tree potential; the value must agree
@@ -569,6 +571,7 @@ def test_measured_crossover_file_overrides_default(tmp_path, monkeypatch):
     assert sim_mod._resolve_backend(_SC(n=262_144), on_tpu=True) == "tree"
 
 
+@pytest.mark.heavy
 def test_energy_routes_through_tree_for_fmm_backend(monkeypatch):
     """fmm runs price --metrics-energy with the O(N log N) tree
     potential too (same scalable-diagnostic contract as tree/p3m)."""
