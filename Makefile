@@ -3,12 +3,18 @@
 
 PYTEST := env JAX_PLATFORMS=cpu python -m pytest
 
-.PHONY: smoke fast test nightly
+.PHONY: smoke chaos fast test nightly
 
 # The documented pre-push check: the -m fast contract lane plus a
 # 2-job ensemble serving e2e through the real CLI daemon (docs/serving.md).
 smoke:
 	bash scripts/smoke.sh
+
+# Serving-layer chaos harness: 2 workers on one spool under injected
+# kill -9 / stale-lease faults — adoption, fencing, solo parity
+# (docs/robustness.md "Fleet failure modes"). Also smoke stage 5.
+chaos:
+	bash scripts/chaos.sh
 
 fast:
 	$(PYTEST) tests/ -q -m 'fast and not slow and not heavy'

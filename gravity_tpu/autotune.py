@@ -246,16 +246,28 @@ def _record_path(h: str) -> str:
     return os.path.join(tuning_dir(), f"{h}.json")
 
 
+def _read_record_file(path: str, attempts: int = 3) -> Optional[dict]:
+    """Lock-free torn-JSON read-retry: N daemons sharing the tuning
+    cache write via atomic ``os.replace``, but a cache dir that has
+    ever seen a NON-atomic writer (or a torn disk) can hand a reader a
+    partial document. A parse failure is retried briefly (the shared
+    ``utils/hostio.read_json_retry`` helper — same contract as every
+    spool/lease reader); a document still torn after that is a plain
+    miss (the re-probe overwrites it) — never an exception into the
+    run."""
+    from .utils.hostio import read_json_retry
+
+    return read_json_retry(path, attempts=attempts)
+
+
 def _load_record(h: str, key: dict) -> Optional[dict]:
     """A cached verdict, or None on miss. Stale entries — version
     mismatch, winner no longer in the candidate set, unparseable —
     are misses (the re-probe overwrites them)."""
     rec = _mem_cache.get(h)
     if rec is None:
-        try:
-            with open(_record_path(h)) as f:
-                rec = json.load(f)
-        except (OSError, ValueError):
+        rec = _read_record_file(_record_path(h))
+        if rec is None:
             return None
     if not isinstance(rec, dict):
         return None
@@ -268,17 +280,36 @@ def _load_record(h: str, key: dict) -> Optional[dict]:
     return rec
 
 
-def _store_record(h: str, rec: dict) -> None:
-    _mem_cache[h] = rec
+def _store_record(h: str, rec: dict, stamp_ns: Optional[int] = None) -> None:
+    # Fencing for concurrent writers (two daemons probing the same
+    # key): records carry a stamp taken when their PROBE STARTED, and a
+    # writer that finds a record stamped after its own probe began
+    # yields to it — the slow prober that finishes last must not
+    # clobber the verdict a peer measured on fresher ground. (Stamping
+    # at write time would make the guard a no-op: the last writer is,
+    # by definition, the latest stamp.) Same-stamp ties land via the
+    # atomic replace.
+    rec = dict(rec, stamp_ns=int(stamp_ns or time.time_ns()))
     try:
         os.makedirs(tuning_dir(), exist_ok=True)
         path = _record_path(h)
+        existing = _read_record_file(path, attempts=1)
+        if (
+            isinstance(existing, dict)
+            and existing.get("versions") == versions()
+            and int(existing.get("stamp_ns", 0) or 0)
+            > rec["stamp_ns"]
+        ):
+            _mem_cache[h] = existing
+            return
+        _mem_cache[h] = rec
         tmp = f"{path}.tmp.{os.getpid()}"
         with open(tmp, "w") as f:
             json.dump(rec, f, indent=1)
         os.replace(tmp, path)  # atomic: concurrent probes race benignly
     except OSError:
-        pass  # a read-only cache dir must never fail the run
+        _mem_cache[h] = rec
+        # a read-only cache dir must never fail the run
 
 
 class AutotuneDecision(NamedTuple):
@@ -404,6 +435,7 @@ def resolve_backend_measured(
             )
 
     t0 = time.perf_counter()
+    probe_started_ns = time.time_ns()  # the record's fencing stamp
     timings: dict[str, float] = {}
     for backend in candidates:
         try:
@@ -435,7 +467,7 @@ def resolve_backend_measured(
         "created_at": time.strftime(
             "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
         ),
-    })
+    }, stamp_ns=probe_started_ns)
     return AutotuneDecision(winner, "miss", probe_ms, timings, skipped, h)
 
 

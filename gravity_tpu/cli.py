@@ -1612,12 +1612,18 @@ def cmd_serve(args: argparse.Namespace) -> int:
         args.spool_dir, host=args.host, port=args.port,
         slots=args.slots, slice_steps=args.slice_steps,
         yield_rounds=args.yield_rounds,
+        worker_id=args.worker_id,
+        lease_ttl_s=args.lease_ttl_s,
+        max_queue=args.max_queue,
+        max_requeues=args.max_requeues,
     )
     host, port = daemon.start()
     print(json.dumps({
         "serving": True, "host": host, "port": port,
         "spool_dir": args.spool_dir, "pid": os.getpid(),
         "slots": args.slots, "slice_steps": args.slice_steps,
+        "worker_id": daemon.worker_id,
+        "lease_ttl_s": args.lease_ttl_s,
     }), flush=True)
     daemon.serve_blocking()
     return 0
@@ -1629,13 +1635,19 @@ def cmd_submit(args: argparse.Namespace) -> int:
     --wait — polls to the terminal status."""
     from .serve import DaemonUnreachable, request, wait_for
 
+    import uuid
+
     config = build_config(args)
     try:
         resp = request(args.spool_dir, "POST", "/submit", {
             "config": json.loads(config.to_json()),
             "priority": args.priority,
             "deadline_s": args.deadline_s,
-        })
+            # Client-generated idempotency key: a retry after a lost
+            # response (or a failover re-POST to a surviving worker)
+            # re-submits the SAME job, never a duplicate.
+            "job_id": f"job-{uuid.uuid4().hex[:12]}",
+        }, retries=args.retries)
     except DaemonUnreachable as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
@@ -1852,6 +1864,23 @@ def main(argv=None) -> int:
                          help="steps per scheduling round (the "
                               "starvation bound: short jobs wait at "
                               "most ~yield-rounds slices)")
+    p_serve.add_argument("--worker-id", dest="worker_id", default=None,
+                         help="stable worker identity in the shared "
+                              "spool (default: host-pid-random)")
+    p_serve.add_argument("--lease-ttl-s", dest="lease_ttl_s",
+                         type=float, default=30.0,
+                         help="job-lease TTL; peers adopt this "
+                              "worker's jobs once its leases expire "
+                              "(a dead pid is adopted immediately)")
+    p_serve.add_argument("--max-queue", dest="max_queue", type=int,
+                         default=1024,
+                         help="bounded admission queue: submissions "
+                              "beyond this shed with HTTP 503 + "
+                              "Retry-After (0 = unbounded)")
+    p_serve.add_argument("--max-requeues", dest="max_requeues",
+                         type=int, default=5,
+                         help="requeue cap per job before it goes "
+                              "terminal failed ('poisoned')")
     p_serve.add_argument("--yield-rounds", dest="yield_rounds", type=int,
                          default=2,
                          help="consecutive rounds a resident job may "
@@ -1872,6 +1901,11 @@ def main(argv=None) -> int:
                                "forever")
     p_submit.add_argument("--wait", action="store_true",
                           help="poll until the job is terminal")
+    p_submit.add_argument("--retries", type=int, default=3,
+                          help="client-side retries with jittered "
+                               "exponential backoff on an unreachable "
+                               "daemon or a 503 load shed (honors "
+                               "Retry-After)")
     p_submit.add_argument("--timeout", type=float, default=600.0,
                           help="--wait poll budget in seconds")
     p_submit.set_defaults(fn=cmd_submit)

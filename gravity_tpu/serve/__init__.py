@@ -11,8 +11,16 @@ Three layers (docs/serving.md):
   divergence isolation, occupancy/latency metrics, spool persistence.
 - :mod:`.service` — the localhost HTTP/JSON daemon (`gravity_tpu
   serve`) and the submit/status/result/cancel client verbs.
+
+Fleet resilience (docs/robustness.md "Fleet failure modes"):
+
+- :mod:`.leases` — TTL job leases with fencing tokens + heartbeats,
+  so N workers share one spool and adopt a dead peer's jobs.
+- :mod:`.breaker` — per-backend circuit breakers over the supervisor's
+  exact-physics degrade ladder, applied at admission keying.
 """
 
+from .breaker import BreakerBoard, CircuitBreaker  # noqa: F401
 from .engine import (  # noqa: F401
     ENGINE_BACKENDS,
     BatchKey,
@@ -21,10 +29,18 @@ from .engine import (  # noqa: F401
     batch_key_for,
     bucket_size,
 )
-from .scheduler import EnsembleScheduler, Job, Spool  # noqa: F401
+from .leases import Lease, LeaseManager  # noqa: F401
+from .scheduler import (  # noqa: F401
+    EnsembleScheduler,
+    Job,
+    QueueFull,
+    Spool,
+    default_worker_id,
+)
 from .service import (  # noqa: F401
     DaemonUnreachable,
     GravityDaemon,
+    backoff_delay,
     find_daemon,
     request,
     wait_for,

@@ -86,11 +86,18 @@ class BatchKey(NamedTuple):
 
 
 def batch_key_for(
-    config: SimulationConfig, *, slots: int, min_bucket: int = MIN_BUCKET
+    config: SimulationConfig, *, slots: int, min_bucket: int = MIN_BUCKET,
+    reroute=None,
 ) -> BatchKey:
     """The batch a job with this config lands in. Raises ValueError for
     configs outside the ensemble envelope (the caller surfaces it as a
-    submit-time rejection, not a mid-batch failure)."""
+    submit-time rejection, not a mid-batch failure).
+
+    ``reroute`` (backend -> backend) is the admission-time degradation
+    hook: the scheduler passes its circuit-breaker board's reroute so a
+    backend with an open breaker is swapped for the next rung of the
+    exact-physics ladder BEFORE the job is keyed into a bucket — the
+    job lands directly in a batch that can run (serve/breaker.py)."""
     backend = config.force_backend
     if backend not in ("auto", "direct") and backend not in ENGINE_BACKENDS:
         raise ValueError(
@@ -146,6 +153,14 @@ def batch_key_for(
             backend = resolve_engine_backend(
                 config, min_bucket=min_bucket
             ).backend
+    if reroute is not None:
+        rerouted = reroute(backend)
+        if rerouted != backend and rerouted not in ENGINE_BACKENDS:
+            raise ValueError(
+                f"reroute {backend!r} -> {rerouted!r} left the engine's "
+                f"backends ({'/'.join(ENGINE_BACKENDS)})"
+            )
+        backend = rerouted
     return BatchKey(
         bucket_n=bucket_size(config.n, min_bucket),
         slots=slots,

@@ -23,6 +23,21 @@ With a spool directory attached, job specs and results persist as
 JSON/NPZ under it, so a restarted daemon re-queues every unfinished
 job (``respooled`` events; ICs are a pure function of the config, so
 a restarted job reproduces the same trajectory from step 0).
+
+Fleet mode (docs/robustness.md "Fleet failure modes"): with a spool,
+every job is additionally owned through a TTL **lease** with a fencing
+token (serve/leases.py), so N scheduler processes can share one spool.
+Each worker heartbeats its leases, periodically scans the spool for
+unclaimed work and **adopts** expired leases (a ``kill -9``'d peer's
+jobs respool onto the survivors; a job whose result ``.npz`` already
+landed is finalized, not re-run), and fences every spool write so a
+paused-then-resurrected worker cannot clobber its adopter's results.
+Admission degrades gracefully: per-backend **circuit breakers**
+(serve/breaker.py) reroute keying down the exact-physics ladder while
+a backend cannot build, a bounded queue **sheds** submissions with a
+retry-after hint instead of accepting unbounded backlog, and a job
+that poisons its bucket (fails its round repeatedly) goes terminal
+``failed`` after ``max_requeues`` instead of starving batchmates.
 """
 
 from __future__ import annotations
@@ -30,6 +45,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+import socket
 import time
 import uuid
 from typing import Optional
@@ -38,13 +54,41 @@ import numpy as np
 
 from ..config import SimulationConfig
 from ..state import ParticleState
+from ..utils.faults import (
+    BackendUnavailable,
+    drop_result_due,
+    maybe_crash_worker,
+    stale_lease_secs,
+    stall_worker_secs,
+)
+from ..utils.hostio import atomic_write_json
 from ..utils.logging import ServingEventLogger
 from ..utils.timing import pairs_per_step
+from .breaker import BreakerBoard
 from .engine import BatchKey, EnsembleBatch, EnsembleEngine, batch_key_for
+from .leases import LeaseManager, read_json_retry
 
 # Job lifecycle: pending -> running -> completed | failed | cancelled
 # (running -> pending again on a yield/preemption).
 TERMINAL = ("completed", "failed", "cancelled")
+
+
+class QueueFull(RuntimeError):
+    """Admission load shed: the bounded queue is at capacity. Carries
+    the retry-after hint the HTTP layer surfaces as ``Retry-After``."""
+
+    def __init__(self, retry_after_s: float, depth: int):
+        super().__init__(
+            f"queue full ({depth} jobs); retry in ~{retry_after_s:.0f}s"
+        )
+        self.retry_after_s = retry_after_s
+        self.depth = depth
+
+
+def default_worker_id() -> str:
+    return (
+        f"{socket.gethostname()}-{os.getpid()}-{uuid.uuid4().hex[:6]}"
+    )
 
 
 @dataclasses.dataclass
@@ -69,6 +113,18 @@ class Job:
     # deterministic ICs from the config.
     state: Optional[ParticleState] = None
     resident_rounds: int = 0
+    # Fleet-mode ownership (persisted): the fencing token of our lease
+    # over this job (0 = never claimed) and how many times the job has
+    # been requeued after a failed/interrupted attempt — the poison-
+    # pill counter behind ``max_requeues``.
+    fence: int = 0
+    requeues: int = 0
+    # Local-only: False = a peer worker owns this job; we serve status
+    # reads from its spool record and never schedule it.
+    owned: bool = True
+    # Local-only: the BatchKey this job was queued under (breaker
+    # reroutes can change the computed key between enqueue and lookup).
+    key_cache: Optional[BatchKey] = None
 
     @property
     def steps(self) -> int:
@@ -88,55 +144,127 @@ class Job:
             "started_ts": self.started_ts,
             "finished_ts": self.finished_ts,
             "active_s": self.active_s,
+            "fence": self.fence,
+            "requeues": self.requeues,
         }
 
 
 class Spool:
     """Directory-backed persistence: ``jobs/<id>.json`` specs + status,
     ``results/<id>.npz`` final states. Everything a restarted daemon
-    needs to resume its queue and keep serving old results."""
+    needs to resume its queue and keep serving old results.
+
+    With a :class:`~gravity_tpu.serve.leases.LeaseManager` attached
+    (fleet mode), job and result writes are FENCED: the caller's token
+    is validated against the job's current lease (and the fence
+    persisted in the record, for released leases) under the lease lock,
+    in the same critical section as the ``os.replace`` — a zombie's
+    stale-token write returns False/None instead of landing."""
 
     def __init__(self, root: str):
         self.root = root
         self.jobs_dir = os.path.join(root, "jobs")
         self.results_dir = os.path.join(root, "results")
+        # Cross-worker cancel requests: any worker may drop a marker;
+        # the job's OWNER consumes it in housekeeping (HTTP handlers
+        # cannot reach a peer's scheduler, but every worker shares the
+        # spool).
+        self.cancels_dir = os.path.join(root, "cancel")
         os.makedirs(self.jobs_dir, exist_ok=True)
         os.makedirs(self.results_dir, exist_ok=True)
+        os.makedirs(self.cancels_dir, exist_ok=True)
+        self.leases: Optional[LeaseManager] = None
 
-    def write_job(self, job: Job) -> None:
+    def request_cancel(self, job_id: str) -> None:
+        atomic_write_json(
+            os.path.join(self.cancels_dir, f"{job_id}.json"),
+            {"job": job_id, "ts": time.time()},
+        )
+
+    def cancel_requested(self, job_id: str) -> bool:
+        return os.path.exists(
+            os.path.join(self.cancels_dir, f"{job_id}.json")
+        )
+
+    def clear_cancel(self, job_id: str) -> None:
+        try:
+            os.remove(os.path.join(self.cancels_dir, f"{job_id}.json"))
+        except OSError:
+            pass
+
+    def attach_leases(self, leases: LeaseManager) -> None:
+        self.leases = leases
+
+    def job_path(self, job_id: str) -> str:
+        return os.path.join(self.jobs_dir, f"{job_id}.json")
+
+    def read_job(self, job_id: str) -> Optional[dict]:
+        """One job record (torn-read-retrying); None if absent."""
+        rec = read_json_retry(self.job_path(job_id))
+        return rec if isinstance(rec, dict) else None
+
+    def record_fence(self, job_id: str) -> int:
+        rec = self.read_job(job_id)
+        try:
+            return int((rec or {}).get("fence", 0) or 0)
+        except (TypeError, ValueError):
+            return 0
+
+    def write_job(self, job: Job) -> bool:
+        """Persist the record; returns False when fencing rejected the
+        write (a newer claim owns this job — the caller must treat the
+        on-disk record as the truth)."""
         record = job.to_dict()
         record["config"] = json.loads(job.config.to_json())
-        path = os.path.join(self.jobs_dir, f"{job.id}.json")
-        tmp = path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(record, f)
-        os.replace(tmp, path)  # atomic: a crash never tears a job file
-
-    def load_jobs(self) -> list[dict]:
-        out = []
-        for name in sorted(os.listdir(self.jobs_dir)):
-            if not name.endswith(".json"):
-                continue
-            try:
-                with open(os.path.join(self.jobs_dir, name)) as f:
-                    out.append(json.load(f))
-            except (OSError, ValueError):
-                continue  # torn write from a crash; the job re-runs
-        return out
+        path = self.job_path(job.id)
+        if self.leases is None:
+            atomic_write_json(path, record)
+            return True
+        with self.leases.locked():
+            if not self.leases.fence_ok(
+                job.id, job.fence, lambda: self.record_fence(job.id)
+            ):
+                return False
+            atomic_write_json(path, record)
+            return True
 
     def result_path(self, job_id: str) -> str:
         return os.path.join(self.results_dir, f"{job_id}.npz")
 
-    def write_result(self, job_id: str, state: ParticleState) -> str:
+    def write_result(
+        self, job_id: str, state: ParticleState,
+        fence: Optional[int] = None,
+    ) -> Optional[str]:
+        """Write the final-state ``.npz``; returns its path, or None
+        when fencing rejected the write. The array serialization runs
+        OUTSIDE the lease lock (it is the heavy part); only the
+        validate + ``os.replace`` are in the critical section."""
         path = self.result_path(job_id)
-        tmp = path + ".tmp.npz"
+        if drop_result_due():
+            # Injected lost write: report success like a writer that
+            # died right after the syscall returned — the adoption
+            # scan's completed-without-result handling must recover.
+            return path
+        tmp = f"{path}.tmp.{os.getpid()}.npz"
         np.savez(
             tmp,
             positions=np.asarray(state.positions),
             velocities=np.asarray(state.velocities),
             masses=np.asarray(state.masses),
         )
-        os.replace(tmp, path)
+        if self.leases is None or fence is None:
+            os.replace(tmp, path)
+            return path
+        with self.leases.locked():
+            if not self.leases.fence_ok(
+                job_id, fence, lambda: self.record_fence(job_id)
+            ):
+                try:
+                    os.remove(tmp)
+                except OSError:
+                    pass
+                return None
+            os.replace(tmp, path)
         return path
 
     def load_result(self, job_id: str) -> Optional[dict]:
@@ -163,10 +291,21 @@ class EnsembleScheduler:
         events: Optional[ServingEventLogger] = None,
         spool: Optional[Spool] = None,
         min_bucket: int = 16,
+        worker_id: Optional[str] = None,
+        lease_ttl_s: float = 30.0,
+        max_queue: int = 0,
+        max_requeues: int = 5,
+        breaker_threshold: int = 3,
+        breaker_cooldown_s: float = 30.0,
+        reap_interval_s: Optional[float] = None,
     ):
         if slots < 1 or slice_steps < 1 or yield_rounds < 1:
             raise ValueError(
                 "slots, slice_steps, and yield_rounds must be >= 1"
+            )
+        if max_queue < 0 or max_requeues < 1:
+            raise ValueError(
+                "max_queue must be >= 0 and max_requeues >= 1"
             )
         self.slots = slots
         self.slice_steps = slice_steps
@@ -175,6 +314,30 @@ class EnsembleScheduler:
         self.events = events
         self.spool = spool
         self.min_bucket = min_bucket
+        self.worker_id = worker_id or default_worker_id()
+        # 0 = unbounded (in-process consumers); the daemon defaults to
+        # a bound so backlog sheds instead of growing without limit.
+        self.max_queue = max_queue
+        self.max_requeues = max_requeues
+        self.breakers = BreakerBoard(
+            threshold=breaker_threshold, cooldown_s=breaker_cooldown_s
+        )
+        # Fleet mode: lease ownership whenever jobs are durable.
+        self.leases: Optional[LeaseManager] = None
+        if spool is not None:
+            self.leases = LeaseManager(
+                spool.root, self.worker_id, ttl_s=lease_ttl_s
+            )
+            spool.attach_leases(self.leases)
+        self._next_scan = 0.0
+        # Spool records whose durable-terminal state is already
+        # registered locally — skipped by the reaper without a read.
+        self._known_terminal: set = set()
+        self.reap_interval_s = (
+            reap_interval_s if reap_interval_s is not None
+            else min(max(lease_ttl_s / 4.0, 0.05), 5.0)
+        )
+        self._last_round_s = 1.0
         # Background spool writer (docs/scaling.md "Host pipeline &
         # donation", serving half): completed-job result fetch (the D2H
         # of the final state) and the .npz write run off the round
@@ -215,9 +378,68 @@ class EnsembleScheduler:
         job_id: Optional[str] = None,
     ) -> str:
         """Validate + enqueue; returns the job id. Raises ValueError for
-        configs the ensemble engine cannot serve."""
+        configs the ensemble engine cannot serve and :class:`QueueFull`
+        when the bounded queue is shedding.
+
+        An explicit ``job_id`` is an idempotency key: re-submitting the
+        SAME config under a known id returns that id instead of raising
+        — the client retry path (lost response after the daemon already
+        accepted, or a failover re-POST to a surviving worker) must not
+        enqueue the simulation twice. A known id with a DIFFERENT
+        config is still a hard duplicate error."""
+        if job_id is not None:
+            # The id becomes a file name under jobs/ leases/ results/
+            # cancel/ — and arrives over an open HTTP API. Reject
+            # anything that could escape the spool or break the
+            # listdir-based reaper.
+            import re
+
+            if not re.fullmatch(r"[A-Za-z0-9._-]{1,128}", job_id) \
+                    or job_id.startswith("."):
+                raise ValueError(
+                    f"invalid job id {job_id!r}: 1-128 chars from "
+                    "[A-Za-z0-9._-], not starting with '.'"
+                )
+        if job_id is not None:
+            existing = self.jobs.get(job_id)
+            if existing is not None:
+                if existing.config.to_json() == config.to_json():
+                    return job_id
+                raise ValueError(f"duplicate job id {job_id!r}")
+            if self.spool is not None:
+                # Unknown locally but maybe not fleet-wide: a retry
+                # after a lost response may land on a worker that has
+                # not scanned the accepting worker's record yet — or
+                # after the job already COMPLETED and released its
+                # lease. Absorb the record through the reaper's own
+                # path (terminal ⇒ registered as done, never re-run;
+                # live-peer-owned ⇒ registered read-only; claimable ⇒
+                # we adopt it) instead of minting a duplicate run.
+                record = self.spool.read_job(job_id)
+                if record is not None:
+                    if json.dumps(
+                        record.get("config"), sort_keys=True
+                    ) != json.dumps(
+                        json.loads(config.to_json()), sort_keys=True
+                    ):
+                        raise ValueError(
+                            f"duplicate job id {job_id!r}"
+                        )
+                    self._absorb_spool_record(job_id, record, None)
+                    return job_id
+        if self.max_queue and self.queue_depth >= self.max_queue:
+            # Load shed with a retry hint sized to how fast rounds are
+            # actually draining the queue here, not a magic constant.
+            retry_after = max(1.0, round(
+                self._last_round_s
+                * (self.queue_depth / max(self.slots, 1)), 1,
+            ))
+            self._event("shed", n=config.n, queue_depth=self.queue_depth,
+                        retry_after_s=retry_after)
+            raise QueueFull(retry_after, self.queue_depth)
         key = batch_key_for(
-            config, slots=self.slots, min_bucket=self.min_bucket
+            config, slots=self.slots, min_bucket=self.min_bucket,
+            reroute=self.breakers.reroute,
         )
         if deadline_s is not None:
             # Coerce at the boundary: the HTTP API is open, and a
@@ -233,6 +455,18 @@ class EnsembleScheduler:
             deadline_s=deadline_s, seq=self._seq,
             submitted_ts=time.time(),
         )
+        if self.leases is not None:
+            lease = self.leases.claim(
+                job_id, min_fence=self.spool.record_fence(job_id)
+            )
+            if lease is None:
+                # A live lease with no readable record: the owner died
+                # between claim and persist, or the record is torn.
+                # (A record-backed retry was already absorbed above.)
+                raise ValueError(
+                    f"job id {job_id!r} is leased by another worker"
+                )
+            job.fence = lease.fence
         self.jobs[job_id] = job
         self._enqueue(key, job_id)
         self._event("submitted", job=job_id, n=config.n,
@@ -242,15 +476,28 @@ class EnsembleScheduler:
 
     def cancel(self, job_id: str) -> bool:
         job = self.jobs.get(job_id)
-        if job is None or job.status in TERMINAL:
+        if job is None or not job.owned:
+            # Not ours (a peer owns it, or we have never heard of it):
+            # if the SHARED spool has a live record, drop a cancel
+            # marker the owner consumes in its housekeeping — any
+            # worker accepts the cancel, the owner executes it.
+            if self.spool is not None:
+                record = self.spool.read_job(job_id)
+                if record is not None and record.get(
+                    "status", "pending"
+                ) not in TERMINAL:
+                    self.spool.request_cancel(job_id)
+                    return True
+            return False
+        if job.status in TERMINAL:
             return False
         if job.status == "running":
-            key = self._job_key(job)
+            key = self._assigned_key(job)
             slots = self._slot_jobs.get(key, [])
             if job_id in slots:
                 self._free_slot(key, slots.index(job_id))
         else:
-            key = self._job_key(job)
+            key = self._assigned_key(job)
             if job_id in self._pending.get(key, []):
                 self._pending[key].remove(job_id)
         self._finish(job, "cancelled")
@@ -258,11 +505,20 @@ class EnsembleScheduler:
 
     def status(self, job_id: str) -> Optional[dict]:
         job = self.jobs.get(job_id)
-        return None if job is None else job.to_dict()
+        if job is None:
+            return None
+        if not job.owned and self.spool is not None:
+            # A peer owns it: its spool record is the live truth.
+            self._sync_from_record(job)
+        return job.to_dict()
 
     def result(self, job_id: str) -> Optional[ParticleState]:
         job = self.jobs.get(job_id)
-        if job is None or job.status != "completed":
+        if job is None:
+            return None
+        if not job.owned and self.spool is not None:
+            self._sync_from_record(job)
+        if job.status != "completed":
             return None
         # Single read: the background spool writer sets job.state = None
         # (without a lock) once the .npz is durably down — reading the
@@ -288,7 +544,7 @@ class EnsembleScheduler:
         if job is None:
             return None
         if job.status == "running":
-            key = self._job_key(job)
+            key = self._assigned_key(job)
             slots = self._slot_jobs.get(key, [])
             if job_id in slots:
                 return self.engine.slot_state(
@@ -325,11 +581,47 @@ class EnsembleScheduler:
         if self.events is not None:
             self.events.event(kind, **fields)
 
-    def _persist(self, job: Job) -> None:
-        if self.spool is not None:
-            self.spool.write_job(job)
+    def _persist(self, job: Job) -> bool:
+        """Write the job record; False = fencing rejected it (we lost
+        ownership to an adopter — local state re-synced from disk)."""
+        if self.spool is None:
+            return True
+        landed = self.spool.write_job(job)
+        if not landed:
+            # Fenced out: a newer claim (our adopter) owns this job —
+            # its record is the truth; stop believing our local copy.
+            self._event("fenced", job=job.id, fence=job.fence,
+                        write="job")
+            self._sync_from_record(job)
+        return landed
+
+    def _apply_record(self, job: Job, rec: Optional[dict]) -> None:
+        """Overlay a spool record (the owner's truth) onto our local
+        job and mark it unowned."""
+        if rec:
+            job.status = rec.get("status", job.status)
+            job.steps_done = rec.get("steps_done", job.steps_done)
+            job.error = rec.get("error", job.error)
+            job.fence = rec.get("fence", job.fence)
+            job.requeues = rec.get("requeues", job.requeues)
+            job.finished_ts = rec.get("finished_ts", job.finished_ts)
+        job.owned = False
+        job.state = None
+        if self.leases is not None:
+            self.leases.forget(job.id)
+
+    def _sync_from_record(self, job: Job) -> None:
+        self._apply_record(job, self.spool.read_job(job.id))
 
     def _spool_result_async(self, job: Job, state: ParticleState) -> None:
+        # The closure captures ONLY what it needs (spool / events /
+        # leases / the job) — never `self`: a queued result write must
+        # not keep a dropped scheduler alive past its __del__-time
+        # lease release (the restart-respool tests rely on `del sched`
+        # behaving like a clean stop).
+        spool, events, leases = self.spool, self.events, self.leases
+        fence = job.fence if leases is not None else None
+
         def _write() -> None:
             # Errors are handled HERE, per job, not left in the
             # HostWriter: its sticky first-error would otherwise
@@ -340,16 +632,35 @@ class EnsembleScheduler:
             # serves it for this process's lifetime; only a restart
             # loses it (and then respools the job).
             try:
-                self.spool.write_result(job.id, state)
+                path = spool.write_result(job.id, state, fence=fence)
             except Exception as e:  # noqa: BLE001
                 try:
-                    self._event("spool_error", job=job.id, error=str(e))
+                    if events is not None:
+                        events.event("spool_error", job=job.id,
+                                     error=str(e))
                 except Exception:  # noqa: BLE001 — the event log likely
                     pass  # shares the failing disk; stay un-sticky
                 return
+            if path is None:
+                # Fenced out mid-air: an adopter's result is already
+                # (or about to be) the durable one; ours is discarded.
+                try:
+                    if events is not None:
+                        events.event("fenced", job=job.id, fence=fence,
+                                     write="result")
+                except Exception:  # noqa: BLE001
+                    pass
+                if leases is not None:
+                    leases.forget(job.id)
+                return
             # Only after the bytes are durably down: result() now
-            # reloads from the spool instead of the in-memory copy.
+            # reloads from the spool instead of the in-memory copy,
+            # and the lease is safe to release (an adopter scanning a
+            # completed-without-result record would otherwise re-run
+            # the job out from under our in-flight write).
             job.state = None
+            if leases is not None:
+                leases.release(job.id)
 
         if self._io is None:  # after close_io: degrade to a sync write
             _write()
@@ -369,13 +680,20 @@ class EnsembleScheduler:
 
     def close_io(self) -> None:
         """Drain and STOP the background writer thread (the scheduler
-        is done serving). drain_io only barriers — without this, every
-        spool-backed scheduler leaks one idle 'gravity-spool-io' thread
-        for the process lifetime (the daemon calls it from stop();
-        Simulator closes its HostWriter the same way)."""
+        is done serving), then RELEASE every held lease — the clean-
+        shutdown half of the ownership contract: a stopped worker's
+        jobs respool onto the next worker immediately instead of after
+        a TTL (a SIGKILL skips all of this; that is what expiry +
+        adoption recover). drain_io only barriers — without the close,
+        every spool-backed scheduler leaks one idle 'gravity-spool-io'
+        thread for the process lifetime (the daemon calls it from
+        stop(); Simulator closes its HostWriter the same way)."""
         if self._io is not None:
             self._io.close(raise_errors=False)
             self._io = None
+        if self.leases is not None:
+            self.leases.stop_heartbeat()
+            self.leases.release_all()
 
     def __enter__(self) -> "EnsembleScheduler":
         return self
@@ -386,16 +704,41 @@ class EnsembleScheduler:
         # (it is a daemon thread, so exit itself is clean either way).
         self.close_io()
 
+    def __del__(self) -> None:
+        # Dropping the last reference behaves like a clean stop:
+        # queued result writes land, leases release. Best-effort only —
+        # interpreter teardown may have dismantled half the world.
+        try:
+            self.close_io()
+        except Exception:  # noqa: BLE001
+            pass
+
+    def start_lease_heartbeat(self) -> None:
+        """Daemon mode: renew leases from a dedicated thread so a
+        minutes-long first compile on the round thread cannot let them
+        lapse (in-process consumers renew from housekeeping instead)."""
+        if self.leases is not None:
+            self.leases.start_heartbeat()
+
     def _job_key(self, job: Job) -> BatchKey:
         return batch_key_for(
-            job.config, slots=self.slots, min_bucket=self.min_bucket
+            job.config, slots=self.slots, min_bucket=self.min_bucket,
+            reroute=self.breakers.reroute,
         )
+
+    def _assigned_key(self, job: Job) -> BatchKey:
+        """The key this job is actually queued/resident under. Distinct
+        from :meth:`_job_key`, which recomputes (and may reroute
+        differently once a breaker opens/closes mid-flight)."""
+        return job.key_cache if job.key_cache is not None \
+            else self._job_key(job)
 
     def _enqueue(self, key: BatchKey, job_id: str) -> None:
         if key not in self._pending:
             self._pending[key] = []
         if key not in self._rotation:
             self._rotation.append(key)
+        self.jobs[job_id].key_cache = key
         self._pending[key].append(job_id)
         # Priority (desc) then submission order: one sort per admission
         # keeps the head of the queue always the next-due job.
@@ -415,6 +758,11 @@ class EnsembleScheduler:
         job.status = status
         job.error = error
         job.finished_ts = time.time()
+        if not self._persist(job):
+            # Fenced: an adopter owns the outcome — no terminal event
+            # from the zombie (exactly one completed/failed per job in
+            # the shared stream; _persist already logged `fenced`).
+            return
         if status == "completed":
             self._completed_latencies.append(
                 job.finished_ts - job.submitted_ts
@@ -423,9 +771,16 @@ class EnsembleScheduler:
             status if status in ServingEventLogger.KINDS else "failed",
             job=job.id, steps_done=job.steps_done, error=error,
         )
-        self._persist(job)
+        if self.leases is not None and status != "completed":
+            # failed/cancelled: nothing further to write — release now.
+            # A completed job keeps its lease until its .npz lands
+            # (released in the writer callback, or by the explicit
+            # release on the finalize-from-spool path), so an adoption
+            # scan can never re-run it out from under the in-flight
+            # result write.
+            self.leases.release(job.id)
 
-    def _admit(self, key: BatchKey, slot: int, job: Job) -> None:
+    def _admit(self, key: BatchKey, slot: int, job: Job) -> bool:
         from ..simulation import make_initial_state
 
         try:
@@ -437,12 +792,52 @@ class EnsembleScheduler:
             # (submit-time validation covers the known cases; this is
             # the backstop for the rest).
             self._finish(job, "failed", error=f"admission failed: {e}")
-            return
+            return False
         batch = self._batch_for(key)
-        self._batches[key] = self.engine.load_slot(
-            batch, slot, state,
-            dt=job.config.dt, steps=job.steps - job.steps_done,
-        )
+        try:
+            self._batches[key] = self.engine.load_slot(
+                batch, slot, state,
+                dt=job.config.dt, steps=job.steps - job.steps_done,
+            )
+        except BackendUnavailable as e:
+            # The slot load builds the key's kernel (carried-accel
+            # seed): a backend that cannot compile surfaces HERE, at
+            # admission — count it on the breaker and requeue the job,
+            # which re-keys through the breaker reroute (once the
+            # breaker opens, the retry lands in a bucket whose backend
+            # builds). The requeue still counts toward max_requeues
+            # (at most one admission attempt per job per round, so the
+            # counter is per-round-bounded): when even the rerouted
+            # FLOOR cannot build, the job must go terminal 'poisoned'
+            # instead of burning a failed kernel build every round
+            # forever.
+            if self.breakers.get(key.backend).record_failure():
+                self._event(
+                    "breaker_open", backend=key.backend,
+                    failures=self.breakers.get(key.backend).failures,
+                    error=str(e),
+                )
+            job.requeues += 1
+            if job.requeues > self.max_requeues:
+                self._event("poisoned", job=job.id,
+                            requeues=job.requeues, error=str(e))
+                self._finish(
+                    job, "failed",
+                    error=f"poisoned: {job.requeues} failed admissions/"
+                          f"requeues (last: {e})",
+                )
+                return False
+            try:
+                new_key = self._job_key(job)
+            except ValueError as err:
+                self._finish(job, "failed",
+                             error=f"requeue rejected: {err}")
+                return False
+            self._enqueue(new_key, job.id)
+            self._event("respooled", job=job.id,
+                        reason=f"backend {key.backend} unavailable")
+            self._persist(job)
+            return False
         self._slot_jobs[key][slot] = job.id
         job.status = "running"
         job.resident_rounds = 0
@@ -451,6 +846,7 @@ class EnsembleScheduler:
         self._event("admitted", job=job.id, slot=slot,
                     bucket=key.bucket_n)
         self._persist(job)
+        return True
 
     def _free_slot(self, key: BatchKey, slot: int) -> None:
         self._batches[key] = self.engine.clear_slot(
@@ -475,17 +871,34 @@ class EnsembleScheduler:
         preemption, then the anti-starvation yield."""
         pending = self._pending.get(key, [])
         slots = self._slot_jobs.setdefault(key, [None] * key.slots)
-        # 1. Backfill free slots.
+        # 1. Backfill free slots. Each candidate is tried at most once
+        # per round: an admission failure may requeue the job into this
+        # very list (backend-unavailable path), and re-trying it in the
+        # same pass would spin. A requeued job at the queue HEAD must
+        # not block the rest of the queue either — skip attempted
+        # entries and keep admitting, so free slots never sit idle
+        # behind one unbuildable job while its breaker warms up.
+        attempted: set = set()
         for slot in range(key.slots):
-            if not pending:
-                break
-            if slots[slot] is None:
-                self._admit(key, slot, self.jobs[pending.pop(0)])
-        if not pending:
+            if slots[slot] is not None:
+                continue
+            while True:
+                job_id = next(
+                    (j for j in pending if j not in attempted), None
+                )
+                if job_id is None:
+                    break
+                pending.remove(job_id)
+                attempted.add(job_id)
+                if self._admit(key, slot, self.jobs[job_id]):
+                    break
+        if not pending or all(j in attempted for j in pending):
             return
         # 2. Priority preemption: a strictly-higher-priority arrival
         # takes the lowest-priority resident's slot.
         for waiting_id in list(pending):
+            if waiting_id in attempted:
+                continue
             waiter = self.jobs[waiting_id]
             resident = [
                 (self.jobs[slots[s]].priority, -s, s)
@@ -497,6 +910,7 @@ class EnsembleScheduler:
             if waiter.priority > low_prio:
                 self._evict(key, low_slot, reason="preempted")
                 pending.remove(waiting_id)
+                attempted.add(waiting_id)
                 self._admit(key, low_slot, waiter)
             else:
                 break  # pending is priority-sorted; no further winners
@@ -507,6 +921,8 @@ class EnsembleScheduler:
         # priority waiters (bounded wait: a short job admitted behind a
         # full batch of long jobs runs within yield_rounds+1 rounds).
         for waiting_id in list(pending):
+            if waiting_id in attempted:
+                continue
             ripe = [
                 (-self.jobs[slots[s]].resident_rounds,
                  self.jobs[slots[s]].priority, s)
@@ -522,6 +938,7 @@ class EnsembleScheduler:
             _, _, slot = min(ripe)
             self._evict(key, slot, reason="yield")
             self._pending[key].remove(waiting_id)
+            attempted.add(waiting_id)
             self._admit(key, slot, self.jobs[waiting_id])
 
     def _next_key(self) -> Optional[BatchKey]:
@@ -541,6 +958,22 @@ class EnsembleScheduler:
         batch one step-slice, retire finished/diverged/expired jobs.
         Returns the round's metrics (also streamed as a ``round``
         event), or None when there is no work at all."""
+        # Chaos hooks, at the real boundary every round crosses:
+        # crash_worker is a genuine un-catchable SIGKILL; stall_worker
+        # pauses us with heartbeats suspended (lease expiry + adoption
+        # happen to a LIVE process); stale_lease backdates our leases
+        # with no sleep at all (the deterministic fencing test).
+        maybe_crash_worker(self.rounds_run)
+        if self.leases is not None:
+            stall = stall_worker_secs(self.rounds_run)
+            if stall > 0:
+                self.leases.suspend(stall)
+                time.sleep(stall)
+            stale = stale_lease_secs(self.rounds_run)
+            if stale > 0:
+                self.leases.suspend(stale)
+                self.leases.backdate()
+        self.housekeeping()
         key = self._next_key()
         if key is None:
             return None
@@ -560,7 +993,7 @@ class EnsembleScheduler:
         t0 = time.perf_counter()
         try:
             batch, res = self.engine.run_slice(batch, self.slice_steps)
-        except Exception:
+        except Exception as exc:
             # run_slice DONATES the batch carry: after a throw mid-slice
             # (e.g. a transient device error at the finite fetch) the
             # resident states are unrecoverable — the old batch's
@@ -571,6 +1004,17 @@ class EnsembleScheduler:
             # from step 0 (ICs are a pure function of the config — the
             # same contract as a daemon-restart respool), then re-raise
             # for the caller's backstop.
+            if isinstance(exc, BackendUnavailable):
+                # A kernel that cannot build fails every round it is
+                # asked to run: count it on the backend's breaker so
+                # admission reroutes down the exact-physics ladder
+                # instead of burning a round per retry forever.
+                if self.breakers.get(key.backend).record_failure():
+                    self._event(
+                        "breaker_open", backend=key.backend,
+                        failures=self.breakers.get(key.backend).failures,
+                        error=str(exc),
+                    )
             self._batches.pop(key, None)
             resident = [j for j in self._slot_jobs.pop(key, []) if j]
             for job_id in resident:
@@ -578,21 +1022,46 @@ class EnsembleScheduler:
                 job.status = "pending"
                 job.steps_done = 0
                 job.state = None
-                # Same "restart clean" reset as _respool: the dead
-                # attempt's compute time and timestamps would otherwise
-                # double-count in /status once the job re-runs.
+                # Same "restart clean" reset as the respool scan: the
+                # dead attempt's compute time and timestamps would
+                # otherwise double-count in /status once the job
+                # re-runs.
                 job.started_ts = None
                 job.finished_ts = None
                 job.error = None
                 job.active_s = 0.0
-                self._enqueue(key, job_id)
+                job.requeues += 1
+                if job.requeues > self.max_requeues:
+                    # Poison pill: this job has now taken down its
+                    # bucket max_requeues times — terminal, instead of
+                    # starving its batchmates forever.
+                    self._event("poisoned", job=job_id,
+                                requeues=job.requeues, error=str(exc))
+                    self._finish(
+                        job, "failed",
+                        error=f"poisoned: requeued {job.requeues} times "
+                              f"(last round error: {exc})",
+                    )
+                    continue
+                # Re-key on requeue: a breaker that just opened must
+                # route the retry to a different bucket/backend.
+                try:
+                    new_key = self._job_key(job)
+                except ValueError as e:
+                    self._finish(job, "failed",
+                                 error=f"requeue rejected: {e}")
+                    continue
+                self._enqueue(new_key, job_id)
                 self._event("respooled", job=job_id,
                             reason="round failed; restarting clean")
                 self._persist(job)
             raise
         round_s = time.perf_counter() - t0
+        self._last_round_s = round_s
         self._batches[key] = batch
         self.rounds_run += 1
+        if self.breakers.success(key.backend):
+            self._event("breaker_closed", backend=key.backend)
 
         real_pairs = 0.0
         for slot in occupied:
@@ -670,10 +1139,11 @@ class EnsembleScheduler:
     def _expire_deadlines(self) -> None:
         now = time.time()
         for job in list(self.jobs.values()):
-            if job.status in TERMINAL or job.deadline_s is None:
+            if job.status in TERMINAL or job.deadline_s is None \
+                    or not job.owned:
                 continue
             if now - job.submitted_ts > job.deadline_s:
-                key = self._job_key(job)
+                key = self._assigned_key(job)
                 if job.status == "running":
                     slots = self._slot_jobs.get(key, [])
                     if job.id in slots:
@@ -685,62 +1155,290 @@ class EnsembleScheduler:
                     error=f"deadline of {job.deadline_s}s exceeded",
                 )
 
-    def _respool(self) -> None:
-        """Reload the spool after a restart: unfinished jobs re-queue
-        (their ICs are a pure function of the config, so they reproduce
-        the same trajectory); terminal jobs stay queryable."""
-        for record in self.spool.load_jobs():
-            try:
-                config = SimulationConfig.from_json(
-                    json.dumps(record["config"])
-                )
-            except (KeyError, TypeError, ValueError):
+    # --- fleet-mode housekeeping: heartbeats, adoption, reaping ---
+
+    def housekeeping(self) -> None:
+        """Fleet-mode periodic work, callable from any round/idle loop:
+        renew our lease heartbeats (rate-limited; the daemon ALSO runs
+        the dedicated thread), react to leases we lost while out, and —
+        every ``reap_interval_s`` — scan the spool for unclaimed work
+        and expired leases to adopt. No-op without a spool."""
+        if self.leases is None:
+            return
+        self.leases.maybe_renew()
+        # Drain losses from EVERY renewal path — the rate-limited one
+        # above and the daemon's dedicated heartbeat thread (whose
+        # renew_all return value nobody reads).
+        for job_id in self.leases.take_lost():
+            self._on_lease_lost(job_id)
+        now = time.time()
+        if now < self._next_scan:
+            return
+        self._next_scan = now + self.reap_interval_s
+        self._scan_spool()
+        self._consume_cancel_markers()
+
+    def _consume_cancel_markers(self) -> None:
+        """Execute cross-worker cancel requests for jobs WE own (any
+        worker accepts a cancel into the spool; only the owner can pull
+        the job out of its batch). Stale markers — job already terminal
+        or unknown — are reaped so the directory stays bounded."""
+        try:
+            names = os.listdir(self.spool.cancels_dir)
+        except OSError:
+            return
+        for name in names:
+            if not name.endswith(".json"):
                 continue
-            self._seq += 1
-            job = Job(
-                id=record["id"], config=config,
-                priority=record.get("priority", 0),
-                deadline_s=record.get("deadline_s"),
-                seq=self._seq,
-                status=record.get("status", "pending"),
-                steps_done=record.get("steps_done", 0),
-                error=record.get("error"),
-                submitted_ts=record.get("submitted_ts", time.time()),
-                started_ts=record.get("started_ts"),
-                finished_ts=record.get("finished_ts"),
+            job_id = name[:-len(".json")]
+            job = self.jobs.get(job_id)
+            if job is not None and job.owned \
+                    and job.status not in TERMINAL:
+                self.cancel(job_id)
+                self.spool.clear_cancel(job_id)
+            elif job is not None and job.status in TERMINAL:
+                self.spool.clear_cancel(job_id)
+            elif job is None:
+                # Nobody absorbed this job (e.g. a record whose config
+                # no live worker can parse — the scan deliberately
+                # leaves those unclaimed): cancel it at the SPOOL level
+                # under a claimed lease so the marker doesn't sit there
+                # forever acknowledging a cancel no one executes.
+                rec = self.spool.read_job(job_id)
+                if rec is None or rec.get("status") in TERMINAL:
+                    self.spool.clear_cancel(job_id)
+                    continue
+                lease = None if self.leases is None else \
+                    self.leases.claim(
+                        job_id,
+                        min_fence=int(rec.get("fence", 0) or 0),
+                    )
+                if lease is None:
+                    continue  # a live peer owns it; that owner acts
+                rec.update(status="cancelled", fence=lease.fence,
+                           finished_ts=time.time())
+                atomic_write_json(self.spool.job_path(job_id), rec)
+                self.leases.release(job_id)
+                self.spool.clear_cancel(job_id)
+                self._event("cancelled", job=job_id,
+                            reason="spool-level cancel (unclaimable "
+                                   "record)")
+
+    def _on_lease_lost(self, job_id: str) -> None:
+        """A heartbeat discovered a peer adopted this job (our lease
+        lapsed — stall, clock trouble, injected staleness): stop
+        scheduling it and treat the spool record as the truth. Any
+        write we still have in flight is rejected by fencing anyway;
+        this just stops wasting rounds on a job we no longer own."""
+        job = self.jobs.get(job_id)
+        if job is None or job.status in TERMINAL or not job.owned:
+            return
+        key = self._assigned_key(job)
+        if job.status == "running":
+            slots = self._slot_jobs.get(key, [])
+            if job_id in slots:
+                self._free_slot(key, slots.index(job_id))
+        elif job_id in self._pending.get(key, []):
+            self._pending[key].remove(job_id)
+        self._sync_from_record(job)
+
+    def _job_from_record(self, record: dict) -> Optional[Job]:
+        try:
+            config = SimulationConfig.from_json(
+                json.dumps(record["config"])
             )
+        except (KeyError, TypeError, ValueError):
+            return None
+        self._seq += 1
+        return Job(
+            id=record["id"], config=config,
+            priority=record.get("priority", 0),
+            deadline_s=record.get("deadline_s"),
+            seq=self._seq,
+            status=record.get("status", "pending"),
+            steps_done=record.get("steps_done", 0),
+            error=record.get("error"),
+            submitted_ts=record.get("submitted_ts", time.time()),
+            started_ts=record.get("started_ts"),
+            finished_ts=record.get("finished_ts"),
+            fence=int(record.get("fence", 0) or 0),
+            requeues=int(record.get("requeues", 0) or 0),
+        )
+
+    def _register_unowned(self, record: dict, known: Optional[Job]
+                          ) -> None:
+        """Track a peer-owned job so /status and /result on THIS worker
+        can answer for it (clients fail over between workers; any
+        replica must be able to speak for the whole spool)."""
+        if known is not None:
+            # The caller just read this record — apply it directly
+            # instead of paying a second disk read per job per scan.
+            self._apply_record(known, record)
+            return
+        job = self._job_from_record(record)
+        if job is not None:
+            job.owned = False
             self.jobs[job.id] = job
-            # A "completed" record without its result bytes on disk is
-            # not durable: _finish persists terminal status while the
-            # .npz write rides the background writer, so a crash (or a
-            # spool_error'd write) in that window leaves result() with
-            # nothing to serve after restart. Re-run it — ICs are a
-            # pure function of the config, so it reproduces the same
-            # trajectory (same semantics as a pre-completion crash).
-            lost_result = job.status == "completed" and not os.path.exists(
-                self.spool.result_path(job.id)
+
+    def _respool(self) -> None:
+        """Startup scan — same machinery as the periodic reaper."""
+        self._scan_spool()
+
+    def _scan_spool(self) -> None:
+        """The reaper: walk the spool's job records and take ownership
+        of everything claimable — unleased pending work, expired leases
+        (a dead peer's jobs: ``adopted`` events), our own records after
+        a restart (``respooled``). Idempotent with the async result
+        writes: a job whose ``.npz`` already landed is finalized as
+        completed, never re-run; one that was mid-flight restarts clean
+        from step 0 (ICs are a pure function of the config) with its
+        ``requeues`` counter bumped — past ``max_requeues`` it goes
+        terminal ``failed`` (``poisoned``) instead of crash-looping
+        through the whole fleet. Live peers' jobs are registered
+        read-only so any worker can answer status/result for them.
+
+        Steady-state cost: terminal records accumulate for the life of
+        the spool, so every record whose terminal state we have already
+        registered joins ``_known_terminal`` and is skipped WITHOUT a
+        file read — the per-scan cost is O(active + new), not O(every
+        job ever submitted)."""
+        try:
+            names = sorted(os.listdir(self.spool.jobs_dir))
+        except OSError:
+            return
+        for name in names:
+            if not name.endswith(".json"):
+                continue
+            file_id = name[:-len(".json")]
+            if file_id in self._known_terminal:
+                continue
+            known = self.jobs.get(file_id)
+            if known is not None and (
+                known.owned or known.status in TERMINAL
+            ):
+                if known.status in TERMINAL and (
+                    known.status != "completed"
+                    or os.path.exists(self.spool.result_path(file_id))
+                ):
+                    self._known_terminal.add(file_id)
+                    continue
+                if known.owned:
+                    continue
+                # Remaining case: UNOWNED 'completed' with no result
+                # bytes — we saw the peer's record during its in-flight
+                # result write. If the peer died before the .npz
+                # landed, this job is claimable and must RE-RUN — fall
+                # through and absorb (while the owner lives, its lease
+                # still blocks us).
+            record = self.spool.read_job(file_id)
+            if record is None:
+                continue  # torn write from a crash; the job re-runs
+            self._absorb_spool_record(file_id, record, known)
+
+    def _absorb_spool_record(
+        self, file_id: str, record: dict, known: Optional[Job]
+    ) -> None:
+        """Take whatever action one spool record calls for: register a
+        durable-terminal or live-peer-owned job read-only, finalize a
+        landed-result job, or claim + requeue claimable work (the
+        reaper's per-record body; `submit` with an explicit job id
+        absorbs through the same path so retries of an already-spooled
+        job never fork a duplicate)."""
+        job_id = record.get("id")
+        if not isinstance(job_id, str) or not job_id:
+            return
+        status = record.get("status", "pending")
+        result_exists = os.path.exists(
+            self.spool.result_path(job_id)
+        )
+        # A "completed" record without its result bytes is not
+        # durable (the .npz rides the background writer): treat it
+        # like a mid-flight crash and re-run. Every other terminal
+        # record is final — register it for queries and move on.
+        if status in TERMINAL and (
+            status != "completed" or result_exists
+        ):
+            self._register_unowned(record, known)
+            self._known_terminal.add(file_id)
+            return
+        if self.leases is None:
+            lease = None
+        else:
+            lease = self.leases.claim(
+                job_id,
+                min_fence=int(record.get("fence", 0) or 0),
             )
-            if job.status in TERMINAL and not lost_result:
-                continue
-            # Interrupted mid-flight, never started, or completed with
-            # its result lost: restart clean.
-            job.status = "pending"
-            job.steps_done = 0
-            job.started_ts = None
-            job.finished_ts = None
-            job.error = None
-            job.active_s = 0.0
-            try:
-                key = self._job_key(job)
-            except ValueError as e:
-                # A stale spool record the current envelope rejects
-                # (model renamed, caps lowered, ...) must fail THAT job,
-                # not crash daemon startup and strand its peers
-                # (review finding).
+            if lease is None:
+                # A live peer owns it.
+                self._register_unowned(record, known)
+                return
+        job = known if known is not None \
+            else self._job_from_record(record)
+        if job is None:
+            # Unparseable config (foreign/corrupt record): leave it
+            # for a worker that understands it; our lease lapses.
+            if self.leases is not None:
+                self.leases.release(job_id)
+            return
+        self.jobs[job_id] = job
+        job.owned = True
+        if lease is not None:
+            job.fence = lease.fence
+        adopted_from = getattr(lease, "adopted_from", None)
+        if result_exists:
+            # Idempotent adoption: the result already landed (the
+            # writer died between the .npz and the record write, or
+            # the record write was fenced) — finalize, don't re-run.
+            job.steps_done = job.config.steps
+            job.state = None
+            self._event("adopted", job=job_id,
+                        from_worker=adopted_from, fence=job.fence,
+                        reason="result already on disk")
+            self._finish(job, "completed")
+            if self.leases is not None:
+                self.leases.release(job_id)
+            return
+        # Interrupted mid-flight, never started, or completed with
+        # its result lost: restart clean.
+        was_started = (
+            status in ("running", "completed")
+            or record.get("started_ts") is not None
+        )
+        job.status = "pending"
+        job.steps_done = 0
+        job.state = None
+        job.started_ts = None
+        job.finished_ts = None
+        job.error = None
+        job.active_s = 0.0
+        if was_started:
+            job.requeues += 1
+            if job.requeues > self.max_requeues:
+                self._event("poisoned", job=job_id,
+                            requeues=job.requeues)
                 self._finish(
-                    job, "failed", error=f"respool rejected: {e}"
+                    job, "failed",
+                    error=f"poisoned: requeued {job.requeues} "
+                          "times across workers",
                 )
-                continue
-            self._enqueue(key, job.id)
+                return
+        try:
+            key = self._job_key(job)
+        except (ValueError, TypeError) as e:
+            # A stale spool record the current envelope rejects
+            # (model renamed, caps lowered, ...) must fail THAT job,
+            # not crash daemon startup and strand its peers (review
+            # finding). TypeError too: dataclasses don't type-check,
+            # so a foreign record with a wrong-typed field (n="10")
+            # parses fine and only blows up inside the keying.
+            self._finish(
+                job, "failed", error=f"respool rejected: {e}"
+            )
+            return
+        self._enqueue(key, job.id)
+        if adopted_from and adopted_from != self.worker_id:
+            self._event("adopted", job=job.id,
+                        from_worker=adopted_from, fence=job.fence)
+        else:
             self._event("respooled", job=job.id)
-            self._persist(job)
+        self._persist(job)

@@ -37,6 +37,7 @@ from __future__ import annotations
 
 import json
 import os
+import random
 import threading
 import time
 import urllib.error
@@ -47,10 +48,17 @@ from typing import Optional
 import numpy as np
 
 from ..config import SimulationConfig
+from ..utils.hostio import atomic_write_json
 from ..utils.logging import ServingEventLogger
-from .scheduler import EnsembleScheduler, Spool
+from .leases import _local_host, _pid_alive, pid_start, read_json_retry
+from .scheduler import EnsembleScheduler, QueueFull, Spool, default_worker_id
 
 DAEMON_FILE = "daemon.json"
+# Per-worker endpoint registry: every worker sharing the spool
+# advertises itself under workers/<worker_id>.json so clients can fail
+# over to a surviving replica when the daemon.json worker dies
+# (docs/serving.md "Multi-worker shared spool").
+WORKERS_DIR = "workers"
 
 
 class GravityDaemon:
@@ -66,20 +74,30 @@ class GravityDaemon:
         slice_steps: int = 100,
         yield_rounds: int = 2,
         idle_sleep_s: float = 0.02,
+        worker_id: Optional[str] = None,
+        lease_ttl_s: float = 30.0,
+        max_queue: int = 1024,
+        max_requeues: int = 5,
     ):
         self.spool_dir = spool_dir
         self.host = host
         self.port = port
         self.idle_sleep_s = idle_sleep_s
+        self.worker_id = worker_id or default_worker_id()
         os.makedirs(spool_dir, exist_ok=True)
         self.spool = Spool(spool_dir)
+        # N workers sharing one spool append to ONE event stream; the
+        # worker context field keeps every line attributable.
         self.events = ServingEventLogger(
-            os.path.join(spool_dir, "serving_events.jsonl")
+            os.path.join(spool_dir, "serving_events.jsonl"),
+            context={"worker": self.worker_id},
         )
         self.scheduler = EnsembleScheduler(
             slots=slots, slice_steps=slice_steps,
             yield_rounds=yield_rounds, events=self.events,
-            spool=self.spool,
+            spool=self.spool, worker_id=self.worker_id,
+            lease_ttl_s=lease_ttl_s, max_queue=max_queue,
+            max_requeues=max_requeues,
         )
         self.lock = threading.Lock()
         self._stop = threading.Event()
@@ -97,11 +115,16 @@ class GravityDaemon:
             def log_message(self, *args):  # quiet by default
                 pass
 
-            def _reply(self, code: int, payload: dict) -> None:
+            def _reply(
+                self, code: int, payload: dict,
+                headers: Optional[dict] = None,
+            ) -> None:
                 body = json.dumps(payload).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, str(v))
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -124,21 +147,48 @@ class GravityDaemon:
                 self._reply(code, payload)
 
             def do_POST(self):
+                headers = None
                 try:
                     body = self._body()
                     path = self.path.partition("?")[0]
                     code, payload = daemon.handle_post(path, body)
+                    if code == 503 and "retry_after_s" in payload:
+                        # Load shed: the standard backpressure header,
+                        # so generic HTTP clients back off correctly.
+                        headers = {
+                            "Retry-After":
+                                int(payload["retry_after_s"]) or 1
+                        }
                 except Exception as e:  # noqa: BLE001 — API boundary
                     code, payload = 500, {"error": str(e)}
-                self._reply(code, payload)
+                self._reply(code, payload, headers)
 
         self._server = ThreadingHTTPServer((self.host, self.port), Handler)
         self.host, self.port = self._server.server_address[:2]
-        with open(os.path.join(self.spool_dir, DAEMON_FILE), "w") as f:
-            json.dump(
-                {"host": self.host, "port": self.port, "pid": os.getpid()},
-                f,
-            )
+        endpoint = {
+            "host": self.host, "port": self.port, "pid": os.getpid(),
+            # Process-instance identity: clients verify (pid, start
+            # time) so a recycled pid can't make this entry look alive
+            # after a SIGKILL (registry files are only removed by a
+            # CLEAN stop).
+            "pid_start": pid_start(os.getpid()),
+            # host = the BIND address; host_name = the machine, so
+            # clients on other hosts know the pid probe does not apply.
+            "host_name": _local_host(),
+            "worker_id": self.worker_id,
+        }
+        # daemon.json stays the primary discovery file (last worker to
+        # start wins); the per-worker registry is the failover list
+        # clients walk when its pid is dead (find_daemon).
+        atomic_write_json(
+            os.path.join(self.spool_dir, DAEMON_FILE), endpoint
+        )
+        workers_dir = os.path.join(self.spool_dir, WORKERS_DIR)
+        os.makedirs(workers_dir, exist_ok=True)
+        atomic_write_json(
+            os.path.join(workers_dir, f"{self.worker_id}.json"), endpoint
+        )
+        self.scheduler.start_lease_heartbeat()
         t_http = threading.Thread(
             target=self._server.serve_forever, daemon=True,
             name="gravity-serve-http",
@@ -163,6 +213,10 @@ class GravityDaemon:
         while not self._stop.is_set():
             try:
                 with self.lock:
+                    # Housekeeping runs even while idle: an idle
+                    # replica is exactly the one that must notice a
+                    # dead peer's expired leases and adopt its jobs.
+                    self.scheduler.housekeeping()
                     worked = (
                         self.scheduler.run_round() is not None
                         if self.scheduler.has_work() else False
@@ -194,7 +248,21 @@ class GravityDaemon:
             pass
         self.scheduler.close_io()
         try:
-            os.remove(os.path.join(self.spool_dir, DAEMON_FILE))
+            os.remove(os.path.join(
+                self.spool_dir, WORKERS_DIR, f"{self.worker_id}.json"
+            ))
+        except OSError:
+            pass
+        try:
+            # Only remove daemon.json if it is OURS: with peers sharing
+            # the spool, deleting a survivor's endpoint file would cut
+            # clients off from a perfectly healthy worker.
+            path = os.path.join(self.spool_dir, DAEMON_FILE)
+            info = read_json_retry(path)
+            if info is None or info.get("worker_id") in (
+                None, self.worker_id
+            ):
+                os.remove(path)
         except OSError:
             pass
 
@@ -227,6 +295,7 @@ class GravityDaemon:
             # attribute reads — racy by a round at worst.
             return 200, {
                 "ok": True,
+                "worker_id": self.worker_id,
                 "queue_depth": self.scheduler.queue_depth,
                 "active": self.scheduler.active_count,
                 "rounds": self.scheduler.rounds_run,
@@ -241,13 +310,13 @@ class GravityDaemon:
                             for j in self.scheduler.jobs.values()
                         ]
                     }
-                st = self.scheduler.status(job_id)
+                st = self._status_any(job_id)
                 if st is None:
                     return 404, {"error": f"unknown job {job_id!r}"}
                 return 200, st
             if path == "/result":
                 job_id = params.get("job", "")
-                st = self.scheduler.status(job_id)
+                st = self._status_any(job_id)
                 if st is None:
                     return 404, {"error": f"unknown job {job_id!r}"}
                 if st["status"] != "completed":
@@ -256,6 +325,18 @@ class GravityDaemon:
                         **st,
                     }
                 state = self.scheduler.result(job_id)
+                if state is None:
+                    # Spool fallback: any replica can serve any durable
+                    # result, including a dead peer's — the reaper may
+                    # not have registered the job locally yet.
+                    data = self.spool.load_result(job_id)
+                    if data is not None:
+                        from ..state import ParticleState
+
+                        state = ParticleState.create(
+                            data["positions"], data["velocities"],
+                            data["masses"],
+                        )
                 payload = dict(st)
                 # The .npz rides the background writer, so "completed"
                 # no longer implies bytes on disk: advertise the path
@@ -275,20 +356,41 @@ class GravityDaemon:
                     payload["masses"] = np.asarray(state.masses).tolist()
                 return 200, payload
             if path == "/metrics":
+                sched = self.scheduler
                 return 200, {
-                    "queue_depth": self.scheduler.queue_depth,
-                    "active": self.scheduler.active_count,
-                    "rounds": self.scheduler.rounds_run,
-                    "latency": self.scheduler.latency_percentiles(),
+                    "worker_id": self.worker_id,
+                    "queue_depth": sched.queue_depth,
+                    "active": sched.active_count,
+                    "rounds": sched.rounds_run,
+                    "latency": sched.latency_percentiles(),
                     "compile_counts": {
                         f"bucket={k.bucket_n},slots={k.slots},"
                         f"backend={k.backend}": v
                         for k, v in
-                        self.scheduler.engine.compile_counts.items()
+                        sched.engine.compile_counts.items()
                     },
+                    "breakers": sched.breakers.snapshot(),
+                    "max_queue": sched.max_queue,
+                    "leases_held": (
+                        len(sched.leases.held_ids())
+                        if sched.leases is not None else 0
+                    ),
                     "events_path": self.events.path,
                 }
         return 404, {"error": f"unknown path {path!r}"}
+
+    def _status_any(self, job_id: str) -> Optional[dict]:
+        """Status from the scheduler, falling back to the shared spool
+        record — any replica answers for any job in the spool, owned or
+        not (the client may have failed over from a dead worker whose
+        jobs we have not adopted yet)."""
+        st = self.scheduler.status(job_id)
+        if st is not None:
+            return st
+        rec = self.spool.read_job(job_id)
+        if rec is None:
+            return None
+        return {k: v for k, v in rec.items() if k != "config"}
 
     def handle_post(self, path: str, body: dict) -> tuple[int, dict]:
         if path == "/submit":
@@ -306,6 +408,15 @@ class GravityDaemon:
                         deadline_s=body.get("deadline_s"),
                         job_id=body.get("job_id"),
                     )
+                except QueueFull as e:
+                    # Bounded-queue load shed: 503 + Retry-After (set
+                    # as a header by the HTTP layer) — the client backs
+                    # off instead of the daemon buffering unboundedly.
+                    return 503, {
+                        "error": str(e),
+                        "retry_after_s": e.retry_after_s,
+                        "queue_depth": e.depth,
+                    }
                 except (ValueError, TypeError) as e:
                     # TypeError too: dataclasses don't type-check, so a
                     # wrong-typed field (n="10") surfaces inside
@@ -329,18 +440,90 @@ class DaemonUnreachable(RuntimeError):
     pass
 
 
-def find_daemon(spool_dir: str) -> tuple[str, int]:
-    path = os.path.join(spool_dir, DAEMON_FILE)
+def _entry_alive(info: dict) -> bool:
+    """Is a registry/daemon.json endpoint's worker still alive, as far
+    as we can tell from HERE? Same-host entries get the precise
+    (pid, starttime) probe; a REMOTE host's pid cannot be probed
+    locally — treat it as alive and let the connection attempt decide
+    (never declare a healthy remote daemon dead from a local pid)."""
+    host = info.get("host_name")
+    if host is not None and host != _local_host():
+        return True
+    return _pid_alive(int(info.get("pid", 0) or 0),
+                      info.get("pid_start"))
+
+
+def _live_workers(spool_dir: str) -> list[dict]:
+    """Worker-registry entries whose pid is still alive, newest file
+    first — the client-side failover list."""
+    workers_dir = os.path.join(spool_dir, WORKERS_DIR)
+
+    def _mtime(name: str) -> float:
+        # Per-entry tolerant: a worker removing its own file mid-listing
+        # (clean stop) must not abort failover to the SURVIVORS.
+        try:
+            return os.path.getmtime(os.path.join(workers_dir, name))
+        except OSError:
+            return 0.0
+
     try:
-        with open(path) as f:
-            info = json.load(f)
-        return info["host"], int(info["port"])
-    except (OSError, KeyError, ValueError) as e:
-        raise DaemonUnreachable(
-            f"no running daemon advertised under {spool_dir!r} "
-            f"(missing/unreadable {path}); start one with "
-            "`gravity_tpu serve --spool-dir " + spool_dir + "`"
-        ) from e
+        names = sorted(
+            (n for n in os.listdir(workers_dir) if n.endswith(".json")),
+            key=_mtime,
+            reverse=True,
+        )
+    except OSError:
+        return []
+    out = []
+    for name in names:
+        info = read_json_retry(os.path.join(workers_dir, name))
+        if isinstance(info, dict) and "host" in info and "port" in info \
+                and _entry_alive(info):
+            out.append(info)
+    return out
+
+
+def find_daemon(spool_dir: str) -> tuple[str, int]:
+    """The endpoint to talk to: ``daemon.json`` while its pid is alive,
+    else any live worker from the registry (failover to a surviving
+    replica). A daemon.json whose pid is DEAD is deleted on sight — a
+    stale endpoint file must produce a clear 'daemon not running'
+    error (CLI exit 2), never a hang against a port nobody owns."""
+    path = os.path.join(spool_dir, DAEMON_FILE)
+    info = read_json_retry(path)
+    if isinstance(info, dict) and "host" in info and "port" in info:
+        if _entry_alive(info):
+            return info["host"], int(info["port"])
+        try:
+            # Re-read before reaping: a fresh daemon may have replaced
+            # the file between our read and now — deleting ITS
+            # endpoint would cut primary discovery for a healthy
+            # worker (TOCTOU; the registry walk would still recover).
+            if read_json_retry(path) == info:
+                os.remove(path)  # stale: its worker is gone
+        except OSError:
+            pass
+    for worker in _live_workers(spool_dir):
+        return worker["host"], int(worker["port"])
+    raise DaemonUnreachable(
+        f"daemon not running: no live worker advertised under "
+        f"{spool_dir!r}; start one with "
+        "`gravity_tpu serve --spool-dir " + spool_dir + "`"
+    )
+
+
+def backoff_delay(
+    attempt: int, base_s: float = 0.25, cap_s: float = 8.0,
+    retry_after_s: Optional[float] = None,
+) -> float:
+    """Exponential backoff with full jitter (attempt counts from 0).
+    A server-provided ``Retry-After`` hint floors the delay — backing
+    off LESS than the server asked for just re-sheds the request."""
+    delay = min(base_s * 2**attempt, cap_s)
+    delay *= 0.5 + random.random() * 0.5  # jitter: de-sync the herd
+    if retry_after_s is not None:
+        delay = max(delay, float(retry_after_s))
+    return delay
 
 
 def request(
@@ -354,8 +537,47 @@ def request(
     # so the client must outwait a round, not a socket RTT (review
     # finding; wait_for additionally retries on transient timeouts).
     timeout: float = 300.0,
+    # Transparent retry with jittered exponential backoff: covers an
+    # unreachable/restarting daemon (the re-entrant find_daemon fails
+    # over to a surviving worker between attempts) and 503 load sheds
+    # (honoring their retry_after_s hint). 0 = one shot.
+    retries: int = 0,
 ) -> dict:
     """One client call against the daemon advertised in ``spool_dir``."""
+    attempt = 0
+    while True:
+        try:
+            return _request_once(
+                spool_dir, method, path, payload, timeout=timeout
+            )
+        except DaemonUnreachable:
+            if attempt >= retries:
+                raise
+            time.sleep(backoff_delay(attempt))
+        except _Shed as e:
+            if attempt >= retries:
+                return e.payload
+            time.sleep(backoff_delay(
+                attempt, retry_after_s=e.payload.get("retry_after_s")
+            ))
+        attempt += 1
+
+
+class _Shed(Exception):
+    """Internal: a 503 load-shed reply (payload carries the hint)."""
+
+    def __init__(self, payload: dict):
+        self.payload = payload
+
+
+def _request_once(
+    spool_dir: str,
+    method: str,
+    path: str,
+    payload: Optional[dict] = None,
+    *,
+    timeout: float = 300.0,
+) -> dict:
     host, port = find_daemon(spool_dir)
     url = f"http://{host}:{port}{path}"
     data = None
@@ -371,9 +593,12 @@ def request(
             return json.loads(resp.read())
     except urllib.error.HTTPError as e:
         try:
-            return json.loads(e.read())
+            body = json.loads(e.read())
         except ValueError:
-            return {"error": f"HTTP {e.code}"}
+            body = {"error": f"HTTP {e.code}"}
+        if e.code == 503:
+            raise _Shed(body) from e
+        return body
     except (urllib.error.URLError, OSError) as e:
         raise DaemonUnreachable(
             f"daemon at {url} not responding: {e}"

@@ -59,8 +59,25 @@ EXIT_PREEMPTED = 75
 # Degrade ladder for compiled direct-sum kernels: MXU matmul formulation
 # -> VPU Pallas kernel -> pure-jnp chunked direct sum (runs anywhere XLA
 # does). Approximate solvers (tree/fmm/pm) are excluded: silently
-# swapping physics fidelity is not a recovery.
+# swapping physics fidelity is not a recovery. Shared by the run
+# supervisor's build-failure recovery AND the serve layer's per-backend
+# circuit breakers (serve/breaker.py): both answer "this exact-physics
+# kernel cannot run here — what is the next exact-physics kernel?".
 BACKEND_LADDER = ("pallas-mxu", "pallas", "chunked")
+
+
+def next_rung(
+    backend: str, ladder: tuple = BACKEND_LADDER,
+) -> Optional[str]:
+    """The next rung down the exact-physics degrade ladder, or None at
+    (or off) the bottom. ``cpp``'s only safe fallback is the jnp direct
+    sum — same platform, same physics."""
+    if backend == "cpp":
+        return "chunked"
+    if backend not in ladder:
+        return None
+    i = ladder.index(backend)
+    return ladder[i + 1] if i + 1 < len(ladder) else None
 
 
 @dataclasses.dataclass
@@ -196,14 +213,7 @@ class RunSupervisor:
                 backend = _resolve_backend(config)
             except Exception:  # noqa: BLE001 — resolution itself failed;
                 return None  # nothing sane to degrade to
-        if backend == "cpp":
-            # The native FFI kernel's only safe fallback is the jnp
-            # direct sum (same platform, same physics).
-            return "chunked"
-        if backend not in ladder:
-            return None
-        i = ladder.index(backend)
-        return ladder[i + 1] if i + 1 < len(ladder) else None
+        return next_rung(backend, ladder)
 
     def _backoff(self, error: Exception, at_step) -> None:
         """Count, log, and sleep one transient retry (raises when the

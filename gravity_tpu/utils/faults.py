@@ -24,6 +24,34 @@ grammar — comma-separated items:
                         build time (persistent, like a platform that
                         cannot compile the kernel)
 
+Serving-layer specs (consumed by ``gravity_tpu/serve/``; the fleet
+failure modes of docs/robustness.md, each at its real code point):
+
+    crash_worker@ROUND      SIGKILL this process at the start of
+                            scheduling round ROUND — the un-catchable
+                            ``kill -9`` the lease/adoption machinery
+                            must survive (scheduler.run_round)
+    stall_worker@ROUNDxSECS pause the worker SECS seconds at round
+                            ROUND with lease heartbeats suspended, as
+                            if the process were SIGSTOPped — leases
+                            expire, a peer adopts, the stalled worker
+                            resumes as a zombie (fencing rejects its
+                            late writes)
+    stale_lease@ROUND       at round ROUND, backdate this worker's
+                            leases to already-expired and suspend
+                            renewal briefly — the no-sleep variant of
+                            stall_worker for deterministic fencing
+                            tests (``xSECS`` sets the suspension,
+                            default 30)
+    torn_spool_write@K      tear the next spool/lease/registry JSON
+                            write once K earlier writes have happened
+                            (K=0 = the very next; ``xCOUNT`` tears
+                            COUNT consecutive writes) —
+                            utils/hostio.atomic_write_json
+    drop_result_write@K     silently drop a result ``.npz`` write
+                            (crash-between-status-and-result window;
+                            Spool.write_result)
+
 Example: ``GRAVITY_TPU_FAULTS="transient@10x2,diverge@20"``.
 """
 
@@ -59,6 +87,16 @@ class _Fault:
     step: int = 0
     count: int = 1
     backend: str = ""
+    # Was COUNT written explicitly (KIND@STEPxCOUNT)? The payload-style
+    # serving faults (stale_lease) need to distinguish "x1" from "no x
+    # given" — the parser's default is also 1.
+    explicit_count: bool = False
+
+
+SERVING_KINDS = (
+    "crash_worker", "stall_worker", "stale_lease",
+    "torn_spool_write", "drop_result_write",
+)
 
 
 class FaultPlan:
@@ -66,6 +104,11 @@ class FaultPlan:
 
     def __init__(self, faults: list[_Fault]):
         self._faults = faults
+        # Ordinal counters for the write-granular serving faults:
+        # torn_spool_write@K / drop_result_write@K key off "how many
+        # such writes happened before", not a simulation step.
+        self._spool_writes = 0
+        self._result_writes = 0
 
     @staticmethod
     def parse(spec: str) -> "FaultPlan":
@@ -85,13 +128,14 @@ class FaultPlan:
                     "or backend:NAME"
                 )
             kind, arg = item.split("@", 1)
-            count = 1
+            count, explicit = 1, False
             if "x" in arg:
                 arg, cnt = arg.split("x", 1)
-                count = int(cnt)
-            if kind not in ("diverge", "transient", "preempt"):
+                count, explicit = int(cnt), True
+            if kind not in ("diverge", "transient", "preempt") + SERVING_KINDS:
                 raise ValueError(f"unknown fault kind {kind!r}")
-            faults.append(_Fault(kind=kind, step=int(arg), count=count))
+            faults.append(_Fault(kind=kind, step=int(arg), count=count,
+                                 explicit_count=explicit))
         return FaultPlan(faults)
 
     def _take(self, kind: str, due) -> Optional[_Fault]:
@@ -193,3 +237,80 @@ def check_backend(backend: str) -> None:
     plan = active()
     if plan is not None and plan.backend_down(backend):
         raise BackendUnavailable(backend)
+
+
+# --- hooks called from the serving layer (gravity_tpu/serve/) ---
+
+
+def maybe_crash_worker(round_no: int) -> None:
+    """SIGKILL this process at the start of scheduling round
+    ``round_no`` — un-catchable by design: no atexit, no finally, no
+    lease release runs, exactly like ``kill -9`` on a serving host."""
+    plan = active()
+    if plan is None:
+        return
+    if plan._take("crash_worker", lambda f: round_no >= f.step) is not None:
+        import signal
+
+        os.kill(os.getpid(), signal.SIGKILL)
+
+
+def _take_once_with_payload(plan: "FaultPlan", kind: str, due) -> int:
+    """Consume a whole fault (these fire once; COUNT is a payload —
+    seconds — not a repeat count) and return its payload, or 0."""
+    for f in plan._faults:
+        if f.kind == kind and f.count > 0 and due(f):
+            payload, f.count = f.count, 0
+            return payload
+    return 0
+
+
+def stall_worker_secs(round_no: int) -> float:
+    """Seconds to pause the worker at this round (0 = no stall due)."""
+    plan = active()
+    if plan is None:
+        return 0.0
+    return float(_take_once_with_payload(
+        plan, "stall_worker", lambda f: round_no >= f.step
+    ))
+
+
+def stale_lease_secs(round_no: int, default_s: float = 30.0) -> float:
+    """Heartbeat-suspension seconds for a due ``stale_lease`` fault
+    (0 = not due). The caller backdates its leases and stops renewing
+    for this long — expiry/adoption without any real sleep."""
+    plan = active()
+    if plan is None:
+        return 0.0
+    for f in plan._faults:
+        if f.kind == "stale_lease" and f.count > 0 and round_no >= f.step:
+            # A bare stale_lease@R uses the default window; any
+            # EXPLICIT xSECS payload — including x1 — is taken
+            # literally (the parser records whether x was written).
+            payload, f.count = f.count, 0
+            return float(payload if f.explicit_count else default_s)
+    return 0.0
+
+
+def torn_write_due() -> bool:
+    """One torn JSON write due? (utils/hostio.atomic_write_json)"""
+    plan = active()
+    if plan is None:
+        return False
+    seq = plan._spool_writes
+    plan._spool_writes += 1
+    return plan._take(
+        "torn_spool_write", lambda f: seq >= f.step
+    ) is not None
+
+
+def drop_result_due() -> bool:
+    """One silently-dropped result write due? (Spool.write_result)"""
+    plan = active()
+    if plan is None:
+        return False
+    seq = plan._result_writes
+    plan._result_writes += 1
+    return plan._take(
+        "drop_result_write", lambda f: seq >= f.step
+    ) is not None

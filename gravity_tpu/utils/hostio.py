@@ -25,11 +25,59 @@ that work onto one background thread behind a bounded queue:
 
 from __future__ import annotations
 
+import json
+import os
 import queue
 import threading
+import time
 from typing import Optional
 
 _SENTINEL = object()
+
+
+def read_json_retry(
+    path: str, attempts: int = 4, delay_s: float = 0.002
+) -> Optional[dict]:
+    """Read a JSON file that a concurrent writer may be replacing:
+    retry a torn/partial parse a few times (a concurrent
+    ``os.replace`` lands in microseconds), then give up with None.
+    Lock-free — readers never block writers. The single read half of
+    the :func:`atomic_write_json` durability contract, shared by the
+    spool/lease/registry readers and the autotune cache."""
+    for i in range(attempts):
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except FileNotFoundError:
+            return None
+        except (OSError, ValueError):
+            if i + 1 < attempts:
+                time.sleep(delay_s)
+    return None
+
+
+def atomic_write_json(path: str, obj: dict) -> None:
+    """Write ``obj`` as JSON to ``path`` via tmp-file + ``os.replace``
+    so readers never observe a half-written file — the one durable-write
+    idiom every spool/lease/registry record in the serving layer shares.
+
+    Fault injection: an armed ``torn_spool_write`` spec
+    (utils/faults.py) makes this call write a TRUNCATED document
+    directly to ``path`` instead — simulating the non-atomic writer /
+    crash-mid-write a reader's torn-JSON handling must survive — while
+    returning success, exactly like a process that died right after the
+    bad write."""
+    payload = json.dumps(obj)
+    from .faults import torn_write_due
+
+    if torn_write_due():
+        with open(path, "w") as f:
+            f.write(payload[: max(1, len(payload) // 3)])
+        return
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(payload)
+    os.replace(tmp, path)
 
 
 class HostWriter:

@@ -107,16 +107,27 @@ class JsonlEventLogger:
 
     KINDS: tuple = ()
 
-    def __init__(self, path: str):
+    def __init__(self, path: str, context: Optional[dict] = None):
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         self.path = path
+        # Fields stamped on every record — e.g. the serving daemon's
+        # worker id, so N workers appending to ONE shared spool stream
+        # stay attributable (adoption forensics need to know who
+        # claimed, who died, who fenced whom).
+        self.context = dict(context or {})
 
     def event(self, kind: str, /, **fields) -> None:
         if kind not in self.KINDS:
             raise ValueError(
                 f"unknown event kind {kind!r}; one of {self.KINDS}"
             )
-        record = {"ts": round(time.time(), 3), "event": kind, **fields}
+        record = {
+            "ts": round(time.time(), 3), "event": kind,
+            **self.context, **fields,
+        }
+        # One short O_APPEND write per event: atomic on POSIX for
+        # records far under PIPE_BUF, so concurrent workers sharing the
+        # stream never interleave mid-line.
         with open(self.path, "a") as f:
             f.write(json.dumps(record, default=str) + "\n")
 
@@ -146,9 +157,17 @@ class ServingEventLogger(JsonlEventLogger):
     batch occupancy (real particles / padded capacity — padding waste
     made visible), per-round pairs/s, and p50/p95 completed-job
     latency. Job lifecycle transitions get their own kinds.
+
+    ``adopted``/``fenced``/``breaker_*``/``shed``/``poisoned`` are the
+    fleet-resilience kinds (docs/robustness.md "Fleet failure modes"):
+    lease takeover of a dead worker's job, a zombie's rejected late
+    write, circuit-breaker transitions, admission load shedding, and
+    the requeue-cap terminal state.
     """
 
     KINDS = (
         "submitted", "admitted", "yielded", "round", "completed",
         "failed", "cancelled", "respooled", "spool_error",
+        "adopted", "fenced", "breaker_open", "breaker_closed",
+        "shed", "poisoned",
     )

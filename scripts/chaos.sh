@@ -1,0 +1,198 @@
+#!/usr/bin/env bash
+# Serving-layer chaos harness (`make chaos`; docs/robustness.md "Fleet
+# failure modes"): two CLI daemon workers share ONE spool directory and
+# run a mixed ensemble under injected faults (utils/faults.py).
+#
+#   Scenario 1 — kill -9 + adoption: worker A claims 8 mixed-size jobs
+#   and is SIGKILLed mid-round (crash_worker@2 — a real, un-catchable
+#   kill). Worker B must adopt the dead host's jobs (pid-dead leases
+#   are claimable immediately), every job must complete with <=1e-5
+#   solo parity, clients must fail over through the worker registry,
+#   and no job may complete twice.
+#
+#   Scenario 2 — stale lease + fencing: worker A stays ALIVE but its
+#   leases go stale (stale_lease@1 backdates + suspends heartbeats, no
+#   sleeps). Worker B adopts; the zombie finishes its copy and every
+#   one of its late writes must be fenced — exactly one completed
+#   event per job, record fences owned by the adopter.
+#
+# Exits nonzero on any violated invariant. CPU-only; ~2-4 min.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+
+PIDS=()
+DIRS=()
+cleanup() {
+    for pid in "${PIDS[@]:-}"; do
+        kill -9 "$pid" 2>/dev/null || true
+    done
+    for d in "${DIRS[@]:-}"; do
+        rm -rf "$d"
+    done
+}
+trap cleanup EXIT
+
+start_worker() { # spool worker_id faults_spec -> appends pid to PIDS
+    local spool=$1 wid=$2 faults=${3:-}
+    GRAVITY_TPU_FAULTS="$faults" python -m gravity_tpu serve \
+        --spool-dir "$spool" --slots 2 --slice-steps 10 \
+        --lease-ttl-s 5 --worker-id "$wid" \
+        >"$spool/$wid.stdout" 2>&1 &
+    PIDS+=($!)
+}
+
+wait_for_daemon() { # spool worker_id
+    local spool=$1 wid=$2
+    for _ in $(seq 1 150); do
+        if python - "$spool" "$wid" <<'EOF' 2>/dev/null; then
+import json, sys
+info = json.load(open(f"{sys.argv[1]}/daemon.json"))
+raise SystemExit(0 if info.get("worker_id") == sys.argv[2] else 1)
+EOF
+            return 0
+        fi
+        sleep 0.2
+    done
+    echo "worker $wid never advertised itself"; cat "$spool/$wid.stdout"
+    return 1
+}
+
+echo "== chaos 1/2: kill -9 a worker mid-round -> adoption, parity, no double-run =="
+SPOOL1=$(mktemp -d /tmp/gravity_chaos1.XXXXXX)
+DIRS+=("$SPOOL1")
+# Survivor first; the doomed worker starts second so daemon.json (last
+# writer wins) routes the submissions to it.
+start_worker "$SPOOL1" chaos-b ""
+B1_PID=${PIDS[-1]}
+wait_for_daemon "$SPOOL1" chaos-b
+start_worker "$SPOOL1" chaos-a "crash_worker@2"
+A1_PID=${PIDS[-1]}
+wait_for_daemon "$SPOOL1" chaos-a
+
+python - "$SPOOL1" <<'EOF'
+import json, sys
+from gravity_tpu.config import SimulationConfig
+from gravity_tpu.serve import request
+
+spool = sys.argv[1]
+ids = []
+for i, n in enumerate((6, 8, 10, 12, 16, 20, 24, 28)):
+    cfg = SimulationConfig(n=n, steps=60, seed=i + 1, model="random",
+                           dt=3600.0, integrator="leapfrog",
+                           force_backend="dense")
+    resp = request(spool, "POST", "/submit",
+                   {"config": json.loads(cfg.to_json())}, retries=5)
+    assert "job" in resp, resp
+    ids.append(resp["job"])
+json.dump(ids, open(f"{spool}/chaos_ids.json", "w"))
+print("submitted:", len(ids), "jobs")
+EOF
+
+# The injected SIGKILL must actually land (exit 137 = 128 + SIGKILL).
+RC=0; wait "$A1_PID" || RC=$?
+[ "$RC" -eq 137 ] || {
+    echo "worker chaos-a should have died by SIGKILL, exit $RC";
+    cat "$SPOOL1/chaos-a.stdout"; exit 1;
+}
+echo "worker chaos-a SIGKILLed as injected (exit $RC)"
+
+python - "$SPOOL1" <<'EOF'
+import json, sys
+import numpy as np
+from gravity_tpu.config import SimulationConfig
+from gravity_tpu.serve import request, wait_for
+from gravity_tpu.simulation import Simulator
+
+spool = sys.argv[1]
+ids = json.load(open(f"{spool}/chaos_ids.json"))
+statuses = wait_for(spool, ids, timeout=300)
+assert all(s["status"] == "completed" for s in statuses.values()), statuses
+
+for i, (jid, n) in enumerate(zip(ids, (6, 8, 10, 12, 16, 20, 24, 28))):
+    cfg = SimulationConfig(n=n, steps=60, seed=i + 1, model="random",
+                           dt=3600.0, integrator="leapfrog",
+                           force_backend="dense")
+    resp = request(spool, "GET", f"/result?job={jid}")
+    got = np.asarray(resp["positions"], np.float32)
+    solo = np.asarray(Simulator(cfg).run()["final_state"].positions)
+    rel = float(np.max(np.abs(got - solo) / np.maximum(np.abs(solo), 1e-30)))
+    assert rel <= 1e-5, (jid, n, rel)
+
+events = [json.loads(l) for l in open(f"{spool}/serving_events.jsonl")]
+adopted = [e for e in events if e["event"] == "adopted"]
+assert adopted, "no adoption events after the kill -9"
+assert {e["worker"] for e in adopted} == {"chaos-b"}, adopted
+completed = [e for e in events if e["event"] == "completed"]
+per_job = {j: sum(1 for e in completed if e["job"] == j) for j in ids}
+assert all(v == 1 for v in per_job.values()), per_job
+for e in adopted:
+    rec = json.load(open(f"{spool}/jobs/{e['job']}.json"))
+    assert rec["fence"] == e["fence"] >= 2, (e, rec)
+print("chaos 1 OK:", len(ids), "jobs completed with solo parity |",
+      len(adopted), "adopted by chaos-b | one completed event per job")
+EOF
+kill "$B1_PID" 2>/dev/null || true
+
+echo "== chaos 2/2: stale leases -> adoption of a LIVE zombie, fencing =="
+SPOOL2=$(mktemp -d /tmp/gravity_chaos2.XXXXXX)
+DIRS+=("$SPOOL2")
+start_worker "$SPOOL2" chaos-d ""
+D_PID=${PIDS[-1]}
+wait_for_daemon "$SPOOL2" chaos-d
+# stale_lease@1x60: at round 1 worker C backdates its leases and stops
+# heartbeating for 60s — alive, integrating, but adoptable.
+start_worker "$SPOOL2" chaos-c "stale_lease@1x60"
+C_PID=${PIDS[-1]}
+wait_for_daemon "$SPOOL2" chaos-c
+
+python - "$SPOOL2" <<'EOF'
+import json, sys, time
+import numpy as np
+from gravity_tpu.config import SimulationConfig
+from gravity_tpu.serve import request, wait_for
+from gravity_tpu.simulation import Simulator
+
+spool = sys.argv[1]
+ids = []
+for i, n in enumerate((8, 12)):
+    cfg = SimulationConfig(n=n, steps=80, seed=20 + i, model="random",
+                           dt=3600.0, integrator="leapfrog",
+                           force_backend="dense")
+    resp = request(spool, "POST", "/submit",
+                   {"config": json.loads(cfg.to_json())}, retries=5)
+    assert "job" in resp, resp
+    ids.append(resp["job"])
+statuses = wait_for(spool, ids, timeout=300)
+assert all(s["status"] == "completed" for s in statuses.values()), statuses
+# Give the zombie time to finish its fenced copies before auditing.
+deadline = time.monotonic() + 60
+while time.monotonic() < deadline:
+    events = [json.loads(l) for l in open(f"{spool}/serving_events.jsonl")]
+    if any(e["event"] == "fenced" for e in events):
+        break
+    time.sleep(1.0)
+fenced = [e for e in events if e["event"] == "fenced"]
+assert fenced, "zombie's late writes were never fenced"
+assert {e["worker"] for e in fenced} == {"chaos-c"}, fenced
+adopted = [e for e in events if e["event"] == "adopted"]
+assert adopted and {e["worker"] for e in adopted} == {"chaos-d"}
+completed = [e for e in events if e["event"] == "completed"]
+per_job = {j: sum(1 for e in completed if e["job"] == j) for j in ids}
+assert all(v == 1 for v in per_job.values()), per_job
+for i, (jid, n) in enumerate(zip(ids, (8, 12))):
+    cfg = SimulationConfig(n=n, steps=80, seed=20 + i, model="random",
+                           dt=3600.0, integrator="leapfrog",
+                           force_backend="dense")
+    resp = request(spool, "GET", f"/result?job={jid}")
+    got = np.asarray(resp["positions"], np.float32)
+    solo = np.asarray(Simulator(cfg).run()["final_state"].positions)
+    rel = float(np.max(np.abs(got - solo) / np.maximum(np.abs(solo), 1e-30)))
+    assert rel <= 1e-5, (jid, n, rel)
+print("chaos 2 OK: live-zombie jobs adopted by chaos-d,",
+      len(fenced), "fenced write(s), one completed event per job")
+EOF
+kill "$C_PID" "$D_PID" 2>/dev/null || true
+
+echo "== chaos: all green =="

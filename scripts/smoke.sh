@@ -1,15 +1,17 @@
 #!/usr/bin/env bash
 # The documented pre-push check (`make smoke`): the fast contract lane,
 # a 2-job ensemble serving e2e through the real CLI daemon, the async
-# host-pipeline e2e (cadence run + SIGTERM + resume), and the autotune
-# cache round-trip (probe-on-miss, instant-on-hit), all on CPU.
-# Exits nonzero on any failure. ~7 min on a laptop-class CPU.
+# host-pipeline e2e (cadence run + SIGTERM + resume), the autotune
+# cache round-trip (probe-on-miss, instant-on-hit), and the serving
+# chaos harness (2 workers, injected kill -9 mid-round, all jobs
+# complete with solo parity — scripts/chaos.sh), all on CPU.
+# Exits nonzero on any failure. ~10 min on a laptop-class CPU.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 export JAX_PLATFORMS=cpu
 
-echo "== smoke 1/4: pytest -m 'fast and not slow and not heavy' (contract + oracle-parity lane) =="
+echo "== smoke 1/5: pytest -m 'fast and not slow and not heavy' (contract + oracle-parity lane) =="
 # "fast and not slow and not heavy": module-level fast marks would
 # otherwise pull a file's slow-marked wall-clock tests into the lane
 # (pytest -m fast selects anything CARRYING the mark; it does not
@@ -18,7 +20,7 @@ echo "== smoke 1/4: pytest -m 'fast and not slow and not heavy' (contract + orac
 # item 5).
 python -m pytest tests/ -q -m "fast and not slow and not heavy" -p no:cacheprovider
 
-echo "== smoke 2/4: 2-job ensemble serving e2e (CLI daemon) =="
+echo "== smoke 2/5: 2-job ensemble serving e2e (CLI daemon) =="
 SPOOL="$(mktemp -d /tmp/gravity_smoke.XXXXXX)"
 cleanup() {
     # Best-effort daemon shutdown + spool removal.
@@ -71,7 +73,7 @@ print("ensemble e2e OK:", {j: s["status"] for j, s in statuses.items()},
       "| compiles:", metrics["compile_counts"])
 EOF
 
-echo "== smoke 3/4: async host pipeline e2e (cadence run + SIGTERM + resume) =="
+echo "== smoke 3/5: async host pipeline e2e (cadence run + SIGTERM + resume) =="
 IODIR="$(mktemp -d /tmp/gravity_smoke_io.XXXXXX)"
 trap 'cleanup; rm -rf "$IODIR"' EXIT
 # Cadence-on pipelined run; preempt@500 delivers a real SIGTERM to the
@@ -107,7 +109,7 @@ print("io-pipeline e2e OK: resumed", stats["steps"], "steps,",
       "host_gap_frac", round(stats["host_gap_frac"], 3))
 EOF
 
-echo "== smoke 4/4: autotune cache round-trip (probe-on-miss, instant-on-hit) =="
+echo "== smoke 4/5: autotune cache round-trip (probe-on-miss, instant-on-hit) =="
 TUNEDIR="$(mktemp -d /tmp/gravity_smoke_tune.XXXXXX)"
 trap 'cleanup; rm -rf "$IODIR" "$TUNEDIR"' EXIT
 # Fresh cache dir + lowered fast-probe floor so plain `auto` runs a
@@ -143,5 +145,8 @@ assert len(records) == 1, records
 print("autotune round-trip OK: backend", s1["backend"],
       "| probe", round(s1["autotune_probe_ms"], 1), "ms -> hit 0 ms")
 EOF
+
+echo "== smoke 5/5: serving chaos harness (kill -9 + adoption + fencing) =="
+bash scripts/chaos.sh
 
 echo "== smoke: all green =="

@@ -431,3 +431,99 @@ def test_real_probe_e2e_miss_then_hit(monkeypatch):
     assert sim2.autotune == {"cache": "hit", "probe_ms": 0.0}
     assert sim2.backend == sim.backend
     assert probe_counters()["probe_steps"] == before
+
+
+# --- concurrent-writer safety (ISSUE 6 satellite) ------------------------
+
+
+def test_torn_cache_record_is_a_miss_not_a_crash(monkeypatch):
+    """Two daemons sharing the tuning dir can leave a reader a torn
+    document: read-retry exhausts lock-free, then the key is a plain
+    miss and the re-probe overwrites the wreckage."""
+    monkeypatch.setattr(at, "_time_backend", _fake_probe(
+        {"dense": 0.05, "tree": 0.01}
+    ))
+    cfg = _cfg(4096)
+    cands = ("dense", "tree")
+    d = resolve_backend_measured(cfg, None, candidates=cands)
+    path = os.path.join(at.tuning_dir(), f"{d.key_hash}.json")
+    # Tear it (a non-atomic writer / torn disk), drop the mem cache.
+    full = open(path).read()
+    with open(path, "w") as f:
+        f.write(full[: len(full) // 3])
+    at._mem_cache.clear()
+    d2 = resolve_backend_measured(cfg, None, candidates=cands)
+    assert d2.cache == "miss"  # re-probed, no exception
+    assert json.load(open(path))["winner"] == "tree"  # repaired
+
+
+def test_torn_read_retry_sees_concurrent_replace(monkeypatch):
+    """The lock-free read-retry: a parse that fails while a concurrent
+    writer's os.replace is mid-flight succeeds on the retry (the repair
+    is injected into the retry sleep, deterministically)."""
+    monkeypatch.setattr(at, "_time_backend", _fake_probe(
+        {"dense": 0.05, "tree": 0.01}
+    ))
+    cfg = _cfg(4096)
+    cands = ("dense", "tree")
+    d = resolve_backend_measured(cfg, None, candidates=cands)
+    path = os.path.join(at.tuning_dir(), f"{d.key_hash}.json")
+    full = open(path).read()
+    with open(path, "w") as f:
+        f.write(full[: len(full) // 3])
+    at._mem_cache.clear()
+
+    def _concurrent_writer_lands(_s):
+        with open(path, "w") as f:
+            f.write(full)
+
+    from gravity_tpu.utils import hostio
+
+    monkeypatch.setattr(hostio.time, "sleep", _concurrent_writer_lands)
+    before = probe_counters()["probe_steps"]
+    d2 = resolve_backend_measured(cfg, None, candidates=cands)
+    assert d2.cache == "hit" and d2.backend == "tree"
+    assert probe_counters()["probe_steps"] == before  # no re-probe
+
+
+def test_store_yields_to_newer_record_fencing(monkeypatch):
+    """Last-writer-wins with fencing: records are stamped when their
+    PROBE STARTED, so a slow prober that finishes after a peer's whole
+    probe ran does not clobber the peer's fresher verdict — it adopts
+    it. Simulated with real clocks: the peer's record lands (and is
+    stamped) WHILE our probe is mid-flight."""
+    cfg = _cfg(4096)
+    cands = ("dense", "tree")
+    # Seed a first record so we know the path.
+    monkeypatch.setattr(at, "_time_backend", _fake_probe(
+        {"dense": 0.05, "tree": 0.01}
+    ))
+    d = resolve_backend_measured(cfg, None, candidates=cands)
+    path = os.path.join(at.tuning_dir(), f"{d.key_hash}.json")
+
+    import time as _time
+
+    real_probe = _fake_probe({"dense": 0.05, "tree": 0.01})
+
+    def slow_probe_with_concurrent_peer(config, backend, state, steps):
+        # The peer daemon's probe starts AND stores while ours runs.
+        rec = json.load(open(path))
+        rec["winner"] = "dense"
+        rec["stamp_ns"] = _time.time_ns()
+        with open(path, "w") as f:
+            json.dump(rec, f)
+        return real_probe(config, backend, state, steps)
+
+    monkeypatch.setattr(at, "_time_backend",
+                        slow_probe_with_concurrent_peer)
+    at._mem_cache.clear()
+    d2 = resolve_backend_measured(
+        cfg, None, candidates=cands, refresh=True
+    )
+    # Our refresh probe ran (tree measured faster), but the store
+    # yielded to the record stamped after our probe began.
+    assert d2.cache == "miss" and d2.backend == "tree"
+    assert json.load(open(path))["winner"] == "dense"
+    at._mem_cache.clear()
+    d3 = resolve_backend_measured(cfg, None, candidates=cands)
+    assert d3.cache == "hit" and d3.backend == "dense"

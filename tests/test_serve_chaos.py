@@ -1,0 +1,151 @@
+"""The ISSUE 6 chaos acceptance gate (tier-1, CPU): two daemon workers
+share one spool, 8 mixed-size jobs are submitted, and one worker is
+``kill -9``'d mid-round via fault injection (``crash_worker@N`` — a
+real SIGKILL: no atexit, no lease release). Every job must complete
+with <=1e-5 solo parity, adoption (and, in the follow-on segment,
+breaker) events must be visible in serving_events.jsonl, and no job
+may complete twice — asserted through the fencing tokens and the
+shared event stream.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from gravity_tpu.config import SimulationConfig
+from gravity_tpu.serve import GravityDaemon, request, wait_for
+from gravity_tpu.simulation import Simulator
+
+
+def _cfg(n, steps, seed, **kw):
+    kw.setdefault("model", "random")
+    kw.setdefault("dt", 3600.0)
+    kw.setdefault("integrator", "leapfrog")
+    kw.setdefault("force_backend", "dense")
+    return SimulationConfig(n=n, steps=steps, seed=seed, **kw)
+
+
+@pytest.mark.heavy  # subprocess worker: JAX import + compiles
+def test_two_worker_kill9_chaos_e2e(tmp_path, faults):
+    from conftest import subprocess_env
+
+    spool_dir = str(tmp_path / "spool")
+    # Worker B: in-process survivor, started FIRST so the crashing
+    # worker's daemon.json wins discovery and receives the submissions.
+    b = GravityDaemon(
+        spool_dir, slots=2, slice_steps=10, idle_sleep_s=0.01,
+        worker_id="worker-b", lease_ttl_s=5.0,
+    )
+    b.start()
+    proc = None
+    try:
+        # Worker A: real subprocess with the kill switch armed — a
+        # genuine SIGKILL at the start of its third scheduling round.
+        env = dict(subprocess_env())
+        env["GRAVITY_TPU_FAULTS"] = "crash_worker@2"
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "gravity_tpu", "serve",
+             "--spool-dir", spool_dir, "--slots", "2",
+             "--slice-steps", "10", "--lease-ttl-s", "5",
+             "--worker-id", "worker-a"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True,
+        )
+        deadline = time.monotonic() + 120
+        daemon_file = os.path.join(spool_dir, "daemon.json")
+
+        def _daemon_is(worker):
+            try:
+                return json.load(open(daemon_file)).get(
+                    "worker_id"
+                ) == worker
+            except (OSError, ValueError):
+                return False
+
+        while not _daemon_is("worker-a"):
+            assert time.monotonic() < deadline, "worker A never came up"
+            assert proc.poll() is None, proc.stdout.read()
+            time.sleep(0.2)
+
+        # 8 mixed-size jobs across two buckets; worker A claims them
+        # (it owns daemon.json), then dies mid-workload.
+        configs = [
+            _cfg(6, 40, 1), _cfg(8, 40, 2), _cfg(10, 40, 3),
+            _cfg(12, 40, 4), _cfg(16, 40, 5), _cfg(20, 40, 6),
+            _cfg(24, 40, 7), _cfg(28, 40, 8),
+        ]
+        ids = []
+        for c in configs:
+            resp = request(spool_dir, "POST", "/submit",
+                           {"config": json.loads(c.to_json())},
+                           retries=3)
+            assert "job" in resp, resp
+            ids.append(resp["job"])
+
+        # The injected kill -9 actually happened (not a clean exit).
+        assert proc.wait(timeout=180) == -signal.SIGKILL
+
+        # Worker B adopts the dead host's jobs (pid-liveness makes the
+        # expired leases claimable immediately) and finishes all 8;
+        # the client fails over to B through the worker registry.
+        statuses = wait_for(spool_dir, ids, timeout=300)
+        assert all(
+            s["status"] == "completed" for s in statuses.values()
+        ), statuses
+
+        # Solo parity for every job — adopted re-runs included.
+        for jid, config in zip(ids, configs):
+            resp = request(spool_dir, "GET", f"/result?job={jid}")
+            got = np.asarray(resp["positions"], np.float32)
+            solo = np.asarray(
+                Simulator(config).run()["final_state"].positions
+            )
+            rel = np.max(
+                np.abs(got - solo) / np.maximum(np.abs(solo), 1e-30)
+            )
+            assert rel <= 1e-5, (jid, config.n, float(rel))
+
+        events = b.events.read()
+        adopted = [e for e in events if e["event"] == "adopted"]
+        assert adopted, "no adoption events after the kill"
+        assert all(e["worker"] == "worker-b" for e in adopted)
+        assert {e["from_worker"] for e in adopted} == {"worker-a"}
+
+        # No job ran twice to completion: exactly one completed event
+        # per job in the SHARED stream, and every adopted job's durable
+        # fence is the adopter's (> the dead worker's token 1).
+        completed = [e for e in events if e["event"] == "completed"]
+        per_job = {jid: sum(1 for e in completed if e["job"] == jid)
+                   for jid in ids}
+        assert all(v == 1 for v in per_job.values()), per_job
+        for e in adopted:
+            rec = json.load(open(os.path.join(
+                spool_dir, "jobs", f"{e['job']}.json"
+            )))
+            assert rec["fence"] == e["fence"] >= 2
+
+        # Breaker visibility segment: with pallas injected down in the
+        # surviving worker, a pallas job opens the breaker and degrades
+        # to an exact-physics rung — breaker events land in the same
+        # serving_events.jsonl.
+        faults("backend:pallas")
+        resp = request(spool_dir, "POST", "/submit", {
+            "config": json.loads(
+                _cfg(8, 10, 9, force_backend="pallas").to_json()
+            ),
+        }, retries=3)
+        assert "job" in resp, resp
+        st = wait_for(spool_dir, [resp["job"]], timeout=120)
+        assert st[resp["job"]]["status"] == "completed"
+        events = b.events.read()
+        assert any(e["event"] == "breaker_open" for e in events)
+    finally:
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+        b.stop()

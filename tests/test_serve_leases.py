@@ -1,0 +1,282 @@
+"""Lease lifecycle + fencing (gravity_tpu/serve/leases.py) — the ISSUE 6
+satellite gate: claim -> heartbeat renew -> expiry -> adoption ->
+fencing-token rejection of the zombie's late write, all deterministic
+(the only sleep is one short TTL; the fencing path itself uses
+backdating, no sleeps at all).
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from gravity_tpu.config import SimulationConfig
+from gravity_tpu.serve import Job, LeaseManager, Spool
+from gravity_tpu.serve.breaker import BreakerBoard, CircuitBreaker
+from gravity_tpu.serve.service import backoff_delay
+from gravity_tpu.state import ParticleState
+
+pytestmark = pytest.mark.fast
+
+
+def _state(n=4):
+    rng = np.random.default_rng(0)
+    return ParticleState.create(
+        rng.normal(size=(n, 3)), rng.normal(size=(n, 3)), np.ones(n)
+    )
+
+
+def _job(job_id="j1", fence=0):
+    return Job(id=job_id, config=SimulationConfig(n=8, steps=5),
+               fence=fence)
+
+
+def test_claim_renew_release_roundtrip(tmp_path):
+    mgr = LeaseManager(str(tmp_path), "w1", ttl_s=30.0)
+    lease = mgr.claim("j1")
+    assert lease is not None and lease.fence == 1
+    assert lease.adopted_from is None
+    assert mgr.peek("j1").worker == "w1"
+    before = mgr.peek("j1").expires_ts
+    assert mgr.renew_all() == []  # nothing lost
+    assert mgr.peek("j1").expires_ts >= before
+    mgr.release("j1")
+    assert mgr.peek("j1") is None
+
+
+def test_live_lease_blocks_peer_claim(tmp_path):
+    a = LeaseManager(str(tmp_path), "a", ttl_s=30.0)
+    b = LeaseManager(str(tmp_path), "b", ttl_s=30.0)
+    assert a.claim("j1") is not None
+    assert b.claim("j1") is None  # same pid, unexpired -> blocked
+
+
+def test_ttl_expiry_allows_adoption_with_fence_bump(tmp_path):
+    a = LeaseManager(str(tmp_path), "a", ttl_s=0.2)
+    b = LeaseManager(str(tmp_path), "b", ttl_s=30.0)
+    first = a.claim("j1")
+    assert first.fence == 1
+    time.sleep(0.25)  # the one real TTL wait in this file
+    adopted = b.claim("j1")
+    assert adopted is not None
+    assert adopted.fence == 2  # strictly past the zombie's token
+    assert adopted.adopted_from == "a"
+    # The zombie's renew discovers the loss.
+    assert a.renew_all() == ["j1"]
+
+
+def test_backdate_expires_without_sleep(tmp_path):
+    a = LeaseManager(str(tmp_path), "a", ttl_s=300.0)
+    b = LeaseManager(str(tmp_path), "b", ttl_s=300.0)
+    a.claim("j1")
+    a.backdate()
+    adopted = b.claim("j1")
+    assert adopted is not None and adopted.fence == 2
+
+
+def test_dead_pid_lease_adopted_immediately(tmp_path):
+    """A kill -9'd worker's lease is adoptable with NO TTL wait — the
+    same-host pid-liveness fast path."""
+    a = LeaseManager(str(tmp_path), "a", ttl_s=3600.0)
+    lease = a.claim("j1")
+    # Forge a dead owner: rewrite the lease with a pid that cannot
+    # exist (pid 1 is init and alive; use an exhausted-range value).
+    rec = lease.to_record()
+    rec["pid"] = 2**22 + 12345
+    with open(os.path.join(a.dir, "j1.json"), "w") as f:
+        json.dump(rec, f)
+    b = LeaseManager(str(tmp_path), "b", ttl_s=30.0)
+    adopted = b.claim("j1")
+    assert adopted is not None and adopted.fence == 2
+
+
+def test_suspended_heartbeat_renews_nothing(tmp_path):
+    a = LeaseManager(str(tmp_path), "a", ttl_s=0.5)
+    a.claim("j1")
+    before = a.peek("j1").expires_ts
+    a.suspend(60.0)
+    assert a.renew_all() == []
+    assert a.peek("j1").expires_ts == before  # untouched
+
+
+def test_min_fence_keeps_token_monotonic_past_released_lease(tmp_path):
+    """Fence continuity survives a deleted lease file via the fence
+    persisted in the job record (passed back as min_fence)."""
+    a = LeaseManager(str(tmp_path), "a", ttl_s=30.0)
+    lease = a.claim("j7")
+    assert lease.fence == 1
+    a.release("j7")
+    b = LeaseManager(str(tmp_path), "b", ttl_s=30.0)
+    again = b.claim("j7", min_fence=1)
+    assert again.fence == 2
+
+
+def test_fenced_result_write_rejected(tmp_path):
+    """The headline fencing property: the zombie's late result write is
+    rejected; the adopter's lands."""
+    spool = Spool(str(tmp_path / "spool"))
+    a = LeaseManager(spool.root, "a", ttl_s=300.0)
+    spool.attach_leases(a)
+    zombie = a.claim("j1")
+    assert spool.write_job(_job("j1", fence=zombie.fence))
+    # Adoption (deterministic: backdate, no sleep).
+    a.backdate()
+    b = LeaseManager(spool.root, "b", ttl_s=300.0)
+    spool_b = Spool(spool.root)
+    spool_b.attach_leases(b)
+    adopter = b.claim("j1", min_fence=zombie.fence)
+    assert adopter.fence == zombie.fence + 1
+    assert spool_b.write_job(_job("j1", fence=adopter.fence))
+    # Zombie writes late: both the record and the result are rejected.
+    assert not spool.write_job(_job("j1", fence=zombie.fence))
+    assert spool.write_result("j1", _state(), fence=zombie.fence) is None
+    assert not os.path.exists(spool.result_path("j1"))
+    # The adopter's write lands.
+    path = spool_b.write_result("j1", _state(), fence=adopter.fence)
+    assert path is not None and os.path.exists(path)
+    # And the zombie STILL cannot clobber it after the adopter is done.
+    b.release("j1")
+    assert spool.write_result("j1", _state(), fence=zombie.fence) is None
+
+
+def test_torn_lease_write_is_survivable(tmp_path, faults):
+    """An injected torn write of a lease file must not crash readers:
+    peek retries, then treats it as claimable (min_fence preserves
+    monotonicity)."""
+    a = LeaseManager(str(tmp_path), "a", ttl_s=30.0)
+    faults("torn_spool_write@0")
+    a.claim("j1")  # this write lands torn
+    assert a.peek("j1") is None  # unreadable after retries -> None
+    b = LeaseManager(str(tmp_path), "b", ttl_s=30.0)
+    lease = b.claim("j1", min_fence=1)
+    # Fence gets an extra bump past an unreadable-but-present lease:
+    # the torn file could hold min_fence+1 (a claim whose record
+    # persist hadn't landed), so the mint must clear that too.
+    assert lease is not None and lease.fence == 3
+
+
+def test_drop_result_write_fault(tmp_path, faults):
+    """drop_result_write: the writer believes it succeeded, the bytes
+    never land — the completed-without-result adoption path's trigger."""
+    spool = Spool(str(tmp_path / "spool"))
+    faults("drop_result_write@0")
+    path = spool.write_result("j1", _state())
+    assert path is not None
+    assert not os.path.exists(path)
+    # The next write (fault exhausted) lands.
+    assert os.path.exists(spool.write_result("j1", _state()))
+
+
+def test_crash_and_stall_fault_parsing():
+    from gravity_tpu.utils import faults as fmod
+
+    plan = fmod.install(
+        "crash_worker@3,stall_worker@2x7,stale_lease@1,"
+        "torn_spool_write@0x2,drop_result_write@1"
+    )
+    try:
+        assert fmod.stall_worker_secs(1) == 0.0
+        assert fmod.stall_worker_secs(2) == 7.0
+        assert fmod.stall_worker_secs(2) == 0.0  # fired once
+        assert fmod.stale_lease_secs(0) == 0.0
+        assert fmod.stale_lease_secs(1) == 30.0  # bare spec -> default
+        assert fmod.stale_lease_secs(1) == 0.0
+        # Write-ordinal faults: two consecutive torn writes, then clean.
+        assert fmod.torn_write_due() and fmod.torn_write_due()
+        assert not fmod.torn_write_due()
+        # drop_result_write@1: the SECOND result write drops.
+        assert not fmod.drop_result_due()
+        assert fmod.drop_result_due()
+        assert not fmod.drop_result_due()
+        assert plan is not None
+        # An EXPLICIT x1 means one second, not the 30s default (a
+        # fresh plan: install replaces the whole spec).
+        fmod.install("stale_lease@0x1")
+        assert fmod.stale_lease_secs(0) == 1.0
+    finally:
+        fmod.reset()
+
+
+# --- circuit breaker unit behavior (serve/breaker.py) ---
+
+
+def test_breaker_opens_after_threshold_and_half_open_recovers():
+    b = CircuitBreaker("pallas", threshold=3, cooldown_s=100.0)
+    t = 1000.0
+    assert b.allow(t)
+    assert not b.record_failure(t)
+    assert not b.record_failure(t)
+    assert b.record_failure(t)  # third consecutive -> opened
+    assert b.state == "open"
+    assert not b.allow(t + 1)  # cooling down
+    assert b.allow(t + 101)  # half-open trial
+    assert b.state == "half-open"
+    assert not b.allow(t + 102)  # exactly ONE trial, no thundering herd
+    assert b.record_success()
+    assert b.state == "closed"
+
+
+def test_breaker_half_open_failure_reopens():
+    b = CircuitBreaker("pallas", threshold=1, cooldown_s=50.0)
+    assert b.record_failure(0.0)
+    assert b.allow(51.0)  # half-open
+    assert b.record_failure(51.0)  # trial failed -> reopen
+    assert b.state == "open"
+    assert not b.allow(52.0)
+
+
+def test_breaker_board_reroutes_down_shared_ladder():
+    board = BreakerBoard(threshold=1, cooldown_s=1e9)
+    assert board.reroute("pallas-mxu") == "pallas-mxu"  # all closed
+    board.get("pallas-mxu").record_failure()
+    assert board.reroute("pallas-mxu") == "pallas"
+    board.get("pallas").record_failure()
+    assert board.reroute("pallas-mxu") == "chunked"
+    board.get("chunked").record_failure()
+    assert board.reroute("pallas-mxu") == "dense"  # the engine floor
+    board.get("dense").record_failure()
+    assert board.reroute("pallas-mxu") == "dense"  # floor holds
+    assert board.success("pallas") is True  # closed an open breaker
+    assert board.reroute("pallas-mxu") == "pallas"
+
+
+def test_backoff_delay_jitter_and_retry_after_floor():
+    delays = [backoff_delay(0) for _ in range(50)]
+    assert all(0.125 <= d <= 0.25 for d in delays)
+    assert len({round(d, 6) for d in delays}) > 1  # jittered
+    assert backoff_delay(10) <= 8.0
+    assert backoff_delay(0, retry_after_s=5.0) >= 5.0
+
+
+def test_remote_host_lease_expires_by_ttl_only(tmp_path):
+    """A lease owned by ANOTHER host must not be judged by a local pid
+    probe — its pid is meaningless here. TTL alone governs."""
+    a = LeaseManager(str(tmp_path), "a", ttl_s=300.0)
+    lease = a.claim("j1")
+    rec = lease.to_record()
+    rec["host"] = "some-other-machine"
+    rec["pid"] = 2**22 + 4242  # dead HERE, but that proves nothing
+    with open(os.path.join(a.dir, "j1.json"), "w") as f:
+        json.dump(rec, f)
+    b = LeaseManager(str(tmp_path), "b", ttl_s=300.0)
+    assert b.claim("j1") is None  # unexpired remote lease: blocked
+    # Backdated (TTL passed): adoptable like any expired lease.
+    rec["expires_ts"] = 0.0
+    with open(os.path.join(a.dir, "j1.json"), "w") as f:
+        json.dump(rec, f)
+    assert b.claim("j1") is not None
+
+
+def test_breaker_aborted_trial_rearms_after_cooldown():
+    """If the half-open trial's job never reaches the backend (no
+    success/failure recorded), a new trial re-arms one cooldown later —
+    the breaker cannot wedge half-open forever."""
+    b = CircuitBreaker("pallas", threshold=1, cooldown_s=10.0)
+    b.record_failure(0.0)  # open
+    assert b.allow(11.0)  # trial granted...
+    assert not b.allow(12.0)  # ...and consumed
+    # The trial job was cancelled; nothing reported back. Re-arm.
+    assert b.allow(22.0)
+    assert b.state == "half-open"

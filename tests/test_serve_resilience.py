@@ -1,0 +1,566 @@
+"""Fleet-resilience behavior of the scheduler/daemon, in-process and
+deterministic: dead-worker adoption with solo parity, zombie fencing,
+circuit-breaker degradation, load shedding, the requeue (poison) cap,
+and stale-daemon.json handling (gravity_tpu/serve/).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from gravity_tpu.config import SimulationConfig
+from gravity_tpu.serve import (
+    EnsembleScheduler,
+    GravityDaemon,
+    QueueFull,
+    Spool,
+    find_daemon,
+)
+from gravity_tpu.serve.service import DaemonUnreachable
+from gravity_tpu.simulation import Simulator
+from gravity_tpu.utils.logging import ServingEventLogger
+
+
+def _cfg(n, steps=20, **kw):
+    kw.setdefault("model", "random")
+    kw.setdefault("dt", 3600.0)
+    kw.setdefault("integrator", "leapfrog")
+    kw.setdefault("force_backend", "dense")
+    return SimulationConfig(n=n, steps=steps, **kw)
+
+
+def _sched(spool_dir, events, worker, **kw):
+    kw.setdefault("slots", 2)
+    kw.setdefault("slice_steps", 10)
+    kw.setdefault("reap_interval_s", 0.0)  # scan every round
+    return EnsembleScheduler(
+        spool=Spool(spool_dir), events=events, worker_id=worker, **kw
+    )
+
+
+def _events_of(path, kind=None):
+    logger = ServingEventLogger(path)
+    evs = logger.read()
+    return [e for e in evs if kind is None or e["event"] == kind]
+
+
+@pytest.mark.fast
+def test_dead_worker_adoption_with_solo_parity(tmp_path):
+    """Worker A claims a job, runs one round, 'dies' (leases backdated,
+    heartbeats suspended — the no-sleep kill). Worker B adopts, re-runs
+    from step 0, and completes with solo parity; A's record fence is
+    superseded."""
+    spool_dir = str(tmp_path / "spool")
+    ev_path = str(tmp_path / "events.jsonl")
+    config = _cfg(10, steps=20, seed=3)
+    a = _sched(spool_dir, ServingEventLogger(
+        ev_path, context={"worker": "a"}), "a", lease_ttl_s=300.0)
+    jid = a.submit(config, job_id="adopt-me")
+    a.run_round()
+    assert a.jobs[jid].steps_done == 10
+    # Simulated kill -9: the process never releases or renews again.
+    a.leases.suspend(600.0)
+    a.leases.backdate()
+
+    b = _sched(spool_dir, ServingEventLogger(
+        ev_path, context={"worker": "b"}), "b", lease_ttl_s=300.0)
+    b.housekeeping()
+    assert b.jobs[jid].owned
+    assert b.jobs[jid].fence == 2  # token bumped past the zombie's
+    b.run_until_idle()
+    assert b.status(jid)["status"] == "completed"
+    solo = np.asarray(Simulator(config).run()["final_state"].positions)
+    got = np.asarray(b.result(jid).positions)
+    rel = np.max(np.abs(got - solo) / np.maximum(np.abs(solo), 1e-30))
+    assert rel <= 1e-5, float(rel)
+    adopted = _events_of(ev_path, "adopted")
+    assert adopted and adopted[0]["job"] == jid
+    assert adopted[0]["from_worker"] == "a"
+    b.close_io()
+    a.close_io()
+
+
+@pytest.mark.fast
+def test_zombie_writes_fenced_exactly_one_completed_event(tmp_path):
+    """The stalled worker resumes AFTER adoption and finishes its copy:
+    its record and result writes are fenced, it emits no terminal
+    event, and the spool holds exactly one completed record/result —
+    the adopter's."""
+    spool_dir = str(tmp_path / "spool")
+    ev_path = str(tmp_path / "events.jsonl")
+    config = _cfg(8, steps=20, seed=4)
+    a = _sched(spool_dir, ServingEventLogger(
+        ev_path, context={"worker": "a"}), "a", lease_ttl_s=300.0)
+    jid = a.submit(config, job_id="zombie-job")
+    a.run_round()
+    # The stall: leases lapse while a is paused; its heartbeat stays
+    # suspended through the rest of the test, so it never NOTICES.
+    a.leases.suspend(600.0)
+    a.leases.backdate()
+
+    b = _sched(spool_dir, ServingEventLogger(
+        ev_path, context={"worker": "b"}), "b", lease_ttl_s=300.0)
+    b.housekeeping()
+    b.run_until_idle()
+    adopter_fence = b.jobs[jid].fence
+    assert b.status(jid)["status"] == "completed"
+
+    # The zombie wakes and drives ITS copy to completion.
+    for _ in range(10):
+        if a.jobs[jid].status in ("completed", "failed", "cancelled"):
+            break
+        a.run_round()
+    a.drain_io()
+    # Fencing rejected the zombie's writes: the durable record carries
+    # the adopter's fence, and the zombie lost ownership locally.
+    rec = json.load(open(os.path.join(spool_dir, "jobs",
+                                      f"{jid}.json")))
+    assert rec["fence"] == adopter_fence == 2
+    assert rec["status"] == "completed"
+    assert not a.jobs[jid].owned
+    fenced = _events_of(ev_path, "fenced")
+    assert fenced and all(e["worker"] == "a" for e in fenced)
+    completed = _events_of(ev_path, "completed")
+    assert len(completed) == 1 and completed[0]["worker"] == "b"
+    # And the adopter's result is intact with solo parity.
+    solo = np.asarray(Simulator(config).run()["final_state"].positions)
+    got = np.asarray(b.result(jid).positions)
+    assert np.max(
+        np.abs(got - solo) / np.maximum(np.abs(solo), 1e-30)
+    ) <= 1e-5
+    a.close_io()
+    b.close_io()
+
+
+@pytest.mark.fast
+def test_completed_without_result_is_rerun_not_trusted(tmp_path, faults):
+    """drop_result_write: the record says completed but the .npz never
+    landed (writer crashed in the async window). A restarted worker
+    re-runs the job and produces a durable result."""
+    spool_dir = str(tmp_path / "spool")
+    config = _cfg(8, steps=10, seed=5)
+    faults("drop_result_write@0")
+    a = _sched(spool_dir, None, "a")
+    jid = a.submit(config, job_id="lost-npz")
+    a.run_until_idle()
+    assert a.status(jid)["status"] == "completed"
+    assert not os.path.exists(a.spool.result_path(jid))
+    a.close_io()
+    del a
+
+    b = _sched(spool_dir, None, "b")
+    b.run_until_idle()
+    assert b.status(jid)["status"] == "completed"
+    assert os.path.exists(b.spool.result_path(jid))
+    assert b.result(jid) is not None
+    b.close_io()
+
+
+@pytest.mark.fast
+def test_result_already_on_disk_is_finalized_not_rerun(tmp_path):
+    """Idempotent adoption: a job whose .npz already landed (but whose
+    record was left non-terminal by a crash) is marked complete — it
+    never runs twice."""
+    spool_dir = str(tmp_path / "spool")
+    config = _cfg(8, steps=10, seed=6)
+    a = _sched(spool_dir, None, "a", lease_ttl_s=300.0)
+    jid = a.submit(config, job_id="landed")
+    a.run_until_idle()
+    assert os.path.exists(a.spool.result_path(jid))
+    # Forge the crash window: rewind the record to 'running' and leave
+    # a backdated lease, as if the worker died right after the npz.
+    rec = a.spool.read_job(jid)
+    rec["status"] = "running"
+    with open(a.spool.job_path(jid), "w") as f:
+        json.dump(rec, f)
+    a.leases.suspend(600.0)
+    a.leases.backdate()
+
+    ev_path = str(tmp_path / "events.jsonl")
+    b = _sched(spool_dir, ServingEventLogger(ev_path), "b",
+               lease_ttl_s=300.0)
+    assert b.status(jid)["status"] == "completed"
+    assert b.jobs[jid].steps_done == config.steps
+    adopted = _events_of(ev_path, "adopted")
+    assert adopted and adopted[0]["reason"] == "result already on disk"
+    assert b.engine.compile_counts == {}  # finalized, never integrated
+    a.close_io()
+    b.close_io()
+
+
+@pytest.mark.fast
+def test_breaker_opens_and_job_degrades_to_working_backend(
+    tmp_path, faults
+):
+    """backend:pallas down: admission failures open the breaker after
+    `threshold` strikes, the job re-keys down the exact-physics ladder
+    (pallas -> chunked), completes, and the events audit the
+    degradation."""
+    ev_path = str(tmp_path / "events.jsonl")
+    events = ServingEventLogger(ev_path)
+    faults("backend:pallas")
+    config = _cfg(8, steps=10, force_backend="pallas", seed=7)
+    sched = EnsembleScheduler(
+        slots=2, slice_steps=10, events=events,
+        breaker_threshold=2, breaker_cooldown_s=1e9,
+    )
+    jid = sched.submit(config)
+    sched.run_until_idle(max_rounds=50)
+    assert sched.status(jid)["status"] == "completed"
+    opened = _events_of(ev_path, "breaker_open")
+    assert opened and opened[0]["backend"] == "pallas"
+    # The completing batch ran on the degraded rung, exact physics.
+    backends = {k.backend for k in sched.engine.compile_counts}
+    assert backends == {"chunked"}
+    # Parity vs the solo dense run: degradation never swaps physics.
+    solo = np.asarray(
+        Simulator(_cfg(8, steps=10, force_backend="dense", seed=7))
+        .run()["final_state"].positions
+    )
+    got = np.asarray(sched.result(jid).positions)
+    assert np.max(
+        np.abs(got - solo) / np.maximum(np.abs(solo), 1e-30)
+    ) <= 1e-5
+    # Later submissions route straight to the open breaker's reroute —
+    # no failed rounds, no new breaker events.
+    jid2 = sched.submit(_cfg(8, steps=10, seed=8,
+                             force_backend="pallas"))
+    assert sched._assigned_key(sched.jobs[jid2]).backend == "chunked"
+
+
+@pytest.mark.fast
+def test_queue_full_sheds_with_retry_hint(tmp_path):
+    sched = EnsembleScheduler(slots=1, slice_steps=5, max_queue=2)
+    sched.submit(_cfg(8, steps=5, seed=1))
+    sched.submit(_cfg(8, steps=5, seed=2))
+    with pytest.raises(QueueFull) as exc:
+        sched.submit(_cfg(8, steps=5, seed=3))
+    assert exc.value.retry_after_s > 0
+    # Draining reopens admission.
+    sched.run_until_idle()
+    sched.submit(_cfg(8, steps=5, seed=3))
+
+
+@pytest.mark.fast
+def test_daemon_submit_returns_503_with_retry_after(tmp_path):
+    """The HTTP mapping of a shed: 503 + retry_after_s (the handler
+    layer adds the Retry-After header from it)."""
+    d = GravityDaemon(str(tmp_path / "spool"), max_queue=1)
+    try:
+        body = {"config": json.loads(_cfg(8, steps=5).to_json())}
+        code, payload = d.handle_post("/submit", dict(body))
+        assert code == 200
+        code, payload = d.handle_post("/submit", dict(body))
+        assert code == 503
+        assert payload["retry_after_s"] > 0
+        assert "queue_depth" in payload
+    finally:
+        d.scheduler.close_io()
+
+
+@pytest.mark.fast
+def test_poison_job_hits_requeue_cap(tmp_path, monkeypatch):
+    """A job whose rounds always throw is requeued max_requeues times,
+    then goes terminal failed with a poisoned event — batchmates stop
+    paying for it."""
+    ev_path = str(tmp_path / "events.jsonl")
+    sched = EnsembleScheduler(
+        slots=1, slice_steps=5, max_requeues=2,
+        events=ServingEventLogger(ev_path),
+    )
+    jid = sched.submit(_cfg(8, steps=10, seed=9))
+
+    def _boom(batch, slice_steps):
+        raise RuntimeError("injected round failure")
+
+    monkeypatch.setattr(sched.engine, "run_slice", _boom)
+    for _ in range(10):
+        if sched.jobs[jid].status == "failed":
+            break
+        try:
+            sched.run_round()
+        except RuntimeError:
+            pass
+    job = sched.jobs[jid]
+    assert job.status == "failed"
+    assert "poisoned" in job.error
+    assert job.requeues == 3  # cap 2 exceeded on the third strike
+    poisoned = _events_of(ev_path, "poisoned")
+    assert poisoned and poisoned[0]["job"] == jid
+    assert not sched.has_work()
+
+
+@pytest.mark.fast
+def test_stale_daemon_json_cleared_with_clear_error(tmp_path):
+    """Satellite: an endpoint file pointing at a dead pid is deleted on
+    sight and the client fails with 'daemon not running' (the CLI maps
+    DaemonUnreachable to exit 2) instead of hanging."""
+    spool = tmp_path / "spool"
+    spool.mkdir()
+    stale = {"host": "127.0.0.1", "port": 1, "pid": 2**22 + 54321}
+    path = spool / "daemon.json"
+    path.write_text(json.dumps(stale))
+    with pytest.raises(DaemonUnreachable, match="daemon not running"):
+        find_daemon(str(spool))
+    assert not path.exists()  # stale file reaped
+
+
+@pytest.mark.fast
+def test_find_daemon_fails_over_to_live_worker_registry(tmp_path):
+    """daemon.json points at a dead worker; a surviving replica in the
+    workers/ registry is found instead."""
+    spool = tmp_path / "spool"
+    workers = spool / "workers"
+    workers.mkdir(parents=True)
+    (spool / "daemon.json").write_text(json.dumps(
+        {"host": "127.0.0.1", "port": 1, "pid": 2**22 + 54321,
+         "worker_id": "dead"}
+    ))
+    (workers / "dead.json").write_text(json.dumps(
+        {"host": "127.0.0.1", "port": 1, "pid": 2**22 + 54321}
+    ))
+    (workers / "alive.json").write_text(json.dumps(
+        {"host": "127.0.0.1", "port": 7777, "pid": os.getpid()}
+    ))
+    host, port = find_daemon(str(spool))
+    assert (host, port) == ("127.0.0.1", 7777)
+
+
+@pytest.mark.fast
+def test_torn_job_record_skipped_not_fatal(tmp_path, faults):
+    """A torn spool job write (injected at the shared atomic_write_json
+    seam) leaves an unparseable record; scans skip it and the next
+    persist repairs it."""
+    spool_dir = str(tmp_path / "spool")
+    a = _sched(spool_dir, None, "a")
+    # Ordinal 1: submit's first JSON write is the lease claim, the
+    # second is the job record — tear the record.
+    faults("torn_spool_write@1")
+    jid = a.submit(_cfg(8, steps=5, seed=11))  # record write torn
+    assert a.spool.read_job(jid) is None  # genuinely torn
+    a.run_until_idle()  # persists repair it; the round completes
+    assert a.status(jid)["status"] == "completed"
+    assert a.spool.read_job(jid)["status"] == "completed"
+    a.close_io()
+
+
+@pytest.mark.fast
+def test_cross_worker_cancel_via_spool_marker(tmp_path):
+    """Any worker accepts a cancel for a peer-owned job (spool marker);
+    the OWNER consumes it in housekeeping and cancels for real."""
+    spool_dir = str(tmp_path / "spool")
+    a = _sched(spool_dir, None, "a", lease_ttl_s=300.0)
+    jid = a.submit(_cfg(8, steps=40, seed=12), job_id="cancel-me")
+    a.run_round()
+    assert a.jobs[jid].status in ("pending", "running")
+
+    b = _sched(spool_dir, None, "b", lease_ttl_s=300.0)
+    b.housekeeping()  # registers the peer's job read-only
+    assert not b.jobs[jid].owned
+    assert b.cancel(jid) is True  # accepted: marker dropped
+    assert a.spool.cancel_requested(jid)
+    a.housekeeping()  # the owner executes it
+    assert a.jobs[jid].status == "cancelled"
+    assert not a.spool.cancel_requested(jid)  # marker reaped
+    assert b.status(jid)["status"] == "cancelled"  # record synced
+    a.close_io()
+    b.close_io()
+
+
+@pytest.mark.fast
+def test_submit_retry_with_job_id_is_idempotent(tmp_path):
+    """The client retry path: re-submitting the same (job_id, config)
+    — to the same worker or to a failover peer — never enqueues the
+    simulation twice; a conflicting config under the same id is still
+    rejected."""
+    spool_dir = str(tmp_path / "spool")
+    config = _cfg(8, steps=20, seed=13)
+    a = _sched(spool_dir, None, "a", lease_ttl_s=300.0)
+    jid = a.submit(config, job_id="retry-key")
+    assert a.submit(config, job_id="retry-key") == jid  # same worker
+    assert a.queue_depth == 1
+    # Failover retry: a peer accepts the same key idempotently while
+    # the owner holds the lease, and registers it read-only.
+    b = _sched(spool_dir, None, "b", lease_ttl_s=300.0)
+    assert b.submit(config, job_id="retry-key") == jid
+    assert b.queue_depth == 0 and not b.jobs[jid].owned
+    with pytest.raises(ValueError, match="duplicate"):
+        a.submit(_cfg(10, steps=20, seed=14), job_id="retry-key")
+    a.close_io()
+    b.close_io()
+
+
+@pytest.mark.fast
+def test_submit_retry_after_completion_returns_done_job(tmp_path):
+    """The nastiest retry window: the job already COMPLETED and its
+    lease was released before the client's retry lands on a fresh
+    worker — the retry must absorb the terminal record, never re-run."""
+    spool_dir = str(tmp_path / "spool")
+    config = _cfg(8, steps=10, seed=15)
+    a = _sched(spool_dir, None, "a", lease_ttl_s=300.0)
+    jid = a.submit(config, job_id="done-key")
+    a.run_until_idle()
+    assert a.status(jid)["status"] == "completed"
+    a.close_io()
+    del a
+
+    b = _sched(spool_dir, None, "b", lease_ttl_s=300.0)
+    assert b.submit(config, job_id="done-key") == jid
+    assert b.status(jid)["status"] == "completed"  # not re-run
+    assert not b.has_work()
+    assert b.result(jid) is not None
+    b.close_io()
+
+
+@pytest.mark.fast
+def test_lost_lease_via_heartbeat_queue_evicts_zombie(tmp_path):
+    """A loss discovered by renew_all (any thread) lands in the
+    lost-lease queue; housekeeping drains it and evicts the zombie's
+    resident copy instead of burning rounds until completion."""
+    spool_dir = str(tmp_path / "spool")
+    a = _sched(spool_dir, None, "a", lease_ttl_s=300.0)
+    jid = a.submit(_cfg(8, steps=50, seed=16), job_id="zombied")
+    a.run_round()
+    assert a.jobs[jid].status == "running"
+    a.leases.backdate()  # expire without suspending renewals
+
+    b = _sched(spool_dir, None, "b", lease_ttl_s=300.0)
+    b.housekeeping()  # adopts
+    assert b.jobs[jid].owned
+
+    # The zombie's renewal (as the heartbeat thread would run it)
+    # discovers the loss; its next housekeeping evicts locally.
+    assert a.leases.renew_all() == [jid]
+    a.housekeeping()
+    assert not a.jobs[jid].owned
+    assert a.active_count == 0  # slot freed, no wasted rounds
+    a.close_io()
+    b.close_io()
+
+
+@pytest.mark.fast
+def test_peer_completed_without_result_adopted_after_owner_dies(
+    tmp_path, monkeypatch
+):
+    """A peer registers a job as completed while the owner's result
+    write is still in flight (owner holds the lease). If the owner
+    then dies before the .npz lands, later scans must re-absorb and
+    RE-RUN the job — not skip it as terminal forever."""
+    spool_dir = str(tmp_path / "spool")
+    config = _cfg(8, steps=10, seed=17)
+    a = _sched(spool_dir, None, "a", lease_ttl_s=300.0)
+    # Wedge a's result writer: record goes terminal, npz never lands,
+    # the lease is HELD (release rides the write callback).
+    monkeypatch.setattr(a, "_spool_result_async", lambda job, state: None)
+    jid = a.submit(config, job_id="in-flight")
+    a.run_until_idle()
+    assert a.spool.read_job(jid)["status"] == "completed"
+    assert not os.path.exists(a.spool.result_path(jid))
+    assert a.leases.held_fence(jid) is not None  # still leased
+
+    b = _sched(spool_dir, None, "b", lease_ttl_s=300.0)
+    b.housekeeping()  # owner alive: registered read-only, not claimed
+    assert not b.jobs[jid].owned
+    assert b.jobs[jid].status == "completed"
+    # Owner dies mid-write.
+    a.leases.suspend(600.0)
+    a.leases.backdate()
+    b.housekeeping()  # must fall through the terminal-skip and adopt
+    assert b.jobs[jid].owned
+    b.run_until_idle()
+    assert b.status(jid)["status"] == "completed"
+    assert os.path.exists(b.spool.result_path(jid))
+    a.close_io()
+    b.close_io()
+
+
+@pytest.mark.fast
+def test_unbuildable_floor_poisons_instead_of_spinning(tmp_path, faults):
+    """Even the rerouted dense floor cannot build: the job must go
+    terminal 'poisoned' after max_requeues admission failures, not
+    burn a failed kernel build every round forever."""
+    faults("backend:dense")
+    sched = EnsembleScheduler(
+        slots=1, slice_steps=5, max_requeues=2,
+        breaker_threshold=2, breaker_cooldown_s=1e9,
+    )
+    jid = sched.submit(_cfg(8, steps=10, seed=18))  # auto -> dense
+    rounds = sched.run_until_idle(max_rounds=50)
+    job = sched.jobs[jid]
+    assert job.status == "failed"
+    assert "poisoned" in job.error
+    assert rounds < 50 and not sched.has_work()
+
+
+@pytest.mark.fast
+def test_cancel_marker_for_unclaimable_record_is_executed(tmp_path):
+    """A cancel for a spool record NO worker can absorb (unparseable
+    config) is executed at the spool level under a claimed lease — the
+    marker never sits forever acknowledging a cancel nobody runs."""
+    spool_dir = str(tmp_path / "spool")
+    a = _sched(spool_dir, None, "a", lease_ttl_s=300.0)
+    # A foreign record the current envelope cannot parse.
+    from gravity_tpu.utils.hostio import atomic_write_json
+
+    atomic_write_json(a.spool.job_path("alien-job"), {
+        "id": "alien-job", "status": "pending", "fence": 0,
+        "config": {"field_from_the_future": 1},
+    })
+    assert a.cancel("alien-job") is True  # marker accepted
+    a.housekeeping()
+    assert not a.spool.cancel_requested("alien-job")  # reaped
+    assert a.spool.read_job("alien-job")["status"] == "cancelled"
+    a.close_io()
+
+
+@pytest.mark.fast
+def test_wrong_typed_foreign_record_fails_job_not_scan(tmp_path):
+    """A foreign record whose config PARSES but carries a wrong-typed
+    field (n='wat') must fail that one job at absorption — never crash
+    the reaper scan (TypeError escapes from_json-level checks)."""
+    spool_dir = str(tmp_path / "spool")
+    a = _sched(spool_dir, None, "a", lease_ttl_s=300.0)
+    from gravity_tpu.utils.hostio import atomic_write_json
+
+    atomic_write_json(a.spool.job_path("typed-wrong"), {
+        "id": "typed-wrong", "status": "pending", "fence": 0,
+        "config": {"model": "random", "n": "wat"},
+    })
+    a.housekeeping()  # must not raise
+    assert a.jobs["typed-wrong"].status == "failed"
+    assert "respool rejected" in a.jobs["typed-wrong"].error
+    a.close_io()
+
+
+@pytest.mark.fast
+def test_submit_rejects_path_traversal_job_id(tmp_path):
+    sched = _sched(str(tmp_path / "spool"), None, "a")
+    for bad in ("../../tmp/evil", "a/b", "", "x" * 129, ".hidden"):
+        with pytest.raises(ValueError, match="invalid job id"):
+            sched.submit(_cfg(8, steps=5), job_id=bad)
+    sched.close_io()
+
+
+@pytest.mark.fast
+def test_restarted_worker_reclaim_restamps_pid(tmp_path):
+    """A restarted worker reusing a fixed --worker-id must re-stamp its
+    own pid on re-claimed leases, or peers would treat the LIVE worker
+    as dead (pid-liveness) and adopt its work out from under it."""
+    import json as _json
+
+    from gravity_tpu.serve import LeaseManager
+
+    mgr = LeaseManager(str(tmp_path), "w1", ttl_s=300.0)
+    lease = mgr.claim("j1")
+    # Forge the predecessor: same worker id, dead pid.
+    rec = lease.to_record()
+    rec["pid"] = 2**22 + 11111
+    with open(os.path.join(mgr.dir, "j1.json"), "w") as f:
+        _json.dump(rec, f)
+    again = mgr.claim("j1")  # the restarted process re-claims
+    assert again.fence == lease.fence  # same grant, not an adoption
+    assert mgr.peek("j1").pid == os.getpid()  # live pid restored
+    peer = LeaseManager(str(tmp_path), "w2", ttl_s=300.0)
+    assert peer.claim("j1") is None  # no longer looks dead
