@@ -5,8 +5,10 @@ PYTEST := env JAX_PLATFORMS=cpu python -m pytest
 
 .PHONY: smoke chaos fast test nightly
 
-# The documented pre-push check: the -m fast contract lane plus a
-# 2-job ensemble serving e2e through the real CLI daemon (docs/serving.md).
+# The documented pre-push check: the -m fast contract lane plus the
+# serving e2es through the real CLI daemon — 2-job ensemble, chaos
+# harness, and the job-class stage (fit + sweep with solo parity;
+# docs/serving.md "Job classes").
 smoke:
 	bash scripts/smoke.sh
 

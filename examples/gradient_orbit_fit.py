@@ -1,12 +1,17 @@
 """Differentiable-simulator demo: fit a transfer-orbit launch velocity by
-gradient descent *through the integrator*.
+gradient descent *through the integrator* — now a thin client of the
+served ``fit`` job class (gravity_tpu/serve/jobs/fit.py).
 
-The whole simulator is a pure JAX program, so ``jax.grad`` flows through
-the scanned leapfrog rollout — a capability class the reference's
-imperative C/CUDA/Spark loops cannot express. Here: find the launch
-velocity that carries a probe from Earth's orbit radius to a target point
-in a fixed flight time, by differentiating the endpoint miss through the
-full N-body integration.
+The solver that used to live in this script is the library's
+:func:`gravity_tpu.serve.jobs.fit.fit_solo` reference (and the vmapped
+program the daemon batches across slots): find the launch velocity that
+carries a probe from Earth's orbit radius to a target point in a fixed
+flight time, by differentiating the endpoint miss through the full
+N-body integration. By default this script starts a serving daemon on a
+temporary spool, submits the fit as a real job, and checks the served
+result against the solo reference — the same ≤1e-5 parity the serving
+test battery pins. ``--solo`` skips the daemon and runs the reference
+directly.
 
     python examples/gradient_orbit_fit.py [--iters 300] [--steps 60]
 """
@@ -21,64 +26,109 @@ def main() -> int:
     ap.add_argument("--iters", type=int, default=300)
     ap.add_argument("--steps", type=int, default=60,
                     help="integration steps over the flight")
+    ap.add_argument("--solo", action="store_true",
+                    help="run the library solver directly (no daemon)")
     args = ap.parse_args()
     if args.iters < 1 or args.steps < 1:
         ap.error("--iters and --steps must be >= 1")
 
     import jax
-    import jax.numpy as jnp
 
     jax.config.update("jax_enable_x64", True)
 
-    from gravity_tpu.ops.forces import pairwise_accelerations_dense
-    from gravity_tpu.ops.integrators import init_carry, make_step_fn
-    from gravity_tpu.state import ParticleState
+    import numpy as np
+
+    from gravity_tpu.config import SimulationConfig
+    from gravity_tpu.serve.jobs.fit import fit_solo
 
     m_sun = 1.989e30
     r0 = 1.496e11  # launch radius = Earth's orbit
     flight_time = 8.0e6  # ~93 days
     dt = flight_time / args.steps
-    masses = jnp.asarray([m_sun, 1.0], jnp.float64)
-    pos = jnp.asarray([[0.0, 0.0, 0.0], [r0, 0.0, 0.0]], jnp.float64)
     # Target: 40 degrees ahead, half-way out toward Mars' orbit radius.
-    theta = jnp.deg2rad(40.0)
+    theta = np.deg2rad(40.0)
     r_t = 1.85e11
-    target = jnp.asarray(
-        [r_t * jnp.cos(theta), r_t * jnp.sin(theta), 0.0], jnp.float64
+    target = [r_t * np.cos(theta), r_t * np.sin(theta), 0.0]
+
+    config = SimulationConfig(
+        model="random", n=2, steps=args.steps, dt=dt,
+        integrator="leapfrog", force_backend="dense", dtype="float64",
     )
+    params = {
+        # Sun at rest + probe at launch radius; the circular-speed
+        # guess the optimizer refines.
+        "state": {
+            "positions": [[0.0, 0.0, 0.0], [r0, 0.0, 0.0]],
+            "velocities": [[0.0, 0.0, 0.0], [0.0, 2.98e4, 0.0]],
+            "masses": [m_sun, 1.0],
+        },
+        # One observation: the target point at the final step, for the
+        # probe only — the endpoint-miss loss of the original demo.
+        "observations": {
+            "steps": [args.steps],
+            "positions": [[target]],
+        },
+        "particles": [1],
+        "optimizer": "gd",
+        # Endpoint ~linear in v0 -> ~quadratic loss; lr ~ 0.7 / Hessian.
+        "lr": 0.35 / (flight_time / r0) ** 2,
+        "scale": r0,
+        "iters": args.iters,
+    }
 
-    accel = lambda p: pairwise_accelerations_dense(p, masses)  # noqa: E731
-    step = make_step_fn("leapfrog", accel, dt)
+    solo = fit_solo(config, dict(params))
+    v_solo = np.asarray(solo["velocities"])[1]
 
-    @jax.jit
-    def endpoint_miss(v0):
-        st = ParticleState(
-            pos, jnp.stack([jnp.zeros(3, jnp.float64), v0]), masses
+    if args.solo:
+        v, loss = v_solo, solo["loss"]
+        served_note = "solo"
+    else:
+        # The served path: a real daemon on a throwaway spool, the fit
+        # submitted over HTTP like any production job.
+        import json
+        import tempfile
+
+        from gravity_tpu.serve import GravityDaemon, request, wait_for
+
+        with tempfile.TemporaryDirectory() as spool:
+            # slice_steps sized to ~8 optimizer iterations per
+            # scheduling round (fit converts via slice_units).
+            daemon = GravityDaemon(
+                spool, slots=2, slice_steps=max(args.steps, 1) * 8,
+                idle_sleep_s=0.01,
+            )
+            daemon.start()
+            try:
+                resp = request(spool, "POST", "/submit", {
+                    "config": json.loads(config.to_json()),
+                    "job_type": "fit",
+                    "params": params,
+                })
+                assert "job" in resp, resp
+                status = wait_for(spool, [resp["job"]], timeout=600)
+                st = status[resp["job"]]
+                if st["status"] != "completed":
+                    print(f"served fit {st['status']}: {st.get('error')}")
+                    return 1
+                result = request(
+                    spool, "GET", f"/result?job={resp['job']}"
+                )
+                v = np.asarray(result["velocities"])[1]
+                loss = float(np.asarray(result["loss"])[0])
+            finally:
+                daemon.stop()
+        rel = np.max(
+            np.abs(v - v_solo) / np.maximum(np.abs(v_solo), 1e-30)
         )
+        served_note = f"served (vs solo max rel {rel:.2e})"
+        if rel > 1e-5:
+            print(f"SERVED/SOLO MISMATCH: {rel:.3e}")
+            return 1
 
-        def body(carry, _):
-            s, a = step(*carry)
-            return (s, a), None
-
-        (st, _), _ = jax.lax.scan(
-            body, (st, init_carry(accel, st)), None, length=args.steps
-        )
-        return jnp.sum(((st.positions[1] - target) / r0) ** 2)
-
-    v = jnp.asarray([0.0, 2.98e4, 0.0], jnp.float64)  # circular guess
-    val_and_grad = jax.jit(jax.value_and_grad(endpoint_miss))
-    # Endpoint ~linear in v0 -> ~quadratic loss; lr ~ 0.7 / Hessian.
-    lr = 0.35 / (flight_time / r0) ** 2
-    for i in range(args.iters):
-        val, g = val_and_grad(v)
-        v = v - lr * g
-        if i % 50 == 0 or i == args.iters - 1:
-            print(f"iter {i:4d}  miss^2 = {float(val):.3e} (r0^2 units)")
-
-    miss_km = float(jnp.sqrt(val)) * r0 / 1e3
-    speed = float(jnp.linalg.norm(v))
-    print(f"\nfitted launch velocity: {[round(float(x), 1) for x in v]} m/s "
-          f"(|v| = {speed:.1f} m/s)")
+    miss_km = float(np.sqrt(loss)) * r0 / 1e3
+    speed = float(np.linalg.norm(v))
+    print(f"fitted launch velocity: {[round(float(x), 1) for x in v]} "
+          f"m/s (|v| = {speed:.1f} m/s) [{served_note}]")
     print(f"endpoint miss: {miss_km:.3e} km over a "
           f"{flight_time / 86400:.0f}-day flight")
     ok = miss_km < 1.0e4  # within 10,000 km of the target

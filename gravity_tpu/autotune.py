@@ -481,7 +481,9 @@ def engine_candidates(on_tpu: bool) -> tuple:
     return ("dense", "pallas", "pallas-mxu") if on_tpu else ("dense",)
 
 
-def resolve_engine_backend(config, *, min_bucket: int = 16) -> AutotuneDecision:
+def resolve_engine_backend(
+    config, *, min_bucket: int = 16, job_type: str = "integrate"
+) -> AutotuneDecision:
     """Serve-admission routing: the measured-fastest ENGINE backend for
     a job's padded bucket. Keyed on the bucket size (jobs sharing a
     bucket share a verdict, exactly like they share a compiled batch
@@ -525,7 +527,14 @@ def resolve_engine_backend(config, *, min_bucket: int = 16) -> AutotuneDecision:
             else "leapfrog"
         ),
     )
+    # The job type joins the probe key through the occupancy marker:
+    # a fit round (optimizer loop: rollout + backward per iteration)
+    # and an integrate round are different programs, so their measured
+    # backend rankings must not share a verdict. "serve" stays the
+    # integrate marker — existing caches keep routing.
+    occupancy = "serve" if job_type == "integrate" \
+        else f"serve:{job_type}"
     return resolve_backend_measured(
         cfg, lambda: make_initial_state(cfg), candidates=candidates,
-        occupancy="serve", static_fallback="dense",
+        occupancy=occupancy, static_fallback="dense",
     )

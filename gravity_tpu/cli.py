@@ -1632,15 +1632,34 @@ def cmd_serve(args: argparse.Namespace) -> int:
 def cmd_submit(args: argparse.Namespace) -> int:
     """Submit one job (the usual config flags describe it) to the
     daemon advertised under --spool-dir; prints the job id, or — with
-    --wait — polls to the terminal status."""
+    --wait — polls to the terminal status. --job-type selects the
+    traffic class (integrate | fit | sweep | watch; docs/serving.md
+    "Job classes"), --params its JSON payload (inline or @file)."""
     from .serve import DaemonUnreachable, request, wait_for
 
     import uuid
 
     config = build_config(args)
+    params = None
+    if args.params:
+        raw = args.params
+        try:
+            if raw.startswith("@"):
+                with open(raw[1:]) as f:
+                    raw = f.read()
+            params = json.loads(raw)
+        except (OSError, ValueError) as e:
+            print(f"error: bad --params: {e}", file=sys.stderr)
+            return 2
+        if not isinstance(params, dict):
+            print("error: --params must be a JSON object",
+                  file=sys.stderr)
+            return 2
     try:
         resp = request(args.spool_dir, "POST", "/submit", {
             "config": json.loads(config.to_json()),
+            "job_type": args.job_type,
+            "params": params,
             "priority": args.priority,
             "deadline_s": args.deadline_s,
             # Client-generated idempotency key: a retry after a lost
@@ -1689,7 +1708,11 @@ def cmd_job_status(args: argparse.Namespace) -> int:
 
 
 def cmd_result(args: argparse.Namespace) -> int:
-    """Fetch a completed job's final state; --out saves it as .npz."""
+    """Fetch a completed job's result; --out saves its arrays as .npz.
+    Every class ships its own schema (integrate/watch: the final state;
+    fit adds the fitted velocities + loss; sweep parents the per-member
+    verdict arrays) — array-valued payload fields are treated
+    uniformly."""
     import numpy as np
 
     from .serve import DaemonUnreachable, request
@@ -1699,7 +1722,12 @@ def cmd_result(args: argparse.Namespace) -> int:
     except DaemonUnreachable as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
-    if "positions" not in resp:
+    array_keys = [
+        k for k, v in resp.items() if isinstance(v, list)
+    ]
+    # A completed job's status dict carries "error": null — only a
+    # TRUTHY error (unknown job, not completed) is a failure.
+    if resp.get("error") or not array_keys:
         print(json.dumps(resp), file=sys.stderr)
         return 1
     if args.out:
@@ -1708,13 +1736,12 @@ def cmd_result(args: argparse.Namespace) -> int:
         # through float64 exactly).
         np.savez(
             args.out,
-            positions=np.asarray(resp["positions"]),
-            velocities=np.asarray(resp["velocities"]),
-            masses=np.asarray(resp["masses"]),
+            **{k: np.asarray(resp[k]) for k in array_keys},
         )
-    summary = {k: v for k, v in resp.items()
-               if k not in ("positions", "velocities", "masses")}
-    summary["n"] = len(resp["positions"])
+    summary = {k: v for k, v in resp.items() if k not in array_keys}
+    summary["arrays"] = sorted(array_keys)
+    if "positions" in resp:
+        summary["n"] = len(resp["positions"])
     if args.out:
         summary["saved_to"] = args.out
     print(json.dumps(summary))
@@ -1892,6 +1919,21 @@ def main(argv=None) -> int:
     )
     _add_config_args(p_submit)
     _add_spool_arg(p_submit)
+    p_submit.add_argument("--job-type", dest="job_type",
+                          default="integrate",
+                          help="traffic class: integrate (default) | "
+                               "fit (recover ICs from observed "
+                               "trajectory points via the "
+                               "differentiable rollout) | sweep "
+                               "(perturbed-IC stability survey) | "
+                               "watch (close-encounter events + "
+                               "auto follow-up); docs/serving.md "
+                               "'Job classes'")
+    p_submit.add_argument("--params", default=None,
+                          help="job-class payload as inline JSON or "
+                               "@file (e.g. '{\"members\": 64}' for "
+                               "sweep; fit observations are usually "
+                               "@file)")
     p_submit.add_argument("--priority", type=int, default=0,
                           help="higher preempts lower in a full batch")
     p_submit.add_argument("--deadline-s", dest="deadline_s", type=float,

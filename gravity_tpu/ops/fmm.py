@@ -629,6 +629,9 @@ def _fmm_core(
             _monopole_neighborhood(
                 sorted_pos, coords[sort_order], levels[depth][0],
                 levels[depth][1], side, span, ws, g, eps, dtype,
+                cells_pos=cells_pos, cells_mass=cells_mass,
+                leaf_count=leaf_count, m_scale=m_scale, cutoff=cutoff,
+                cquad_l=levels[depth][2] if quad else None,
             ),
             near_sorted,
         ),
@@ -651,16 +654,25 @@ def _fmm_core(
 
 def _monopole_neighborhood(
     eval_pos, eval_coords, cmass_l, ccom_l, side, span, ws, g, eps,
-    dtype, potential: bool = False,
+    dtype, cells_pos=None, cells_mass=None, leaf_count=None,
+    m_scale=None, cutoff=0.0, cquad_l=None, potential: bool = False,
 ):
-    """Full 7^3 neighborhood of each eval point's leaf as softened cell
-    monopoles at the point's OWN position: the near 3^3 with cell-size
-    softening (the same bounded resolution-limited degradation the
-    source-side overflow contract uses; the own-cell self term is
-    bounded by that softening too), the interaction-list cells with the
-    run's eps. Covers the finest interaction list too, so the result
-    REPLACES the whole (cell, slot) near+finest sum for its targets.
-    Per-point gathers — only ever run for the overflow minority."""
+    """Full 7^3 neighborhood of each eval point's leaf at the point's
+    OWN position, replacing the whole (cell, slot) near+finest sum for
+    targets that layout cannot serve.
+
+    With the padded cell blocks (``cells_pos``/``cells_mass``/
+    ``leaf_count``/``m_scale``) the near 3^3 is EXACT: pair sums
+    against each neighbor cell's capped prefix plus the cell-size-
+    softened remainder monopole for overflowing cells — the same
+    sources a gather-based tree target sees, so overflow TARGETS keep
+    tree-parity accuracy (an all-monopole own-cell treatment loses the
+    dominant near force entirely in a dense core, measured p90 12.7%
+    on the 2048-disk at depth 5). Without cell blocks the near 3^3
+    degrades to cell-size-softened monopoles as before. The
+    interaction-list cells are monopoles with the run's eps in both
+    forms. Per-point gathers — only ever run for the fallback
+    minority."""
     m = eval_pos.shape[0]
     offsets = jnp.asarray(_offsets(ws), jnp.int32)
     pmask_t = jnp.asarray(_parity_mask_table(ws))
@@ -670,6 +682,7 @@ def _monopole_neighborhood(
         | (eval_coords[:, 2] & 1)
     )
     eps_over = jnp.maximum(jnp.asarray(eps, dtype), 0.5 * span / side)
+    exact_near = cells_pos is not None
 
     def body(carry, xs):
         acc, phi = carry
@@ -685,7 +698,12 @@ def _monopole_neighborhood(
         is_near = jnp.max(jnp.abs(off)) <= ws
         ok = jnp.logical_and(
             in_b,
-            jnp.logical_or(is_near, pm_row[parity]),
+            jnp.logical_or(
+                jnp.logical_and(is_near, jnp.logical_not(exact_near)),
+                jnp.logical_and(
+                    jnp.logical_not(is_near), pm_row[parity]
+                ),
+            ),
         )
         sm = cmass_l[ids]
         ok = jnp.logical_and(ok, sm > 0)
@@ -708,35 +726,117 @@ def _monopole_neighborhood(
         acc = acc + w[:, None] * diff
         if phi is not None:
             phi = phi + w * safe
+        if cquad_l is not None:
+            # Finest-list source quadrupoles — same term (and h) as
+            # _finest_exact_shifted, so fallback targets keep the
+            # default accuracy class instead of dropping to
+            # monopole-only on the list cells ((h/r)^2 ~ 10%).
+            sq = jnp.where(ok[:, None], cquad_l[ids], 0.0)
+            acc = acc + _quad_correction(
+                diff, inv_r, sq, ok, g, m_scale, span / side, dtype,
+            )
         return (acc, phi), None
 
     phi0 = jnp.zeros((m,), dtype) if potential else None
     (mono, phi), _ = jax.lax.scan(
         body, (jnp.zeros((m, 3), dtype), phi0), (offsets, pmask_t.T)
     )
+    if not exact_near:
+        return (mono, phi) if potential else mono
+
+    # Exact near 3^3: per-cell overflow remainder first (same math and
+    # softening contract as _near_field_shifted).
+    leaf_cap = cells_pos.shape[-2]
+    pref_mhat = jnp.sum(cells_mass, axis=-1) / m_scale
+    cell_mhat = cmass_l / m_scale
+    over_g = leaf_count > leaf_cap
+    rem_mhat = jnp.maximum(
+        jnp.where(over_g, cell_mhat - pref_mhat, 0.0), 0.0
+    )
+    tot_mw = ccom_l * cell_mhat[:, None]
+    pref_mw = jnp.sum(
+        (cells_mass / m_scale)[..., None] * cells_pos, axis=-2
+    )
+    rem_com = (tot_mw - pref_mw) / jnp.maximum(
+        rem_mhat, jnp.asarray(1e-37, dtype)
+    )[:, None]
+    near = jnp.asarray(_near_offsets(ws), jnp.int32)
+
+    def near_body(carry, off):
+        acc, phi = carry
+        cell = eval_coords + off[None, :]
+        in_b = jnp.all(
+            jnp.logical_and(cell >= 0, cell < side), axis=-1
+        )
+        ids = (
+            jnp.clip(cell[:, 0], 0, side - 1) * side
+            + jnp.clip(cell[:, 1], 0, side - 1)
+        ) * side + jnp.clip(cell[:, 2], 0, side - 1)
+        spos = cells_pos[ids]  # (m, cap, 3)
+        smass = jnp.where(in_b[:, None], cells_mass[ids], 0.0)
+        diff = spos - eval_pos[:, None, :]
+        r2s = jnp.sum(diff * diff, axis=-1) + jnp.asarray(
+            eps * eps, dtype
+        )
+        ok = r2s > jnp.asarray(cutoff * cutoff, dtype)
+        safe = jnp.where(ok, r2s, jnp.asarray(1.0, dtype))
+        inv_r = jax.lax.rsqrt(safe)
+        w = jnp.where(
+            ok,
+            ((jnp.asarray(g, dtype) * smass) * inv_r) * inv_r * inv_r,
+            jnp.asarray(0.0, dtype),
+        )
+        acc = acc + jnp.sum(w[..., None] * diff, axis=1)
+        if phi is not None:
+            phi = phi + jnp.sum(w * safe, axis=-1)
+        r_over = jnp.logical_and(in_b, over_g[ids])
+        r_m = jnp.where(r_over, rem_mhat[ids], 0.0)
+        diff_o = jnp.where(
+            r_over[:, None],
+            rem_com[ids] - eval_pos,
+            jnp.asarray(0.0, dtype),
+        )
+        r2o = jnp.sum(diff_o * diff_o, axis=-1) + eps_over * eps_over
+        inv_ro = jax.lax.rsqrt(r2o)
+        w_o = jnp.where(
+            r_over,
+            ((jnp.asarray(g, dtype) * (r_m * m_scale)) * inv_ro)
+            * inv_ro * inv_ro,
+            jnp.asarray(0.0, dtype),
+        )
+        acc = acc + w_o[:, None] * diff_o
+        if phi is not None:
+            phi = phi + w_o * r2o
+        return (acc, phi), None
+
+    (mono, phi), _ = jax.lax.scan(near_body, (mono, phi), near)
     return (mono, phi) if potential else mono
 
 
 def _monopole_all_levels(
     eval_pos, eval_coords, levels, depth, side, span, ws, g, eps,
-    dtype, potential: bool = False,
+    dtype, cells_pos=None, cells_mass=None, leaf_count=None,
+    m_scale=None, cutoff=0.0, cquad_l=None, potential: bool = False,
 ):
-    """COMPLETE per-point monopole evaluation at the point's own
-    position: the leaf-level 7^3 neighborhood (_monopole_neighborhood,
-    covering near + finest interaction list) plus every coarse
-    ancestor's parity-masked interaction list, all at REAL distances —
-    the fallback that replaces the whole far + near sum for targets the
-    (cell, slot) layout cannot serve (slot overflow, and out-of-cube
-    targets whose clipped-edge Taylor expansion would diverge). The
-    union of the per-level interaction sets tiles every cell exactly
-    once (the same telescoping as the main decomposition), so no mass
-    is dropped or double-counted; accuracy is the tree far="direct"
-    class (~1% median). Per-point gathers — only ever run for the
-    fallback minority. With ``potential``, returns (acc, phi): the
+    """COMPLETE per-point evaluation at the point's own position: the
+    leaf-level 7^3 neighborhood (_monopole_neighborhood — exact near
+    pairs when the padded cell blocks are supplied, covering near +
+    finest interaction list) plus every coarse ancestor's parity-masked
+    interaction list as monopoles, all at REAL distances — the fallback
+    that replaces the whole far + near sum for targets the (cell, slot)
+    layout cannot serve (slot overflow, and out-of-cube targets whose
+    clipped-edge Taylor expansion would diverge). The union of the
+    per-level interaction sets tiles every cell exactly once (the same
+    telescoping as the main decomposition), so no mass is dropped or
+    double-counted; with cell blocks the near field is exact and
+    accuracy is the tree class. Per-point gathers — only ever run for
+    the fallback minority. With ``potential``, returns (acc, phi): the
     scalar channel shared with :func:`fmm_potential_energy`."""
     out = _monopole_neighborhood(
         eval_pos, eval_coords, levels[depth][0], levels[depth][1],
-        side, span, ws, g, eps, dtype, potential=potential,
+        side, span, ws, g, eps, dtype, cells_pos=cells_pos,
+        cells_mass=cells_mass, leaf_count=leaf_count, m_scale=m_scale,
+        cutoff=cutoff, cquad_l=cquad_l, potential=potential,
     )
     acc, phi = out if potential else (out, None)
     return _monopole_coarse_levels(
@@ -991,7 +1091,10 @@ def fmm_accelerations_vs(
             fallback[:, None],
             _monopole_all_levels(
                 t_sorted_pos, t_coords[t_sort], levels, depth, side,
-                span, ws, g, eps, dtype,
+                span, ws, g, eps, dtype, cells_pos=cells_pos,
+                cells_mass=cells_mass, leaf_count=leaf_count,
+                m_scale=m_scale, cutoff=cutoff,
+                cquad_l=levels[depth][2] if quad else None,
             ),
             a,
         ),
@@ -1104,7 +1207,9 @@ def _fmm_pe_scaled(
             over_t,
             _monopole_all_levels(
                 sorted_pos, coords[sort_order], levels, depth, side,
-                span, ws, g, eps, dtype, potential=True,
+                span, ws, g, eps, dtype, cells_pos=cells_pos,
+                cells_mass=cells_mass, leaf_count=leaf_count,
+                m_scale=m_scale, cutoff=cutoff, potential=True,
             )[1],
             pt,
         ),

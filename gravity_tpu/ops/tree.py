@@ -922,3 +922,42 @@ def recommended_depth_data(
         stacklevel=2,
     )
     return max_depth
+
+
+def recommended_leaf_cap(
+    positions, depth: int, *, cap_min: int = 32, cap_max: int = 256
+) -> int:
+    """Data-driven near-field occupancy cap for a given depth: the
+    smallest power of two >= the DENSEST leaf cell's occupancy, clamped
+    to [cap_min, cap_max] — at that cap the capped-exact near field
+    covers every cell and no mass flows through overflow monopoles.
+
+    :func:`recommended_depth_data` sizes depth by the MEAN occupied-
+    leaf load, which a strongly clustered core exceeds by multiples: at
+    depth 5 the 2048-body disk's densest cell holds 103 particles vs
+    the default cap of 32, so 70% of the core's mass degrades to one
+    cell-size-softened monopole — measured p90 force error 12.7% (fmm)
+    / 8.9% (tree far="direct") against the <=2% accuracy class, vs
+    0.6% with the cap sized by this helper (tests/test_fmm.py disk
+    cases). ``cap_max`` bounds the padded per-cell blocks, which scale
+    as 16 B x 8^depth x cap; past it the remaining overflow is the
+    documented resolution-limited degradation."""
+    import numpy as np
+
+    if not getattr(positions, "is_fully_addressable", True):
+        return cap_min  # multi-host mesh: see recommended_depth_data
+    pos = np.asarray(positions, np.float64)
+    origin = pos.min(axis=0)
+    span = float((pos.max(axis=0) - origin).max())
+    if span <= 0.0:
+        return cap_min
+    side = 1 << depth
+    coords = np.clip(
+        (pos - origin) / span * side, 0, side - 1
+    ).astype(np.int64)
+    ids = (coords[:, 0] * side + coords[:, 1]) * side + coords[:, 2]
+    occ = int(np.bincount(ids).max())
+    cap = cap_min
+    while cap < occ and cap < cap_max:
+        cap *= 2
+    return cap

@@ -18,6 +18,17 @@ Fleet resilience (docs/robustness.md "Fleet failure modes"):
   so N workers share one spool and adopt a dead peer's jobs.
 - :mod:`.breaker` — per-backend circuit breakers over the supervisor's
   exact-physics degrade ladder, applied at admission keying.
+
+Traffic classes (docs/serving.md "Job classes"):
+
+- :mod:`.jobs` — the job-class registry: ``integrate`` (advance N
+  steps), ``fit`` (inverse problems via the differentiable rollout —
+  on-device Adam/GD loops vmapped across slots), ``sweep`` (ensemble
+  stability surveys with per-member verdicts), and ``watch``
+  (event-driven runs: in-program encounter/merger detection raising
+  serving events + auto-submitted high-resolution follow-ups). All
+  classes inherit the scheduler/lease/breaker resilience contracts
+  unchanged.
 """
 
 from .breaker import BreakerBoard, CircuitBreaker  # noqa: F401
@@ -28,6 +39,14 @@ from .engine import (  # noqa: F401
     EnsembleEngine,
     batch_key_for,
     bucket_size,
+)
+from .jobs import (  # noqa: F401
+    JobValidationError,
+    fit_solo,
+    get_class,
+    job_types,
+    sweep_member_solo,
+    watch_solo,
 )
 from .leases import Lease, LeaseManager  # noqa: F401
 from .scheduler import (  # noqa: F401

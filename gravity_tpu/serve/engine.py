@@ -73,7 +73,14 @@ class BatchKey(NamedTuple):
     """Everything that must be equal for two jobs to share a compiled
     batch program (one compile per distinct key, cached for the engine's
     lifetime). dt / steps / model / seed deliberately absent: traced or
-    host-side."""
+    host-side.
+
+    ``job_type`` selects the program FAMILY (serve/jobs registry):
+    jobs of different classes never share a batch even at the same
+    bucket — a fit round is an optimizer loop, not an integrate slice.
+    ``extra`` carries the class's additional static program parameters
+    (e.g. the fit rollout length and observation-slot count) as a
+    hashable (name, value) tuple."""
 
     bucket_n: int
     slots: int
@@ -83,11 +90,13 @@ class BatchKey(NamedTuple):
     g: float
     eps: float
     cutoff: float
+    job_type: str = "integrate"
+    extra: tuple = ()
 
 
 def batch_key_for(
     config: SimulationConfig, *, slots: int, min_bucket: int = MIN_BUCKET,
-    reroute=None,
+    reroute=None, job_type: str = "integrate", extra: tuple = (),
 ) -> BatchKey:
     """The batch a job with this config lands in. Raises ValueError for
     configs outside the ensemble envelope (the caller surfaces it as a
@@ -151,7 +160,7 @@ def batch_key_for(
             from ..autotune import resolve_engine_backend
 
             backend = resolve_engine_backend(
-                config, min_bucket=min_bucket
+                config, min_bucket=min_bucket, job_type=job_type
             ).backend
     if reroute is not None:
         rerouted = reroute(backend)
@@ -170,6 +179,8 @@ def batch_key_for(
         g=config.g,
         eps=config.eps,
         cutoff=config.cutoff,
+        job_type=job_type,
+        extra=tuple(extra),
     )
 
 
@@ -198,6 +209,29 @@ class SliceResult(NamedTuple):
     finite: np.ndarray  # (B,) bool — real lanes finite after the slice
 
 
+def budget_i32(remaining: np.ndarray) -> np.ndarray:
+    """Per-slot budgets clamped for the device: the scan counter is
+    int32 and budgets beyond 2^31 units are not a serving shape. The
+    ONE clamp every program family ships its traced budgets through."""
+    return np.minimum(remaining, np.iinfo(np.int32).max).astype(
+        np.int32
+    )
+
+
+def account_slice(
+    remaining: np.ndarray, n_real: np.ndarray, units: int, finite
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Shared host bookkeeping after one budgeted slice of any program
+    family: (advanced, new remaining, finite with empty slots vacuously
+    True). One definition so the integrate/fit/sweep/watch classes
+    cannot drift from each other on the budget-mask arithmetic."""
+    advanced = np.minimum(remaining, units)
+    finite_np = np.where(
+        np.asarray(n_real) > 0, np.asarray(finite), True
+    )
+    return advanced, remaining - advanced, finite_np
+
+
 class EnsembleEngine:
     """Owner of the per-BatchKey compiled round programs.
 
@@ -205,13 +239,29 @@ class EnsembleEngine:
     function — the honest "did serving this job retrace?" signal the
     e2e compile-once acceptance gate asserts on (a cache hit executes
     the compiled program without touching the Python body).
-    """
+
+    Non-``integrate`` job types (serve/jobs registry: fit optimizer
+    loops, sweep stability members, watch event runs) route every
+    batch-lifecycle call through their :class:`~gravity_tpu.serve.jobs.
+    registry.JobClass` — each class owns its batch layout and compiled
+    round program family, keyed (and compile-counted) by the same
+    extended :class:`BatchKey`."""
 
     def __init__(self):
         self._round_fns: dict[BatchKey, object] = {}
         self._kernels: dict[BatchKey, object] = {}
         self._seed_fns: dict[BatchKey, object] = {}
         self.compile_counts: dict[BatchKey, int] = {}
+
+    @staticmethod
+    def _job_class(key: BatchKey):
+        """The registered program family for a non-integrate key; None
+        for the engine's native integrate family."""
+        if key.job_type == "integrate":
+            return None
+        from .jobs import get_class
+
+        return get_class(key.job_type)
 
     # --- kernel / program construction ---
 
@@ -306,13 +356,20 @@ class EnsembleEngine:
 
     def round_fn(self, key: BatchKey):
         if key not in self._round_fns:
-            self._round_fns[key] = self._build_round_fn(key)
+            cls = self._job_class(key)
+            self._round_fns[key] = (
+                self._build_round_fn(key) if cls is None
+                else cls.build_round_fn(self, key)
+            )
         return self._round_fns[key]
 
     # --- batch lifecycle ---
 
-    def new_batch(self, key: BatchKey) -> EnsembleBatch:
+    def new_batch(self, key: BatchKey):
         """All-empty batch: zero-mass states, zero budgets."""
+        cls = self._job_class(key)
+        if cls is not None:
+            return cls.new_batch(self, key)
         b, n = key.slots, key.bucket_n
         from ..simulation import resolve_dtype
 
@@ -336,18 +393,27 @@ class EnsembleEngine:
 
     def load_slot(
         self,
-        batch: EnsembleBatch,
+        batch,
         slot: int,
         state: ParticleState,
         *,
         dt: float,
         steps: int,
-    ) -> EnsembleBatch:
+        job=None,
+    ):
         """Admit a job into ``slot``: pad its state to the bucket, seed
         the carried acceleration (the deterministic accel-at-positions
         the integrators carry — identical at admission and re-admission,
-        so evict/resume round-trips preserve solo parity)."""
+        so evict/resume round-trips preserve solo parity). ``job`` (the
+        scheduler's Job record) is only consulted by non-integrate
+        program families, whose slot loads need the job's params and
+        evict-snapshot extras."""
         key = batch.key
+        cls = self._job_class(key)
+        if cls is not None:
+            return cls.load_slot(
+                self, batch, slot, state, dt=dt, steps=steps, job=job
+            )
         from ..simulation import resolve_dtype
 
         n_real = state.n
@@ -370,10 +436,13 @@ class EnsembleEngine:
             n_real=nr,
         )
 
-    def clear_slot(self, batch: EnsembleBatch, slot: int) -> EnsembleBatch:
+    def clear_slot(self, batch, slot: int):
         """Free a slot (job completed/failed/evicted). Only the budget
         and mass need zeroing — a zero-mass slot exerts no force and a
         zero budget freezes its lanes."""
+        cls = self._job_class(batch.key)
+        if cls is not None:
+            return cls.clear_slot(self, batch, slot)
         rem = batch.remaining.copy()
         nr = batch.n_real.copy()
         rem[slot], nr[slot] = 0, 0
@@ -386,11 +455,26 @@ class EnsembleEngine:
             n_real=nr,
         )
 
+    def slot_snapshot(
+        self, batch, slot: int
+    ) -> tuple[ParticleState, dict]:
+        """(state, extras) snapshot of one slot — everything a job
+        needs to leave its slot and come back later with full fidelity
+        (integrate carries no extras; fit adds its optimizer moments,
+        sweep/watch their in-program accumulators)."""
+        cls = self._job_class(batch.key)
+        if cls is not None:
+            return cls.slot_snapshot(self, batch, slot)
+        return self.slot_state(batch, slot), {}
+
     def slot_state(
-        self, batch: EnsembleBatch, slot: int,
+        self, batch, slot: int,
         n_real: Optional[int] = None,
     ) -> ParticleState:
         """The (unpadded) current state of one slot's job."""
+        cls = self._job_class(batch.key)
+        if cls is not None:
+            return cls.slot_snapshot(self, batch, slot)[0]
         n = int(batch.n_real[slot]) if n_real is None else n_real
         st = ParticleState(
             positions=batch.positions, velocities=batch.velocities,
@@ -418,29 +502,25 @@ class EnsembleEngine:
         lane that went non-finite comes back rolled back to its
         round-start state (see ``one_system``), flagged in
         ``SliceResult.finite``."""
+        cls = self._job_class(batch.key)
+        if cls is not None:
+            return cls.run_slice(self, batch, slice_steps)
         fn = self.round_fn(batch.key)
         dtype = batch.positions.dtype
         pos, vel, acc, finite = fn(
             batch.positions, batch.velocities, batch.masses, batch.acc,
             jnp.asarray(batch.dt, dtype),
-            # int32 on device: the scan counter is int32 and budgets
-            # beyond 2^31 steps are not a serving shape.
-            jnp.asarray(
-                np.minimum(batch.remaining, np.iinfo(np.int32).max)
-                .astype(np.int32)
-            ),
+            jnp.asarray(budget_i32(batch.remaining)),
             jnp.asarray(batch.n_real, jnp.int32),
             n_steps=slice_steps,
         )
-        advanced = np.minimum(batch.remaining, slice_steps)
-        remaining = batch.remaining - advanced
+        advanced, remaining, finite_np = account_slice(
+            batch.remaining, batch.n_real, slice_steps, finite
+        )
         new_batch = dataclasses.replace(
             batch, positions=pos, velocities=vel, acc=acc,
             remaining=remaining,
         )
-        finite_np = np.asarray(finite)
-        # Empty slots are vacuously finite.
-        finite_np = np.where(batch.n_real > 0, finite_np, True)
         return new_batch, SliceResult(
             advanced=advanced, finite=finite_np
         )

@@ -1,0 +1,30 @@
+"""Served job classes (docs/serving.md "Job classes").
+
+Importing this package registers the built-in traffic classes:
+
+- ``integrate`` — advance N steps (the original product)
+- ``fit`` — inverse problems via the differentiable rollout: an
+  on-device Adam/GD loop inside one jitted scan, vmapped across slots
+- ``sweep`` / ``sweep-member`` — ensemble stability surveys: perturbed
+  ICs fanned into vmap buckets, per-member energy-drift / escape /
+  min-separation verdicts, parent-level aggregation
+- ``watch`` — event-driven runs: in-program close-encounter / merger
+  detection raising events through the serving stream, with optional
+  auto-submitted high-resolution follow-up jobs
+"""
+
+from .fit import FitJob, fit_solo  # noqa: F401
+from .integrate import IntegrateJob  # noqa: F401
+from .registry import (  # noqa: F401
+    REGISTRY,
+    JobClass,
+    JobValidationError,
+    get_class,
+    job_types,
+)
+from .sweep import (  # noqa: F401
+    SweepJob,
+    SweepMemberJob,
+    sweep_member_solo,
+)
+from .watch import WatchJob, watch_solo  # noqa: F401

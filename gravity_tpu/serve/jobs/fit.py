@@ -1,0 +1,555 @@
+"""The ``fit`` job class: inverse problems through the differentiable
+rollout, served.
+
+The whole simulator is a pure JAX program, so ``jax.value_and_grad``
+flows through the scanned integrator — the capability
+``examples/gradient_orbit_fit.py`` demos solo is promoted here into a
+served product: recover initial velocities (launch vectors, orbital
+elements expressed as velocity DOF) from observed trajectory points.
+One fit job = one gradient-descent/Adam loop run ON DEVICE inside a
+single jitted ``lax.scan`` over iterations (each iteration is a full
+forward rollout + backward pass + parameter update — no host
+round-trips), and B fit jobs vmap across slots exactly like the engine
+batches integrations: same bucket padding, same per-slot traced
+budgets, one compile per extended BatchKey.
+
+Budget semantics: fit jobs are ITERATION-budgeted. The scheduler's
+``slice_steps`` converts via ``slice_units`` (~slice_steps integration
+steps worth of device work per round: ``max(1, slice_steps //
+rollout)`` iterations), so a fit round costs about what an integrate
+round costs and mixed-class rotations stay fair.
+
+Loss: sum over observation times t_k of
+``sum_i w_i |(x_i(t_k) - obs_{k,i}) / scale|^2`` — observed particles
+selected by ``params["particles"]``, every step of the rollout
+contributing through the same step function the solo Simulator uses,
+so a served fit recovers exactly what the solo reference
+(:func:`fit_solo`) recovers.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import numpy as np
+
+from ...state import ParticleState
+from .registry import (
+    JobClass,
+    JobValidationError,
+    params_state,
+    register,
+    validate_params_state,
+)
+
+OPTIMIZERS = ("adam", "gd")
+ADAM_B1, ADAM_B2, ADAM_EPS = 0.9, 0.999, 1e-8
+
+
+@dataclasses.dataclass
+class FitBatch:
+    """Device-side slot arrays for one fit BatchKey (cf. EnsembleBatch;
+    host-side budget bookkeeping identical)."""
+
+    key: object
+    pos0: object   # (B, n, 3) initial positions — fixed
+    v: object      # (B, n, 3) current velocity parameters
+    masses: object  # (B, n)
+    free: object   # (B, n) 1.0 where v receives gradient updates
+    obs_pos: object   # (B, K, n, 3)
+    obs_w: object     # (B, K, n) observation weights (0 = unobserved)
+    obs_step: object  # (B, K) int32
+    scale: object  # (B,) loss normalization
+    lr: object     # (B,)
+    m_adam: object  # (B, n, 3)
+    v_adam: object  # (B, n, 3)
+    loss: object   # (B,)
+    dt: np.ndarray         # (B,) host
+    remaining: np.ndarray  # (B,) host int64 — iterations left
+    iter_done: np.ndarray  # (B,) host int64 — Adam step counter base
+    n_real: np.ndarray     # (B,) host int32
+
+    @property
+    def slots(self) -> int:
+        return self.pos0.shape[0]
+
+
+def _system_fn(kernel, integrator, rollout: int, optimizer: str):
+    """The per-system fit program: (slot operands, n_iters) ->
+    (updated carries, finite). ONE definition shared by the vmapped
+    engine family and the solo reference — served-vs-solo parity is
+    structural, not coincidental."""
+    import jax
+    import jax.numpy as jnp
+
+    from ...ops.integrators import make_step_fn
+
+    def one_system(pos0, v, masses, free, obs_pos, obs_w, obs_step,
+                   scale, lr, dt, m_a, v_a, loss, remaining, iter0,
+                   n_real, *, n_iters):
+        dtype = pos0.dtype
+        accel = lambda p: kernel(p, p, masses)  # noqa: E731
+        step = make_step_fn(integrator, accel, dt)
+
+        def loss_fn(vp):
+            st = ParticleState(pos0, vp, masses)
+            a0 = kernel(pos0, pos0, masses)
+
+            def body(carry, i):
+                s, a = carry
+                s2, a2 = step(s, a)
+                # Observation hit at step i+1 ("state after s steps").
+                hit = obs_step == (i + 1)
+                d = (s2.positions[None, :, :] - obs_pos) / scale
+                c = jnp.sum(
+                    jnp.where(hit[:, None, None],
+                              obs_w[..., None] * d * d, 0.0)
+                )
+                return (s2, a2), c
+
+            _, cs = jax.lax.scan(
+                body, (st, a0), jnp.arange(rollout)
+            )
+            return jnp.sum(cs)
+
+        vg = jax.value_and_grad(loss_fn)
+
+        def iter_body(carry, i):
+            v_c, m_c, vv_c, loss_c = carry
+            val, g = vg(v_c)
+            g = g * free[:, None]
+            take = i < remaining
+            if optimizer == "adam":
+                t = (iter0 + i + 1).astype(dtype)
+                m_n = ADAM_B1 * m_c + (1.0 - ADAM_B1) * g
+                vv_n = ADAM_B2 * vv_c + (1.0 - ADAM_B2) * g * g
+                m_hat = m_n / (1.0 - jnp.power(
+                    jnp.asarray(ADAM_B1, dtype), t))
+                v_hat = vv_n / (1.0 - jnp.power(
+                    jnp.asarray(ADAM_B2, dtype), t))
+                upd = lr * m_hat / (jnp.sqrt(v_hat) + ADAM_EPS)
+            else:
+                m_n, vv_n = m_c, vv_c
+                upd = lr * g
+            v_n = v_c - upd * free[:, None]
+            keep = lambda new, old: jnp.where(take, new, old)  # noqa: E731
+            return (
+                keep(v_n, v_c), keep(m_n, m_c), keep(vv_n, vv_c),
+                keep(val, loss_c),
+            ), None
+
+        (v, m_a, v_a, loss), _ = jax.lax.scan(
+            iter_body, (v, m_a, v_a, loss), jnp.arange(n_iters)
+        )
+        real = jnp.arange(pos0.shape[0]) < n_real
+        fin = jnp.all(
+            jnp.where(real[:, None], jnp.isfinite(v), True)
+        ) & jnp.isfinite(loss)
+        # Non-finite lanes roll back nothing here — the scheduler fails
+        # the slot; loss/v of a diverged fit are not a result.
+        return v, m_a, v_a, loss, fin
+
+    return one_system
+
+
+def _key_params(key) -> dict:
+    return dict(key.extra)
+
+
+class FitJob(JobClass):
+    name = "fit"
+    units = "iters"
+
+    # --- admission ---
+
+    def validate(self, config, params):
+        params = dict(params or {})
+        unknown = set(params) - {
+            "observations", "particles", "iters", "lr", "optimizer",
+            "scale", "guess_velocities", "state",
+        }
+        if unknown:
+            raise JobValidationError(
+                f"fit: unknown params {sorted(unknown)}"
+            )
+        obs = params.get("observations")
+        if not isinstance(obs, dict) or "steps" not in obs \
+                or "positions" not in obs:
+            raise JobValidationError(
+                "fit requires params.observations = {steps: [...], "
+                "positions: [[...]]} — there is nothing to fit to"
+            )
+        validate_params_state(config, params)
+        try:
+            steps = [int(s) for s in obs["steps"]]
+        except (TypeError, ValueError) as e:
+            raise JobValidationError(
+                f"fit: observations.steps not integers: {e}"
+            ) from e
+        if not steps:
+            raise JobValidationError(
+                "fit: observations.steps is empty"
+            )
+        if any(s < 1 or s > config.steps for s in steps):
+            raise JobValidationError(
+                f"fit: observation steps {steps} outside the rollout "
+                f"[1, {config.steps}]"
+            )
+        particles = params.get("particles")
+        if particles is None:
+            particles = list(range(config.n))
+        try:
+            particles = sorted({int(p) for p in particles})
+        except (TypeError, ValueError) as e:
+            raise JobValidationError(
+                f"fit: particles not integers: {e}"
+            ) from e
+        if not particles or particles[0] < 0 \
+                or particles[-1] >= config.n:
+            raise JobValidationError(
+                f"fit: particles must be non-empty indices in "
+                f"[0, {config.n})"
+            )
+        try:
+            pos = np.asarray(obs["positions"], dtype=np.float64)
+        except (TypeError, ValueError) as e:
+            raise JobValidationError(
+                f"fit: observations.positions not numeric: {e}"
+            ) from e
+        want = (len(steps), len(particles), 3)
+        if pos.shape != want:
+            raise JobValidationError(
+                f"fit: observations.positions shape {pos.shape} != "
+                f"(len(steps), len(particles), 3) = {want}"
+            )
+        iters = params.get("iters", 100)
+        try:
+            iters = int(iters)
+        except (TypeError, ValueError) as e:
+            raise JobValidationError(f"fit: bad iters: {e}") from e
+        if iters < 1:
+            raise JobValidationError("fit: iters must be >= 1")
+        lr = params.get("lr", 1e-2)
+        scale = params.get("scale", 1.0)
+        try:
+            lr, scale = float(lr), float(scale)
+        except (TypeError, ValueError) as e:
+            raise JobValidationError(f"fit: bad lr/scale: {e}") from e
+        if lr <= 0 or scale <= 0:
+            raise JobValidationError("fit: lr and scale must be > 0")
+        optimizer = params.get("optimizer", "adam")
+        if optimizer not in OPTIMIZERS:
+            raise JobValidationError(
+                f"fit: optimizer {optimizer!r} not in {OPTIMIZERS}"
+            )
+        guess = params.get("guess_velocities")
+        if guess is not None:
+            try:
+                guess = np.asarray(guess, dtype=np.float64)
+            except (TypeError, ValueError) as e:
+                raise JobValidationError(
+                    f"fit: guess_velocities not numeric: {e}"
+                ) from e
+            if guess.shape != (config.n, 3):
+                raise JobValidationError(
+                    f"fit: guess_velocities shape {guess.shape} != "
+                    f"({config.n}, 3)"
+                )
+            params["guess_velocities"] = guess.tolist()
+        params["observations"] = {
+            "steps": steps, "positions": pos.tolist(),
+        }
+        params["particles"] = particles
+        params["iters"] = iters
+        params["lr"] = lr
+        params["scale"] = scale
+        params["optimizer"] = optimizer
+        return params
+
+    def key_extra(self, config, params) -> tuple:
+        # Static program parameters: rollout length, observation slot
+        # count, optimizer — jobs differing in any of these cannot
+        # share a compiled fit program.
+        return (
+            ("rollout", int(config.steps)),
+            ("obs", len(params["observations"]["steps"])),
+            ("opt", params["optimizer"]),
+        )
+
+    def budget(self, job) -> int:
+        return int(job.params["iters"])
+
+    def slice_units(self, key, slice_steps: int) -> int:
+        return max(1, slice_steps // max(1, _key_params(key)["rollout"]))
+
+    def pairs_per_unit(self, job) -> float:
+        # One iteration = one forward rollout (+ backward, ~2x; count
+        # the forward — the metric is dense-equivalent throughput, not
+        # FLOPs accounting).
+        from ...utils.timing import pairs_per_step
+
+        return pairs_per_step(job.config.n) * job.config.steps
+
+    # --- engine program family ---
+
+    def build_round_fn(self, engine, key):
+        import jax
+
+        kp = _key_params(key)
+        kernel = engine._kernel(key)
+        one = _system_fn(
+            kernel, key.integrator, kp["rollout"], kp["opt"]
+        )
+
+        def round_fn(pos0, v, masses, free, obs_pos, obs_w, obs_step,
+                     scale, lr, dt, m_a, v_a, loss, remaining, iter0,
+                     n_real, *, n_iters):
+            engine.compile_counts[key] = \
+                engine.compile_counts.get(key, 0) + 1
+            return jax.vmap(partial(one, n_iters=n_iters))(
+                pos0, v, masses, free, obs_pos, obs_w, obs_step,
+                scale, lr, dt, m_a, v_a, loss, remaining, iter0, n_real,
+            )
+
+        return jax.jit(round_fn, static_argnames=("n_iters",))
+
+    def new_batch(self, engine, key):
+        import jax.numpy as jnp
+
+        from ...simulation import resolve_dtype
+
+        b, n = key.slots, key.bucket_n
+        k_obs = _key_params(key)["obs"]
+        dtype = resolve_dtype(key.dtype)
+        z3 = jnp.zeros((b, n, 3), dtype)
+        return FitBatch(
+            key=key,
+            pos0=z3, v=z3, masses=jnp.zeros((b, n), dtype),
+            free=jnp.zeros((b, n), dtype),
+            obs_pos=jnp.zeros((b, k_obs, n, 3), dtype),
+            obs_w=jnp.zeros((b, k_obs, n), dtype),
+            obs_step=jnp.full((b, k_obs), -1, jnp.int32),
+            scale=jnp.ones((b,), dtype),
+            lr=jnp.zeros((b,), dtype),
+            m_adam=z3, v_adam=z3,
+            loss=jnp.zeros((b,), dtype),
+            dt=np.zeros((b,), np.float64),
+            remaining=np.zeros((b,), np.int64),
+            iter_done=np.zeros((b,), np.int64),
+            n_real=np.zeros((b,), np.int32),
+        )
+
+    def load_slot(self, engine, batch, slot, state, *, dt, steps, job):
+        import jax.numpy as jnp
+
+        from ...simulation import resolve_dtype
+
+        key = batch.key
+        dtype = resolve_dtype(key.dtype)
+        params = job.params
+        n_real = state.n
+        extra = job.extra_state or {}
+        # Current parameter vector: resume snapshot > explicit guess >
+        # the config's own initial velocities.
+        if "v" in extra:
+            vel = np.asarray(extra["v"])
+        elif params.get("guess_velocities") is not None:
+            vel = np.asarray(params["guess_velocities"])
+        else:
+            vel = np.asarray(state.velocities)
+        st = ParticleState.create(
+            np.asarray(state.positions), vel, np.asarray(state.masses),
+            dtype=dtype,
+        )
+        padded, _ = st.pad_to(key.bucket_n)
+        obs = params["observations"]
+        particles = params["particles"]
+        k_obs = _key_params(key)["obs"]
+        obs_pos = np.zeros((k_obs, key.bucket_n, 3))
+        obs_w = np.zeros((k_obs, key.bucket_n))
+        obs_step = np.full((k_obs,), -1, np.int64)
+        pos_arr = np.asarray(obs["positions"], dtype=np.float64)
+        for k, s in enumerate(obs["steps"]):
+            obs_step[k] = s
+            obs_pos[k, particles] = pos_arr[k]
+            obs_w[k, particles] = 1.0
+        free = np.zeros((key.bucket_n,))
+        free[particles] = 1.0
+        z3 = np.zeros((key.bucket_n, 3))
+        m_a = np.asarray(extra.get("m_adam", z3))
+        v_a = np.asarray(extra.get("v_adam", z3))
+        if m_a.shape[0] < key.bucket_n:
+            m_a = np.pad(m_a, ((0, key.bucket_n - m_a.shape[0]), (0, 0)))
+            v_a = np.pad(v_a, ((0, key.bucket_n - v_a.shape[0]), (0, 0)))
+        dt_h, rem, it0, nr = (batch.dt.copy(), batch.remaining.copy(),
+                              batch.iter_done.copy(), batch.n_real.copy())
+        dt_h[slot], rem[slot], nr[slot] = dt, steps, n_real
+        it0[slot] = int(extra.get("iter_done", job.steps_done))
+        asdt = lambda a: jnp.asarray(a, dtype)  # noqa: E731
+        return dataclasses.replace(
+            batch,
+            pos0=batch.pos0.at[slot].set(padded.positions),
+            v=batch.v.at[slot].set(padded.velocities),
+            masses=batch.masses.at[slot].set(padded.masses),
+            free=batch.free.at[slot].set(asdt(free)),
+            obs_pos=batch.obs_pos.at[slot].set(asdt(obs_pos)),
+            obs_w=batch.obs_w.at[slot].set(asdt(obs_w)),
+            obs_step=batch.obs_step.at[slot].set(
+                jnp.asarray(obs_step, jnp.int32)),
+            scale=batch.scale.at[slot].set(float(params["scale"])),
+            lr=batch.lr.at[slot].set(float(params["lr"])),
+            m_adam=batch.m_adam.at[slot].set(asdt(m_a)),
+            v_adam=batch.v_adam.at[slot].set(asdt(v_a)),
+            loss=batch.loss.at[slot].set(
+                float(extra.get("loss", 0.0))),
+            dt=dt_h, remaining=rem, iter_done=it0, n_real=nr,
+        )
+
+    def clear_slot(self, engine, batch, slot):
+        import jax.numpy as jnp
+
+        rem = batch.remaining.copy()
+        nr = batch.n_real.copy()
+        rem[slot], nr[slot] = 0, 0
+        return dataclasses.replace(
+            batch,
+            masses=batch.masses.at[slot].set(
+                jnp.zeros_like(batch.masses[slot])),
+            free=batch.free.at[slot].set(
+                jnp.zeros_like(batch.free[slot])),
+            remaining=rem, n_real=nr,
+        )
+
+    def slot_snapshot(self, engine, batch, slot):
+        n = int(batch.n_real[slot])
+        state = ParticleState(
+            positions=batch.pos0[slot][:n],
+            velocities=batch.v[slot][:n],
+            masses=batch.masses[slot][:n],
+        )
+        extra = {
+            "v": np.asarray(batch.v[slot][:n]),
+            "m_adam": np.asarray(batch.m_adam[slot][:n]),
+            "v_adam": np.asarray(batch.v_adam[slot][:n]),
+            "loss": float(np.asarray(batch.loss[slot])),
+            "iter_done": int(batch.iter_done[slot]),
+        }
+        return state, extra
+
+    def run_slice(self, engine, batch, slice_steps):
+        import jax.numpy as jnp
+
+        from ..engine import SliceResult, account_slice, budget_i32
+
+        key = batch.key
+        n_iters = self.slice_units(key, slice_steps)
+        fn = engine.round_fn(key)
+        dtype = batch.pos0.dtype
+        v, m_a, v_a, loss, finite = fn(
+            batch.pos0, batch.v, batch.masses, batch.free,
+            batch.obs_pos, batch.obs_w, batch.obs_step, batch.scale,
+            batch.lr, jnp.asarray(batch.dt, dtype), batch.m_adam,
+            batch.v_adam, batch.loss,
+            jnp.asarray(budget_i32(batch.remaining)),
+            jnp.asarray(batch.iter_done.astype(np.int32)),
+            jnp.asarray(batch.n_real, jnp.int32),
+            n_iters=n_iters,
+        )
+        advanced, remaining, finite_np = account_slice(
+            batch.remaining, batch.n_real, n_iters, finite
+        )
+        new_batch = dataclasses.replace(
+            batch, v=v, m_adam=m_a, v_adam=v_a, loss=loss,
+            remaining=remaining,
+            iter_done=batch.iter_done + advanced,
+        )
+        return new_batch, SliceResult(
+            advanced=advanced, finite=finite_np
+        )
+
+    def finalize(self, job, state, extra):
+        arrays = {
+            "positions": np.asarray(state.positions),
+            "velocities": np.asarray(state.velocities),
+            "masses": np.asarray(state.masses),
+            "loss": np.asarray([extra.get("loss", np.nan)]),
+            "iters_done": np.asarray(
+                [extra.get("iter_done", job.steps_done)]
+            ),
+        }
+        payload = {
+            "loss": float(extra.get("loss", np.nan)),
+            "iters_done": int(extra.get("iter_done", job.steps_done)),
+        }
+        return arrays, payload
+
+
+def fit_solo(config, params) -> dict:
+    """The solo reference solver: the SAME per-system program the
+    served family vmaps, run once on this host — the parity oracle
+    (a served fit must recover the same parameters to <=1e-5) and the
+    library entry examples/gradient_orbit_fit.py builds on."""
+    import jax.numpy as jnp
+
+    from ...simulation import make_initial_state, make_local_kernel
+    from ...simulation import resolve_dtype
+
+    fit = FitJob()
+    params = fit.validate(config, params)
+    dtype = resolve_dtype(config.dtype)
+    base = params_state(params) or make_initial_state(config)
+    base = base.astype(dtype)
+    backend = config.force_backend
+    if backend in ("auto", "direct"):
+        backend = "dense"
+    kernel = make_local_kernel(
+        dataclasses.replace(config, force_backend=backend), backend
+    )
+    one = _system_fn(
+        kernel, config.integrator, int(config.steps),
+        params["optimizer"],
+    )
+    n = base.n
+    if params.get("guess_velocities") is not None:
+        vel = np.asarray(params["guess_velocities"])
+    else:
+        vel = np.asarray(base.velocities)
+    obs = params["observations"]
+    particles = params["particles"]
+    k_obs = len(obs["steps"])
+    obs_pos = np.zeros((k_obs, n, 3))
+    obs_w = np.zeros((k_obs, n))
+    obs_step = np.full((k_obs,), -1, np.int64)
+    pos_arr = np.asarray(obs["positions"], dtype=np.float64)
+    for k, s in enumerate(obs["steps"]):
+        obs_step[k] = s
+        obs_pos[k, particles] = pos_arr[k]
+        obs_w[k, particles] = 1.0
+    free = np.zeros((n,))
+    free[particles] = 1.0
+    asdt = lambda a: jnp.asarray(a, dtype)  # noqa: E731
+    iters = int(params["iters"])
+    v, m_a, v_a, loss, fin = one(
+        asdt(base.positions), asdt(vel), asdt(base.masses), asdt(free),
+        asdt(obs_pos), asdt(obs_w), jnp.asarray(obs_step, jnp.int32),
+        jnp.asarray(float(params["scale"]), dtype),
+        jnp.asarray(float(params["lr"]), dtype),
+        jnp.asarray(float(config.dt), dtype),
+        asdt(np.zeros((n, 3))), asdt(np.zeros((n, 3))),
+        jnp.asarray(0.0, dtype),
+        jnp.asarray(iters, jnp.int32), jnp.asarray(0, jnp.int32),
+        jnp.asarray(n, jnp.int32),
+        n_iters=iters,
+    )
+    return {
+        "positions": np.asarray(base.positions),
+        "velocities": np.asarray(v),
+        "masses": np.asarray(base.masses),
+        "loss": float(np.asarray(loss)),
+        "iters_done": iters,
+        "finite": bool(np.asarray(fin)),
+    }
+
+
+register(FitJob())

@@ -1,0 +1,47 @@
+"""The ``integrate`` job class — the original "advance N steps"
+traffic, expressed through the registry interface.
+
+The compiled program family IS the :class:`~gravity_tpu.serve.engine.
+EnsembleEngine`'s native vmapped scan (the engine dispatches
+``job_type == "integrate"`` to its own methods, so this class never
+re-enters the engine's batch lifecycle); what it adds is the
+admission-contract half: an optional inline ``params["state"]``
+(positions/velocities/masses at config.n) that replaces the
+model-derived ICs — the hook watch follow-up jobs use to re-integrate
+a flagged interval at higher resolution from the round-start snapshot,
+since no model/seed can reproduce a mid-run state.
+"""
+
+from __future__ import annotations
+
+from .registry import (
+    JobClass,
+    JobValidationError,
+    params_state,
+    register,
+    validate_params_state,
+)
+
+
+class IntegrateJob(JobClass):
+    name = "integrate"
+    units = "steps"
+
+    def validate(self, config, params):
+        params = dict(params or {})
+        unknown = set(params) - {"state"}
+        if unknown:
+            raise JobValidationError(
+                f"integrate takes no params {sorted(unknown)} "
+                "(only an optional inline 'state')"
+            )
+        validate_params_state(config, params)
+        return params
+
+    def initial_state(self, job):
+        from ...simulation import make_initial_state
+
+        return params_state(job.params) or make_initial_state(job.config)
+
+
+register(IntegrateJob())

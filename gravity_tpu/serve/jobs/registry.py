@@ -1,0 +1,225 @@
+"""Job-class registry: the traffic classes the serving stack speaks.
+
+PR 3-6 built a fleet-grade daemon that serves exactly one thing:
+"integrate N steps". Every other capability the repo already has —
+a fully differentiable scanned rollout, close-encounter detection,
+thousands of idle vmap slots — was unreachable through serve. A
+:class:`JobClass` packages one such capability as a served product:
+
+- its admission contract (``validate`` — typed rejections at submit,
+  mirroring the PR-3 unknown-model contract),
+- its compiled program family (``build_round_fn`` keyed by the
+  extended :class:`~gravity_tpu.serve.engine.BatchKey`, one compile
+  per (job type, bucket) for the engine's lifetime),
+- its batch layout (``new_batch``/``load_slot``/``clear_slot``/
+  ``slot_snapshot`` — whatever per-slot carries the class needs
+  beyond the integrate engine's (pos, vel, mass, acc)),
+- its budget semantics (``budget`` — fit jobs are ITERATION-budgeted,
+  not step-budgeted; the scheduler accounts in the class's units),
+- and its result schema (``finalize`` — arrays for the spool ``.npz``
+  plus a small JSON verdict persisted in the job record).
+
+The scheduler/leases/breaker machinery never special-cases a class:
+jobs of every type flow through the same admission queue, slot
+backfill, divergence isolation, TTL leases, fencing, adoption, requeue
+caps, and circuit-breaker reroutes — that inheritance is the point,
+and the chaos battery asserts it against a ``fit`` workload too.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ...config import SimulationConfig
+from ...state import ParticleState
+
+
+class JobValidationError(ValueError):
+    """A malformed job-type payload, rejected at admission (HTTP 400):
+    unknown type, fit without observations, sweep with zero members,
+    wrong-shaped arrays. Subclasses ValueError so every existing
+    submit-time rejection path (scheduler, daemon, CLI) handles it
+    unchanged."""
+
+
+class JobClass:
+    """One served traffic class. Stateless — all per-job state lives in
+    the scheduler's Job record and the engine's batch objects."""
+
+    #: registry name == the wire-format ``job_type``
+    name: str = "?"
+    #: what ``steps``/``steps_done`` count for this class
+    units: str = "steps"
+    #: surfaced in /status and docs; internal classes (sweep members)
+    #: are not directly submittable over the API
+    submittable: bool = True
+
+    # --- admission ---
+
+    def validate(self, config: SimulationConfig, params: dict) -> dict:
+        """Normalize + validate the class payload; raises
+        :class:`JobValidationError` on malformed input. The returned
+        dict is persisted verbatim in the job record (JSON), so it must
+        round-trip json.dumps."""
+        return dict(params)
+
+    def batch_key(self, config: SimulationConfig, params: dict, *,
+                  slots: int, min_bucket: int, reroute=None):
+        from ..engine import batch_key_for
+
+        return batch_key_for(
+            config, slots=slots, min_bucket=min_bucket, reroute=reroute,
+            job_type=self.name, extra=self.key_extra(config, params),
+        )
+
+    def key_extra(self, config: SimulationConfig, params: dict) -> tuple:
+        """The class's additional static program parameters — part of
+        the compile key (see BatchKey.extra)."""
+        return ()
+
+    def budget(self, job) -> int:
+        """Total work units for this job (steps, iterations, members).
+        ``job.steps_done`` counts against this."""
+        return job.config.steps
+
+    def initial_state(self, job) -> ParticleState:
+        """Deterministic ICs from the job record alone (config +
+        params) — the restart/adoption contract: a respooled job
+        reproduces the same trajectory from unit 0 on any worker."""
+        from ...simulation import make_initial_state
+
+        return params_state(job.params) or make_initial_state(job.config)
+
+    # --- engine-side program family (non-integrate classes) ---
+
+    def build_round_fn(self, engine, key):
+        raise NotImplementedError
+
+    def new_batch(self, engine, key):
+        raise NotImplementedError
+
+    def load_slot(self, engine, batch, slot, state, *, dt, steps, job):
+        raise NotImplementedError
+
+    def clear_slot(self, engine, batch, slot):
+        raise NotImplementedError
+
+    def slot_snapshot(self, engine, batch, slot):
+        raise NotImplementedError
+
+    def run_slice(self, engine, batch, slice_steps):
+        raise NotImplementedError
+
+    # --- scheduler hooks ---
+
+    def slice_units(self, key, slice_steps: int) -> int:
+        """Work units per scheduling round for this key, derived from
+        the scheduler's ``slice_steps`` so every class does a
+        comparable amount of device work per round. Must be a pure
+        function of (key, slice_steps): it is baked into the compiled
+        round program's shape."""
+        return slice_steps
+
+    def pairs_per_unit(self, job) -> float:
+        """Dense-equivalent pair interactions per work unit — the
+        round throughput metric's per-class conversion."""
+        from ...utils.timing import pairs_per_step
+
+        return pairs_per_step(job.config.n)
+
+    def post_round(self, scheduler, key, batch, slot_jobs, res,
+                   start_units: dict, round_start) -> None:
+        """After a round of this key's batch: class-specific event
+        emission / follow-up submission (watch). ``start_units`` maps
+        job id -> steps_done BEFORE the round; ``round_start`` is the
+        class's pre-round host snapshot (None unless the class
+        requested one via ``snapshot_before_round``)."""
+
+    snapshot_before_round: bool = False
+
+    def round_snapshot(self, scheduler, batch, slot_jobs):
+        """Host-side pre-round snapshot for post_round (only called
+        when ``snapshot_before_round``). Implementations should gate
+        on which resident jobs can actually consume it — this runs on
+        the hot round path."""
+        return None
+
+    def finalize(self, job, state: Optional[ParticleState],
+                 extra: dict) -> tuple[dict, Optional[dict]]:
+        """(result arrays for the spool .npz, small JSON verdict for
+        the job record) of a completed job."""
+        import numpy as np
+
+        return (
+            {
+                "positions": np.asarray(state.positions),
+                "velocities": np.asarray(state.velocities),
+                "masses": np.asarray(state.masses),
+            },
+            None,
+        )
+
+
+def params_state(params: dict) -> Optional[ParticleState]:
+    """Inline initial state carried in a job payload (watch follow-ups,
+    the fit example's custom two-body system), already validated by
+    :func:`validate_params_state`. None when absent."""
+    st = (params or {}).get("state")
+    if not st:
+        return None
+    return ParticleState.create(
+        st["positions"], st["velocities"], st["masses"]
+    )
+
+
+def validate_params_state(config: SimulationConfig, params: dict) -> None:
+    """Validate an optional inline ``params["state"]`` against the
+    config's n (typed 400s, not an admission-round crash)."""
+    st = params.get("state")
+    if st is None:
+        return
+    if not isinstance(st, dict) or not all(
+        k in st for k in ("positions", "velocities", "masses")
+    ):
+        raise JobValidationError(
+            "params.state must carry positions/velocities/masses arrays"
+        )
+    import numpy as np
+
+    try:
+        pos = np.asarray(st["positions"], dtype=np.float64)
+        vel = np.asarray(st["velocities"], dtype=np.float64)
+        m = np.asarray(st["masses"], dtype=np.float64)
+    except (TypeError, ValueError) as e:
+        raise JobValidationError(f"params.state is not numeric: {e}") \
+            from e
+    if pos.shape != (config.n, 3) or vel.shape != (config.n, 3) \
+            or m.shape != (config.n,):
+        raise JobValidationError(
+            f"params.state shapes {pos.shape}/{vel.shape}/{m.shape} "
+            f"do not match config.n={config.n}"
+        )
+    params["state"] = {
+        "positions": pos.tolist(), "velocities": vel.tolist(),
+        "masses": m.tolist(),
+    }
+
+
+REGISTRY: dict[str, JobClass] = {}
+
+
+def register(cls: JobClass) -> JobClass:
+    REGISTRY[cls.name] = cls
+    return cls
+
+
+def get_class(name: str) -> JobClass:
+    if name not in REGISTRY:
+        raise JobValidationError(
+            f"unknown job type {name!r}; one of {sorted(REGISTRY)}"
+        )
+    return REGISTRY[name]
+
+
+def job_types() -> list[str]:
+    return sorted(REGISTRY)

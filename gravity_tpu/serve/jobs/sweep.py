@@ -1,0 +1,467 @@
+"""The ``sweep`` job class: ensemble stability surveys, served.
+
+One submission = hundreds-to-thousands of perturbed initial conditions
+("members") of the same base system, fanned into the scheduler as
+ordinary jobs — which is the point: a single sweep finally exercises
+the continuous-batching machinery (priority, deadlines, backfill,
+yields, per-slot divergence isolation, leases, adoption) at real
+occupancy, instead of those paths idling under one-job-at-a-time
+traffic. Member k's ICs are the base model state with a deterministic
+velocity perturbation (``spread`` x RMS speed, seeded by
+``fold_in(sweep seed, k)``), so any worker reproduces any member from
+its spool record alone — the restart/adoption contract unchanged.
+
+Members run a dedicated program family: the integrate scan plus an
+in-program per-step closest-pair accumulator (min separation over the
+WHOLE trajectory — a round-boundary check would miss close passages
+inside a slice). The per-member verdict — energy drift, escape,
+minimum separation — is computed at completion from (recomputed) ICs
+and the final state, identically for a served member and the solo
+reference (:func:`sweep_member_solo`), which is the parity gate.
+
+The parent ``sweep`` job never occupies a slot: it tracks its members
+and aggregates their verdicts into one result (per-member arrays + a
+summary payload) when the last member lands.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from ...state import ParticleState
+from ..engine import (
+    EnsembleBatch,
+    SliceResult,
+    account_slice,
+    budget_i32,
+)
+from .registry import JobClass, JobValidationError, register
+
+MAX_MEMBERS = 4096  # one submission; the queue bound still applies
+
+
+def masked_min_pair(positions, masses):
+    """(d2, i, j) of the closest pair among massive particles — the
+    in-program building block of the sweep/watch diagnostics, riding
+    :func:`gravity_tpu.ops.encounters.closest_pairs` (k=1) so served
+    detection and the standalone diagnostics share one definition.
+    Zero-mass padding (bucket tails, merge donors) is excluded by the
+    op's own mass mask; (inf, -1, -1) when fewer than two massive
+    bodies. Same O(N*chunk) cost class as the direct-sum force step it
+    rides along with."""
+    from ...ops.encounters import closest_pairs
+
+    n = positions.shape[0]
+    d, bi, bj = closest_pairs(
+        positions, masses, k=1, chunk=min(n, 1024)
+    )
+    return d[0] * d[0], bi[0], bj[0]
+
+
+@dataclasses.dataclass
+class SweepBatch:
+    """An EnsembleBatch plus the per-slot minimum-separation carry.
+
+    ``base`` carries the native integrate-keyed batch so the engine's
+    own slot-lifecycle methods (pad, carried-accel seed, zero-mass
+    clear) serve it directly; ``key`` is the sweep-member key the
+    scheduler and compile counters see."""
+
+    key: object
+    base: EnsembleBatch
+    min_d2: object  # (B,) device
+
+
+def _member_system_fn(kernel, integrator):
+    """Per-system member program: one integrate slice that also carries
+    min pair separation. Shared by the vmapped family and the solo
+    reference."""
+    import jax
+    import jax.numpy as jnp
+
+    from ...ops.integrators import make_step_fn
+
+    def one_system(pos, vel, mass, acc, min_d2, dt, remaining, n_real,
+                   *, n_steps):
+        state = ParticleState(pos, vel, mass)
+        accel = lambda p: kernel(p, p, mass)  # noqa: E731
+        step = make_step_fn(integrator, accel, dt)
+
+        def body(carry, i):
+            st, a, md2 = carry
+            new_st, new_a = step(st, a)
+            take = i < remaining
+            st = jax.tree_util.tree_map(
+                lambda old, new: jnp.where(take, new, old), st, new_st
+            )
+            a = jnp.where(take, new_a, a)
+            d2, _, _ = masked_min_pair(st.positions, mass)
+            md2 = jnp.where(take, jnp.minimum(md2, d2), md2)
+            return (st, a, md2), None
+
+        (out, acc_out, min_out), _ = jax.lax.scan(
+            body, (state, acc, min_d2), jnp.arange(n_steps)
+        )
+        real = jnp.arange(pos.shape[0]) < n_real
+        fin = jnp.all(
+            jnp.where(real[:, None], jnp.isfinite(out.positions), True)
+        ) & jnp.all(
+            jnp.where(real[:, None], jnp.isfinite(out.velocities), True)
+        )
+        keep = lambda new, old: jnp.where(fin, new, old)  # noqa: E731
+        return (
+            keep(out.positions, pos), keep(out.velocities, vel),
+            keep(acc_out, acc), keep(min_out, min_d2), fin,
+        )
+
+    return one_system
+
+
+def _validate_common(params: dict) -> dict:
+    """The member-verdict knobs shared by parent and member params."""
+    out = {}
+    try:
+        out["spread"] = float(params.get("spread", 0.01))
+        out["drift_tol"] = float(params.get("drift_tol", 0.05))
+        out["escape_radius"] = float(params.get("escape_radius", 0.0))
+        out["sweep_seed"] = int(params.get("sweep_seed", 0))
+    except (TypeError, ValueError) as e:
+        raise JobValidationError(f"sweep: bad numeric param: {e}") from e
+    if out["spread"] < 0:
+        raise JobValidationError("sweep: spread must be >= 0")
+    if out["drift_tol"] <= 0:
+        raise JobValidationError("sweep: drift_tol must be > 0")
+    if out["escape_radius"] < 0:
+        raise JobValidationError("sweep: escape_radius must be >= 0")
+    return out
+
+
+def member_initial_state(config, params) -> ParticleState:
+    """Member ICs: base model state + deterministic velocity kick of
+    ``spread`` x RMS speed, seeded per member — pure function of
+    (config, params), the respool/adoption contract."""
+    import jax
+    import jax.numpy as jnp
+
+    from ...simulation import make_initial_state
+
+    base = make_initial_state(config)
+    spread = float(params.get("spread", 0.0))
+    if spread <= 0.0:
+        return base
+    key = jax.random.fold_in(
+        jax.random.PRNGKey(int(params.get("sweep_seed", 0))),
+        int(params.get("member", 0)),
+    )
+    v = base.velocities
+    v_rms = jnp.sqrt(
+        jnp.maximum(jnp.mean(jnp.sum(v * v, axis=-1)), 1e-30)
+    )
+    kick = spread * v_rms * jax.random.normal(
+        key, v.shape, dtype=v.dtype
+    )
+    return base.replace(velocities=v + kick)
+
+
+def member_verdict(config, params, ics: ParticleState,
+                   final: ParticleState, min_sep: float) -> dict:
+    """The per-member stability verdict — ONE definition used by the
+    served finalize and the solo reference, so parity is structural."""
+    from ...ops.diagnostics import total_energy
+
+    e0 = float(np.asarray(total_energy(
+        ics, g=config.g, cutoff=config.cutoff, eps=config.eps
+    )))
+    e1 = float(np.asarray(total_energy(
+        final, g=config.g, cutoff=config.cutoff, eps=config.eps
+    )))
+    drift = abs(e1 - e0) / max(abs(e0), 1e-30)
+    m = np.asarray(ics.masses, np.float64)
+    w = m / max(m.sum(), 1e-30)
+    com0 = (w[:, None] * np.asarray(ics.positions, np.float64)).sum(0)
+    r0 = np.linalg.norm(
+        np.asarray(ics.positions, np.float64) - com0, axis=1
+    )
+    esc_r = float(params.get("escape_radius", 0.0)) or 4.0 * float(
+        r0.max() if r0.size else 0.0
+    )
+    r1 = np.linalg.norm(
+        np.asarray(final.positions, np.float64) - com0, axis=1
+    )
+    mass1 = np.asarray(final.masses, np.float64)
+    escaped = bool(((r1 > esc_r) & (mass1 > 0)).any()) if esc_r > 0 \
+        else False
+    return {
+        "member": int(params.get("member", 0)),
+        "min_sep": float(min_sep),
+        "energy_drift": float(drift),
+        "escaped": escaped,
+        "drift_exceeded": bool(drift > float(
+            params.get("drift_tol", 0.05)
+        )),
+    }
+
+
+class SweepMemberJob(JobClass):
+    """One member of a sweep — an internal class (clients submit the
+    parent ``sweep``; members appear in /status with ids
+    ``<parent>.m<k>``)."""
+
+    name = "sweep-member"
+    units = "steps"
+    submittable = False
+
+    def validate(self, config, params):
+        params = dict(params or {})
+        out = _validate_common(params)
+        try:
+            out["member"] = int(params.get("member", 0))
+        except (TypeError, ValueError) as e:
+            raise JobValidationError(f"sweep: bad member: {e}") from e
+        if "parent" in params:
+            out["parent"] = str(params["parent"])
+        return out
+
+    def initial_state(self, job):
+        return member_initial_state(job.config, job.params)
+
+    # --- program family ---
+
+    def build_round_fn(self, engine, key):
+        import jax
+
+        from functools import partial
+
+        kernel = engine._kernel(key)
+        one = _member_system_fn(kernel, key.integrator)
+
+        def round_fn(pos, vel, mass, acc, min_d2, dt, remaining,
+                     n_real, *, n_steps):
+            engine.compile_counts[key] = \
+                engine.compile_counts.get(key, 0) + 1
+            return jax.vmap(partial(one, n_steps=n_steps))(
+                pos, vel, mass, acc, min_d2, dt, remaining, n_real
+            )
+
+        return jax.jit(
+            round_fn, static_argnames=("n_steps",),
+            donate_argnums=(0, 1, 3, 4),
+        )
+
+    @staticmethod
+    def _native_key(key):
+        """The integrate twin of a member key: same bucket/backend/
+        physics, so ``base`` shares the engine's kernel cache with
+        plain integrate batches."""
+        return key._replace(job_type="integrate", extra=())
+
+    def new_batch(self, engine, key):
+        import jax.numpy as jnp
+
+        base = engine.new_batch(self._native_key(key))
+        return SweepBatch(
+            key=key, base=base,
+            min_d2=jnp.full(
+                (key.slots,), jnp.inf, base.positions.dtype
+            ),
+        )
+
+    def load_slot(self, engine, batch, slot, state, *, dt, steps, job):
+        extra = (job.extra_state or {}) if job is not None else {}
+        base = engine.load_slot(
+            batch.base, slot, state, dt=dt, steps=steps,
+        )
+        return dataclasses.replace(
+            batch, base=base,
+            min_d2=batch.min_d2.at[slot].set(
+                float(extra.get("min_d2", np.inf))
+            ),
+        )
+
+    def clear_slot(self, engine, batch, slot):
+        return dataclasses.replace(
+            batch,
+            base=engine.clear_slot(batch.base, slot),
+            min_d2=batch.min_d2.at[slot].set(np.inf),
+        )
+
+    def slot_snapshot(self, engine, batch, slot):
+        n = int(batch.base.n_real[slot])
+        state = ParticleState(
+            positions=batch.base.positions[slot][:n],
+            velocities=batch.base.velocities[slot][:n],
+            masses=batch.base.masses[slot][:n],
+        )
+        return state, {
+            "min_d2": float(np.asarray(batch.min_d2[slot])),
+        }
+
+    def run_slice(self, engine, batch, slice_steps):
+        import jax.numpy as jnp
+
+        b = batch.base
+        fn = engine.round_fn(batch.key)
+        dtype = b.positions.dtype
+        pos, vel, acc, min_d2, finite = fn(
+            b.positions, b.velocities, b.masses, b.acc, batch.min_d2,
+            jnp.asarray(b.dt, dtype),
+            jnp.asarray(budget_i32(b.remaining)),
+            jnp.asarray(b.n_real, jnp.int32),
+            n_steps=slice_steps,
+        )
+        advanced, remaining, finite_np = account_slice(
+            b.remaining, b.n_real, slice_steps, finite
+        )
+        base = dataclasses.replace(
+            b, positions=pos, velocities=vel, acc=acc,
+            remaining=remaining,
+        )
+        return (
+            dataclasses.replace(batch, base=base, min_d2=min_d2),
+            SliceResult(advanced=advanced, finite=finite_np),
+        )
+
+    def finalize(self, job, state, extra):
+        ics = self.initial_state(job)
+        min_sep = float(np.sqrt(max(
+            float(extra.get("min_d2", np.inf)), 0.0
+        ))) if np.isfinite(extra.get("min_d2", np.inf)) else float("inf")
+        verdict = member_verdict(
+            job.config, job.params, ics, state, min_sep
+        )
+        arrays = {
+            "positions": np.asarray(state.positions),
+            "velocities": np.asarray(state.velocities),
+            "masses": np.asarray(state.masses),
+            "min_sep": np.asarray([verdict["min_sep"]]),
+            "energy_drift": np.asarray([verdict["energy_drift"]]),
+            "escaped": np.asarray([int(verdict["escaped"])]),
+        }
+        return arrays, verdict
+
+
+class SweepJob(JobClass):
+    """The parent: validated at submit, expanded into members by the
+    scheduler, aggregated on last-member completion. Never resident."""
+
+    name = "sweep"
+    units = "members"
+    resident = False
+
+    def validate(self, config, params):
+        params = dict(params or {})
+        unknown = set(params) - {
+            "members", "spread", "drift_tol", "escape_radius",
+            "sweep_seed",
+        }
+        if unknown:
+            raise JobValidationError(
+                f"sweep: unknown params {sorted(unknown)}"
+            )
+        try:
+            members = int(params.get("members", 0))
+        except (TypeError, ValueError) as e:
+            raise JobValidationError(f"sweep: bad members: {e}") from e
+        if members < 1:
+            raise JobValidationError(
+                "sweep: members must be >= 1 (a sweep with zero "
+                "members has nothing to survey)"
+            )
+        if members > MAX_MEMBERS:
+            raise JobValidationError(
+                f"sweep: members {members} > cap {MAX_MEMBERS}; "
+                "split the survey across submissions"
+            )
+        out = _validate_common(params)
+        out["members"] = members
+        return out
+
+    def budget(self, job) -> int:
+        return int(job.params["members"])
+
+    def member_params(self, job, k: int) -> dict:
+        return {
+            "member": k,
+            "parent": job.id,
+            "spread": job.params["spread"],
+            "drift_tol": job.params["drift_tol"],
+            "escape_radius": job.params["escape_radius"],
+            "sweep_seed": job.params["sweep_seed"],
+        }
+
+    @staticmethod
+    def member_id(parent_id: str, k: int) -> str:
+        return f"{parent_id}.m{k}"
+
+    @staticmethod
+    def aggregate(job, member_payloads: list) -> tuple[dict, dict]:
+        """(arrays, payload) for the completed parent, from the
+        members' verdict payloads (None for failed/cancelled members)."""
+        m = len(member_payloads)
+        min_sep = np.full((m,), np.nan)
+        drift = np.full((m,), np.nan)
+        escaped = np.zeros((m,), np.int8)
+        exceeded = np.zeros((m,), np.int8)
+        done = np.zeros((m,), np.int8)
+        for k, p in enumerate(member_payloads):
+            if not p:
+                continue
+            done[k] = 1
+            min_sep[k] = p.get("min_sep", np.nan)
+            drift[k] = p.get("energy_drift", np.nan)
+            escaped[k] = int(bool(p.get("escaped")))
+            exceeded[k] = int(bool(p.get("drift_exceeded")))
+        arrays = {
+            "min_sep": min_sep, "energy_drift": drift,
+            "escaped": escaped, "drift_exceeded": exceeded,
+            "completed": done,
+        }
+        payload = {
+            "members": m,
+            "completed": int(done.sum()),
+            "failed": int(m - done.sum()),
+            "escaped": int(escaped.sum()),
+            "drift_exceeded": int(exceeded.sum()),
+        }
+        return arrays, payload
+
+
+def sweep_member_solo(config, params) -> dict:
+    """Solo reference for one member: the SAME program the served
+    family vmaps, run once — the per-member verdict parity oracle."""
+    import jax.numpy as jnp
+
+    from ...simulation import make_local_kernel, resolve_dtype
+
+    member = SweepMemberJob()
+    params = member.validate(config, params)
+    dtype = resolve_dtype(config.dtype)
+    ics = member_initial_state(config, params).astype(dtype)
+    backend = config.force_backend
+    if backend in ("auto", "direct"):
+        backend = "dense"
+    kernel = make_local_kernel(
+        dataclasses.replace(config, force_backend=backend), backend
+    )
+    one = _member_system_fn(kernel, config.integrator)
+    acc0 = kernel(ics.positions, ics.positions, ics.masses)
+    pos, vel, _, min_d2, fin = one(
+        ics.positions, ics.velocities, ics.masses, acc0,
+        jnp.asarray(np.inf, dtype),
+        jnp.asarray(float(config.dt), dtype),
+        jnp.asarray(config.steps, jnp.int32),
+        jnp.asarray(ics.n, jnp.int32),
+        n_steps=config.steps,
+    )
+    final = ParticleState(pos, vel, ics.masses)
+    min_sep = float(np.sqrt(np.asarray(min_d2))) \
+        if np.isfinite(np.asarray(min_d2)) else float("inf")
+    verdict = member_verdict(config, params, ics, final, min_sep)
+    verdict["finite"] = bool(np.asarray(fin))
+    return verdict
+
+
+register(SweepMemberJob())
+register(SweepJob())

@@ -1,0 +1,536 @@
+"""The ``watch`` job class: event-driven runs, served.
+
+A watch job integrates like any other, but every step the program also
+finds the closest massive pair (ops/encounters.py semantics, inlined
+as a scan carry) and raises an ``encounter`` event on the step the
+pair first crosses ``radius`` — a rising-edge detector whose "was
+inside" flag is carried across scheduling rounds, so slice boundaries
+never duplicate or drop a crossing. An optional ``merge_radius``
+raises ``merger`` events the same way at the tighter radius. Events
+stream through the shared ``serving_events.jsonl``
+(:class:`~gravity_tpu.utils.logging.ServingEventLogger` kinds
+``encounter``/``merger``) with the job id, global step, pair indices,
+and distance — the serving-side analog of the run supervisor's
+recovery log.
+
+Event-triggered workflows: with ``params["followup"]`` set, the first
+flagged round auto-submits a high-resolution integrate job over the
+flagged interval — initial state = this job's round-start snapshot
+(carried inline in the follow-up's params), ``dt / refine``,
+``refine x`` the steps, at priority+1 so it preempts queued background
+work. That closes the loop ROADMAP item 5 describes: detection raises
+an event, the event submits the zoom-in, the scheduler's priority
+machinery runs it next.
+
+Solo parity: :func:`watch_solo` drives the same compiled scan in the
+same slice structure, so a served watch emits exactly the events an
+inline solo detection emits — (step, pair, kind) equality is the
+acceptance gate, not a tolerance.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from ...state import ParticleState
+from ..engine import (
+    EnsembleBatch,
+    SliceResult,
+    account_slice,
+    budget_i32,
+)
+from .registry import (
+    JobClass,
+    JobValidationError,
+    register,
+    validate_params_state,
+)
+from .sweep import masked_min_pair
+
+MAX_EVENTS_CAP = 64
+
+
+@dataclasses.dataclass
+class WatchBatch:
+    """EnsembleBatch + per-slot detector carries and the last round's
+    event buffers (host) for post_round emission."""
+
+    key: object
+    base: EnsembleBatch
+    radius: object     # (B,) device — encounter radius per slot
+    mradius: object    # (B,) device — merger radius (0 = disabled)
+    in_enc: object     # (B,) device bool — closest pair inside radius
+    in_mrg: object     # (B,) device bool
+    last_events: object = None  # host tuple of np arrays after a slice
+
+
+def _watch_system_fn(kernel, integrator, max_events: int):
+    """Per-system watch program: integrate slice + rising-edge closest-
+    pair event detector with a bounded per-slice event buffer. Shared
+    by the vmapped family and the solo reference."""
+    import jax
+    import jax.numpy as jnp
+
+    from ...ops.integrators import make_step_fn
+
+    def one_system(pos, vel, mass, acc, dt, remaining, n_real,
+                   radius, mradius, in_enc, in_mrg, *, n_steps):
+        state = ParticleState(pos, vel, mass)
+        accel = lambda p: kernel(p, p, mass)  # noqa: E731
+        step = make_step_fn(integrator, accel, dt)
+        e0 = (
+            jnp.full((max_events,), -1, jnp.int32),  # step (in slice)
+            jnp.full((max_events,), -1, jnp.int32),  # i
+            jnp.full((max_events,), -1, jnp.int32),  # j
+            jnp.zeros((max_events,), pos.dtype),     # distance
+            jnp.zeros((max_events,), jnp.int32),     # kind 0=enc 1=mrg
+        )
+
+        def record(bufs, count, fire, i_step, bi, bj, d, kind):
+            ev_s, ev_i, ev_j, ev_d, ev_k = bufs
+            idx = jnp.minimum(count, max_events - 1)
+            can = fire & (count < max_events)
+            put = lambda buf, val: jnp.where(  # noqa: E731
+                can, buf.at[idx].set(val), buf
+            )
+            return (
+                put(ev_s, i_step), put(ev_i, bi), put(ev_j, bj),
+                put(ev_d, d),
+                put(ev_k, jnp.asarray(kind, jnp.int32)),
+            ), count + can.astype(jnp.int32)
+
+        def body(carry, i):
+            st, a, pe, pm, bufs, count = carry
+            new_st, new_a = step(st, a)
+            take = i < remaining
+            st = jax.tree_util.tree_map(
+                lambda old, new: jnp.where(take, new, old), st, new_st
+            )
+            a = jnp.where(take, new_a, a)
+            d2, bi, bj = masked_min_pair(st.positions, mass)
+            d = jnp.sqrt(jnp.where(jnp.isfinite(d2), d2, 0.0))
+            has = bi >= 0
+            enc_in = has & (d2 < radius * radius)
+            fire_e = take & enc_in & jnp.logical_not(pe)
+            bufs, count = record(
+                bufs, count, fire_e, i + 1, bi, bj, d, 0
+            )
+            pe = jnp.where(take, enc_in, pe)
+            mrg_in = has & (mradius > 0) & (d2 < mradius * mradius)
+            fire_m = take & mrg_in & jnp.logical_not(pm)
+            bufs, count = record(
+                bufs, count, fire_m, i + 1, bi, bj, d, 1
+            )
+            pm = jnp.where(take, mrg_in, pm)
+            return (st, a, pe, pm, bufs, count), None
+
+        init = (state, acc, in_enc, in_mrg, e0,
+                jnp.asarray(0, jnp.int32))
+        (out, acc_out, pe, pm, bufs, count), _ = jax.lax.scan(
+            body, init, jnp.arange(n_steps)
+        )
+        real = jnp.arange(pos.shape[0]) < n_real
+        fin = jnp.all(
+            jnp.where(real[:, None], jnp.isfinite(out.positions), True)
+        ) & jnp.all(
+            jnp.where(real[:, None], jnp.isfinite(out.velocities), True)
+        )
+        keep = lambda new, old: jnp.where(fin, new, old)  # noqa: E731
+        return (
+            keep(out.positions, pos), keep(out.velocities, vel),
+            keep(acc_out, acc), keep(pe, in_enc), keep(pm, in_mrg),
+            fin, bufs, count,
+        )
+
+    return one_system
+
+
+class WatchJob(JobClass):
+    name = "watch"
+    units = "steps"
+    snapshot_before_round = True
+
+    def validate(self, config, params):
+        params = dict(params or {})
+        unknown = set(params) - {
+            "radius", "merge_radius", "max_events", "followup", "state",
+        }
+        if unknown:
+            raise JobValidationError(
+                f"watch: unknown params {sorted(unknown)}"
+            )
+        if "radius" not in params:
+            raise JobValidationError(
+                "watch requires params.radius (the encounter distance "
+                "to watch for)"
+            )
+        validate_params_state(config, params)
+        try:
+            radius = float(params["radius"])
+            mradius = float(params.get("merge_radius", 0.0))
+            max_events = int(params.get("max_events", 16))
+        except (TypeError, ValueError) as e:
+            raise JobValidationError(f"watch: bad param: {e}") from e
+        if radius <= 0:
+            raise JobValidationError("watch: radius must be > 0")
+        if mradius < 0:
+            raise JobValidationError(
+                "watch: merge_radius must be >= 0 (0 disables)"
+            )
+        if not 1 <= max_events <= MAX_EVENTS_CAP:
+            raise JobValidationError(
+                f"watch: max_events must be in [1, {MAX_EVENTS_CAP}]"
+            )
+        followup = params.get("followup")
+        if followup is not None:
+            if not isinstance(followup, dict):
+                raise JobValidationError(
+                    "watch: followup must be an object"
+                )
+            try:
+                refine = int(followup.get("refine", 4))
+                fmax = int(followup.get("max", 1))
+            except (TypeError, ValueError) as e:
+                raise JobValidationError(
+                    f"watch: bad followup: {e}"
+                ) from e
+            if refine < 2:
+                raise JobValidationError(
+                    "watch: followup.refine must be >= 2"
+                )
+            if fmax < 1:
+                raise JobValidationError(
+                    "watch: followup.max must be >= 1"
+                )
+            params["followup"] = {"refine": refine, "max": fmax}
+        params["radius"] = radius
+        params["merge_radius"] = mradius
+        params["max_events"] = max_events
+        return params
+
+    def key_extra(self, config, params) -> tuple:
+        return (("events", int(params["max_events"])),)
+
+    # --- program family ---
+
+    @staticmethod
+    def _native_key(key):
+        return key._replace(job_type="integrate", extra=())
+
+    def build_round_fn(self, engine, key):
+        import jax
+
+        from functools import partial
+
+        max_events = dict(key.extra)["events"]
+        kernel = engine._kernel(self._native_key(key))
+        one = _watch_system_fn(kernel, key.integrator, max_events)
+
+        def round_fn(pos, vel, mass, acc, dt, remaining, n_real,
+                     radius, mradius, in_enc, in_mrg, *, n_steps):
+            engine.compile_counts[key] = \
+                engine.compile_counts.get(key, 0) + 1
+            return jax.vmap(partial(one, n_steps=n_steps))(
+                pos, vel, mass, acc, dt, remaining, n_real,
+                radius, mradius, in_enc, in_mrg,
+            )
+
+        return jax.jit(
+            round_fn, static_argnames=("n_steps",),
+            donate_argnums=(0, 1, 3),
+        )
+
+    def new_batch(self, engine, key):
+        import jax.numpy as jnp
+
+        base = engine.new_batch(self._native_key(key))
+        b = key.slots
+        dtype = base.positions.dtype
+        return WatchBatch(
+            key=key, base=base,
+            radius=jnp.zeros((b,), dtype),
+            mradius=jnp.zeros((b,), dtype),
+            in_enc=jnp.zeros((b,), bool),
+            in_mrg=jnp.zeros((b,), bool),
+        )
+
+    def load_slot(self, engine, batch, slot, state, *, dt, steps, job):
+        extra = (job.extra_state or {}) if job is not None else {}
+        params = job.params if job is not None else {}
+        base = engine.load_slot(
+            batch.base, slot, state, dt=dt, steps=steps,
+        )
+        return dataclasses.replace(
+            batch, base=base,
+            radius=batch.radius.at[slot].set(
+                float(params.get("radius", 0.0))),
+            mradius=batch.mradius.at[slot].set(
+                float(params.get("merge_radius", 0.0))),
+            in_enc=batch.in_enc.at[slot].set(
+                bool(extra.get("in_enc", False))),
+            in_mrg=batch.in_mrg.at[slot].set(
+                bool(extra.get("in_mrg", False))),
+        )
+
+    def clear_slot(self, engine, batch, slot):
+        return dataclasses.replace(
+            batch,
+            base=engine.clear_slot(batch.base, slot),
+            radius=batch.radius.at[slot].set(0.0),
+            mradius=batch.mradius.at[slot].set(0.0),
+            in_enc=batch.in_enc.at[slot].set(False),
+            in_mrg=batch.in_mrg.at[slot].set(False),
+        )
+
+    def slot_snapshot(self, engine, batch, slot):
+        state = engine.slot_state(batch.base, slot)
+        return state, {
+            "in_enc": bool(np.asarray(batch.in_enc[slot])),
+            "in_mrg": bool(np.asarray(batch.in_mrg[slot])),
+        }
+
+    def round_snapshot(self, scheduler, batch, slot_jobs):
+        """Round-start states (host) of slots whose job can still
+        submit a follow-up — the zoom-in's ICs must be the state the
+        flagged interval STARTED from, and run_slice donates the
+        pre-round buffers. Jobs without a followup config (or with
+        their budget spent) cost no D2H here: this runs every round."""
+        out = {}
+        for slot, job_id in enumerate(slot_jobs):
+            if job_id is None:
+                continue
+            job = scheduler.jobs.get(job_id)
+            if job is None:
+                continue
+            followup = job.params.get("followup")
+            if not followup or int(
+                (job.extra_state or {}).get("followups_done", 0)
+            ) >= int(followup["max"]):
+                continue
+            st = scheduler.engine.slot_state(batch.base, slot)
+            out[slot] = ParticleState(
+                positions=np.asarray(st.positions),
+                velocities=np.asarray(st.velocities),
+                masses=np.asarray(st.masses),
+            )
+        return out
+
+    def run_slice(self, engine, batch, slice_steps):
+        import jax.numpy as jnp
+
+        b = batch.base
+        fn = engine.round_fn(batch.key)
+        dtype = b.positions.dtype
+        (pos, vel, acc, in_enc, in_mrg, finite,
+         bufs, count) = fn(
+            b.positions, b.velocities, b.masses, b.acc,
+            jnp.asarray(b.dt, dtype),
+            jnp.asarray(budget_i32(b.remaining)),
+            jnp.asarray(b.n_real, jnp.int32),
+            batch.radius, batch.mradius, batch.in_enc, batch.in_mrg,
+            n_steps=slice_steps,
+        )
+        advanced, remaining, finite_np = account_slice(
+            b.remaining, b.n_real, slice_steps, finite
+        )
+        base = dataclasses.replace(
+            b, positions=pos, velocities=vel, acc=acc,
+            remaining=remaining,
+        )
+        events = tuple(np.asarray(x) for x in bufs) + (
+            np.asarray(count),
+        )
+        return (
+            dataclasses.replace(
+                batch, base=base, in_enc=in_enc, in_mrg=in_mrg,
+                last_events=events,
+            ),
+            SliceResult(advanced=advanced, finite=finite_np),
+        )
+
+    # --- scheduler hooks ---
+
+    def post_round(self, scheduler, key, batch, slot_jobs, res,
+                   start_units, round_start) -> None:
+        """Emit this round's events into the serving stream and submit
+        the configured follow-up for newly flagged jobs."""
+        if batch.last_events is None:
+            return
+        ev_s, ev_i, ev_j, ev_d, ev_k, counts = batch.last_events
+        for slot, job_id in enumerate(slot_jobs):
+            if job_id is None or not bool(res.finite[slot]):
+                continue
+            job = scheduler.jobs.get(job_id)
+            if job is None:
+                continue
+            n_ev = int(counts[slot])
+            if n_ev == 0:
+                continue
+            base_step = start_units.get(job_id, job.steps_done)
+            extra = job.extra_state = dict(job.extra_state or {})
+            log = extra.setdefault("events", [])
+            for e in range(n_ev):
+                kind = "merger" if int(ev_k[slot, e]) else "encounter"
+                step = base_step + int(ev_s[slot, e])
+                record = {
+                    "step": step,
+                    "i": int(ev_i[slot, e]),
+                    "j": int(ev_j[slot, e]),
+                    "distance": float(ev_d[slot, e]),
+                    "kind": kind,
+                }
+                log.append(record)
+                scheduler._event(
+                    kind, job=job_id, step=step, i=record["i"],
+                    j=record["j"], distance=record["distance"],
+                )
+            self._maybe_followup(
+                scheduler, job, base_step, int(res.advanced[slot]),
+                None if round_start is None else round_start.get(slot),
+            )
+
+    def _maybe_followup(self, scheduler, job, base_step, advanced,
+                        start_state) -> None:
+        followup = job.params.get("followup")
+        if not followup or start_state is None or advanced < 1:
+            return
+        extra = job.extra_state = dict(job.extra_state or {})
+        done = int(extra.get("followups_done", 0))
+        if done >= int(followup["max"]):
+            return
+        refine = int(followup["refine"])
+        config = dataclasses.replace(
+            job.config,
+            dt=job.config.dt / refine,
+            steps=advanced * refine,
+        )
+        child_id = f"{job.id}.f{done}"
+        from ..scheduler import QueueFull
+
+        try:
+            scheduler.submit(
+                config,
+                job_type="integrate",
+                params={
+                    "state": {
+                        "positions": np.asarray(
+                            start_state.positions).tolist(),
+                        "velocities": np.asarray(
+                            start_state.velocities).tolist(),
+                        "masses": np.asarray(
+                            start_state.masses).tolist(),
+                    },
+                },
+                priority=job.priority + 1,
+                job_id=child_id,
+            )
+        except (ValueError, QueueFull):
+            # Shed/duplicate/envelope rejection: the event stream
+            # already carries the encounter; the zoom-in is
+            # best-effort. QueueFull is a RuntimeError, NOT a
+            # ValueError — uncaught it would escape post_round mid-
+            # run_round, after run_slice already advanced (and
+            # donated) the batch but before the accounting loop
+            # credited any job, wedging the bucket's budgets forever.
+            return
+        extra["followups_done"] = done + 1
+        scheduler._event(
+            "followup_submitted", job=job.id, followup=child_id,
+            from_step=base_step, steps=config.steps,
+            dt=config.dt, refine=refine,
+        )
+
+    def finalize(self, job, state, extra):
+        events = (extra or {}).get("events") \
+            or (job.extra_state or {}).get("events") or []
+        arrays = {
+            "positions": np.asarray(state.positions),
+            "velocities": np.asarray(state.velocities),
+            "masses": np.asarray(state.masses),
+            "event_step": np.asarray(
+                [e["step"] for e in events], np.int64),
+            "event_i": np.asarray([e["i"] for e in events], np.int64),
+            "event_j": np.asarray([e["j"] for e in events], np.int64),
+            "event_distance": np.asarray(
+                [e["distance"] for e in events]),
+            "event_kind": np.asarray(
+                [int(e["kind"] == "merger") for e in events], np.int64),
+        }
+        payload = {
+            "events": len(events),
+            "encounters": sum(
+                1 for e in events if e["kind"] == "encounter"),
+            "mergers": sum(
+                1 for e in events if e["kind"] == "merger"),
+            "followups": int(
+                (job.extra_state or {}).get("followups_done", 0)),
+        }
+        return arrays, payload
+
+
+def watch_solo(config, params, slice_steps=None) -> list:
+    """Solo reference: the SAME watch scan, driven in the same slice
+    structure a daemon with ``slice_steps`` would use (None = one
+    slice). Returns the event list [(step, i, j, kind, distance)] an
+    inline-detection run emits — served watch jobs must match it
+    exactly (step and pair equality, not a tolerance)."""
+    import jax.numpy as jnp
+
+    from ...simulation import (
+        make_initial_state,
+        make_local_kernel,
+        resolve_dtype,
+    )
+    from .registry import params_state
+
+    watch = WatchJob()
+    params = watch.validate(config, params)
+    dtype = resolve_dtype(config.dtype)
+    ics = (params_state(params) or make_initial_state(config)).astype(
+        dtype
+    )
+    backend = config.force_backend
+    if backend in ("auto", "direct"):
+        backend = "dense"
+    kernel = make_local_kernel(
+        dataclasses.replace(config, force_backend=backend), backend
+    )
+    one = _watch_system_fn(
+        kernel, config.integrator, int(params["max_events"])
+    )
+    slice_steps = slice_steps or config.steps
+    pos = jnp.asarray(ics.positions)
+    vel = jnp.asarray(ics.velocities)
+    mass = jnp.asarray(ics.masses)
+    acc = kernel(pos, pos, mass)
+    in_enc = jnp.asarray(False)
+    in_mrg = jnp.asarray(False)
+    events = []
+    done = 0
+    while done < config.steps:
+        n_steps = min(slice_steps, config.steps - done)
+        (pos, vel, acc, in_enc, in_mrg, fin, bufs, count) = one(
+            pos, vel, mass, acc,
+            jnp.asarray(float(config.dt), dtype),
+            jnp.asarray(n_steps, jnp.int32),
+            jnp.asarray(ics.n, jnp.int32),
+            jnp.asarray(params["radius"], dtype),
+            jnp.asarray(params["merge_radius"], dtype),
+            in_enc, in_mrg,
+            n_steps=n_steps,
+        )
+        ev_s, ev_i, ev_j, ev_d, ev_k = (np.asarray(x) for x in bufs)
+        for e in range(int(np.asarray(count))):
+            events.append({
+                "step": done + int(ev_s[e]),
+                "i": int(ev_i[e]), "j": int(ev_j[e]),
+                "distance": float(ev_d[e]),
+                "kind": "merger" if int(ev_k[e]) else "encounter",
+            })
+        done += n_steps
+    return events
+
+
+register(WatchJob())

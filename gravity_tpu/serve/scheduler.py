@@ -63,7 +63,6 @@ from ..utils.faults import (
 )
 from ..utils.hostio import atomic_write_json
 from ..utils.logging import ServingEventLogger
-from ..utils.timing import pairs_per_step
 from .breaker import BreakerBoard
 from .engine import BatchKey, EnsembleBatch, EnsembleEngine, batch_key_for
 from .leases import LeaseManager, read_json_retry
@@ -101,6 +100,19 @@ class Job:
     status: str = "pending"
     steps_done: int = 0
     error: Optional[str] = None
+    # Traffic class (serve/jobs registry) + its validated payload.
+    # ``steps_done`` counts the CLASS's units (steps for integrate/
+    # sweep members/watch, optimizer iterations for fit, completed
+    # members for a sweep parent).
+    job_type: str = "integrate"
+    params: dict = dataclasses.field(default_factory=dict)
+    # Sweep parent linkage (members carry the parent id; the parent
+    # aggregates member verdicts when the last one lands).
+    parent: Optional[str] = None
+    # Small JSON verdict persisted in the record (fit loss, sweep
+    # member verdict, watch event counts) — the typed result half that
+    # survives without the .npz.
+    result_payload: Optional[dict] = None
     submitted_ts: float = 0.0
     started_ts: Optional[float] = None
     finished_ts: Optional[float] = None
@@ -112,6 +124,11 @@ class Job:
     # Evict/resume snapshot (unpadded). None = not yet started -> the
     # deterministic ICs from the config.
     state: Optional[ParticleState] = None
+    # Class-specific evict/resume extras (fit optimizer moments, sweep
+    # min-separation, watch detector flags + event log) and the full
+    # result arrays held in memory until the spool write lands.
+    extra_state: Optional[dict] = None
+    result_data: Optional[dict] = None
     resident_rounds: int = 0
     # Fleet-mode ownership (persisted): the fencing token of our lease
     # over this job (0 = never claimed) and how many times the job has
@@ -128,14 +145,23 @@ class Job:
 
     @property
     def steps(self) -> int:
-        return self.config.steps
+        """This job's total work budget in its class's units."""
+        from .jobs import get_class
+
+        return get_class(self.job_type).budget(self)
 
     def to_dict(self) -> dict:
+        from .jobs import get_class
+
         return {
             "id": self.id,
             "status": self.status,
             "n": self.config.n,
-            "steps": self.config.steps,
+            "job_type": self.job_type,
+            "units": get_class(self.job_type).units,
+            "parent": self.parent,
+            "result": self.result_payload,
+            "steps": self.steps,
             "steps_done": self.steps_done,
             "priority": self.priority,
             "deadline_s": self.deadline_s,
@@ -216,6 +242,7 @@ class Spool:
         on-disk record as the truth)."""
         record = job.to_dict()
         record["config"] = json.loads(job.config.to_json())
+        record["params"] = job.params
         path = self.job_path(job.id)
         if self.leases is None:
             atomic_write_json(path, record)
@@ -232,25 +259,31 @@ class Spool:
         return os.path.join(self.results_dir, f"{job_id}.npz")
 
     def write_result(
-        self, job_id: str, state: ParticleState,
+        self, job_id: str, result,
         fence: Optional[int] = None,
     ) -> Optional[str]:
-        """Write the final-state ``.npz``; returns its path, or None
-        when fencing rejected the write. The array serialization runs
-        OUTSIDE the lease lock (it is the heavy part); only the
-        validate + ``os.replace`` are in the critical section."""
+        """Write the result ``.npz`` — a ParticleState or a plain
+        {name: array} dict (the job-class result schema: fit jobs add
+        loss/iterations, sweeps their per-member verdict arrays);
+        returns its path, or None when fencing rejected the write. The
+        array serialization runs OUTSIDE the lease lock (it is the
+        heavy part); only the validate + ``os.replace`` are in the
+        critical section."""
         path = self.result_path(job_id)
         if drop_result_due():
             # Injected lost write: report success like a writer that
             # died right after the syscall returned — the adoption
             # scan's completed-without-result handling must recover.
             return path
+        if isinstance(result, ParticleState):
+            result = {
+                "positions": result.positions,
+                "velocities": result.velocities,
+                "masses": result.masses,
+            }
         tmp = f"{path}.tmp.{os.getpid()}.npz"
         np.savez(
-            tmp,
-            positions=np.asarray(state.positions),
-            velocities=np.asarray(state.velocities),
-            masses=np.asarray(state.masses),
+            tmp, **{k: np.asarray(v) for k, v in result.items()}
         )
         if self.leases is None or fence is None:
             os.replace(tmp, path)
@@ -363,6 +396,14 @@ class EnsembleScheduler:
         from collections import deque
 
         self._completed_latencies: deque = deque(maxlen=512)
+        # Per-class latency windows + terminal counters (/metrics
+        # "classes": queue/active are recomputed per call; these are
+        # the cumulative halves).
+        self._class_latencies: dict = {}
+        self._class_terminal: dict = {}
+        # Sweep parents: tracked jobs that never occupy a slot; their
+        # members complete them (``_check_parents``).
+        self._parents: set = set()
         self.rounds_run = 0
         if spool is not None:
             self._respool()
@@ -376,17 +417,39 @@ class EnsembleScheduler:
         priority: int = 0,
         deadline_s: Optional[float] = None,
         job_id: Optional[str] = None,
+        job_type: str = "integrate",
+        params: Optional[dict] = None,
+        _internal: bool = False,
     ) -> str:
-        """Validate + enqueue; returns the job id. Raises ValueError for
-        configs the ensemble engine cannot serve and :class:`QueueFull`
-        when the bounded queue is shedding.
+        """Validate + enqueue; returns the job id. Raises ValueError
+        (:class:`~gravity_tpu.serve.jobs.JobValidationError` for
+        malformed class payloads — unknown type, fit without
+        observations, sweep with zero members) for jobs the stack
+        cannot serve and :class:`QueueFull` when the bounded queue is
+        shedding.
+
+        ``job_type`` selects the traffic class (serve/jobs registry);
+        ``params`` is the class payload, validated HERE so a bad job is
+        a clean submit-time 400, never an admission-round crash — the
+        PR-3 unknown-model contract extended to every class. A sweep
+        expands into its members in this call (each member an ordinary
+        leased, respoolable job).
 
         An explicit ``job_id`` is an idempotency key: re-submitting the
-        SAME config under a known id returns that id instead of raising
+        SAME job under a known id returns that id instead of raising
         — the client retry path (lost response after the daemon already
         accepted, or a failover re-POST to a surviving worker) must not
         enqueue the simulation twice. A known id with a DIFFERENT
-        config is still a hard duplicate error."""
+        config/type/payload is still a hard duplicate error."""
+        from .jobs import JobValidationError, get_class
+
+        cls = get_class(job_type)
+        if not getattr(cls, "submittable", True) and not _internal:
+            raise JobValidationError(
+                f"job type {job_type!r} is internal (submit its "
+                "parent class instead)"
+            )
+        params = cls.validate(config, params or {})
         if job_id is not None:
             # The id becomes a file name under jobs/ leases/ results/
             # cancel/ — and arrives over an open HTTP API. Reject
@@ -400,10 +463,17 @@ class EnsembleScheduler:
                     f"invalid job id {job_id!r}: 1-128 chars from "
                     "[A-Za-z0-9._-], not starting with '.'"
                 )
+        fingerprint = (
+            config.to_json(), job_type,
+            json.dumps(params, sort_keys=True),
+        )
         if job_id is not None:
             existing = self.jobs.get(job_id)
             if existing is not None:
-                if existing.config.to_json() == config.to_json():
+                if (
+                    existing.config.to_json(), existing.job_type,
+                    json.dumps(existing.params, sort_keys=True),
+                ) == fingerprint:
                     return job_id
                 raise ValueError(f"duplicate job id {job_id!r}")
             if self.spool is not None:
@@ -417,17 +487,31 @@ class EnsembleScheduler:
                 # we adopt it) instead of minting a duplicate run.
                 record = self.spool.read_job(job_id)
                 if record is not None:
-                    if json.dumps(
-                        record.get("config"), sort_keys=True
-                    ) != json.dumps(
-                        json.loads(config.to_json()), sort_keys=True
+                    rec_fp = (
+                        json.dumps(record.get("config"),
+                                   sort_keys=True),
+                        record.get("job_type", "integrate"),
+                        json.dumps(record.get("params") or {},
+                                   sort_keys=True),
+                    )
+                    if rec_fp != (
+                        json.dumps(json.loads(config.to_json()),
+                                   sort_keys=True),
+                        job_type,
+                        json.dumps(params, sort_keys=True),
                     ):
                         raise ValueError(
                             f"duplicate job id {job_id!r}"
                         )
                     self._absorb_spool_record(job_id, record, None)
                     return job_id
-        if self.max_queue and self.queue_depth >= self.max_queue:
+        resident = getattr(cls, "resident", True)
+        # A sweep admits its whole member fan-out in one call: shed it
+        # as a unit (members queue entries), not after half the
+        # members are in.
+        admits = 1 if resident else int(params.get("members", 1))
+        if self.max_queue and \
+                self.queue_depth + admits > self.max_queue:
             # Load shed with a retry hint sized to how fast rounds are
             # actually draining the queue here, not a magic constant.
             retry_after = max(1.0, round(
@@ -437,10 +521,28 @@ class EnsembleScheduler:
             self._event("shed", n=config.n, queue_depth=self.queue_depth,
                         retry_after_s=retry_after)
             raise QueueFull(retry_after, self.queue_depth)
-        key = batch_key_for(
-            config, slots=self.slots, min_bucket=self.min_bucket,
-            reroute=self.breakers.reroute,
-        )
+        key = None
+        if resident:
+            key = cls.batch_key(
+                config, params, slots=self.slots,
+                min_bucket=self.min_bucket,
+                reroute=self.breakers.reroute,
+            )
+        else:
+            # Parent classes never enter a batch, but their members
+            # must be servable — key one member now so the whole fan-
+            # out is a submit-time rejection, not N admission failures.
+            from .jobs import get_class as _gc
+
+            _gc("sweep-member").batch_key(
+                config, {"member": 0, **{
+                    k: v for k, v in params.items()
+                    if k in ("spread", "drift_tol", "escape_radius",
+                             "sweep_seed")
+                }},
+                slots=self.slots, min_bucket=self.min_bucket,
+                reroute=self.breakers.reroute,
+            )
         if deadline_s is not None:
             # Coerce at the boundary: the HTTP API is open, and a
             # string deadline would TypeError inside _expire_deadlines
@@ -454,6 +556,8 @@ class EnsembleScheduler:
             id=job_id, config=config, priority=priority,
             deadline_s=deadline_s, seq=self._seq,
             submitted_ts=time.time(),
+            job_type=job_type, params=params,
+            parent=params.get("parent") if _internal else None,
         )
         if self.leases is not None:
             lease = self.leases.claim(
@@ -468,11 +572,109 @@ class EnsembleScheduler:
                 )
             job.fence = lease.fence
         self.jobs[job_id] = job
-        self._enqueue(key, job_id)
-        self._event("submitted", job=job_id, n=config.n,
-                    bucket=key.bucket_n, priority=priority)
+        if resident:
+            self._enqueue(key, job_id)
+            self._event("submitted", job=job_id, n=config.n,
+                        bucket=key.bucket_n, priority=priority,
+                        job_type=job_type)
+        else:
+            self._parents.add(job_id)
+            self._event("submitted", job=job_id, n=config.n,
+                        priority=priority, job_type=job_type,
+                        members=admits)
         self._persist(job)
+        if not resident:
+            # Fan the members out through the normal submit path so
+            # every one is an ordinary leased, respoolable, adoptable
+            # job (deterministic ids: a retried/adopted expansion
+            # reuses the same member records instead of forking).
+            for k in range(admits):
+                self.submit(
+                    config,
+                    priority=priority,
+                    deadline_s=deadline_s,
+                    job_id=cls.member_id(job_id, k),
+                    job_type="sweep-member",
+                    params=cls.member_params(job, k),
+                    _internal=True,
+                )
         return job_id
+
+    def _check_parents(self) -> None:
+        """Complete sweep parents whose members are all terminal:
+        aggregate the member verdicts (local jobs first, the shared
+        spool's records for peer-run members) into the parent's result.
+        Early-outs on the first nonterminal member, so the steady-state
+        cost is one status read per live sweep."""
+        from .jobs import get_class
+
+        for pid in list(self._parents):
+            job = self.jobs.get(pid)
+            if job is None or job.status in TERMINAL or not job.owned:
+                continue
+            cls = get_class(job.job_type)
+            members = int(job.params.get("members", 0))
+            payloads: list = [None] * members
+            done = 0
+            complete = True
+            for k in range(members):
+                mid = cls.member_id(pid, k)
+                member = self.jobs.get(mid)
+                status = payload = None
+                if member is not None:
+                    status, payload = member.status, \
+                        member.result_payload
+                if (member is None or not member.owned) \
+                        and status not in TERMINAL \
+                        and self.spool is not None:
+                    rec = self.spool.read_job(mid)
+                    if rec is not None:
+                        status = rec.get("status")
+                        payload = rec.get("result")
+                if status is None:
+                    # Neither a local job nor a spool record: the
+                    # fan-out was interrupted (a worker died between
+                    # persisting the parent and submitting this
+                    # member). Member ids and params are deterministic
+                    # — the parent's owner re-expands the hole, so an
+                    # adopted half-expanded sweep completes instead of
+                    # hanging pending forever.
+                    complete = False
+                    try:
+                        self.submit(
+                            job.config,
+                            priority=job.priority,
+                            deadline_s=job.deadline_s,
+                            job_id=mid,
+                            job_type="sweep-member",
+                            params=cls.member_params(job, k),
+                            _internal=True,
+                        )
+                    except (ValueError, QueueFull):
+                        pass  # shed / leased by a peer: next scan
+                    continue
+                if status not in TERMINAL:
+                    complete = False
+                    continue  # keep counting: progress must not
+                    # understate behind one running member
+                if status == "completed":
+                    done += 1
+                    payloads[k] = payload
+            job.steps_done = done
+            if not complete:
+                continue
+            arrays, payload = cls.aggregate(job, payloads)
+            job.result_payload = payload
+            job.result_data = arrays
+            if self.spool is not None:
+                self._spool_result_async(job, arrays)
+            if done > 0:
+                self._finish(job, "completed")
+            else:
+                self._finish(
+                    job, "failed",
+                    error=f"all {members} members failed/cancelled",
+                )
 
     def cancel(self, job_id: str) -> bool:
         job = self.jobs.get(job_id)
@@ -491,6 +693,19 @@ class EnsembleScheduler:
             return False
         if job.status in TERMINAL:
             return False
+        if job_id in self._parents:
+            # Cancelling a sweep cancels its members (local ones
+            # directly; peer-owned ones via the spool marker path).
+            from .jobs import get_class
+
+            cls = get_class(job.job_type)
+            for k in range(int(job.params.get("members", 0))):
+                mid = cls.member_id(job_id, k)
+                member = self.jobs.get(mid)
+                if member is None or member.status not in TERMINAL:
+                    self.cancel(mid)
+            self._finish(job, "cancelled")
+            return True
         if job.status == "running":
             key = self._assigned_key(job)
             slots = self._slot_jobs.get(key, [])
@@ -512,7 +727,10 @@ class EnsembleScheduler:
             self._sync_from_record(job)
         return job.to_dict()
 
-    def result(self, job_id: str) -> Optional[ParticleState]:
+    def result_data(self, job_id: str) -> Optional[dict]:
+        """A completed job's result arrays — the class's full schema
+        (integrate: positions/velocities/masses; fit adds the fitted
+        parameters + loss; sweeps their per-member verdict arrays)."""
         job = self.jobs.get(job_id)
         if job is None:
             return None
@@ -520,20 +738,35 @@ class EnsembleScheduler:
             self._sync_from_record(job)
         if job.status != "completed":
             return None
-        # Single read: the background spool writer sets job.state = None
-        # (without a lock) once the .npz is durably down — reading the
-        # attribute twice races it into returning None for a job whose
-        # result exists both in memory and on disk.
+        # Single read: the background spool writer sets
+        # job.result_data = None (without a lock) once the .npz is
+        # durably down — reading the attribute twice races it into
+        # returning None for a job whose result exists both in memory
+        # and on disk.
+        data = job.result_data
+        if data is not None:
+            return data
         state = job.state
         if state is not None:
-            return state
+            return {
+                "positions": np.asarray(state.positions),
+                "velocities": np.asarray(state.velocities),
+                "masses": np.asarray(state.masses),
+            }
         if self.spool is not None:
-            data = self.spool.load_result(job_id)
-            if data is not None:
-                return ParticleState.create(
-                    data["positions"], data["velocities"], data["masses"]
-                )
+            return self.spool.load_result(job_id)
         return None
+
+    def result(self, job_id: str) -> Optional[ParticleState]:
+        """ParticleState view of :meth:`result_data` (the classic
+        integrate client surface; classes without a state result —
+        sweep parents — return None here)."""
+        data = self.result_data(job_id)
+        if data is None or "positions" not in data:
+            return None
+        return ParticleState.create(
+            data["positions"], data["velocities"], data["masses"]
+        )
 
     def peek_state(self, job_id: str) -> Optional[ParticleState]:
         """Current (unpadded) state of a job wherever it lives: its
@@ -564,16 +797,65 @@ class EnsembleScheduler:
         )
 
     def has_work(self) -> bool:
-        return self.queue_depth > 0 or self.active_count > 0
+        if self.queue_depth > 0 or self.active_count > 0:
+            return True
+        # A sweep parent whose members are still landing is work: the
+        # aggregation check must keep running until it goes terminal.
+        for pid in self._parents:
+            job = self.jobs.get(pid)
+            if job is not None and job.owned \
+                    and job.status not in TERMINAL:
+                return True
+        return False
 
-    def latency_percentiles(self) -> dict:
-        lat = list(self._completed_latencies)
+    def latency_percentiles(self, job_type: Optional[str] = None
+                            ) -> dict:
+        lat = list(
+            self._completed_latencies if job_type is None
+            else self._class_latencies.get(job_type, ())
+        )
         if not lat:
-            return {"p50_s": None, "p95_s": None}
+            return {"p50_s": None, "p95_s": None, "p99_s": None}
         return {
             "p50_s": float(np.percentile(lat, 50)),
             "p95_s": float(np.percentile(lat, 95)),
+            "p99_s": float(np.percentile(lat, 99)),
         }
+
+    def class_metrics(self) -> dict:
+        """Per-traffic-class serving health: queue depth, occupancy,
+        terminal counters, completed-latency percentiles — the
+        /metrics "classes" block."""
+        queue: dict = {}
+        for key, pending in self._pending.items():
+            queue[key.job_type] = queue.get(key.job_type, 0) \
+                + len(pending)
+        for pid in self._parents:
+            job = self.jobs.get(pid)
+            if job is not None and job.owned \
+                    and job.status not in TERMINAL:
+                queue[job.job_type] = queue.get(job.job_type, 0) + 1
+        active: dict = {}
+        for key, slots in self._slot_jobs.items():
+            n_act = sum(1 for j in slots if j is not None)
+            if n_act:
+                active[key.job_type] = \
+                    active.get(key.job_type, 0) + n_act
+        out = {}
+        for jt in (
+            set(queue) | set(active) | set(self._class_terminal)
+            | set(self._class_latencies)
+        ):
+            terminal = self._class_terminal.get(jt, {})
+            out[jt] = {
+                "queue_depth": queue.get(jt, 0),
+                "active": active.get(jt, 0),
+                "completed": terminal.get("completed", 0),
+                "failed": terminal.get("failed", 0),
+                "cancelled": terminal.get("cancelled", 0),
+                "latency": self.latency_percentiles(jt),
+            }
+        return out
 
     # --- internals ---
 
@@ -605,15 +887,18 @@ class EnsembleScheduler:
             job.fence = rec.get("fence", job.fence)
             job.requeues = rec.get("requeues", job.requeues)
             job.finished_ts = rec.get("finished_ts", job.finished_ts)
+            job.result_payload = rec.get("result", job.result_payload)
         job.owned = False
         job.state = None
+        job.extra_state = None
+        job.result_data = None
         if self.leases is not None:
             self.leases.forget(job.id)
 
     def _sync_from_record(self, job: Job) -> None:
         self._apply_record(job, self.spool.read_job(job.id))
 
-    def _spool_result_async(self, job: Job, state: ParticleState) -> None:
+    def _spool_result_async(self, job: Job, result) -> None:
         # The closure captures ONLY what it needs (spool / events /
         # leases / the job) — never `self`: a queued result write must
         # not keep a dropped scheduler alive past its __del__-time
@@ -632,7 +917,7 @@ class EnsembleScheduler:
             # serves it for this process's lifetime; only a restart
             # loses it (and then respools the job).
             try:
-                path = spool.write_result(job.id, state, fence=fence)
+                path = spool.write_result(job.id, result, fence=fence)
             except Exception as e:  # noqa: BLE001
                 try:
                     if events is not None:
@@ -659,6 +944,7 @@ class EnsembleScheduler:
             # completed-without-result record would otherwise re-run
             # the job out from under our in-flight write).
             job.state = None
+            job.result_data = None
             if leases is not None:
                 leases.release(job.id)
 
@@ -721,9 +1007,11 @@ class EnsembleScheduler:
             self.leases.start_heartbeat()
 
     def _job_key(self, job: Job) -> BatchKey:
-        return batch_key_for(
-            job.config, slots=self.slots, min_bucket=self.min_bucket,
-            reroute=self.breakers.reroute,
+        from .jobs import get_class
+
+        return get_class(job.job_type).batch_key(
+            job.config, job.params, slots=self.slots,
+            min_bucket=self.min_bucket, reroute=self.breakers.reroute,
         )
 
     def _assigned_key(self, job: Job) -> BatchKey:
@@ -763,10 +1051,18 @@ class EnsembleScheduler:
             # from the zombie (exactly one completed/failed per job in
             # the shared stream; _persist already logged `fenced`).
             return
+        from collections import deque
+
+        counts = self._class_terminal.setdefault(
+            job.job_type, {"completed": 0, "failed": 0, "cancelled": 0}
+        )
+        counts[status] = counts.get(status, 0) + 1
         if status == "completed":
-            self._completed_latencies.append(
-                job.finished_ts - job.submitted_ts
-            )
+            latency = job.finished_ts - job.submitted_ts
+            self._completed_latencies.append(latency)
+            self._class_latencies.setdefault(
+                job.job_type, deque(maxlen=512)
+            ).append(latency)
         self._event(
             status if status in ServingEventLogger.KINDS else "failed",
             job=job.id, steps_done=job.steps_done, error=error,
@@ -781,12 +1077,12 @@ class EnsembleScheduler:
             self.leases.release(job.id)
 
     def _admit(self, key: BatchKey, slot: int, job: Job) -> bool:
-        from ..simulation import make_initial_state
+        from .jobs import get_class
 
         try:
             state = job.state
             if state is None:
-                state = make_initial_state(job.config)
+                state = get_class(job.job_type).initial_state(job)
         except Exception as e:  # noqa: BLE001 — a bad config must fail
             # THIS job, not crash the scheduling round for its peers
             # (submit-time validation covers the known cases; this is
@@ -798,6 +1094,7 @@ class EnsembleScheduler:
             self._batches[key] = self.engine.load_slot(
                 batch, slot, state,
                 dt=job.config.dt, steps=job.steps - job.steps_done,
+                job=job,
             )
         except BackendUnavailable as e:
             # The slot load builds the key's kernel (carried-accel
@@ -859,7 +1156,14 @@ class EnsembleScheduler:
         re-queue it (continuous-batching time slicing / preemption)."""
         job_id = self._slot_jobs[key][slot]
         job = self.jobs[job_id]
-        job.state = self.engine.slot_state(self._batches[key], slot)
+        state, extra = self.engine.slot_snapshot(
+            self._batches[key], slot
+        )
+        job.state = state
+        # MERGE: job-level extras (the watch event log, follow-up
+        # counters) must survive an evict; the snapshot only refreshes
+        # the slot-carried keys.
+        job.extra_state = {**(job.extra_state or {}), **extra}
         self._free_slot(key, slot)
         job.status = "pending"
         self._enqueue(key, job_id)
@@ -974,6 +1278,11 @@ class EnsembleScheduler:
                 self.leases.suspend(stale)
                 self.leases.backdate()
         self.housekeeping()
+        # Parent aggregation runs even when no batch has work: the
+        # last member may have landed in a previous round (or on a
+        # peer), and the parent must complete without further batch
+        # traffic.
+        self._check_parents()
         key = self._next_key()
         if key is None:
             return None
@@ -990,6 +1299,19 @@ class EnsembleScheduler:
         occ_particles = sum(
             self.jobs[slots[s]].config.n for s in occupied
         )
+        from .jobs import get_class
+
+        cls = get_class(key.job_type)
+        # Pre-round host snapshot for classes that need the round-START
+        # state after run_slice donated it (watch follow-ups), plus the
+        # round-start unit counts post_round anchors event steps to.
+        round_start = (
+            cls.round_snapshot(self, batch, list(slots))
+            if cls.snapshot_before_round else None
+        )
+        start_units = {
+            slots[s]: self.jobs[slots[s]].steps_done for s in occupied
+        }
         t0 = time.perf_counter()
         try:
             batch, res = self.engine.run_slice(batch, self.slice_steps)
@@ -1022,6 +1344,8 @@ class EnsembleScheduler:
                 job.status = "pending"
                 job.steps_done = 0
                 job.state = None
+                job.extra_state = None
+                job.result_data = None
                 # Same "restart clean" reset as the respool scan: the
                 # dead attempt's compute time and timestamps would
                 # otherwise double-count in /status once the job
@@ -1063,6 +1387,12 @@ class EnsembleScheduler:
         if self.breakers.success(key.backend):
             self._event("breaker_closed", backend=key.backend)
 
+        # Class hook BEFORE accounting: event emission / follow-up
+        # submission sees round-start unit counts, and a job completing
+        # this very round still emits its final-round events.
+        cls.post_round(
+            self, key, batch, list(slots), res, start_units, round_start
+        )
         real_pairs = 0.0
         for slot in occupied:
             job = self.jobs[slots[slot]]
@@ -1070,7 +1400,7 @@ class EnsembleScheduler:
             job.steps_done += advanced
             job.resident_rounds += 1
             job.active_s += round_s
-            real_pairs += pairs_per_step(job.config.n) * advanced
+            real_pairs += cls.pairs_per_unit(job) * advanced
             if not bool(res.finite[slot]):
                 # Per-slot watchdog: the engine already rolled the lane
                 # back to its round-start state IN-program (run_slice
@@ -1083,26 +1413,45 @@ class EnsembleScheduler:
                 self._free_slot(key, slot)
                 self._finish(
                     job, "failed",
-                    error=f"diverged within steps "
+                    error=f"diverged within {cls.units} "
                           f"{job.steps_done + 1}..{job.steps_done + advanced} "
-                          f"(non-finite state; last finite step "
-                          f"{job.steps_done})",
+                          f"(non-finite state; last finite "
+                          f"{cls.units[:-1]} {job.steps_done})",
                 )
             elif job.steps_done >= job.steps:
-                job.state = self.engine.slot_state(batch, slot)
+                state, extra = self.engine.slot_snapshot(batch, slot)
+                job.extra_state = {**(job.extra_state or {}), **extra}
+                try:
+                    arrays, payload = cls.finalize(
+                        job, state, job.extra_state
+                    )
+                except Exception as e:  # noqa: BLE001 — a verdict that
+                    # cannot be computed fails THIS job, not the round.
+                    job.state = state
+                    self._free_slot(key, slot)
+                    self._finish(
+                        job, "failed", error=f"finalize failed: {e}"
+                    )
+                    continue
+                job.result_payload = payload
+                job.state = state
+                job.result_data = arrays
                 if self.spool is not None:
                     # Result fetch + .npz write on the background
                     # writer: the D2H of the final state overlaps the
-                    # next round's compute. job.state keeps serving
-                    # result() from memory until the bytes are down,
-                    # then ownership passes to the spool (keeping every
-                    # finished state in-memory is an unbounded leak in
-                    # a long-lived daemon — review finding).
-                    self._spool_result_async(job, job.state)
+                    # next round's compute. job.result_data keeps
+                    # serving result() from memory until the bytes are
+                    # down, then ownership passes to the spool (keeping
+                    # every finished state in-memory is an unbounded
+                    # leak in a long-lived daemon — review finding).
+                    self._spool_result_async(job, arrays)
                 self._free_slot(key, slot)
                 self._finish(job, "completed")
+        self._check_parents()
 
         metrics = {
+            "job_type": key.job_type,
+            "units": cls.units,
             "bucket": key.bucket_n,
             "slots_used": len(occupied),
             "slots_total": key.slots,
@@ -1243,12 +1592,23 @@ class EnsembleScheduler:
         self._sync_from_record(job)
 
     def _job_from_record(self, record: dict) -> Optional[Job]:
+        from .jobs import JobValidationError, get_class
+
         try:
             config = SimulationConfig.from_json(
                 json.dumps(record["config"])
             )
         except (KeyError, TypeError, ValueError):
             return None
+        job_type = record.get("job_type", "integrate")
+        try:
+            get_class(job_type)
+        except JobValidationError:
+            # A class this worker's build does not speak: leave the
+            # record for a peer that does (same contract as an
+            # unparseable config).
+            return None
+        params = record.get("params")
         self._seq += 1
         return Job(
             id=record["id"], config=config,
@@ -1263,6 +1623,10 @@ class EnsembleScheduler:
             finished_ts=record.get("finished_ts"),
             fence=int(record.get("fence", 0) or 0),
             requeues=int(record.get("requeues", 0) or 0),
+            job_type=job_type,
+            params=params if isinstance(params, dict) else {},
+            parent=record.get("parent"),
+            result_payload=record.get("result"),
         )
 
     def _register_unowned(self, record: dict, known: Optional[Job]
@@ -1380,6 +1744,8 @@ class EnsembleScheduler:
             if self.leases is not None:
                 self.leases.release(job_id)
             return
+        from .jobs import get_class
+
         self.jobs[job_id] = job
         job.owned = True
         if lease is not None:
@@ -1389,7 +1755,7 @@ class EnsembleScheduler:
             # Idempotent adoption: the result already landed (the
             # writer died between the .npz and the record write, or
             # the record write was fenced) — finalize, don't re-run.
-            job.steps_done = job.config.steps
+            job.steps_done = job.steps
             job.state = None
             self._event("adopted", job=job_id,
                         from_worker=adopted_from, fence=job.fence,
@@ -1397,6 +1763,20 @@ class EnsembleScheduler:
             self._finish(job, "completed")
             if self.leases is not None:
                 self.leases.release(job_id)
+            return
+        if not getattr(get_class(job.job_type), "resident", True):
+            # A sweep parent: nothing to enqueue — its members are
+            # their own records (absorbed independently); tracking +
+            # the aggregation check complete it once they land.
+            self._parents.add(job_id)
+            job.status = "pending"
+            job.state = None
+            if adopted_from and adopted_from != self.worker_id:
+                self._event("adopted", job=job_id,
+                            from_worker=adopted_from, fence=job.fence)
+            else:
+                self._event("respooled", job=job_id)
+            self._persist(job)
             return
         # Interrupted mid-flight, never started, or completed with
         # its result lost: restart clean.
@@ -1407,6 +1787,8 @@ class EnsembleScheduler:
         job.status = "pending"
         job.steps_done = 0
         job.state = None
+        job.extra_state = None
+        job.result_data = None
         job.started_ts = None
         job.finished_ts = None
         job.error = None

@@ -18,6 +18,8 @@ path        method  body / query
 ==========  ======  ================================================
 /healthz    GET     liveness + queue counters
 /submit     POST    {"config": {...SimulationConfig...},
+                    "job_type": "integrate|fit|sweep|watch",
+                    "params": {...class payload...},
                     "priority": int, "deadline_s": float|null}
 /status     GET     ?job=<id> (omit for every job)
 /result     GET     ?job=<id> -> final state arrays + spool path
@@ -324,19 +326,12 @@ class GravityDaemon:
                         "error": f"job {job_id!r} is {st['status']}",
                         **st,
                     }
-                state = self.scheduler.result(job_id)
-                if state is None:
+                data = self.scheduler.result_data(job_id)
+                if data is None:
                     # Spool fallback: any replica can serve any durable
                     # result, including a dead peer's — the reaper may
                     # not have registered the job locally yet.
                     data = self.spool.load_result(job_id)
-                    if data is not None:
-                        from ..state import ParticleState
-
-                        state = ParticleState.create(
-                            data["positions"], data["velocities"],
-                            data["masses"],
-                        )
                 payload = dict(st)
                 # The .npz rides the background writer, so "completed"
                 # no longer implies bytes on disk: advertise the path
@@ -346,14 +341,26 @@ class GravityDaemon:
                 result_path = self.spool.result_path(job_id)
                 if os.path.exists(result_path):
                     payload["path"] = result_path
-                if state is not None:
-                    payload["positions"] = np.asarray(
-                        state.positions
-                    ).tolist()
-                    payload["velocities"] = np.asarray(
-                        state.velocities
-                    ).tolist()
-                    payload["masses"] = np.asarray(state.masses).tolist()
+                if data is not None:
+                    # The class's full result schema, arrays as lists:
+                    # integrate/watch ship the final state, fit adds
+                    # the fitted parameters + loss, sweeps their
+                    # per-member verdict arrays. Non-finite entries
+                    # (a failed member's NaN verdict, an inf min_sep
+                    # from a single-body member) become null: bare
+                    # NaN/Infinity tokens are json.dumps-legal but
+                    # rejected by strict parsers (jq, JS JSON.parse),
+                    # and this API is open to non-Python clients. The
+                    # spool .npz keeps the exact values.
+                    for k, v in data.items():
+                        arr = np.asarray(v)
+                        if np.issubdtype(arr.dtype, np.floating) \
+                                and not np.isfinite(arr).all():
+                            obj = arr.astype(object)
+                            obj[~np.isfinite(arr)] = None
+                            payload[k] = obj.tolist()
+                        else:
+                            payload[k] = arr.tolist()
                 return 200, payload
             if path == "/metrics":
                 sched = self.scheduler
@@ -363,9 +370,13 @@ class GravityDaemon:
                     "active": sched.active_count,
                     "rounds": sched.rounds_run,
                     "latency": sched.latency_percentiles(),
+                    # Per-traffic-class health: queue depth, occupancy,
+                    # terminal counts, p50/p99 latency (docs/serving.md
+                    # "Job classes").
+                    "classes": sched.class_metrics(),
                     "compile_counts": {
-                        f"bucket={k.bucket_n},slots={k.slots},"
-                        f"backend={k.backend}": v
+                        f"job={k.job_type},bucket={k.bucket_n},"
+                        f"slots={k.slots},backend={k.backend}": v
                         for k, v in
                         sched.engine.compile_counts.items()
                     },
@@ -400,6 +411,9 @@ class GravityDaemon:
                 )
             except TypeError as e:
                 return 400, {"error": f"bad config: {e}"}
+            params = body.get("params")
+            if params is not None and not isinstance(params, dict):
+                return 400, {"error": "params must be an object"}
             with self.lock:
                 try:
                     job_id = self.scheduler.submit(
@@ -407,6 +421,10 @@ class GravityDaemon:
                         priority=int(body.get("priority") or 0),
                         deadline_s=body.get("deadline_s"),
                         job_id=body.get("job_id"),
+                        job_type=str(
+                            body.get("job_type") or "integrate"
+                        ),
+                        params=params,
                     )
                 except QueueFull as e:
                     # Bounded-queue load shed: 503 + Retry-After (set

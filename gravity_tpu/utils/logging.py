@@ -163,6 +163,12 @@ class ServingEventLogger(JsonlEventLogger):
     lease takeover of a dead worker's job, a zombie's rejected late
     write, circuit-breaker transitions, admission load shedding, and
     the requeue-cap terminal state.
+
+    ``encounter``/``merger``/``followup_submitted`` are the watch job
+    class's event-driven kinds (docs/serving.md "Job classes"): an
+    in-program detector crossing its radius raises them with the job,
+    global step, pair, and distance; the follow-up kind records the
+    auto-submitted high-resolution zoom-in job.
     """
 
     KINDS = (
@@ -170,4 +176,5 @@ class ServingEventLogger(JsonlEventLogger):
         "failed", "cancelled", "respooled", "spool_error",
         "adopted", "fenced", "breaker_open", "breaker_closed",
         "shed", "poisoned",
+        "encounter", "merger", "followup_submitted",
     )

@@ -2,16 +2,18 @@
 # The documented pre-push check (`make smoke`): the fast contract lane,
 # a 2-job ensemble serving e2e through the real CLI daemon, the async
 # host-pipeline e2e (cadence run + SIGTERM + resume), the autotune
-# cache round-trip (probe-on-miss, instant-on-hit), and the serving
+# cache round-trip (probe-on-miss, instant-on-hit), the serving
 # chaos harness (2 workers, injected kill -9 mid-round, all jobs
-# complete with solo parity — scripts/chaos.sh), all on CPU.
-# Exits nonzero on any failure. ~10 min on a laptop-class CPU.
+# complete with solo parity — scripts/chaos.sh), and the job-class
+# e2e (one fit + one sweep through the live daemon with solo parity),
+# all on CPU. Exits nonzero on any failure. ~10 min on a laptop-class
+# CPU.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 export JAX_PLATFORMS=cpu
 
-echo "== smoke 1/5: pytest -m 'fast and not slow and not heavy' (contract + oracle-parity lane) =="
+echo "== smoke 1/6: pytest -m 'fast and not slow and not heavy' (contract + oracle-parity lane) =="
 # "fast and not slow and not heavy": module-level fast marks would
 # otherwise pull a file's slow-marked wall-clock tests into the lane
 # (pytest -m fast selects anything CARRYING the mark; it does not
@@ -20,7 +22,7 @@ echo "== smoke 1/5: pytest -m 'fast and not slow and not heavy' (contract + orac
 # item 5).
 python -m pytest tests/ -q -m "fast and not slow and not heavy" -p no:cacheprovider
 
-echo "== smoke 2/5: 2-job ensemble serving e2e (CLI daemon) =="
+echo "== smoke 2/6: 2-job ensemble serving e2e (CLI daemon) =="
 SPOOL="$(mktemp -d /tmp/gravity_smoke.XXXXXX)"
 cleanup() {
     # Best-effort daemon shutdown + spool removal.
@@ -73,7 +75,7 @@ print("ensemble e2e OK:", {j: s["status"] for j, s in statuses.items()},
       "| compiles:", metrics["compile_counts"])
 EOF
 
-echo "== smoke 3/5: async host pipeline e2e (cadence run + SIGTERM + resume) =="
+echo "== smoke 3/6: async host pipeline e2e (cadence run + SIGTERM + resume) =="
 IODIR="$(mktemp -d /tmp/gravity_smoke_io.XXXXXX)"
 trap 'cleanup; rm -rf "$IODIR"' EXIT
 # Cadence-on pipelined run; preempt@500 delivers a real SIGTERM to the
@@ -109,7 +111,7 @@ print("io-pipeline e2e OK: resumed", stats["steps"], "steps,",
       "host_gap_frac", round(stats["host_gap_frac"], 3))
 EOF
 
-echo "== smoke 4/5: autotune cache round-trip (probe-on-miss, instant-on-hit) =="
+echo "== smoke 4/6: autotune cache round-trip (probe-on-miss, instant-on-hit) =="
 TUNEDIR="$(mktemp -d /tmp/gravity_smoke_tune.XXXXXX)"
 trap 'cleanup; rm -rf "$IODIR" "$TUNEDIR"' EXIT
 # Fresh cache dir + lowered fast-probe floor so plain `auto` runs a
@@ -146,7 +148,117 @@ print("autotune round-trip OK: backend", s1["backend"],
       "| probe", round(s1["autotune_probe_ms"], 1), "ms -> hit 0 ms")
 EOF
 
-echo "== smoke 5/5: serving chaos harness (kill -9 + adoption + fencing) =="
+echo "== smoke 5/6: serving chaos harness (kill -9 + adoption + fencing) =="
 bash scripts/chaos.sh
+
+echo "== smoke 6/6: job classes through the CLI daemon (fit + sweep) =="
+# One fit + one sweep submitted through the REAL daemon from stage 2
+# (still serving), asserting completion + served-vs-solo parity
+# (docs/serving.md "Job classes").
+python - "$SPOOL" <<'EOF'
+import json, sys
+import numpy as np
+from gravity_tpu.config import SimulationConfig
+from gravity_tpu.serve.jobs.fit import fit_solo
+
+spool = sys.argv[1]
+cfg = SimulationConfig(model="random", n=6, steps=20, dt=3600.0,
+                       integrator="leapfrog", force_backend="dense",
+                       seed=3)
+# True-trajectory observations from a solo rollout; perturbed guess.
+import dataclasses
+from gravity_tpu.ops.integrators import make_step_fn
+from gravity_tpu.simulation import make_initial_state, make_local_kernel
+st = make_initial_state(cfg)
+kernel = make_local_kernel(
+    dataclasses.replace(cfg, force_backend="dense"), "dense")
+step = make_step_fn(
+    cfg.integrator, lambda p: kernel(p, p, st.masses), cfg.dt)
+s, a = st, kernel(st.positions, st.positions, st.masses)
+for _ in range(cfg.steps):
+    s, a = step(s, a)
+params = {
+    "observations": {"steps": [cfg.steps],
+                     "positions": [np.asarray(s.positions).tolist()]},
+    "iters": 10, "lr": 1.0, "optimizer": "adam",
+    "scale": float(np.abs(np.asarray(s.positions)).max()),
+    "guess_velocities": (np.asarray(st.velocities) * 0.97).tolist(),
+}
+json.dump({"config": json.loads(cfg.to_json()), "params": params},
+          open(f"{spool}/fitjob.json", "w"))
+json.dump({"solo_velocities":
+           np.asarray(fit_solo(cfg, dict(params))["velocities"])
+           .tolist()},
+          open(f"{spool}/fitsolo.json", "w"))
+EOF
+
+FIT_PARAMS=$(python -c \
+    'import json,sys; print(json.dumps(json.load(open(sys.argv[1]))["params"]))' \
+    "$SPOOL/fitjob.json")
+FIT_JOB=$(python -m gravity_tpu submit --spool-dir "$SPOOL" \
+    --model random --n 6 --steps 20 --dt 3600 --seed 3 \
+    --integrator leapfrog --force-backend dense \
+    --job-type fit --params "$FIT_PARAMS" | python -c \
+    'import json,sys; print(json.load(sys.stdin)["job"])')
+SWEEP_JOB=$(python -m gravity_tpu submit --spool-dir "$SPOOL" \
+    --model random --n 8 --steps 30 --dt 3600 --seed 7 \
+    --integrator leapfrog --force-backend dense \
+    --job-type sweep --params '{"members": 4, "spread": 0.03}' \
+    | python -c 'import json,sys; print(json.load(sys.stdin)["job"])')
+
+python - "$SPOOL" "$FIT_JOB" "$SWEEP_JOB" <<'EOF'
+import json, sys
+import numpy as np
+from gravity_tpu.config import SimulationConfig
+from gravity_tpu.serve import request, wait_for
+from gravity_tpu.serve.jobs.sweep import sweep_member_solo
+
+spool, fit_id, sweep_id = sys.argv[1:4]
+statuses = wait_for(spool, [fit_id, sweep_id], timeout=300)
+for jid, st in statuses.items():
+    assert st["status"] == "completed", (jid, st)
+
+# Fit parity vs the pre-computed solo reference.
+solo_v = np.asarray(json.load(open(f"{spool}/fitsolo.json"))
+                    ["solo_velocities"])
+resp = request(spool, "GET", f"/result?job={fit_id}")
+got = np.asarray(resp["velocities"])
+rel = np.max(np.abs(got - solo_v) / np.maximum(np.abs(solo_v), 1e-30))
+assert rel <= 1e-5, rel
+
+# Sweep verdicts vs solo members of the same seeds.
+cfg = SimulationConfig(model="random", n=8, steps=30, dt=3600.0,
+                       integrator="leapfrog", force_backend="dense",
+                       seed=7)
+resp = request(spool, "GET", f"/result?job={sweep_id}")
+assert resp["completed"] == [1, 1, 1, 1], resp
+for k in range(4):
+    solo = sweep_member_solo(
+        cfg, {"members": 4, "spread": 0.03, "member": k})
+    got_min = float(resp["min_sep"][k])
+    assert abs(got_min - solo["min_sep"]) <= 1e-5 * solo["min_sep"], k
+
+# Per-class metrics visible.
+metrics = request(spool, "GET", "/metrics")
+classes = metrics["classes"]
+assert classes["fit"]["completed"] >= 1, classes
+assert classes["sweep"]["completed"] >= 1, classes
+assert classes["sweep-member"]["completed"] >= 4, classes
+# Compile-once per (job type, bucket): every key — integrate, fit,
+# sweep-member — traced exactly once for the daemon's lifetime.
+assert all(v == 1 for v in metrics["compile_counts"].values()), metrics
+assert any(k.startswith("job=fit") for k in metrics["compile_counts"])
+print("job classes e2e OK: fit rel", float(rel),
+      "| classes:", {k: v["completed"] for k, v in classes.items()})
+EOF
+
+# The result VERB on a class-schema payload (saves verdict arrays).
+python -m gravity_tpu result --spool-dir "$SPOOL" "$SWEEP_JOB" \
+    --out "$SPOOL/sweep_verdicts.npz" >/dev/null
+python -c "
+import numpy as np, sys
+z = np.load(sys.argv[1])
+assert 'min_sep' in z.files and len(z['min_sep']) == 4, z.files
+" "$SPOOL/sweep_verdicts.npz"
 
 echo "== smoke: all green =="

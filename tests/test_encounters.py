@@ -356,3 +356,79 @@ def test_merge_check_cadence_honors_merge_every(monkeypatch, x64):
     sim = Simulator(config, state=ParticleState(pos, vel, masses))
     sim.run()
     assert calls["n"] == 5
+
+
+# --- vmap coverage: the watch job class batches detection over slots ---
+
+
+def test_closest_pairs_vmapped_over_slots(key, x64):
+    """closest_pairs under vmap — each lane detects ITS system's pairs
+    (the serving engine's batched-slot layout; lanes must not mix)."""
+    b, n = 4, 64
+    pos = jax.random.uniform(
+        key, (b, n, 3), jnp.float64, minval=-1.0, maxval=1.0
+    )
+    masses = jnp.ones((b, n), jnp.float64)
+    # Lane 2 has a deliberately colliding pair; lane 0 a zero-mass
+    # tracer pair that must be ignored.
+    pos = pos.at[2, 10].set(pos[2, 11] + 1e-7)
+    pos = pos.at[0, 5].set(pos[0, 6] + 1e-9)
+    masses = masses.at[0, 5].set(0.0)
+    batched = jax.vmap(
+        lambda p, m: closest_pairs(p, m, k=4, chunk=16)
+    )
+    d, i_, j_ = batched(pos, masses)
+    assert d.shape == (b, 4)
+    for lane in range(b):
+        want = _brute_pairs(
+            np.asarray(pos[lane]), np.asarray(masses[lane])
+        )[:4]
+        np.testing.assert_allclose(
+            np.asarray(d[lane]), [w[0] for w in want], rtol=1e-12
+        )
+        assert (int(i_[lane, 0]), int(j_[lane, 0])) == \
+            (want[0][1], want[0][2])
+    # The injected near-coincident pair surfaces only in its own lane.
+    assert {int(i_[2, 0]), int(j_[2, 0])} == {10, 11}
+    assert {int(i_[0, 0]), int(j_[0, 0])} != {5, 6}
+
+
+def test_grid_nearest_vmapped_over_slots(key, x64):
+    """nearest_within_radius_grid under vmap (the grid path builds a
+    per-lane cell structure; padded/zero-mass lanes stay inert)."""
+    b, n = 3, 128
+    radius = 0.3
+    pos = jax.random.uniform(
+        key, (b, n, 3), jnp.float64, minval=0.0, maxval=4.0
+    )
+    masses = jnp.ones((b, n), jnp.float64)
+    # Lane 1 carries zero-mass padding (a serving bucket's tail).
+    masses = masses.at[1, n // 2:].set(0.0)
+    batched = jax.vmap(
+        lambda p, m: nearest_within_radius_grid(
+            p, m, radius, side=8, cap=32, chunk=64
+        )
+    )
+    d, j_, dropped = batched(pos, masses)
+    assert d.shape == (b, n) and dropped.shape == (b,)
+    assert int(jnp.sum(dropped)) == 0
+    for lane in range(b):
+        p = np.asarray(pos[lane])
+        m = np.asarray(masses[lane])
+        for t in [0, 7, 31, n - 1]:
+            if m[t] == 0:
+                assert int(j_[lane, t]) == -1
+                continue
+            dist = np.linalg.norm(p - p[t], axis=1)
+            dist[t] = np.inf
+            dist[m == 0] = np.inf
+            jb = int(np.argmin(dist))
+            if dist[jb] < radius:
+                assert int(j_[lane, t]) == jb, (lane, t)
+                np.testing.assert_allclose(
+                    float(d[lane, t]), dist[jb], rtol=1e-12
+                )
+            else:
+                assert int(j_[lane, t]) == -1, (lane, t)
+        # Zero-mass tracers produce no candidates in this lane only.
+        assert np.all(np.asarray(j_[lane, m == 0]) == -1)

@@ -44,10 +44,16 @@ def test_field_probe_example():
 
 
 def test_gradient_orbit_fit_example():
+    """The example is a thin client of serve/jobs/fit.py: its default
+    path starts a real daemon, submits the fit as a served job, and
+    checks the result against the solo reference."""
     out = _run(["examples/gradient_orbit_fit.py", "--iters", "120",
                 "--steps", "30"])
     assert out.returncode == 0, out.stderr[-2000:]
     assert "FIT OK" in out.stdout
+    assert "[served" in out.stdout, out.stdout  # daemon path taken
+    # (--solo is the same fit_solo call the served path checks against,
+    # so it needs no separate subprocess run.)
 
 
 def test_plot_trajectory_example(tmp_path):

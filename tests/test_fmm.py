@@ -21,7 +21,7 @@ from gravity_tpu.models import (
 )
 from gravity_tpu.ops.fmm import fmm_accelerations
 from gravity_tpu.ops.forces import pairwise_accelerations_dense
-from gravity_tpu.ops.tree import tree_accelerations
+from gravity_tpu.ops.tree import recommended_leaf_cap, tree_accelerations
 
 
 def _rel_err(approx, exact):
@@ -51,14 +51,21 @@ def _make_model(key, n, model):
 def test_fmm_matches_tree_expansion(key, model):
     """Shifted-slice FMM == gather-based tree far="expansion", to float
     roundoff: same interaction sets, same kernels, different data
-    movement. This pins the whole gather-free reorganization."""
+    movement. This pins the whole gather-free reorganization.
+
+    leaf_cap is data-sized (recommended_leaf_cap): uniform/cold measure
+    the 32 default; the disk's depth-5 core cell holds 103 particles,
+    and at cap 32 BOTH solvers route 70% of the core through their
+    (differing-order) overflow paths — the accuracy re-derivation is
+    in test_fmm_accuracy; parity wants the on-design operating point."""
     n = 2048
     pos, m, eps, g = _make_model(key, n, model)
+    cap = recommended_leaf_cap(pos, 5)
     ref = tree_accelerations(
-        pos, m, depth=5, g=g, eps=eps, far="expansion"
+        pos, m, depth=5, leaf_cap=cap, g=g, eps=eps, far="expansion"
     )
     out = fmm_accelerations(
-        pos, m, depth=5, g=g, eps=eps, order=1, quad=False
+        pos, m, depth=5, leaf_cap=cap, g=g, eps=eps, order=1, quad=False
     )
     rel = _rel_err(out, ref)
     assert np.median(rel) < 1e-5, f"median {np.median(rel):.2e}"
@@ -75,8 +82,17 @@ def test_fmm_accuracy(key, model):
     class as the gather-based tree far="direct"."""
     n = 2048
     pos, m, eps, g = _make_model(key, n, model)
+    # Measured re-derivation of the disk budget (2026-08-04): at the
+    # default cap 32 the depth-5 disk core cell holds 103 particles, so
+    # ~70% of the core's mass enters as ONE cell-size-softened overflow
+    # monopole — p90 12.7% here and 8.9% for the depth-5 tree, an
+    # operating-point overload, not solver drift. recommended_leaf_cap
+    # sizes the cap to the densest cell (disk -> 128; uniform/cold
+    # stay at the 32 default) and the op lands back in its class:
+    # measured disk median 0.19%, p90 0.62%.
+    cap = recommended_leaf_cap(pos, 5)
     exact = pairwise_accelerations_dense(pos, m, g=g, eps=eps)
-    out = fmm_accelerations(pos, m, depth=5, g=g, eps=eps)
+    out = fmm_accelerations(pos, m, depth=5, leaf_cap=cap, g=g, eps=eps)
     rel = _rel_err(out, exact)
     assert np.median(rel) < 0.008, f"median {np.median(rel):.4f}"
     assert np.percentile(rel, 90) < 0.02, (

@@ -31,6 +31,40 @@ def _cfg(n, steps, seed, **kw):
     return SimulationConfig(n=n, steps=steps, seed=seed, **kw)
 
 
+def _chaos_fit_params(config, iters=4):
+    """A tiny true-trajectory fit problem (observations from a solo
+    rollout of the config's own ICs, perturbed starting guess)."""
+    import dataclasses
+
+    from gravity_tpu.ops.integrators import make_step_fn
+    from gravity_tpu.simulation import (
+        make_initial_state,
+        make_local_kernel,
+    )
+
+    st = make_initial_state(config)
+    kernel = make_local_kernel(
+        dataclasses.replace(config, force_backend="dense"), "dense"
+    )
+    accel = lambda p: kernel(p, p, st.masses)  # noqa: E731
+    step = make_step_fn(config.integrator, accel, config.dt)
+    s, a = st, kernel(st.positions, st.positions, st.masses)
+    for _ in range(config.steps):
+        s, a = step(s, a)
+    obs = {"steps": [config.steps],
+           "positions": [np.asarray(s.positions).tolist()]}
+    return {
+        "observations": obs,
+        "iters": iters,
+        "lr": 1.0,
+        "optimizer": "adam",
+        "scale": float(np.abs(np.asarray(s.positions)).max()),
+        "guess_velocities": (
+            np.asarray(st.velocities) * 0.97
+        ).tolist(),
+    }
+
+
 @pytest.mark.heavy  # subprocess worker: JAX import + compiles
 def test_two_worker_kill9_chaos_e2e(tmp_path, faults):
     from conftest import subprocess_env
@@ -87,12 +121,26 @@ def test_two_worker_kill9_chaos_e2e(tmp_path, faults):
                            retries=3)
             assert "job" in resp, resp
             ids.append(resp["job"])
+        # ISSUE 7 acceptance: the adoption contract covers MIXED
+        # traffic classes — a fit job (iteration-budgeted optimizer
+        # loop, its own program family + lease + fence) rides the same
+        # crash. ICs/observations are pure functions of the payload,
+        # so an adopted re-run recovers identical parameters.
+        fit_cfg = _cfg(4, 10, 21)
+        fit_params = _chaos_fit_params(fit_cfg)
+        resp = request(spool_dir, "POST", "/submit", {
+            "config": json.loads(fit_cfg.to_json()),
+            "job_type": "fit", "params": fit_params,
+        }, retries=3)
+        assert "job" in resp, resp
+        fit_id = resp["job"]
+        ids.append(fit_id)
 
         # The injected kill -9 actually happened (not a clean exit).
         assert proc.wait(timeout=180) == -signal.SIGKILL
 
         # Worker B adopts the dead host's jobs (pid-liveness makes the
-        # expired leases claimable immediately) and finishes all 8;
+        # expired leases claimable immediately) and finishes all 9;
         # the client fails over to B through the worker registry.
         statuses = wait_for(spool_dir, ids, timeout=300)
         assert all(
@@ -110,6 +158,18 @@ def test_two_worker_kill9_chaos_e2e(tmp_path, faults):
                 np.abs(got - solo) / np.maximum(np.abs(solo), 1e-30)
             )
             assert rel <= 1e-5, (jid, config.n, float(rel))
+        # Fit parity: the served (possibly adopted + re-run) optimizer
+        # recovers the solo reference's parameters.
+        from gravity_tpu.serve import fit_solo
+
+        solo_fit = fit_solo(fit_cfg, dict(fit_params))
+        resp = request(spool_dir, "GET", f"/result?job={fit_id}")
+        got_v = np.asarray(resp["velocities"])
+        rel = np.max(
+            np.abs(got_v - solo_fit["velocities"])
+            / np.maximum(np.abs(solo_fit["velocities"]), 1e-30)
+        )
+        assert rel <= 1e-5, float(rel)
 
         events = b.events.read()
         adopted = [e for e in events if e["event"] == "adopted"]

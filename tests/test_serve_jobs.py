@@ -1,0 +1,484 @@
+"""Job-class subsystem acceptance (gravity_tpu/serve/jobs/).
+
+The contract under test, per ISSUE 7:
+
+- **Served-vs-solo parity per class.** A ``fit`` job served through
+  the scheduler recovers the same parameters (<=1e-5) as the same
+  optimizer run solo; a ``sweep`` job's per-member verdicts match solo
+  runs of the same seeds; a ``watch`` job emits the same encounter
+  events (step, pair) as a solo run with inline detection — exact
+  equality, not a tolerance.
+- **Typed admission rejections** for malformed payloads (unknown type,
+  fit without observations, sweep with zero members), mirroring the
+  PR-3 unknown-model contract, surfaced as HTTP 400 by the daemon.
+- **Compile-once per (job type, bucket)** proven through the engine's
+  compile counters, and per-class /metrics counters.
+- The resilience machinery (evict/resume, divergence isolation,
+  respool) applies to the new classes unchanged.
+"""
+
+import dataclasses
+import json
+
+import numpy as np
+import pytest
+
+from gravity_tpu.config import SimulationConfig
+from gravity_tpu.serve import (
+    EnsembleScheduler,
+    JobValidationError,
+    fit_solo,
+    sweep_member_solo,
+    watch_solo,
+)
+from gravity_tpu.serve.jobs import get_class
+
+
+def _cfg(n, steps=30, **kw):
+    kw.setdefault("model", "random")
+    kw.setdefault("dt", 3600.0)
+    kw.setdefault("integrator", "leapfrog")
+    kw.setdefault("force_backend", "dense")
+    return SimulationConfig(n=n, steps=steps, **kw)
+
+
+def _max_rel(a, b):
+    return float(
+        np.max(np.abs(np.asarray(a) - np.asarray(b))
+               / np.maximum(np.abs(np.asarray(b)), 1e-30))
+    )
+
+
+def _observations(config, obs_steps):
+    """True-trajectory observations for a fit problem: a solo rollout
+    of the config's own ICs recorded at ``obs_steps``."""
+    from gravity_tpu.ops.integrators import make_step_fn
+    from gravity_tpu.simulation import make_initial_state, make_local_kernel
+
+    st = make_initial_state(config)
+    kernel = make_local_kernel(
+        dataclasses.replace(config, force_backend="dense"), "dense"
+    )
+    accel = lambda p: kernel(p, p, st.masses)  # noqa: E731
+    step = make_step_fn(config.integrator, accel, config.dt)
+    s, a = st, kernel(st.positions, st.positions, st.masses)
+    out = []
+    for i in range(config.steps):
+        s, a = step(s, a)
+        if (i + 1) in obs_steps:
+            out.append(np.asarray(s.positions).tolist())
+    return st, {"steps": list(obs_steps), "positions": out}
+
+
+def _fit_params(config, iters=30):
+    st, obs = _observations(config, [config.steps // 2, config.steps])
+    guess = np.asarray(st.velocities) * 0.95
+    scale = float(np.abs(np.asarray(obs["positions"])).max())
+    return st, {
+        "observations": obs,
+        "iters": iters,
+        "lr": 2.0,
+        "optimizer": "adam",
+        "scale": scale,
+        "guess_velocities": guess.tolist(),
+    }
+
+
+# --- admission validation (typed 400s) ---
+
+
+@pytest.mark.fast
+def test_submit_rejects_malformed_job_payloads():
+    sched = EnsembleScheduler(slots=2, slice_steps=10)
+    cfg = _cfg(8)
+    cases = [
+        # (job_type, params, match)
+        ("not-a-type", {}, "unknown job type"),
+        ("fit", {}, "observations"),
+        ("fit", {"observations": {"steps": [], "positions": []}},
+         "empty"),
+        ("fit", {"observations": {"steps": [999],
+                                  "positions": [[[0, 0, 0]] * 8]}},
+         "outside the rollout"),
+        ("fit", {"observations": {"steps": [5],
+                                  "positions": [[[0, 0, 0]] * 3]}},
+         "shape"),
+        ("sweep", {}, "members"),
+        ("sweep", {"members": 0}, "members must be >= 1"),
+        ("sweep", {"members": 3, "spread": -1}, "spread"),
+        ("watch", {}, "radius"),
+        ("watch", {"radius": -1.0}, "radius must be > 0"),
+        ("watch", {"radius": 1.0, "max_events": 0}, "max_events"),
+        ("watch", {"radius": 1.0, "followup": {"refine": 1}},
+         "refine"),
+        # Internal classes are not directly submittable.
+        ("sweep-member", {"member": 0}, "internal"),
+        ("integrate", {"bogus": 1}, "no params"),
+        ("integrate", {"state": {"positions": [[0, 0, 0]]}},
+         "state"),
+    ]
+    for job_type, params, match in cases:
+        with pytest.raises(ValueError, match=match):
+            sched.submit(cfg, job_type=job_type, params=params)
+    # Typed class: every rejection above is a JobValidationError, the
+    # daemon's 400 marker.
+    with pytest.raises(JobValidationError):
+        sched.submit(cfg, job_type="fit", params={})
+    assert sched.queue_depth == 0  # nothing half-admitted
+
+
+@pytest.mark.fast
+def test_daemon_submit_rejects_bad_payloads_as_400(tmp_path):
+    """The HTTP surface maps JobValidationError to a 400-class reply
+    (handle_post is the shared request path; no sockets needed)."""
+    from gravity_tpu.serve import GravityDaemon
+
+    daemon = GravityDaemon(str(tmp_path / "spool"))
+    try:
+        config = json.loads(_cfg(8).to_json())
+        for body, frag in [
+            ({"config": config, "job_type": "wat"}, "unknown job type"),
+            ({"config": config, "job_type": "fit"}, "observations"),
+            ({"config": config, "job_type": "sweep",
+              "params": {"members": 0}}, "members"),
+            ({"config": config, "job_type": "sweep",
+              "params": "zero"}, "params"),
+        ]:
+            code, payload = daemon.handle_post("/submit", body)
+            assert code == 400, (body, code, payload)
+            assert frag in payload["error"], (frag, payload)
+    finally:
+        daemon.scheduler.close_io()
+
+
+# --- fit ---
+
+
+def test_fit_served_matches_solo_and_recovers(key):
+    del key
+    cfg = _cfg(6, steps=12, seed=3)
+    st, params = _fit_params(cfg, iters=16)
+    solo = fit_solo(cfg, params)
+    sched = EnsembleScheduler(slots=2, slice_steps=48)
+    jid = sched.submit(cfg, job_type="fit", params=params)
+    sched.run_until_idle()
+    status = sched.status(jid)
+    assert status["status"] == "completed", status
+    assert status["units"] == "iters"
+    assert status["steps_done"] == 16  # iteration-budgeted
+    data = sched.result_data(jid)
+    # Served == solo: the same program, vmapped.
+    assert _max_rel(data["velocities"], solo["velocities"]) <= 1e-5
+    assert abs(float(data["loss"][0]) - solo["loss"]) <= 1e-5 * max(
+        abs(solo["loss"]), 1e-30
+    )
+    # And the optimizer actually moved toward the truth.
+    truth = np.asarray(st.velocities)
+    guess_err = np.abs(
+        np.asarray(params["guess_velocities"]) - truth
+    ).max()
+    fit_err = np.abs(np.asarray(solo["velocities"]) - truth).max()
+    assert solo["loss"] < 1.0  # normalized miss shrank
+    assert fit_err < guess_err
+
+
+def test_fit_survives_evict_resume():
+    """Anti-starvation yields on a fit batch round-trip the optimizer
+    state (Adam moments, iteration counter) through the snapshot —
+    the sliced, contended run converges to the same answer."""
+    cfg = _cfg(6, steps=10, seed=5)
+    _, params = _fit_params(cfg, iters=12)
+    solo = fit_solo(cfg, params)
+    # slots=1 + 2 jobs + yield_rounds=1 forces evict/resume churn;
+    # slice of 10 steps = 1 iteration per round.
+    sched = EnsembleScheduler(slots=1, slice_steps=10, yield_rounds=1)
+    ids = [
+        sched.submit(_cfg(6, steps=10, seed=5), job_type="fit",
+                     params=params)
+        for _ in range(2)
+    ]
+    sched.run_until_idle()
+    for jid in ids:
+        st = sched.status(jid)
+        assert st["status"] == "completed", st
+        data = sched.result_data(jid)
+        assert _max_rel(data["velocities"], solo["velocities"]) <= 1e-5
+
+
+# --- sweep ---
+
+
+def test_sweep_member_verdicts_match_solo():
+    cfg = _cfg(8, steps=20, seed=7)
+    params = {"members": 4, "spread": 0.05, "sweep_seed": 11}
+    sched = EnsembleScheduler(slots=4, slice_steps=10)
+    pid = sched.submit(cfg, job_type="sweep", params=dict(params))
+    sched.run_until_idle()
+    status = sched.status(pid)
+    assert status["status"] == "completed", status
+    assert status["steps_done"] == 4  # member-budgeted
+    summary = status["result"]
+    assert summary["members"] == 4 and summary["completed"] == 4
+    data = sched.result_data(pid)
+    for k in range(4):
+        solo = sweep_member_solo(cfg, {**params, "member": k})
+        assert solo["finite"]
+        got_min = float(data["min_sep"][k])
+        got_drift = float(data["energy_drift"][k])
+        assert abs(got_min - solo["min_sep"]) <= 1e-5 * max(
+            solo["min_sep"], 1e-30
+        ), k
+        assert abs(got_drift - solo["energy_drift"]) <= 1e-7, k
+        assert bool(data["escaped"][k]) == solo["escaped"], k
+    # Members are ordinary jobs: visible, member-id'd, completed.
+    member = sched.status(f"{pid}.m2")
+    assert member["status"] == "completed"
+    assert member["parent"] == pid
+    assert member["job_type"] == "sweep-member"
+
+
+def test_sweep_exercises_scheduler_and_cancel():
+    """A sweep bigger than the slot count drives backfill/rotation at
+    real occupancy; cancelling the parent cancels every member."""
+    cfg = _cfg(6, steps=400, seed=1)
+    sched = EnsembleScheduler(slots=2, slice_steps=20)
+    pid = sched.submit(
+        cfg, job_type="sweep", params={"members": 6, "spread": 0.02}
+    )
+    # A few rounds in, members occupy all slots and queue behind.
+    for _ in range(3):
+        sched.run_round()
+    assert sched.active_count == 2
+    assert sched.queue_depth >= 3
+    assert sched.cancel(pid)
+    for k in range(6):
+        st = sched.status(f"{pid}.m{k}")
+        assert st["status"] == "cancelled", (k, st)
+    st = sched.status(pid)
+    assert st["status"] == "cancelled"
+
+
+# --- watch ---
+
+
+def _encounter_setup(steps=50):
+    cfg = _cfg(3, steps=steps)
+    params = {
+        "radius": 1.99e10,
+        "merge_radius": 1.96e10,
+        "state": {
+            "positions": [[-1e10, 0, 0], [1e10, 0, 0],
+                          [5e11, 5e11, 0]],
+            "velocities": [[500.0, 0, 0], [-500.0, 0, 0], [0, 0, 0]],
+            "masses": [1e26, 1e26, 1.0],
+        },
+    }
+    return cfg, params
+
+
+def test_watch_events_match_solo_inline_detection():
+    cfg, params = _encounter_setup()
+    slice_steps = 25
+    solo_events = watch_solo(cfg, dict(params), slice_steps=slice_steps)
+    assert solo_events, "setup should produce at least one encounter"
+    from gravity_tpu.utils.logging import ServingEventLogger
+    import tempfile, os
+
+    with tempfile.TemporaryDirectory() as tmp:
+        events = ServingEventLogger(os.path.join(tmp, "ev.jsonl"))
+        sched = EnsembleScheduler(
+            slots=2, slice_steps=slice_steps, events=events
+        )
+        jid = sched.submit(cfg, job_type="watch", params=dict(params))
+        sched.run_until_idle()
+        status = sched.status(jid)
+        assert status["status"] == "completed", status
+        data = sched.result_data(jid)
+        served = list(zip(
+            data["event_step"].tolist(), data["event_i"].tolist(),
+            data["event_j"].tolist(), data["event_kind"].tolist(),
+        ))
+        want = [
+            (e["step"], e["i"], e["j"], int(e["kind"] == "merger"))
+            for e in solo_events
+        ]
+        assert served == want  # exact step+pair equality
+        stream = [e for e in events.read()
+                  if e["event"] in ("encounter", "merger")]
+        assert [(e["step"], e["i"], e["j"]) for e in stream] == [
+            (e["step"], e["i"], e["j"]) for e in solo_events
+        ]
+
+
+def test_watch_followup_submits_highres_job():
+    cfg, params = _encounter_setup()
+    params["followup"] = {"refine": 4, "max": 1}
+    from gravity_tpu.utils.logging import ServingEventLogger
+    import tempfile, os
+
+    with tempfile.TemporaryDirectory() as tmp:
+        events = ServingEventLogger(os.path.join(tmp, "ev.jsonl"))
+        sched = EnsembleScheduler(
+            slots=2, slice_steps=25, events=events
+        )
+        jid = sched.submit(cfg, job_type="watch", params=params)
+        sched.run_until_idle()
+        assert sched.status(jid)["status"] == "completed"
+        follow = sched.status(f"{jid}.f0")
+        assert follow is not None and follow["status"] == "completed"
+        child = sched.jobs[f"{jid}.f0"]
+        # Zoom-in contract: refine x steps at dt / refine, from the
+        # flagged round's start state, ahead of background priority.
+        assert child.config.dt == cfg.dt / 4
+        assert child.config.steps == 25 * 4
+        assert child.priority == 1
+        assert child.params.get("state") is not None
+        sub = [e for e in events.read()
+               if e["event"] == "followup_submitted"]
+        assert len(sub) == 1 and sub[0]["followup"] == f"{jid}.f0"
+        # Exactly one follow-up despite later rounds (max=1).
+        assert sched.status(f"{jid}.f1") is None
+
+
+def test_watch_followup_queuefull_does_not_break_round(monkeypatch):
+    """A shed follow-up is best-effort: QueueFull raised by the
+    auto-submit must not escape post_round (it is a RuntimeError, not
+    a ValueError) — the watch job itself still completes with full
+    accounting (review finding: an escaped shed desynced the batch's
+    budgets forever)."""
+    from gravity_tpu.serve.scheduler import QueueFull
+
+    cfg, params = _encounter_setup()
+    params["followup"] = {"refine": 2, "max": 1}
+    sched = EnsembleScheduler(slots=2, slice_steps=25)
+    jid = sched.submit(cfg, job_type="watch", params=dict(params))
+    orig = sched.submit
+
+    def shedding(config, **kw):
+        if kw.get("job_type") == "integrate" and str(
+            kw.get("job_id") or ""
+        ).startswith(jid):
+            raise QueueFull(1.0, 99)
+        return orig(config, **kw)
+
+    monkeypatch.setattr(sched, "submit", shedding)
+    sched.run_until_idle()
+    st = sched.status(jid)
+    assert st["status"] == "completed", st
+    assert st["steps_done"] == cfg.steps  # accounting intact
+    assert st["result"]["events"] >= 1  # the event still landed
+    assert sched.status(f"{jid}.f0") is None  # follow-up shed
+
+
+def test_sweep_parent_reexpands_interrupted_fanout(tmp_path):
+    """A worker that persisted the parent but died before finishing
+    the member fan-out leaves holes; the parent's (re)owner re-expands
+    the missing members from their deterministic ids/params instead of
+    hanging pending forever (review finding)."""
+    import os
+
+    from gravity_tpu.serve import Spool
+
+    cfg = _cfg(6, steps=10, seed=4)
+    spool = Spool(str(tmp_path / "spool"))
+    sched = EnsembleScheduler(slots=2, slice_steps=10, spool=spool)
+    pid = sched.submit(
+        cfg, job_type="sweep", params={"members": 3, "spread": 0.02}
+    )
+    sched.close_io()
+    del sched
+    # Simulate the interrupted expansion: members 1 and 2 never made
+    # it to the spool.
+    for k in (1, 2):
+        os.remove(spool.job_path(f"{pid}.m{k}"))
+
+    spool2 = Spool(str(tmp_path / "spool"))
+    sched2 = EnsembleScheduler(slots=2, slice_steps=10, spool=spool2)
+    sched2.run_until_idle()
+    st = sched2.status(pid)
+    assert st["status"] == "completed", st
+    assert st["result"]["completed"] == 3
+    sched2.close_io()
+
+
+# --- cross-class serving behavior ---
+
+
+def test_mixed_classes_compile_once_per_type_and_bucket():
+    """integrate + fit + sweep members + watch in one scheduler: every
+    (job type, bucket) program compiles exactly once, and /metrics-
+    style per-class counters see all of them."""
+    cfg = _cfg(8, steps=20, seed=2)
+    _, fparams = _fit_params(_cfg(6, steps=10, seed=4), iters=6)
+    wcfg, wparams = _encounter_setup(steps=20)
+    sched = EnsembleScheduler(slots=2, slice_steps=10)
+    ids = {
+        "integrate": sched.submit(cfg),
+        "fit": sched.submit(_cfg(6, steps=10, seed=4), job_type="fit",
+                            params=fparams),
+        "sweep": sched.submit(cfg, job_type="sweep",
+                              params={"members": 3, "spread": 0.01}),
+        "watch": sched.submit(wcfg, job_type="watch", params=wparams),
+    }
+    sched.run_until_idle()
+    for jt, jid in ids.items():
+        st = sched.status(jid)
+        assert st["status"] == "completed", (jt, st)
+    counts = sched.engine.compile_counts
+    assert all(v == 1 for v in counts.values()), counts
+    types = {k.job_type for k in counts}
+    assert types == {"integrate", "fit", "sweep-member", "watch"}
+    # Distinct program families at the same bucket never share keys.
+    assert len(counts) == len(set(counts))
+    classes = sched.class_metrics()
+    assert classes["integrate"]["completed"] >= 1
+    assert classes["fit"]["completed"] == 1
+    assert classes["sweep"]["completed"] == 1
+    assert classes["sweep-member"]["completed"] == 3
+    assert classes["watch"]["completed"] == 1
+    for jt in ("fit", "sweep", "watch"):
+        assert classes[jt]["latency"]["p99_s"] is not None, jt
+
+
+def test_sweep_respools_after_restart(tmp_path):
+    """A daemon restart mid-sweep re-queues unfinished members AND the
+    parent; the re-run completes with the same verdicts (ICs are a
+    pure function of config+params)."""
+    from gravity_tpu.serve import Spool
+
+    cfg = _cfg(6, steps=20, seed=9)
+    params = {"members": 3, "spread": 0.03}
+    spool = Spool(str(tmp_path / "spool"))
+    sched = EnsembleScheduler(slots=2, slice_steps=10, spool=spool)
+    pid = sched.submit(cfg, job_type="sweep", params=dict(params))
+    sched.run_round()  # partial progress only
+    sched.close_io()
+    del sched
+
+    spool2 = Spool(str(tmp_path / "spool"))
+    sched2 = EnsembleScheduler(slots=2, slice_steps=10, spool=spool2)
+    sched2.run_until_idle()
+    st = sched2.status(pid)
+    assert st["status"] == "completed", st
+    data = sched2.result_data(pid)
+    for k in range(3):
+        solo = sweep_member_solo(cfg, {**params, "member": k})
+        assert abs(float(data["min_sep"][k]) - solo["min_sep"]) \
+            <= 1e-5 * max(solo["min_sep"], 1e-30)
+    sched2.close_io()
+
+
+@pytest.mark.fast
+def test_job_class_registry_surface():
+    for name, units, resident in [
+        ("integrate", "steps", True),
+        ("fit", "iters", True),
+        ("sweep", "members", False),
+        ("sweep-member", "steps", True),
+        ("watch", "steps", True),
+    ]:
+        cls = get_class(name)
+        assert cls.units == units
+        assert getattr(cls, "resident", True) == resident
+    with pytest.raises(JobValidationError):
+        get_class("nope")
