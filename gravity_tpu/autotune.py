@@ -397,6 +397,15 @@ def resolve_backend_measured(
     if not refresh:
         rec = _load_record(h, key)
         if rec is not None:
+            # Verdict provenance in the submitting job's trace (a
+            # no-op unless a tracer is bound — serve admission binds
+            # one): a hit is a zero-cost span carrying the winner.
+            from .telemetry import tracing as _tracing
+
+            _tracing.emit_bound(
+                "autotune_probe", time.time(), 0.0, cache="hit",
+                winner=rec["winner"], key_hash=h,
+            )
             return AutotuneDecision(
                 rec["winner"], "hit", 0.0,
                 rec.get("timings_s", {}), rec.get("skipped", {}), h,
@@ -435,6 +444,7 @@ def resolve_backend_measured(
             )
 
     t0 = time.perf_counter()
+    t0_wall = time.time()
     probe_started_ns = time.time_ns()  # the record's fencing stamp
     timings: dict[str, float] = {}
     for backend in candidates:
@@ -456,6 +466,17 @@ def resolve_backend_measured(
             _static(), "static", probe_ms, {}, skipped, h
         )
     winner = min(timings, key=timings.get)
+    from .telemetry import tracing as _tracing
+
+    # Probe span + verdict provenance (docs/observability.md): the
+    # measured timings and the winner land in the trace of whichever
+    # job paid this probe.
+    _tracing.emit_bound(
+        "autotune_probe", t0_wall, probe_ms / 1e3, cache="miss",
+        winner=winner, key_hash=h,
+        timings_ms={k: round(v * 1e3, 3) for k, v in timings.items()},
+        skipped=sorted(skipped),
+    )
     _store_record(h, {
         "key": key,
         "winner": winner,

@@ -156,3 +156,126 @@ def run_cadence_benchmark(config: SimulationConfig) -> dict:
         checkpoint_every=config.checkpoint_every,
     )
     return stats
+
+
+# --- perf-trend reporting over the accumulated round artifacts ---
+
+
+def _round_num(path: str) -> int:
+    import re
+
+    m = re.search(r"_r(\d+)\.json$", path)
+    return int(m.group(1)) if m else -1
+
+
+def collect_bench_rounds(root: str = ".") -> dict:
+    """Fold the per-round ``BENCH_r*.json`` / ``MULTICHIP_r*.json``
+    artifacts into structured rows. Each BENCH row carries the parsed
+    headline (pairs/s, n, backend, platform, avg step time) plus any
+    newer fields present (mfu, achieved_tflops, host_gap_frac,
+    autotune_cache) — older rounds predate those and show as None.
+    Pure file reading: no device, no config."""
+    import glob
+    import json
+    import os
+
+    bench_rows = []
+    for path in sorted(
+        glob.glob(os.path.join(root, "BENCH_r*.json")), key=_round_num
+    ):
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        parsed = doc.get("parsed") or {}
+        avg = parsed.get("avg_step_s")
+        bench_rows.append({
+            "round": _round_num(path),
+            "n": parsed.get("n"),
+            "backend": parsed.get("backend"),
+            "platform": parsed.get("platform"),
+            "steps_per_s": (1.0 / avg) if avg else None,
+            "pairs_per_s": parsed.get("value"),
+            "mfu": parsed.get("mfu"),
+            "achieved_tflops": parsed.get("achieved_tflops"),
+            "host_gap_frac": parsed.get("host_gap_frac"),
+            "autotune_cache": parsed.get("autotune_cache"),
+            "measured_at": parsed.get("measured_at"),
+        })
+    multichip_rows = []
+    for path in sorted(
+        glob.glob(os.path.join(root, "MULTICHIP_r*.json")),
+        key=_round_num,
+    ):
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        multichip_rows.append({
+            "round": _round_num(path),
+            "n_devices": doc.get("n_devices"),
+            "ok": doc.get("ok"),
+            "skipped": doc.get("skipped"),
+            "rc": doc.get("rc"),
+        })
+    return {"bench": bench_rows, "multichip": multichip_rows}
+
+
+def _fmt(v, spec: str = "", none: str = "-") -> str:
+    if v is None:
+        return none
+    try:
+        return format(v, spec) if spec else str(v)
+    except (TypeError, ValueError):
+        return str(v)
+
+
+def format_bench_report(data: dict) -> str:
+    """Render :func:`collect_bench_rounds` as the trend table
+    ``gravity_tpu bench --report`` prints — the perf trajectory
+    readable without hand-diffing round JSON files. Delta column:
+    pairs/s vs the previous round with the same platform class."""
+    lines = ["== bench rounds =="]
+    header = (
+        f"{'rnd':>3} {'n':>9} {'backend':>10} {'platform':>10} "
+        f"{'steps/s':>9} {'pairs/s':>10} {'mfu':>6} "
+        f"{'host_gap':>8} {'delta':>7}"
+    )
+    lines.append(header)
+    prev_by_platform: dict = {}
+    for row in data.get("bench", []):
+        platform = (row.get("platform") or "?").split("-")[0]
+        prev = prev_by_platform.get(platform)
+        delta = None
+        if prev and row.get("pairs_per_s"):
+            delta = row["pairs_per_s"] / prev - 1.0
+        if row.get("pairs_per_s"):
+            prev_by_platform[platform] = row["pairs_per_s"]
+        lines.append(
+            f"{_fmt(row['round'], '3d'):>3} "
+            f"{_fmt(row['n'], 'd'):>9} "
+            f"{_fmt(row['backend']):>10} "
+            f"{_fmt(row['platform']):>10} "
+            f"{_fmt(row['steps_per_s'], '.2f'):>9} "
+            f"{_fmt(row['pairs_per_s'], '.2e'):>10} "
+            f"{_fmt(row['mfu'], '.3f'):>6} "
+            f"{_fmt(row['host_gap_frac'], '.3f'):>8} "
+            f"{_fmt(delta, '+.1%'):>7}"
+        )
+    if not data.get("bench"):
+        lines.append("  (no BENCH_r*.json rounds found)")
+    lines.append("")
+    lines.append("== multichip rounds ==")
+    lines.append(f"{'rnd':>3} {'devices':>8} {'ok':>5} {'skipped':>8}")
+    for row in data.get("multichip", []):
+        lines.append(
+            f"{_fmt(row['round'], '3d'):>3} "
+            f"{_fmt(row['n_devices']):>8} "
+            f"{_fmt(row['ok']):>5} "
+            f"{_fmt(row['skipped']):>8}"
+        )
+    if not data.get("multichip"):
+        lines.append("  (no MULTICHIP_r*.json rounds found)")
+    return "\n".join(lines)

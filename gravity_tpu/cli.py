@@ -155,6 +155,11 @@ def _add_config_args(p: argparse.ArgumentParser) -> None:
                         "chunk) eval per block - opt-in)")
     p.add_argument("--profile", action="store_true", default=None,
                    help="capture a jax.profiler trace of the run")
+    p.add_argument("--trace", action="store_true", default=None,
+                   help="emit lifecycle spans (blocks, checkpoints) as "
+                        "JSONL under --log-dir, exportable with "
+                        "`gravity_tpu trace-export` "
+                        "(docs/observability.md)")
     p.add_argument("--debug-check", dest="debug_check", action="store_true",
                    default=None,
                    help="cross-check Pallas vs jnp forces on final state")
@@ -313,6 +318,23 @@ def cmd_run(args: argparse.Namespace) -> int:
         metrics_logger = MetricsLogger(
             os.path.join(config.log_dir, f"metrics_{logger.timestamp}.jsonl")
         )
+    telemetry = None
+    if config.trace:
+        import os
+
+        from .telemetry import Telemetry
+
+        # Spans land in <log_dir>/traces.jsonl (shared across runs —
+        # trace-export filters by trace id); flight-recorder dumps in
+        # the same directory.
+        telemetry = Telemetry(
+            out_dir=config.log_dir, worker=f"run-{os.getpid()}"
+        )
+        if config.adaptive:
+            logger.log_print(
+                "note: --trace spans cover the fixed-dt driver; "
+                "adaptive runs get flight-recorder triggers only"
+            )
     sup = None
     if config.auto_recover:
         import os
@@ -328,6 +350,7 @@ def cmd_run(args: argparse.Namespace) -> int:
             config, logger=logger, events=events,
             checkpoint_manager=ckpt_mgr, trajectory_writer=writer,
             metrics_logger=metrics_logger, state=state0,
+            telemetry=telemetry,
         )
 
     def _go():
@@ -339,7 +362,8 @@ def cmd_run(args: argparse.Namespace) -> int:
                                     metrics_logger=metrics_logger)
         return sim.run(logger, trajectory_writer=writer,
                        checkpoint_manager=ckpt_mgr,
-                       metrics_logger=metrics_logger)
+                       metrics_logger=metrics_logger,
+                       telemetry=telemetry)
 
     def _close_writer():
         # The run loop only closes the writer on normal completion;
@@ -452,6 +476,13 @@ def cmd_run(args: argparse.Namespace) -> int:
             f"median_rel_err={check['median_rel_err']:.3e} "
             f"(n={check['n_checked']})"
         )
+    if telemetry is not None and telemetry.tracer.path \
+            and "trace_id" in stats:
+        # Only when spans were actually emitted: the adaptive driver
+        # takes recorder triggers but no span stream, and advertising
+        # a traces.jsonl that was never written sends the user to a
+        # trace-export error.
+        stats["trace_path"] = telemetry.tracer.path
     stats.pop("final_state", None)
     print(json.dumps(stats))
     return 0
@@ -1616,6 +1647,8 @@ def cmd_serve(args: argparse.Namespace) -> int:
         lease_ttl_s=args.lease_ttl_s,
         max_queue=args.max_queue,
         max_requeues=args.max_requeues,
+        slo_p99_ms=args.slo_p99_ms,
+        slo_occupancy=args.slo_occupancy,
     )
     host, port = daemon.start()
     print(json.dumps({
@@ -1761,6 +1794,97 @@ def cmd_cancel(args: argparse.Namespace) -> int:
     return 0 if resp.get("cancelled") else 1
 
 
+def cmd_trace_export(args: argparse.Namespace) -> int:
+    """Export one trace as Chrome/Perfetto ``trace_event`` JSON
+    (load it at ui.perfetto.dev or chrome://tracing). Resolve the
+    trace either from a served job's spool record (--spool-dir + job
+    id carry the trace id, stitched across adoptions) or an explicit
+    --trace id / --trace-file (solo runs: --log-dir/traces.jsonl)."""
+    import os
+
+    from .telemetry import (
+        TRACES_FILE,
+        chrome_trace,
+        load_spans,
+        span_coverage,
+        trace_ids,
+    )
+
+    trace = args.trace
+    trace_file = args.trace_file
+    if args.job:
+        from .utils.hostio import read_json_retry
+
+        rec = read_json_retry(
+            os.path.join(args.spool_dir, "jobs", f"{args.job}.json")
+        )
+        if not isinstance(rec, dict):
+            print(f"error: no spool record for job {args.job!r} under "
+                  f"{args.spool_dir!r}", file=sys.stderr)
+            return 2
+        trace = rec.get("trace_id") or None
+        if trace is None:
+            print(f"error: job {args.job!r} has no trace id (submitted "
+                  "before tracing?)", file=sys.stderr)
+            return 2
+        trace_file = trace_file or os.path.join(
+            args.spool_dir, TRACES_FILE
+        )
+    if trace_file is None:
+        trace_file = os.path.join(args.spool_dir, TRACES_FILE)
+    spans = load_spans(trace_file)
+    if not spans:
+        print(f"error: no spans in {trace_file!r}", file=sys.stderr)
+        return 2
+    if trace is None:
+        ids = trace_ids(spans)
+        if len(ids) != 1:
+            print("error: --trace or a job id required; file holds "
+                  f"{len(ids)} traces: {ids[:10]}", file=sys.stderr)
+            return 2
+        trace = ids[0]
+    doc = chrome_trace(spans, trace)
+    if len(doc["traceEvents"]) == 0:
+        print(f"error: trace {trace!r} not found in {trace_file!r}",
+              file=sys.stderr)
+        return 2
+    out = args.out or f"{trace}.trace.json"
+    with open(out, "w") as f:
+        json.dump(doc, f)
+    cov = span_coverage(spans, trace)
+    print(json.dumps({
+        "trace": trace,
+        "out": out,
+        "spans": cov["spans"],
+        "wall_s": cov["wall_s"],
+        "union_s": cov["union_s"],
+        # Fraction of the trace's wall-clock covered by top-level
+        # spans — the acceptance gate's "spans sum to ~the job's
+        # end-to-end latency" number.
+        "coverage": cov["coverage"],
+    }))
+    return 0
+
+
+def cmd_fleet_status(args: argparse.Namespace) -> int:
+    """Fleet-wide serving health: every live worker's snapshot from
+    the shared spool, aggregated (per-class p50/p95/p99, occupancy,
+    breakers, SLO burn) — `/metrics?fleet=1` as a CLI verb."""
+    from .serve import DaemonUnreachable, request
+
+    try:
+        resp = request(args.spool_dir, "GET", "/metrics?fleet=1")
+    except DaemonUnreachable as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if not args.full:
+        # The registry dump is for machines; the default view is the
+        # operator summary.
+        resp.pop("registry", None)
+    print(json.dumps(resp, indent=2))
+    return 0
+
+
 def cmd_tune(args: argparse.Namespace) -> int:
     """Pre-warm the autotune cache over a size ladder — the measured-
     routing analog of ``benchmarks/crossover.py``'s sweep (same default
@@ -1826,6 +1950,17 @@ def cmd_tune(args: argparse.Namespace) -> int:
 
 
 def cmd_bench(args: argparse.Namespace) -> int:
+    if args.report:
+        # Trend report over the accumulated BENCH_r*/MULTICHIP_r*
+        # round artifacts — no run, no device (scripts/bench_report.py
+        # is the same code as a standalone script; main() skips the
+        # backend probe for this mode too).
+        from .bench import collect_bench_rounds, format_bench_report
+
+        print(format_bench_report(
+            collect_bench_rounds(args.report_dir)
+        ))
+        return 0
     from .bench import run_benchmark, run_cadence_benchmark
 
     _maybe_distributed(args)
@@ -1912,6 +2047,17 @@ def main(argv=None) -> int:
                          default=2,
                          help="consecutive rounds a resident job may "
                               "hold a contended slot before yielding")
+    p_serve.add_argument("--slo-p99-ms", dest="slo_p99_ms", type=float,
+                         default=None,
+                         help="p99 completed-latency SLO in ms: "
+                              "crossings emit slo_breach events + burn "
+                              "flags in /metrics "
+                              "(docs/observability.md)")
+    p_serve.add_argument("--slo-occupancy", dest="slo_occupancy",
+                         type=float, default=None,
+                         help="round-occupancy SLO (0..1): rounds "
+                              "below it emit slo_breach events + burn "
+                              "flags in /metrics")
     p_serve.set_defaults(fn=cmd_serve)
 
     p_submit = sub.add_parser(
@@ -2137,13 +2283,53 @@ def main(argv=None) -> int:
                               "reports steps_per_sec + host_gap_frac "
                               "(A/B the host pipeline via --io-pipeline "
                               "on|off)")
+    p_bench.add_argument("--report", action="store_true",
+                         help="print the perf trend table over the "
+                              "accumulated BENCH_r*/MULTICHIP_r* round "
+                              "artifacts instead of running "
+                              "(docs/observability.md)")
+    p_bench.add_argument("--report-dir", dest="report_dir", default=".",
+                         help="directory holding the round JSON files")
     p_bench.set_defaults(fn=cmd_bench)
+
+    p_texp = sub.add_parser(
+        "trace-export",
+        help="export a job/run trace as Chrome/Perfetto trace_event "
+             "JSON (docs/observability.md 'Trace model')",
+    )
+    _add_spool_arg(p_texp)
+    p_texp.add_argument("job", nargs="?", default=None,
+                        help="served job id (its spool record carries "
+                             "the trace id)")
+    p_texp.add_argument("--trace", default=None,
+                        help="explicit trace id (solo runs print it in "
+                             "their stats JSON)")
+    p_texp.add_argument("--trace-file", dest="trace_file", default=None,
+                        help="traces.jsonl to read (default: "
+                             "<spool-dir>/traces.jsonl)")
+    p_texp.add_argument("--out", default=None,
+                        help="output path (default <trace>.trace.json)")
+    p_texp.set_defaults(fn=cmd_trace_export)
+
+    p_fleet = sub.add_parser(
+        "fleet-status",
+        help="aggregated fleet health across every live worker on the "
+             "spool (/metrics?fleet=1; docs/observability.md)",
+    )
+    _add_spool_arg(p_fleet)
+    p_fleet.add_argument("--full", action="store_true",
+                         help="include the merged metric registry dump")
+    p_fleet.set_defaults(fn=cmd_fleet_status)
 
     args = parser.parse_args(argv)
     # traj and the serving CLIENT verbs never touch the device (they
     # talk JSON to files / the daemon) — skip the backend probe there.
     if args.command not in (
-        "traj", "submit", "status", "result", "cancel"
+        "traj", "submit", "status", "result", "cancel",
+        "trace-export", "fleet-status",
+    ) and not (
+        # bench --report only globs local round JSONs — device-free.
+        args.command == "bench" and getattr(args, "report", False)
     ) and not getattr(args, "distributed", False):
         # Every device-touching command would hang forever on a wedged
         # axon tunnel; bound that with a subprocess probe + CPU fallback.

@@ -158,6 +158,11 @@ class SimulationConfig:
     metrics: bool = False  # JSONL per-block metrics stream
     metrics_energy: bool = False  # add per-block total-energy drift (costly)
     profile: bool = False  # capture a jax.profiler trace of the run
+    # Span tracing (docs/observability.md): emit the run's lifecycle
+    # spans (blocks, checkpoints, divergence/preemption markers) as
+    # JSONL under log_dir — the solo twin of the serving trace stream,
+    # exportable with `gravity_tpu trace-export`.
+    trace: bool = False
     debug_check: bool = False  # Pallas-vs-jnp force cross-check at end
     # Divergence watchdog: per-block NaN/Inf state check; on detection the
     # run aborts with an emergency checkpoint (when checkpointing is on)

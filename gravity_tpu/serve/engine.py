@@ -252,6 +252,25 @@ class EnsembleEngine:
         self._kernels: dict[BatchKey, object] = {}
         self._seed_fns: dict[BatchKey, object] = {}
         self.compile_counts: dict[BatchKey, int] = {}
+        # Optional telemetry hook (a FlightRecorder, or anything with
+        # .record(kind, **fields)): (re)trace marks land in the crash
+        # ring so a postmortem can see "this round paid a compile".
+        self.recorder = None
+
+    def _mark_compile(self, key: BatchKey) -> None:
+        """Count one (re)trace of ``key``'s round program — called at
+        TRACE time by every program family — and mirror it into the
+        attached recorder."""
+        self.compile_counts[key] = self.compile_counts.get(key, 0) + 1
+        if self.recorder is not None:
+            try:
+                self.recorder.record(
+                    "compile", bucket=key.bucket_n, slots=key.slots,
+                    backend=key.backend, job_type=key.job_type,
+                    count=self.compile_counts[key],
+                )
+            except Exception:  # noqa: BLE001 — telemetry must not
+                pass  # poison a trace
 
     @staticmethod
     def _job_class(key: BatchKey):
@@ -340,7 +359,7 @@ class EnsembleEngine:
         def round_fn(pos, vel, mass, acc, dt, remaining, n_real, *, n_steps):
             # Trace-time side effect: executions of the compiled program
             # skip this line, so the count is exactly the retrace count.
-            self.compile_counts[key] = self.compile_counts.get(key, 0) + 1
+            self._mark_compile(key)
             return jax.vmap(
                 partial(one_system, n_steps=n_steps)
             )(pos, vel, mass, acc, dt, remaining, n_real)

@@ -305,8 +305,7 @@ class FitJob(JobClass):
         def round_fn(pos0, v, masses, free, obs_pos, obs_w, obs_step,
                      scale, lr, dt, m_a, v_a, loss, remaining, iter0,
                      n_real, *, n_iters):
-            engine.compile_counts[key] = \
-                engine.compile_counts.get(key, 0) + 1
+            engine._mark_compile(key)
             return jax.vmap(partial(one, n_iters=n_iters))(
                 pos0, v, masses, free, obs_pos, obs_w, obs_step,
                 scale, lr, dt, m_a, v_a, loss, remaining, iter0, n_real,

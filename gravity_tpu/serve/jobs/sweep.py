@@ -239,8 +239,7 @@ class SweepMemberJob(JobClass):
 
         def round_fn(pos, vel, mass, acc, min_d2, dt, remaining,
                      n_real, *, n_steps):
-            engine.compile_counts[key] = \
-                engine.compile_counts.get(key, 0) + 1
+            engine._mark_compile(key)
             return jax.vmap(partial(one, n_steps=n_steps))(
                 pos, vel, mass, acc, min_d2, dt, remaining, n_real
             )

@@ -230,8 +230,7 @@ class WatchJob(JobClass):
 
         def round_fn(pos, vel, mass, acc, dt, remaining, n_real,
                      radius, mradius, in_enc, in_mrg, *, n_steps):
-            engine.compile_counts[key] = \
-                engine.compile_counts.get(key, 0) + 1
+            engine._mark_compile(key)
             return jax.vmap(partial(one, n_steps=n_steps))(
                 pos, vel, mass, acc, dt, remaining, n_real,
                 radius, mradius, in_enc, in_mrg,

@@ -143,13 +143,19 @@ class LeaseManager:
     spool directory. Cross-process safety via flock; in-process safety
     (daemon worker thread vs heartbeat thread) via an RLock."""
 
-    def __init__(self, root: str, worker_id: str, ttl_s: float = 30.0):
+    def __init__(self, root: str, worker_id: str, ttl_s: float = 30.0,
+                 recorder=None):
         if ttl_s <= 0:
             raise ValueError(f"ttl_s must be > 0, got {ttl_s}")
         self.dir = os.path.join(root, "leases")
         os.makedirs(self.dir, exist_ok=True)
         self.worker_id = worker_id
         self.ttl_s = float(ttl_s)
+        # Telemetry hook (a FlightRecorder, or anything with
+        # .record(kind, **fields)): lease transitions — claim/adopt,
+        # release, loss-to-a-peer — are exactly what a crash
+        # postmortem needs to sequence, so they join the ring.
+        self.recorder = recorder
         self._lock_path = os.path.join(self.dir, ".lock")
         self._mu = threading.RLock()
         self._held: dict[str, Lease] = {}
@@ -164,6 +170,13 @@ class LeaseManager:
         self._suspended_until = 0.0
         self._hb_thread: Optional[threading.Thread] = None
         self._hb_stop = threading.Event()
+
+    def _record(self, op: str, /, **fields) -> None:
+        if self.recorder is not None:
+            try:
+                self.recorder.record("lease", op=op, **fields)
+            except Exception:  # noqa: BLE001 — telemetry never takes
+                pass  # down the ownership protocol it observes
 
     # --- locking ---
 
@@ -273,6 +286,8 @@ class LeaseManager:
             )
             atomic_write_json(self._path(job_id), lease.to_record())
             self._held[job_id] = lease
+            self._record("claim", job=job_id, fence=lease.fence,
+                         adopted_from=adopted_from)
             return lease
 
     def release(self, job_id: str) -> None:
@@ -290,6 +305,7 @@ class LeaseManager:
                     os.remove(self._path(job_id))
                 except OSError:
                     pass
+            self._record("release", job=job_id, fence=held.fence)
 
     def renew_all(self, now: Optional[float] = None) -> list[str]:
         """Heartbeat: extend every held lease's TTL. Returns the job
@@ -308,6 +324,10 @@ class LeaseManager:
                         or cur.worker != self.worker_id:
                     self._held.pop(job_id, None)
                     lost.append(job_id)
+                    self._record(
+                        "lost", job=job_id, our_fence=held.fence,
+                        holder=None if cur is None else cur.worker,
+                    )
                     continue
                 lease = dataclasses.replace(
                     held, expires_ts=now + self.ttl_s, renewed_ts=now

@@ -54,6 +54,8 @@ import numpy as np
 
 from ..config import SimulationConfig
 from ..state import ParticleState
+from ..telemetry import Telemetry, declare_worker_metrics
+from ..telemetry import tracing as _tracing
 from ..utils.faults import (
     BackendUnavailable,
     drop_result_due,
@@ -136,6 +138,14 @@ class Job:
     # pill counter behind ``max_requeues``.
     fence: int = 0
     requeues: int = 0
+    # Telemetry (persisted): the job's trace id, minted at submit and
+    # carried in the spool record so an adopted job's spans — dead
+    # worker's and survivor's — stitch into ONE trace
+    # (docs/observability.md "Trace model").
+    trace_id: str = ""
+    # Local-only: when this job last entered a pending queue (the
+    # start of its current queue-wait span).
+    queued_ts: float = 0.0
     # Local-only: False = a peer worker owns this job; we serve status
     # reads from its spool record and never schedule it.
     owned: bool = True
@@ -172,6 +182,7 @@ class Job:
             "active_s": self.active_s,
             "fence": self.fence,
             "requeues": self.requeues,
+            "trace_id": self.trace_id,
         }
 
 
@@ -258,6 +269,21 @@ class Spool:
     def result_path(self, job_id: str) -> str:
         return os.path.join(self.results_dir, f"{job_id}.npz")
 
+    @staticmethod
+    def normalize_result(result) -> dict:
+        """The ONE result-schema mapping: a ParticleState or a
+        {name: array} dict becomes {name: np.ndarray} (host-fetched).
+        Shared by :meth:`write_result` and the scheduler's background
+        writer (which times the fetch as the ``d2h`` span) so the two
+        can never drift."""
+        if isinstance(result, ParticleState):
+            result = {
+                "positions": result.positions,
+                "velocities": result.velocities,
+                "masses": result.masses,
+            }
+        return {k: np.asarray(v) for k, v in result.items()}
+
     def write_result(
         self, job_id: str, result,
         fence: Optional[int] = None,
@@ -275,16 +301,9 @@ class Spool:
             # died right after the syscall returned — the adoption
             # scan's completed-without-result handling must recover.
             return path
-        if isinstance(result, ParticleState):
-            result = {
-                "positions": result.positions,
-                "velocities": result.velocities,
-                "masses": result.masses,
-            }
+        result = self.normalize_result(result)
         tmp = f"{path}.tmp.{os.getpid()}.npz"
-        np.savez(
-            tmp, **{k: np.asarray(v) for k, v in result.items()}
-        )
+        np.savez(tmp, **result)
         if self.leases is None or fence is None:
             os.replace(tmp, path)
             return path
@@ -331,6 +350,9 @@ class EnsembleScheduler:
         breaker_threshold: int = 3,
         breaker_cooldown_s: float = 30.0,
         reap_interval_s: Optional[float] = None,
+        telemetry: Optional[Telemetry] = None,
+        slo_p99_ms: Optional[float] = None,
+        slo_occupancy: Optional[float] = None,
     ):
         if slots < 1 or slice_steps < 1 or yield_rounds < 1:
             raise ValueError(
@@ -348,6 +370,26 @@ class EnsembleScheduler:
         self.spool = spool
         self.min_bucket = min_bucket
         self.worker_id = worker_id or default_worker_id()
+        # Unified telemetry (docs/observability.md): tracer + typed
+        # metric registry + crash flight recorder, one bundle per
+        # worker. Spool-backed schedulers write spans/dumps under the
+        # spool (shared stream: adoption stitches traces for free);
+        # in-process ones keep the ring in memory only.
+        self.telemetry = telemetry or Telemetry(
+            out_dir=spool.root if spool is not None else None,
+            worker=self.worker_id,
+        )
+        declare_worker_metrics(self.telemetry.registry)
+        # Compile marks from the engine land in the same ring.
+        self.engine.recorder = self.telemetry.recorder
+        # SLO burn flags (--slo-p99-ms / --slo-occupancy): breaches are
+        # edge-triggered slo_breach events + counters, state readable
+        # in /metrics (docs/observability.md "SLO flags").
+        self.slo_p99_ms = slo_p99_ms
+        self.slo_occupancy = slo_occupancy
+        self._slo_burn: dict = {"p99": False, "occupancy": False}
+        self._last_occupancy: Optional[float] = None
+        self._last_adoption_dump = 0.0
         # 0 = unbounded (in-process consumers); the daemon defaults to
         # a bound so backlog sheds instead of growing without limit.
         self.max_queue = max_queue
@@ -359,7 +401,8 @@ class EnsembleScheduler:
         self.leases: Optional[LeaseManager] = None
         if spool is not None:
             self.leases = LeaseManager(
-                spool.root, self.worker_id, ttl_s=lease_ttl_s
+                spool.root, self.worker_id, ttl_s=lease_ttl_s,
+                recorder=self.telemetry.recorder,
             )
             spool.attach_leases(self.leases)
         self._next_scan = 0.0
@@ -405,8 +448,15 @@ class EnsembleScheduler:
         # members complete them (``_check_parents``).
         self._parents: set = set()
         self.rounds_run = 0
+        # Last published metrics snapshot: /metrics serves this when
+        # the round lock is busy (a long compile must not stall
+        # scrapes — docs/observability.md), refreshed at round end and
+        # in housekeeping.
+        self.last_metrics: Optional[dict] = None
+        self._last_metrics_pub = 0.0
         if spool is not None:
             self._respool()
+        self.metrics_snapshot()
 
     # --- submission / lifecycle API ---
 
@@ -450,6 +500,12 @@ class EnsembleScheduler:
                 "parent class instead)"
             )
         params = cls.validate(config, params or {})
+        # Telemetry: the trace is born HERE. The admission span id is
+        # pre-minted so the autotune probe (which may run inside the
+        # batch keying below) can parent its span under it.
+        t_admit = time.time()
+        trace_id = _tracing.new_trace_id()
+        admission_span = _tracing.new_span_id()
         if job_id is not None:
             # The id becomes a file name under jobs/ leases/ results/
             # cancel/ — and arrives over an open HTTP API. Reject
@@ -522,27 +578,33 @@ class EnsembleScheduler:
                         retry_after_s=retry_after)
             raise QueueFull(retry_after, self.queue_depth)
         key = None
-        if resident:
-            key = cls.batch_key(
-                config, params, slots=self.slots,
-                min_bucket=self.min_bucket,
-                reroute=self.breakers.reroute,
-            )
-        else:
-            # Parent classes never enter a batch, but their members
-            # must be servable — key one member now so the whole fan-
-            # out is a submit-time rejection, not N admission failures.
-            from .jobs import get_class as _gc
+        # The bind hands the autotune probe (resolve_engine_backend on
+        # a cache miss) this trace: probe spans + verdict provenance
+        # land in the job's own timeline.
+        with _tracing.bind(self.telemetry.tracer, trace_id,
+                           parent=admission_span):
+            if resident:
+                key = cls.batch_key(
+                    config, params, slots=self.slots,
+                    min_bucket=self.min_bucket,
+                    reroute=self.breakers.reroute,
+                )
+            else:
+                # Parent classes never enter a batch, but their members
+                # must be servable — key one member now so the whole
+                # fan-out is a submit-time rejection, not N admission
+                # failures.
+                from .jobs import get_class as _gc
 
-            _gc("sweep-member").batch_key(
-                config, {"member": 0, **{
-                    k: v for k, v in params.items()
-                    if k in ("spread", "drift_tol", "escape_radius",
-                             "sweep_seed")
-                }},
-                slots=self.slots, min_bucket=self.min_bucket,
-                reroute=self.breakers.reroute,
-            )
+                _gc("sweep-member").batch_key(
+                    config, {"member": 0, **{
+                        k: v for k, v in params.items()
+                        if k in ("spread", "drift_tol", "escape_radius",
+                                 "sweep_seed")
+                    }},
+                    slots=self.slots, min_bucket=self.min_bucket,
+                    reroute=self.breakers.reroute,
+                )
         if deadline_s is not None:
             # Coerce at the boundary: the HTTP API is open, and a
             # string deadline would TypeError inside _expire_deadlines
@@ -558,6 +620,7 @@ class EnsembleScheduler:
             submitted_ts=time.time(),
             job_type=job_type, params=params,
             parent=params.get("parent") if _internal else None,
+            trace_id=trace_id,
         )
         if self.leases is not None:
             lease = self.leases.claim(
@@ -583,6 +646,14 @@ class EnsembleScheduler:
                         priority=priority, job_type=job_type,
                         members=admits)
         self._persist(job)
+        self.telemetry.registry.counter(
+            "gravity_jobs_submitted_total", **{"class": job_type}
+        ).inc()
+        self.telemetry.tracer.emit(
+            "admission", trace_id, t_admit, time.time() - t_admit,
+            span_id=admission_span, job=job_id, job_type=job_type,
+            n=config.n,
+        )
         if not resident:
             # Fan the members out through the normal submit path so
             # every one is an ordinary leased, respoolable, adoptable
@@ -857,11 +928,130 @@ class EnsembleScheduler:
             }
         return out
 
+    def slo_status(self) -> dict:
+        """Current SLO flags + burn state for /metrics."""
+        return {
+            "p99_ms": self.slo_p99_ms,
+            "occupancy": self.slo_occupancy,
+            "burn": dict(self._slo_burn),
+        }
+
+    def metrics_snapshot(self) -> dict:
+        """The full worker metrics view — one dict behind the JSON
+        /metrics payload, the Prometheus exposition's gauge refresh,
+        and the per-worker snapshot file the fleet view aggregates.
+        Stored in ``self.last_metrics`` so the daemon can serve a
+        scrape WITHOUT the round lock while a long compile holds it
+        (satellite contract: a scrape returns within a bound even
+        mid-round)."""
+        reg = self.telemetry.registry
+        reg.gauge("gravity_queue_depth").set(self.queue_depth)
+        reg.gauge("gravity_active_slots").set(self.active_count)
+        breakers = self.breakers.snapshot()
+        for backend, b in breakers.items():
+            reg.gauge("gravity_breaker_open", backend=backend).set(
+                1.0 if b.get("state") == "open" else 0.0
+            )
+        recorder = self.telemetry.recorder
+        snap = {
+            "v": 1,
+            "ts": round(time.time(), 3),
+            "worker_id": self.worker_id,
+            "queue_depth": self.queue_depth,
+            "active": self.active_count,
+            "rounds": self.rounds_run,
+            "occupancy": self._last_occupancy,
+            "latency": self.latency_percentiles(),
+            "classes": self.class_metrics(),
+            "compile_counts": {
+                f"job={k.job_type},bucket={k.bucket_n},"
+                f"slots={k.slots},backend={k.backend}": v
+                for k, v in self.engine.compile_counts.items()
+            },
+            "breakers": breakers,
+            "max_queue": self.max_queue,
+            "leases_held": (
+                len(self.leases.held_ids())
+                if self.leases is not None else 0
+            ),
+            "slo": self.slo_status(),
+            "flightrec": {
+                "entries": len(recorder),
+                "dumps": recorder.dumps,
+                "last_dump": recorder.last_dump_path,
+            },
+            "registry": reg.snapshot(),
+        }
+        self.last_metrics = snap
+        return snap
+
+    def _publish_metrics(self, min_interval_s: float = 1.0) -> None:
+        """Refresh ``last_metrics`` and (spool mode, rate-limited)
+        write it to ``workers/<id>.metrics.json`` — the file the fleet
+        view (`/metrics?fleet=1`, `gravity_tpu fleet-status`) reads
+        for every live worker without having to scrape N HTTP
+        endpoints mid-round."""
+        now = time.time()
+        # Elapsed-since-last-publish, not an absolute deadline: a
+        # caller with a long interval (idle housekeeping at
+        # reap_interval_s) must not suppress a later caller's shorter
+        # one (round end at 1s) — the round-end freshness contract is
+        # "stale by at most ~a round" (review finding).
+        if now - self._last_metrics_pub < min_interval_s:
+            return
+        self._last_metrics_pub = now
+        snap = self.metrics_snapshot()
+        if self.spool is not None:
+            workers_dir = os.path.join(self.spool.root, "workers")
+            path = os.path.join(
+                workers_dir, f"{self.worker_id}.metrics.json"
+            )
+            # Direct tmp+replace (NOT atomic_write_json): that helper
+            # is the torn_spool_write fault-injection point, and a
+            # metrics publish must not consume a chaos token aimed at
+            # job/lease records.
+            tmp = f"{path}.tmp.{os.getpid()}"
+            try:
+                os.makedirs(workers_dir, exist_ok=True)
+                with open(tmp, "w") as f:
+                    f.write(json.dumps(snap))
+                os.replace(tmp, path)
+            except OSError:
+                pass  # metrics publication must never fail serving
+
     # --- internals ---
 
     def _event(self, kind: str, /, **fields) -> None:
         if self.events is not None:
             self.events.event(kind, **fields)
+        # Every serving event also lands in the flight-recorder ring:
+        # a dump is the merged recent history, not one stream's view.
+        self.telemetry.recorder.record("event", event=kind, **fields)
+        if kind == "breaker_open":
+            # A breaker opening is a fleet incident: dump the recent
+            # history at the moment of the first strike-over-threshold
+            # (both the slot-load and the run_slice strike sites land
+            # here).
+            self._dump_flightrec("breaker_open")
+        elif kind == "adopted" and fields.get("from_worker") not in (
+            None, self.worker_id
+        ):
+            # Adopting a dead peer's jobs means a worker just died
+            # unexpectedly — the survivor's ring holds the discovery
+            # sequence (expired lease, claim, respool). One dump per
+            # reaper pass, not one per adopted job.
+            now = time.time()
+            if now - self._last_adoption_dump > 5.0:
+                self._last_adoption_dump = now
+                self._dump_flightrec("adoption")
+
+    def _dump_flightrec(self, reason: str) -> Optional[str]:
+        path = self.telemetry.recorder.dump(reason)
+        if path is not None:
+            self.telemetry.registry.counter(
+                "gravity_flightrec_dumps_total"
+            ).inc()
+        return path
 
     def _persist(self, job: Job) -> bool:
         """Write the job record; False = fencing rejected it (we lost
@@ -906,6 +1096,7 @@ class EnsembleScheduler:
         # behaving like a clean stop).
         spool, events, leases = self.spool, self.events, self.leases
         fence = job.fence if leases is not None else None
+        tracer, trace_id = self.telemetry.tracer, job.trace_id
 
         def _write() -> None:
             # Errors are handled HERE, per job, not left in the
@@ -917,7 +1108,20 @@ class EnsembleScheduler:
             # serves it for this process's lifetime; only a restart
             # loses it (and then respools the job).
             try:
-                path = spool.write_result(job.id, result, fence=fence)
+                # D2H span: fetching the result arrays off the device
+                # is the heavy host half; the spool write is the disk
+                # half — split so the trace shows which one hurt.
+                t_d2h = time.time()
+                fetched = Spool.normalize_result(result)
+                if trace_id:
+                    tracer.emit("d2h", trace_id, t_d2h,
+                                time.time() - t_d2h, job=job.id)
+                t_wr = time.time()
+                path = spool.write_result(job.id, fetched, fence=fence)
+                if trace_id:
+                    tracer.emit("result_write", trace_id, t_wr,
+                                time.time() - t_wr, job=job.id,
+                                fenced=path is None)
             except Exception as e:  # noqa: BLE001
                 try:
                     if events is not None:
@@ -1027,6 +1231,7 @@ class EnsembleScheduler:
         if key not in self._rotation:
             self._rotation.append(key)
         self.jobs[job_id].key_cache = key
+        self.jobs[job_id].queued_ts = time.time()
         self._pending[key].append(job_id)
         # Priority (desc) then submission order: one sort per admission
         # keeps the head of the queue always the next-due job.
@@ -1057,12 +1262,22 @@ class EnsembleScheduler:
             job.job_type, {"completed": 0, "failed": 0, "cancelled": 0}
         )
         counts[status] = counts.get(status, 0) + 1
+        self.telemetry.registry.counter(
+            "gravity_jobs_terminal_total",
+            **{"class": job.job_type, "status": status},
+        ).inc()
         if status == "completed":
             latency = job.finished_ts - job.submitted_ts
             self._completed_latencies.append(latency)
             self._class_latencies.setdefault(
                 job.job_type, deque(maxlen=512)
             ).append(latency)
+            # Bucketed twin of the exact-window percentiles: what the
+            # Prometheus exposition and the fleet merge read.
+            self.telemetry.registry.histogram(
+                "gravity_job_latency_seconds",
+                **{"class": job.job_type},
+            ).observe(latency)
         self._event(
             status if status in ServingEventLogger.KINDS else "failed",
             job=job.id, steps_done=job.steps_done, error=error,
@@ -1089,6 +1304,17 @@ class EnsembleScheduler:
             # the backstop for the rest).
             self._finish(job, "failed", error=f"admission failed: {e}")
             return False
+        # Queue-wait span: enqueue (or last requeue/evict) to now.
+        now = time.time()
+        if job.trace_id and job.queued_ts:
+            self.telemetry.tracer.emit(
+                "queue", job.trace_id, job.queued_ts,
+                now - job.queued_ts, job=job.id,
+            )
+            self.telemetry.registry.histogram(
+                "gravity_queue_wait_seconds"
+            ).observe(now - job.queued_ts)
+        t_load = now
         batch = self._batch_for(key)
         try:
             self._batches[key] = self.engine.load_slot(
@@ -1135,6 +1361,12 @@ class EnsembleScheduler:
                         reason=f"backend {key.backend} unavailable")
             self._persist(job)
             return False
+        if job.trace_id:
+            self.telemetry.tracer.emit(
+                "slot_load", job.trace_id, t_load,
+                time.time() - t_load, job=job.id, slot=slot,
+                bucket=key.bucket_n, backend=key.backend,
+            )
         self._slot_jobs[key][slot] = job.id
         job.status = "running"
         job.resident_rounds = 0
@@ -1312,6 +1544,8 @@ class EnsembleScheduler:
         start_units = {
             slots[s]: self.jobs[slots[s]].steps_done for s in occupied
         }
+        compiles_before = self.engine.compile_counts.get(key, 0)
+        t0_wall = time.time()
         t0 = time.perf_counter()
         try:
             batch, res = self.engine.run_slice(batch, self.slice_steps)
@@ -1337,6 +1571,14 @@ class EnsembleScheduler:
                         failures=self.breakers.get(key.backend).failures,
                         error=str(exc),
                     )
+            # Fatal round error: the batch carry is consumed — dump the
+            # flight recorder before the respool bookkeeping so the
+            # postmortem sees the ring as the crash left it.
+            self.telemetry.recorder.record(
+                "event", event="round_error", bucket=key.bucket_n,
+                backend=key.backend, error=str(exc),
+            )
+            self._dump_flightrec("round_error")
             self._batches.pop(key, None)
             resident = [j for j in self._slot_jobs.pop(key, []) if j]
             for job_id in resident:
@@ -1384,6 +1626,14 @@ class EnsembleScheduler:
         self._last_round_s = round_s
         self._batches[key] = batch
         self.rounds_run += 1
+        compiled = (
+            self.engine.compile_counts.get(key, 0) > compiles_before
+        )
+        reg = self.telemetry.registry
+        reg.counter("gravity_rounds_total").inc()
+        reg.histogram("gravity_round_seconds").observe(round_s)
+        if compiled:
+            reg.counter("gravity_compiles_total").inc()
         if self.breakers.success(key.backend):
             self._event("breaker_closed", backend=key.backend)
 
@@ -1401,6 +1651,24 @@ class EnsembleScheduler:
             job.resident_rounds += 1
             job.active_s += round_s
             real_pairs += cls.pairs_per_unit(job) * advanced
+            if job.trace_id:
+                # One round span per resident job: same interval for
+                # batchmates (they shared the device program), so each
+                # job's own timeline stays gap-free. The first round
+                # of a key carries the trace cost — surfaced as a
+                # child compile span.
+                rid = self.telemetry.tracer.emit(
+                    "round", job.trace_id, t0_wall, round_s,
+                    job=job.id, round=self.rounds_run,
+                    units=advanced, bucket=key.bucket_n,
+                    backend=key.backend, compiled=compiled,
+                )
+                if compiled:
+                    self.telemetry.tracer.emit(
+                        "compile", job.trace_id, t0_wall, round_s,
+                        parent=rid, bucket=key.bucket_n,
+                        backend=key.backend,
+                    )
             if not bool(res.finite[slot]):
                 # Per-slot watchdog: the engine already rolled the lane
                 # back to its round-start state IN-program (run_slice
@@ -1418,6 +1686,9 @@ class EnsembleScheduler:
                           f"(non-finite state; last finite "
                           f"{cls.units[:-1]} {job.steps_done})",
                 )
+                # Divergence postmortem: the failed/round events above
+                # are already in the ring — dump it.
+                self._dump_flightrec("divergence")
             elif job.steps_done >= job.steps:
                 state, extra = self.engine.slot_snapshot(batch, slot)
                 job.extra_state = {**(job.extra_state or {}), **extra}
@@ -1465,8 +1736,39 @@ class EnsembleScheduler:
             ),
             **self.latency_percentiles(),
         }
+        self._last_occupancy = metrics["occupancy"]
+        reg.gauge("gravity_occupancy").set(metrics["occupancy"])
         self._event("round", **metrics)
+        self._check_slo(metrics)
+        self._publish_metrics(min_interval_s=1.0)
         return metrics
+
+    def _check_slo(self, round_metrics: dict) -> None:
+        """Edge-triggered SLO burn: emit one ``slo_breach`` event per
+        healthy->breached transition (and count it), clear the flag on
+        recovery — a breached fleet must not firehose one event per
+        round (docs/observability.md "SLO flags")."""
+        reg = self.telemetry.registry
+        if self.slo_p99_ms is not None:
+            p99 = round_metrics.get("p99_s")
+            burning = p99 is not None and p99 * 1e3 > self.slo_p99_ms
+            if burning and not self._slo_burn["p99"]:
+                reg.counter("gravity_slo_breaches_total",
+                            slo="p99").inc()
+                self._event("slo_breach", slo="p99",
+                            p99_ms=round(p99 * 1e3, 1),
+                            target_ms=self.slo_p99_ms)
+            self._slo_burn["p99"] = burning
+        if self.slo_occupancy is not None:
+            occ = round_metrics.get("occupancy")
+            burning = occ is not None and occ < self.slo_occupancy
+            if burning and not self._slo_burn["occupancy"]:
+                reg.counter("gravity_slo_breaches_total",
+                            slo="occupancy").inc()
+                self._event("slo_breach", slo="occupancy",
+                            occupancy=round(occ, 4),
+                            target=self.slo_occupancy)
+            self._slo_burn["occupancy"] = burning
 
     def run_until_idle(self, max_rounds: int = 100_000) -> int:
         """Drive rounds until every job is terminal; returns rounds run
@@ -1526,6 +1828,9 @@ class EnsembleScheduler:
         self._next_scan = now + self.reap_interval_s
         self._scan_spool()
         self._consume_cancel_markers()
+        # Keep the published snapshot fresh even while idle (an idle
+        # replica still answers /metrics and the fleet view).
+        self._publish_metrics(min_interval_s=self.reap_interval_s)
 
     def _consume_cancel_markers(self) -> None:
         """Execute cross-worker cancel requests for jobs WE own (any
@@ -1627,6 +1932,7 @@ class EnsembleScheduler:
             params=params if isinstance(params, dict) else {},
             parent=record.get("parent"),
             result_payload=record.get("result"),
+            trace_id=record.get("trace_id") or "",
         )
 
     def _register_unowned(self, record: dict, known: Optional[Job]
@@ -1751,6 +2057,15 @@ class EnsembleScheduler:
         if lease is not None:
             job.fence = lease.fence
         adopted_from = getattr(lease, "adopted_from", None)
+        if job.trace_id and adopted_from \
+                and adopted_from != self.worker_id:
+            # Stitch marker: the adopter's first span in the dead
+            # worker's trace (the trace id rode the spool record).
+            now = time.time()
+            self.telemetry.tracer.emit(
+                "adopted", job.trace_id, now, 0.0, job=job_id,
+                from_worker=adopted_from, fence=job.fence,
+            )
         if result_exists:
             # Idempotent adoption: the result already landed (the
             # writer died between the .npz and the record write, or

@@ -80,6 +80,8 @@ class GravityDaemon:
         lease_ttl_s: float = 30.0,
         max_queue: int = 1024,
         max_requeues: int = 5,
+        slo_p99_ms: Optional[float] = None,
+        slo_occupancy: Optional[float] = None,
     ):
         self.spool_dir = spool_dir
         self.host = host
@@ -100,11 +102,17 @@ class GravityDaemon:
             spool=self.spool, worker_id=self.worker_id,
             lease_ttl_s=lease_ttl_s, max_queue=max_queue,
             max_requeues=max_requeues,
+            slo_p99_ms=slo_p99_ms, slo_occupancy=slo_occupancy,
         )
+        self.telemetry = self.scheduler.telemetry
         self.lock = threading.Lock()
         self._stop = threading.Event()
         self._server: Optional[ThreadingHTTPServer] = None
         self._threads: list[threading.Thread] = []
+        # Per-round jax.profiler capture budget (the /profile endpoint;
+        # docs/observability.md "Chip windows"): zero cost while 0.
+        self._profile_rounds = 0
+        self._profile_dir = os.path.join(spool_dir, "profile")
 
     # --- lifecycle ---
 
@@ -136,6 +144,17 @@ class GravityDaemon:
                     return {}
                 return json.loads(self.rfile.read(length) or b"{}")
 
+            def _reply_text(self, code: int, text: str) -> None:
+                body = text.encode()
+                self.send_response(code)
+                self.send_header(
+                    "Content-Type",
+                    "text/plain; version=0.0.4; charset=utf-8",
+                )
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
             def do_GET(self):
                 try:
                     path, _, query = self.path.partition("?")
@@ -143,6 +162,18 @@ class GravityDaemon:
                         kv.split("=", 1)
                         for kv in query.split("&") if "=" in kv
                     )
+                    # Content negotiation on /metrics: Prometheus
+                    # scrapers ask for text/plain (or force it with
+                    # ?format=prometheus); everything else keeps the
+                    # JSON blob.
+                    accept = self.headers.get("Accept", "")
+                    if path == "/metrics" and (
+                        params.get("format") == "prometheus"
+                        or "text/plain" in accept
+                    ):
+                        code, text = daemon.metrics_prometheus(params)
+                        self._reply_text(code, text)
+                        return
                     code, payload = daemon.handle_get(path, params)
                 except Exception as e:  # noqa: BLE001 — API boundary
                     code, payload = 500, {"error": str(e)}
@@ -219,10 +250,24 @@ class GravityDaemon:
                     # replica is exactly the one that must notice a
                     # dead peer's expired leases and adopt its jobs.
                     self.scheduler.housekeeping()
-                    worked = (
-                        self.scheduler.run_round() is not None
-                        if self.scheduler.has_work() else False
-                    )
+                    if not self.scheduler.has_work():
+                        worked = False
+                    elif self._profile_rounds > 0:
+                        # Chip-window capture (POST /profile): wrap
+                        # exactly the requested number of rounds in a
+                        # jax.profiler trace — nothing is paid when
+                        # the budget is zero (the idle steady state).
+                        from ..utils.profiling import trace
+
+                        self._profile_rounds -= 1
+                        with trace(self._profile_dir):
+                            worked = (
+                                self.scheduler.run_round() is not None
+                            )
+                    else:
+                        worked = (
+                            self.scheduler.run_round() is not None
+                        )
             except Exception:  # noqa: BLE001 — keep the daemon alive
                 traceback.print_exc()
                 worked = False
@@ -273,6 +318,13 @@ class GravityDaemon:
         import signal
 
         def _sig(signum, frame):
+            if signum == signal.SIGTERM:
+                # Flight recorder on the way out: SIGTERM is the
+                # preemption path chaos postmortems reconstruct.
+                try:
+                    self.scheduler._dump_flightrec("sigterm")
+                except Exception:  # noqa: BLE001 — never block the stop
+                    pass
             self._stop.set()
 
         for s in (signal.SIGINT, signal.SIGTERM):
@@ -288,6 +340,138 @@ class GravityDaemon:
 
     # --- request handling (shared by HTTP and tests) ---
 
+    def metrics_snapshot(self, timeout: float = 0.25) -> dict:
+        """The /metrics payload, WITHOUT queueing behind a round: try
+        the daemon lock briefly for a fresh snapshot; fall back to the
+        scheduler's last published one when the worker is deep in a
+        long compile (satellite contract: a scrape always returns
+        within ~the timeout, stale by at most a round)."""
+        acquired = self.lock.acquire(timeout=timeout)
+        if acquired:
+            try:
+                snap = self.scheduler.metrics_snapshot()
+            finally:
+                self.lock.release()
+            stale = False
+        else:
+            snap = self.scheduler.last_metrics or {
+                "v": 1, "worker_id": self.worker_id,
+                "queue_depth": self.scheduler.queue_depth,
+                "active": self.scheduler.active_count,
+                "rounds": self.scheduler.rounds_run,
+            }
+            stale = True
+        return {**snap, "stale": stale, "events_path": self.events.path}
+
+    def fleet_metrics(self, timeout: float = 0.25) -> dict:
+        """`/metrics?fleet=1`: every live worker's published snapshot
+        (workers/<id>.metrics.json beside the endpoint registry),
+        aggregated — summed counters/queue depths, bucket-merged
+        latency histograms for honest fleet-wide per-class p50/p95/p99,
+        breaker union, and the SLO burn state
+        (docs/observability.md "Fleet view")."""
+        from ..telemetry import (
+            merge_snapshots,
+            snapshot_quantile,
+        )
+
+        mine = self.metrics_snapshot(timeout=timeout)
+        snaps = {self.worker_id: mine}
+        workers_dir = os.path.join(self.spool_dir, WORKERS_DIR)
+        for info in _live_workers(self.spool_dir):
+            wid = info.get("worker_id")
+            if not wid or wid in snaps:
+                continue
+            rec = read_json_retry(
+                os.path.join(workers_dir, f"{wid}.metrics.json")
+            )
+            if isinstance(rec, dict):
+                snaps[wid] = rec
+        merged = merge_snapshots(
+            [s.get("registry") or {} for s in snaps.values()]
+        )
+        classes: dict = {}
+        for s in snaps.values():
+            for cls, row in (s.get("classes") or {}).items():
+                agg = classes.setdefault(cls, {
+                    "queue_depth": 0, "active": 0, "completed": 0,
+                    "failed": 0, "cancelled": 0,
+                })
+                for k in ("queue_depth", "active", "completed",
+                          "failed", "cancelled"):
+                    agg[k] += row.get(k) or 0
+        for cls, agg in classes.items():
+            agg["latency"] = {
+                f"p{int(q * 100)}_s": snapshot_quantile(
+                    merged, "gravity_job_latency_seconds", q,
+                    **{"class": cls},
+                )
+                for q in (0.5, 0.95, 0.99)
+            }
+        breakers: dict = {}
+        for s in snaps.values():
+            for backend, b in (s.get("breakers") or {}).items():
+                cur = breakers.get(backend)
+                if cur is None or b.get("state") == "open":
+                    breakers[backend] = b
+        occs = [
+            s.get("occupancy") for s in snaps.values()
+            if s.get("occupancy") is not None
+        ]
+        burn = {"p99": False, "occupancy": False}
+        breaches = 0
+        for s in snaps.values():
+            slo = s.get("slo") or {}
+            for k, v in (slo.get("burn") or {}).items():
+                burn[k] = burn.get(k, False) or bool(v)
+        fam = merged.get("gravity_slo_breaches_total") or {}
+        for row in fam.get("series", []):
+            breaches += row.get("value", 0)
+        return {
+            "fleet": True,
+            "workers": sorted(snaps),
+            "worker_snapshots": {
+                wid: {
+                    k: s.get(k)
+                    for k in ("queue_depth", "active", "rounds",
+                              "occupancy", "ts", "stale")
+                }
+                for wid, s in snaps.items()
+            },
+            "queue_depth": sum(
+                s.get("queue_depth") or 0 for s in snaps.values()
+            ),
+            "active": sum(
+                s.get("active") or 0 for s in snaps.values()
+            ),
+            "rounds": sum(
+                s.get("rounds") or 0 for s in snaps.values()
+            ),
+            "occupancy": (
+                sum(occs) / len(occs) if occs else None
+            ),
+            "classes": classes,
+            "breakers": breakers,
+            "slo": {
+                "p99_ms": self.scheduler.slo_p99_ms,
+                "occupancy": self.scheduler.slo_occupancy,
+                "burn": burn,
+                "breaches_total": breaches,
+            },
+            "registry": merged,
+        }
+
+    def metrics_prometheus(self, params: dict) -> tuple[int, str]:
+        """Prometheus text exposition (Accept: text/plain, or
+        ?format=prometheus) — single worker or ?fleet=1 merged."""
+        from ..telemetry import prometheus_text
+
+        if params.get("fleet") in ("1", "true", "yes"):
+            snap = self.fleet_metrics()
+        else:
+            snap = self.metrics_snapshot()
+        return 200, prometheus_text(snap.get("registry") or {})
+
     def handle_get(self, path: str, params: dict) -> tuple[int, dict]:
         if path == "/healthz":
             # Deliberately lock-free: the worker holds the lock through
@@ -301,6 +485,25 @@ class GravityDaemon:
                 "queue_depth": self.scheduler.queue_depth,
                 "active": self.scheduler.active_count,
                 "rounds": self.scheduler.rounds_run,
+            }
+        if path == "/metrics":
+            # Served from a snapshot taken OUTSIDE the round lock: a
+            # long first compile must not stall scrapes.
+            if params.get("fleet") in ("1", "true", "yes"):
+                return 200, self.fleet_metrics()
+            return 200, self.metrics_snapshot()
+        if path == "/flightrec":
+            # On-demand flight-recorder dump (ring has its own lock —
+            # no round-lock contention here either).
+            recorder = self.telemetry.recorder
+            dump_path = None
+            if params.get("dump", "1") not in ("0", "false", "no"):
+                dump_path = self.scheduler._dump_flightrec("request")
+            return 200, {
+                "worker_id": self.worker_id,
+                "entries": len(recorder),
+                "dumps": recorder.dumps,
+                "path": dump_path,
             }
         with self.lock:
             if path == "/status":
@@ -362,32 +565,6 @@ class GravityDaemon:
                         else:
                             payload[k] = arr.tolist()
                 return 200, payload
-            if path == "/metrics":
-                sched = self.scheduler
-                return 200, {
-                    "worker_id": self.worker_id,
-                    "queue_depth": sched.queue_depth,
-                    "active": sched.active_count,
-                    "rounds": sched.rounds_run,
-                    "latency": sched.latency_percentiles(),
-                    # Per-traffic-class health: queue depth, occupancy,
-                    # terminal counts, p50/p99 latency (docs/serving.md
-                    # "Job classes").
-                    "classes": sched.class_metrics(),
-                    "compile_counts": {
-                        f"job={k.job_type},bucket={k.bucket_n},"
-                        f"slots={k.slots},backend={k.backend}": v
-                        for k, v in
-                        sched.engine.compile_counts.items()
-                    },
-                    "breakers": sched.breakers.snapshot(),
-                    "max_queue": sched.max_queue,
-                    "leases_held": (
-                        len(sched.leases.held_ids())
-                        if sched.leases is not None else 0
-                    ),
-                    "events_path": self.events.path,
-                }
         return 404, {"error": f"unknown path {path!r}"}
 
     def _status_any(self, job_id: str) -> Optional[dict]:
@@ -445,6 +622,24 @@ class GravityDaemon:
             with self.lock:
                 ok = self.scheduler.cancel(str(body.get("job")))
             return (200 if ok else 409), {"cancelled": ok}
+        if path == "/profile":
+            # Chip-window profiler toggle: capture the next N rounds
+            # under jax.profiler (docs/observability.md). Zero cost
+            # while the budget is 0 — exactly what ROADMAP item 3's
+            # playbook needs from an idle fleet.
+            try:
+                rounds = int(body.get("rounds", 1))
+            except (TypeError, ValueError):
+                return 400, {"error": "rounds must be an integer"}
+            if rounds < 0:
+                return 400, {"error": "rounds must be >= 0"}
+            out_dir = body.get("dir")
+            if out_dir:
+                self._profile_dir = str(out_dir)
+            self._profile_rounds = rounds
+            return 200, {
+                "profiling_rounds": rounds, "dir": self._profile_dir,
+            }
         if path == "/shutdown":
             self._stop.set()
             return 200, {"stopping": True}

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import contextlib
 import math
+import time as _time
 from functools import partial
 from typing import Optional
 
@@ -1111,14 +1112,18 @@ class Simulator:
         return wrap(state), acc, traj
 
     def _make_host_pipeline(self, trajectory_writer, checkpoint_manager,
-                            enabled: bool):
+                            enabled: bool, telemetry=None,
+                            trace_id: Optional[str] = None):
         """The background-writer half of the host pipeline, shared by
         the fixed-dt and adaptive drivers: returns ``(host_writer,
         trajectory_writer, submit_save)``. With ``enabled`` and any I/O
         consumer present, trajectory records and checkpoint saves route
         through one bounded-queue :class:`~gravity_tpu.utils.hostio.
         HostWriter`; otherwise ``host_writer`` is None and
-        ``submit_save`` saves inline (the serial path)."""
+        ``submit_save`` saves inline (the serial path). With a
+        telemetry bundle attached, every checkpoint save emits a
+        ``checkpoint`` span (timed where it RUNS — on the background
+        thread under the pipeline)."""
         host_writer = None
         if enabled and (
             trajectory_writer is not None or checkpoint_manager is not None
@@ -1132,20 +1137,26 @@ class Simulator:
                     trajectory_writer, host_writer
                 )
 
-        def submit_save(at_step, at_state, extra=None):
+        tracer = telemetry.tracer if telemetry is not None else None
+
+        def _save(at_step, at_state, extra=None):
             from .utils.checkpoint import save_checkpoint
 
+            t0 = _time.time()
+            save_checkpoint(
+                checkpoint_manager, at_step, at_state, extra=extra
+            )
+            if tracer is not None and trace_id is not None:
+                tracer.emit("checkpoint", trace_id, t0,
+                            _time.time() - t0, step=at_step)
+
+        def submit_save(at_step, at_state, extra=None):
             # The background writer runs the SHA-256 payload checksum
             # and the Orbax save off the critical path.
             if host_writer is not None:
-                host_writer.submit(
-                    save_checkpoint, checkpoint_manager, at_step,
-                    at_state, extra=extra,
-                )
+                host_writer.submit(_save, at_step, at_state, extra=extra)
             else:
-                save_checkpoint(
-                    checkpoint_manager, at_step, at_state, extra=extra
-                )
+                _save(at_step, at_state, extra=extra)
 
         return host_writer, trajectory_writer, submit_save
 
@@ -1184,8 +1195,16 @@ class Simulator:
         checkpoint_manager=None,
         metrics_logger=None,
         start_step: int = 0,
+        telemetry=None,
     ) -> dict:
         """Run the configured number of steps; returns a results dict.
+
+        ``telemetry`` (a :class:`~gravity_tpu.telemetry.Telemetry`
+        bundle, CLI: ``--trace``) gives the solo run the serving
+        stack's span structure — per-block ``block`` spans,
+        ``checkpoint`` spans, and flight-recorder dumps on divergence
+        and preemption (docs/observability.md). Adaptive runs take the
+        supervisor's recorder triggers only.
 
         ``config.adaptive`` runs dispatch to :meth:`run_adaptive` — the
         CLI did this already, but a Python-API caller setting
@@ -1201,6 +1220,7 @@ class Simulator:
                 logger, steps=steps, trajectory_writer=trajectory_writer,
                 checkpoint_manager=checkpoint_manager,
                 metrics_logger=metrics_logger, start_step=start_step,
+                telemetry=telemetry,
             )
 
     def _run_impl(
@@ -1212,6 +1232,7 @@ class Simulator:
         checkpoint_manager=None,
         metrics_logger=None,
         start_step: int = 0,
+        telemetry=None,
     ) -> dict:
         config = self.config
         if config.adaptive:
@@ -1249,9 +1270,16 @@ class Simulator:
         pipelined = self._resolve_io_pipeline()
         self.io_pipelined = pipelined
         self.donated = pipelined and donation_supported()
+        tracer = telemetry.tracer if telemetry is not None else None
+        trace_id = None
+        if telemetry is not None:
+            from .telemetry import tracing as _tracing
+
+            trace_id = _tracing.new_trace_id()
         host_writer, trajectory_writer, _save_cadence = (
             self._make_host_pipeline(
-                trajectory_writer, checkpoint_manager, pipelined
+                trajectory_writer, checkpoint_manager, pipelined,
+                telemetry=telemetry, trace_id=trace_id,
             )
         )
 
@@ -1414,10 +1442,27 @@ class Simulator:
                         + (" (checkpoint saved)"
                            if checkpoint_manager is not None else "")
                     )
+                if telemetry is not None:
+                    telemetry.recorder.record(
+                        "event", event="diverged", step=prev_step,
+                        end_step=end_step,
+                    )
+                    telemetry.recorder.dump("divergence")
                 raise SimulationDiverged(prev_step)
             now = timer.mark()
             block_elapsed = now - block_prev
             block_prev = now
+            if tracer is not None:
+                # The solo twin of the serving `round` span: one span
+                # per consumed block (the first one carries the
+                # compile).
+                t_wall = _time.time()
+                tracer.emit(
+                    "block", trace_id, t_wall - block_elapsed,
+                    block_elapsed, steps_from=prev_step + 1,
+                    steps_to=end_step,
+                    compiled=(prev_step == start_step),
+                )
             self.state, self._last_step = bstate, end_step
             if pipelined:
                 last_good = bstate
@@ -1555,6 +1600,18 @@ class Simulator:
             # CONSUMED block — a pipelined run's in-flight block is
             # dropped and re-integrated on resume. The queued cadence
             # saves drain first (Orbax drops out-of-order steps).
+            if telemetry is not None:
+                telemetry.recorder.record(
+                    "event",
+                    event=(
+                        "preempted"
+                        if isinstance(e, SimulationPreempted)
+                        else "interrupted"
+                    ),
+                    step=self._last_step,
+                )
+                if isinstance(e, SimulationPreempted):
+                    telemetry.recorder.dump("sigterm")
             if checkpoint_manager is not None and \
                     self._last_step > start_step:
                 from .utils.checkpoint import save_checkpoint
@@ -1619,6 +1676,8 @@ class Simulator:
         stats["autotune_probe_ms"] = self.autotune["probe_ms"]
         stats["host_gap_frac"] = gap.host_gap_frac
         self.last_host_gap_frac = gap.host_gap_frac
+        if trace_id is not None:
+            stats["trace_id"] = trace_id
         if config.merge_radius > 0.0:
             stats["merged_pairs"] = merged_total
         return self._finish(logger, total_time, total_steps - start_step,

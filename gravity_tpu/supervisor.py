@@ -136,6 +136,7 @@ class RunSupervisor:
         start_step: int = 0,
         start_t: float = 0.0,
         start_comp: float = 0.0,
+        telemetry=None,
     ):
         self.config = config
         self.policy = policy or SupervisorPolicy.from_config(config)
@@ -143,6 +144,10 @@ class RunSupervisor:
         self.events = events
         self.writer = trajectory_writer
         self.metrics = metrics_logger
+        # Telemetry bundle (docs/observability.md): recovery events
+        # mirror into the flight-recorder ring, divergences dump it,
+        # and the main run legs emit block/checkpoint spans.
+        self.telemetry = telemetry
         if checkpoint_manager is None:
             from .utils.checkpoint import make_checkpoint_manager
 
@@ -169,6 +174,13 @@ class RunSupervisor:
         if self.logger is not None:
             detail = " ".join(f"{k}={v}" for k, v in fields.items())
             self.logger.log_print(f"[supervisor] {kind}: {detail}")
+        if self.telemetry is not None:
+            self.telemetry.recorder.record("event", event=kind, **fields)
+            if kind == "diverged":
+                # The solo twin of the serving divergence dump: the
+                # ring already holds the run-up (retries, rollbacks,
+                # degradations).
+                self.telemetry.recorder.dump("divergence")
 
     # --- shared recovery machinery ---
 
@@ -285,6 +297,7 @@ class RunSupervisor:
                         trajectory_writer=self.writer,
                         checkpoint_manager=self.mgr,
                         metrics_logger=self.metrics,
+                        telemetry=self.telemetry,
                     )
                     self.last_sim = sim
                     return self._annotate(stats)

@@ -1,0 +1,82 @@
+"""Unified telemetry: tracing + typed metrics + crash flight recorder.
+
+One per-worker bundle (:class:`Telemetry`) threads through the serving
+stack and the solo run path so every surface shares the same spine:
+
+- **Tracing** (telemetry/tracing.py): per-job trace ids, lifecycle
+  spans as JSONL, Chrome/Perfetto export, cross-worker stitching via
+  the spool record.
+- **Metrics** (telemetry/metrics.py): counter/gauge/histogram registry
+  behind both the JSON ``/metrics`` blob and the Prometheus text
+  exposition, mergeable across workers for the fleet view.
+- **Flight recorder** (telemetry/flightrec.py): bounded ring of recent
+  spans/events dumped atomically on divergence, breaker-open, SIGTERM,
+  fatal round errors, and demand.
+
+See docs/observability.md for the trace model, metric name table, SLO
+flags, and the flight-recorder format.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from .flightrec import FlightRecorder
+from .metrics import (
+    MetricsRegistry,
+    declare_worker_metrics,
+    merge_snapshots,
+    parse_prometheus_text,
+    prometheus_text,
+    snapshot_quantile,
+)
+from .tracing import (
+    SPAN_NAMES,
+    Tracer,
+    bind,
+    chrome_trace,
+    emit_bound,
+    load_spans,
+    new_trace_id,
+    span_coverage,
+    trace_ids,
+)
+
+TRACES_FILE = "traces.jsonl"
+
+
+class Telemetry:
+    """Per-worker telemetry bundle. ``out_dir=None`` keeps everything
+    in memory (no span file, no dump target) — the zero-setup default
+    for in-process schedulers; the daemon and the CLI runs point it at
+    the spool/log directory."""
+
+    def __init__(
+        self,
+        out_dir: Optional[str] = None,
+        worker: Optional[str] = None,
+        capacity: int = 512,
+        trace_path: Optional[str] = None,
+    ):
+        self.out_dir = out_dir
+        self.worker = worker or f"pid-{os.getpid()}"
+        self.recorder = FlightRecorder(
+            capacity=capacity, out_dir=out_dir, worker=self.worker
+        )
+        self.registry = MetricsRegistry()
+        if trace_path is None and out_dir is not None:
+            trace_path = os.path.join(out_dir, TRACES_FILE)
+        self.tracer = Tracer(
+            trace_path, worker=self.worker, recorder=self.recorder
+        )
+
+
+__all__ = [
+    "FlightRecorder", "MetricsRegistry", "SPAN_NAMES", "TRACES_FILE",
+    "Telemetry", "Tracer", "bind", "chrome_trace",
+    "declare_worker_metrics", "emit_bound", "load_spans",
+    "merge_snapshots", "new_trace_id", "parse_prometheus_text",
+    "prometheus_text", "snapshot_quantile", "span_coverage",
+    "trace_ids",
+]

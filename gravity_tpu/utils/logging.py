@@ -23,7 +23,12 @@ import numpy as np
 
 
 class RunLogger:
-    """Mirrors messages to stdout and a timestamped log file."""
+    """Mirrors messages to stdout and a timestamped log file, plus a
+    machine-readable JSONL sidecar (``<prefix>_<ts>.jsonl``) of the
+    structured sections — banner, progress, performance, completion —
+    on the shared :class:`JsonlEventLogger` spine (the text log stays
+    byte-comparable with the reference; the sidecar is what dashboards
+    and tests read)."""
 
     def __init__(
         self,
@@ -31,6 +36,7 @@ class RunLogger:
         prefix: str = "simulation_log",
         quiet: bool = False,
         timestamp: Optional[str] = None,
+        jsonl: bool = True,
     ):
         os.makedirs(log_dir, exist_ok=True)
         self.timestamp = timestamp or datetime.datetime.now().strftime(
@@ -38,6 +44,16 @@ class RunLogger:
         )
         self.path = os.path.join(log_dir, f"{prefix}_{self.timestamp}.txt")
         self.quiet = quiet
+        self.events: Optional[RunEventLogger] = (
+            RunEventLogger(
+                os.path.join(log_dir, f"{prefix}_{self.timestamp}.jsonl")
+            )
+            if jsonl else None
+        )
+
+    def _emit(self, kind: str, /, **fields) -> None:
+        if self.events is not None:
+            self.events.event(kind, **fields)
 
     def log_print(self, message: str) -> None:
         if not self.quiet:
@@ -64,9 +80,16 @@ class RunLogger:
             f"Force backend: {backend} | Sharding: {sharding} | Dtype: {dtype}"
         )
         self.log_print("")
+        self._emit(
+            "banner", num_devices=num_devices,
+            num_particles=num_particles, steps=steps, dt=dt,
+            model=model, integrator=integrator, backend=backend,
+            sharding=sharding, dtype=dtype,
+        )
 
     def progress(self, step: int, total_steps: int) -> None:
         self.log_print(f"Step {step}/{total_steps}")
+        self._emit("progress", step=step, total_steps=total_steps)
 
     def performance(self, total_time: float, steps: int,
                     pairs_per_sec: Optional[float] = None) -> None:
@@ -79,6 +102,11 @@ class RunLogger:
             self.log_print(
                 f"Pair interactions per second: {pairs_per_sec:.4e}"
             )
+        self._emit(
+            "performance", total_time_s=total_time, steps=steps,
+            avg_step_s=total_time / max(steps, 1),
+            pairs_per_sec=pairs_per_sec,
+        )
 
     def final_positions(self, positions, max_particles: int = 10) -> None:
         positions = np.asarray(positions)
@@ -94,18 +122,27 @@ class RunLogger:
 
     def completed(self) -> None:
         self.log_print("\nSimulation completed successfully")
+        self._emit("completed")
 
 
 class JsonlEventLogger:
-    """Append-only JSONL stream of structured events.
+    """Append-only JSONL stream of structured events — THE emission
+    spine every stream in the repo shares (recovery events, serving
+    events, per-block metrics, the run log's JSON sidecar, trace
+    spans), so one tooling path reads them all.
 
-    One JSON object per line: ``{"ts": <unix seconds>, "event": <kind>,
-    ...}`` with ``kind`` restricted to the subclass's ``KINDS`` —
-    the streams are audit trails consumers filter by kind, so a typo
-    must fail the writer, not silently vanish downstream.
+    One JSON object per line: ``{"v": <schema version>, "ts": <unix
+    seconds>, "event": <kind>, ...}`` with ``kind`` restricted to the
+    subclass's ``KINDS`` — the streams are audit trails consumers
+    filter by kind, so a typo must fail the writer, not silently
+    vanish downstream. ``ts``/``v`` are stamped HERE so the timestamp
+    key can never drift between streams again (the pre-unification
+    emitters disagreed: serving events carried ``ts``, block metrics
+    only a relative ``wall_s``, the run log no timestamp at all).
     """
 
     KINDS: tuple = ()
+    SCHEMA_VERSION = 1
 
     def __init__(self, path: str, context: Optional[dict] = None):
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
@@ -122,6 +159,7 @@ class JsonlEventLogger:
                 f"unknown event kind {kind!r}; one of {self.KINDS}"
             )
         record = {
+            "v": self.SCHEMA_VERSION,
             "ts": round(time.time(), 3), "event": kind,
             **self.context, **fields,
         }
@@ -136,6 +174,13 @@ class JsonlEventLogger:
             return []
         with open(self.path) as f:
             return [json.loads(line) for line in f if line.strip()]
+
+
+class RunEventLogger(JsonlEventLogger):
+    """The run log's structured sidecar: the banner/progress/perf
+    sections as events on the shared spine (docs/observability.md)."""
+
+    KINDS = ("banner", "progress", "performance", "completed")
 
 
 class RecoveryEventLogger(JsonlEventLogger):
@@ -169,6 +214,11 @@ class ServingEventLogger(JsonlEventLogger):
     in-program detector crossing its radius raises them with the job,
     global step, pair, and distance; the follow-up kind records the
     auto-submitted high-resolution zoom-in job.
+
+    ``slo_breach`` is the telemetry layer's SLO burn signal
+    (docs/observability.md "SLO flags"): edge-triggered when the
+    worker's p99 latency crosses ``--slo-p99-ms`` or round occupancy
+    falls below ``--slo-occupancy``.
     """
 
     KINDS = (
@@ -177,4 +227,5 @@ class ServingEventLogger(JsonlEventLogger):
         "adopted", "fenced", "breaker_open", "breaker_closed",
         "shed", "poisoned",
         "encounter", "merger", "followup_submitted",
+        "slo_breach",
     )

@@ -21,13 +21,14 @@ sanitizer anywhere. The TPU replacements:
 from __future__ import annotations
 
 import contextlib
-import json
 import os
 import time
 from typing import Optional
 
 import jax
 import numpy as np
+
+from .logging import JsonlEventLogger
 
 
 @contextlib.contextmanager
@@ -53,8 +54,13 @@ def device_memory_stats() -> list[dict]:
     return out
 
 
-class MetricsLogger:
-    """Append-only JSONL metrics stream.
+class MetricsLogger(JsonlEventLogger):
+    """Per-block metrics stream on the shared JSONL event spine
+    (utils/logging.JsonlEventLogger): every record is an
+    ``event="block"`` line with the spine's ``ts`` + schema-version
+    stamp — the same timestamp key as the recovery/serving streams
+    (the pre-unification stream only had a relative ``wall_s``, which
+    is kept alongside for block-delta math).
 
     Per-block records carry ``step``, ``block_steps``, ``block_s``, and
     a pair rate whose KEY is honest about what was computed
@@ -69,21 +75,20 @@ class MetricsLogger:
     ``host_gap_frac`` in the run stats for the device-idle picture.
     """
 
+    KINDS = ("block",)
+
     def __init__(self, path: str):
-        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-        self.path = path
+        super().__init__(path)
         self._start = time.perf_counter()
 
     def log(self, **metrics) -> None:
-        record = {"wall_s": time.perf_counter() - self._start, **metrics}
-        with open(self.path, "a") as f:
-            f.write(json.dumps(record, default=float) + "\n")
-
-    def read(self) -> list[dict]:
-        if not os.path.exists(self.path):
-            return []
-        with open(self.path) as f:
-            return [json.loads(line) for line in f if line.strip()]
+        clean = {
+            k: (v.item() if hasattr(v, "item") else v)
+            for k, v in metrics.items()
+        }
+        self.event(
+            "block", wall_s=time.perf_counter() - self._start, **clean
+        )
 
 
 def debug_check_forces(

@@ -1,0 +1,50 @@
+#!/usr/bin/env python
+"""Perf-trend table over the accumulated per-round bench artifacts.
+
+Folds ``BENCH_r*.json`` / ``MULTICHIP_r*.json`` (written by the round
+driver at the repo root) into one table — steps/s, pairs/s, MFU,
+host_gap_frac per round — so the perf trajectory is readable without
+hand-diffing JSON. Thin wrapper over :mod:`gravity_tpu.bench`; the
+same table is ``gravity_tpu bench --report``.
+
+Usage::
+
+    python scripts/bench_report.py [--root DIR] [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="bench round trend report"
+    )
+    parser.add_argument("--root", default=".",
+                        help="directory holding BENCH_r*/MULTICHIP_r* "
+                             "JSON files (default: .)")
+    parser.add_argument("--json", action="store_true",
+                        help="emit the structured rows instead of the "
+                             "table")
+    args = parser.parse_args(argv)
+    # Import here so --help works without jax on the path.
+    from gravity_tpu.bench import collect_bench_rounds, format_bench_report
+
+    data = collect_bench_rounds(args.root)
+    if args.json:
+        print(json.dumps(data, indent=2))
+    else:
+        print(format_bench_report(data))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

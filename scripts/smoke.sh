@@ -4,16 +4,18 @@
 # host-pipeline e2e (cadence run + SIGTERM + resume), the autotune
 # cache round-trip (probe-on-miss, instant-on-hit), the serving
 # chaos harness (2 workers, injected kill -9 mid-round, all jobs
-# complete with solo parity — scripts/chaos.sh), and the job-class
+# complete with solo parity — scripts/chaos.sh), the job-class
 # e2e (one fit + one sweep through the live daemon with solo parity),
-# all on CPU. Exits nonzero on any failure. ~10 min on a laptop-class
-# CPU.
+# and the unified-telemetry stage (strict Prometheus scrape of the
+# live daemon + a Perfetto trace export whose spans cover the job's
+# e2e latency — docs/observability.md), all on CPU. Exits nonzero on
+# any failure. ~10 min on a laptop-class CPU.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 export JAX_PLATFORMS=cpu
 
-echo "== smoke 1/6: pytest -m 'fast and not slow and not heavy' (contract + oracle-parity lane) =="
+echo "== smoke 1/7: pytest -m 'fast and not slow and not heavy' (contract + oracle-parity lane) =="
 # "fast and not slow and not heavy": module-level fast marks would
 # otherwise pull a file's slow-marked wall-clock tests into the lane
 # (pytest -m fast selects anything CARRYING the mark; it does not
@@ -22,7 +24,7 @@ echo "== smoke 1/6: pytest -m 'fast and not slow and not heavy' (contract + orac
 # item 5).
 python -m pytest tests/ -q -m "fast and not slow and not heavy" -p no:cacheprovider
 
-echo "== smoke 2/6: 2-job ensemble serving e2e (CLI daemon) =="
+echo "== smoke 2/7: 2-job ensemble serving e2e (CLI daemon) =="
 SPOOL="$(mktemp -d /tmp/gravity_smoke.XXXXXX)"
 cleanup() {
     # Best-effort daemon shutdown + spool removal.
@@ -75,7 +77,7 @@ print("ensemble e2e OK:", {j: s["status"] for j, s in statuses.items()},
       "| compiles:", metrics["compile_counts"])
 EOF
 
-echo "== smoke 3/6: async host pipeline e2e (cadence run + SIGTERM + resume) =="
+echo "== smoke 3/7: async host pipeline e2e (cadence run + SIGTERM + resume) =="
 IODIR="$(mktemp -d /tmp/gravity_smoke_io.XXXXXX)"
 trap 'cleanup; rm -rf "$IODIR"' EXIT
 # Cadence-on pipelined run; preempt@500 delivers a real SIGTERM to the
@@ -111,7 +113,7 @@ print("io-pipeline e2e OK: resumed", stats["steps"], "steps,",
       "host_gap_frac", round(stats["host_gap_frac"], 3))
 EOF
 
-echo "== smoke 4/6: autotune cache round-trip (probe-on-miss, instant-on-hit) =="
+echo "== smoke 4/7: autotune cache round-trip (probe-on-miss, instant-on-hit) =="
 TUNEDIR="$(mktemp -d /tmp/gravity_smoke_tune.XXXXXX)"
 trap 'cleanup; rm -rf "$IODIR" "$TUNEDIR"' EXIT
 # Fresh cache dir + lowered fast-probe floor so plain `auto` runs a
@@ -148,10 +150,10 @@ print("autotune round-trip OK: backend", s1["backend"],
       "| probe", round(s1["autotune_probe_ms"], 1), "ms -> hit 0 ms")
 EOF
 
-echo "== smoke 5/6: serving chaos harness (kill -9 + adoption + fencing) =="
+echo "== smoke 5/7: serving chaos harness (kill -9 + adoption + fencing) =="
 bash scripts/chaos.sh
 
-echo "== smoke 6/6: job classes through the CLI daemon (fit + sweep) =="
+echo "== smoke 6/7: job classes through the CLI daemon (fit + sweep) =="
 # One fit + one sweep submitted through the REAL daemon from stage 2
 # (still serving), asserting completion + served-vs-solo parity
 # (docs/serving.md "Job classes").
@@ -260,5 +262,50 @@ import numpy as np, sys
 z = np.load(sys.argv[1])
 assert 'min_sep' in z.files and len(z['min_sep']) == 4, z.files
 " "$SPOOL/sweep_verdicts.npz"
+
+echo "== smoke 7/7: unified telemetry (Prometheus scrape + Perfetto trace export) =="
+# Against the STILL-LIVE stage-2 daemon: (a) a text/plain /metrics
+# scrape must be valid Prometheus exposition (validated by the strict
+# parser the tests use) including per-class latency histograms and
+# occupancy; (b) one stage-2 job's trace must export to a loadable
+# Chrome/Perfetto JSON whose top-level spans cover >=90% of the job's
+# end-to-end latency (the ISSUE-8 acceptance bound).
+python - "$SPOOL" <<'PYEOF'
+import sys, urllib.request
+from gravity_tpu.serve import request
+from gravity_tpu.serve.service import find_daemon
+from gravity_tpu.telemetry import parse_prometheus_text
+
+spool = sys.argv[1]
+host, port = find_daemon(spool)
+req = urllib.request.Request(f"http://{host}:{port}/metrics",
+                             headers={"Accept": "text/plain"})
+text = urllib.request.urlopen(req, timeout=30).read().decode()
+parsed = parse_prometheus_text(text)  # strict: raises on bad exposition
+for name in ("gravity_rounds_total", "gravity_jobs_terminal_total",
+             "gravity_job_latency_seconds", "gravity_occupancy",
+             "gravity_compiles_total"):
+    assert name in parsed, name
+fleet = request(spool, "GET", "/metrics?fleet=1")
+assert fleet["fleet"], fleet
+assert fleet["classes"]["integrate"]["latency"]["p99_s"] is not None
+print("prometheus + fleet OK:", len(parsed), "metric families")
+PYEOF
+
+python -m gravity_tpu trace-export --spool-dir "$SPOOL" "$JOB1" \
+    --out "$SPOOL/job1.trace.json" | tee "$SPOOL/texp.out"
+python - "$SPOOL" <<'PYEOF'
+import json, sys
+spool = sys.argv[1]
+summary = json.loads(open(f"{spool}/texp.out").read())
+doc = json.load(open(f"{spool}/job1.trace.json"))
+events = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+assert events, "empty perfetto trace"
+names = {e["name"] for e in events}
+assert {"admission", "round"} <= names, names
+assert summary["coverage"] is not None and summary["coverage"] >= 0.9, \
+    summary
+print("perfetto export OK:", summary)
+PYEOF
 
 echo "== smoke: all green =="

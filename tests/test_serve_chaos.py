@@ -190,6 +190,40 @@ def test_two_worker_kill9_chaos_e2e(tmp_path, faults):
             )))
             assert rec["fence"] == e["fence"] >= 2
 
+        # ISSUE 8 telemetry acceptance: adopted jobs stitch into ONE
+        # trace — the trace id rode the spool record, so the dead
+        # worker's spans (admission on worker-a) and the survivor's
+        # (adopted marker + rounds on worker-b) share it in the shared
+        # spool traces.jsonl.
+        from gravity_tpu.telemetry import load_spans
+
+        spans = load_spans(os.path.join(spool_dir, "traces.jsonl"))
+        for e in adopted:
+            rec = json.load(open(os.path.join(
+                spool_dir, "jobs", f"{e['job']}.json"
+            )))
+            tr = rec["trace_id"]
+            tr_spans = [s for s in spans if s.get("trace") == tr]
+            workers = {s.get("worker") for s in tr_spans}
+            assert workers == {"worker-a", "worker-b"}, (
+                e["job"], workers
+            )
+            names = [s["name"] for s in tr_spans]
+            assert "adopted" in names, names
+            # Contiguous single trace: the survivor's round spans and
+            # the dead worker's admission live under one id, ordered.
+            assert "admission" in names and "round" in names, names
+
+        # The kill also produced a flight-recorder dump ON THE
+        # SURVIVOR (reason: adoption) — the postmortem artifact the
+        # ISSUE-8 acceptance names.
+        dumps = [f for f in os.listdir(spool_dir)
+                 if f.startswith("flightrec_worker-b_")]
+        assert dumps, os.listdir(spool_dir)
+        reasons = {json.load(open(os.path.join(spool_dir, f)))["reason"]
+                   for f in dumps}
+        assert "adoption" in reasons, reasons
+
         # Breaker visibility segment: with pallas injected down in the
         # surviving worker, a pallas job opens the breaker and degrades
         # to an exact-physics rung — breaker events land in the same

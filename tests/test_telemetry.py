@@ -1,0 +1,466 @@
+"""Unified telemetry (docs/observability.md): typed metric registry +
+Prometheus exposition, end-to-end job tracing with Perfetto export,
+the crash flight recorder, SLO burn events, the lock-free /metrics
+snapshot contract, the unified JSONL emitter spine, and the docs lint
+that pins every emitted event/metric name to docs/observability.md.
+"""
+
+import json
+import math
+import os
+import time
+
+import numpy as np
+import pytest
+
+from gravity_tpu.config import SimulationConfig
+from gravity_tpu.serve import EnsembleScheduler, GravityDaemon, request, wait_for
+from gravity_tpu.telemetry import (
+    FlightRecorder,
+    MetricsRegistry,
+    Telemetry,
+    chrome_trace,
+    declare_worker_metrics,
+    load_spans,
+    merge_snapshots,
+    parse_prometheus_text,
+    prometheus_text,
+    snapshot_quantile,
+    span_coverage,
+)
+from gravity_tpu.telemetry.metrics import WORKER_METRICS, Histogram
+
+
+def _cfg(n, steps=30, **kw):
+    kw.setdefault("model", "random")
+    kw.setdefault("dt", 3600.0)
+    kw.setdefault("integrator", "leapfrog")
+    kw.setdefault("force_backend", "dense")
+    return SimulationConfig(n=n, steps=steps, **kw)
+
+
+# --- metrics registry ---
+
+
+@pytest.mark.fast
+def test_histogram_bucket_correctness():
+    h = Histogram(buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.1, 0.5, 2.0, 100.0):
+        h.observe(v)
+    # Bucket semantics: (lo, le] — 0.1 lands in the le=0.1 bucket.
+    assert h.counts == [2, 1, 1, 1]
+    assert h.count == 5
+    assert h.sum == pytest.approx(102.65)
+    # Quantiles interpolate inside the winning bucket; the +Inf bucket
+    # clamps to the top finite bound.
+    assert 0.0 < h.quantile(0.2) <= 0.1
+    assert 0.1 < h.quantile(0.5) <= 1.0
+    assert h.quantile(0.999) == 10.0
+    assert Histogram(buckets=(1.0,)).quantile(0.5) is None
+
+
+@pytest.mark.fast
+def test_prometheus_exposition_strict_parse():
+    reg = MetricsRegistry()
+    declare_worker_metrics(reg)
+    reg.counter("gravity_rounds_total").inc(3)
+    reg.gauge("gravity_queue_depth").set(7)
+    reg.counter("gravity_jobs_terminal_total",
+                **{"class": "integrate", "status": "completed"}).inc()
+    h = reg.histogram("gravity_job_latency_seconds",
+                      **{"class": "integrate"})
+    for v in (0.01, 0.2, 3.0):
+        h.observe(v)
+    text = reg.prometheus_text()
+    parsed = parse_prometheus_text(text)
+    assert parsed["gravity_rounds_total"]["type"] == "counter"
+    samples = parsed["gravity_rounds_total"]["samples"]
+    assert list(samples.values()) == [3.0]
+    # Histogram invariants validated by the strict parser (monotone
+    # cumulative buckets, +Inf == _count) — and the values round-trip.
+    hist = parsed["gravity_job_latency_seconds"]["samples"]
+    count = hist[("gravity_job_latency_seconds_count",
+                  (("class", "integrate"),))]
+    assert count == 3.0
+    inf_bucket = hist[("gravity_job_latency_seconds_bucket",
+                       (("class", "integrate"), ("le", "+Inf")))]
+    assert inf_bucket == 3.0
+
+
+@pytest.mark.fast
+def test_prometheus_parser_rejects_malformed():
+    with pytest.raises(ValueError):
+        parse_prometheus_text("no_type_line 1\n")
+    with pytest.raises(ValueError):
+        parse_prometheus_text(
+            "# TYPE x counter\nx{bad-label=\"1\"} 1\n"
+        )
+    # Non-monotone buckets must fail.
+    bad = (
+        "# TYPE h histogram\n"
+        'h_bucket{le="1"} 5\nh_bucket{le="+Inf"} 3\n'
+        "h_sum 1\nh_count 3\n"
+    )
+    with pytest.raises(ValueError, match="monotone"):
+        parse_prometheus_text(bad)
+
+
+@pytest.mark.fast
+def test_fleet_merge_and_quantiles():
+    regs = []
+    for latencies in ((0.01, 0.02), (5.0, 8.0)):
+        reg = MetricsRegistry()
+        reg.counter("gravity_rounds_total").inc(2)
+        h = reg.histogram("gravity_job_latency_seconds",
+                          **{"class": "fit"})
+        for v in latencies:
+            h.observe(v)
+        regs.append(reg.snapshot())
+    merged = merge_snapshots(regs)
+    rounds = merged["gravity_rounds_total"]["series"][0]["value"]
+    assert rounds == 4
+    p99 = snapshot_quantile(
+        merged, "gravity_job_latency_seconds", 0.99, **{"class": "fit"}
+    )
+    # Across both workers the tail sits in the slow worker's bucket.
+    assert p99 is not None and p99 > 2.5
+    # Merged snapshot still renders + parses as valid exposition.
+    parse_prometheus_text(prometheus_text(merged))
+
+
+@pytest.mark.fast
+def test_fleet_merge_gauge_semantics():
+    """Non-additive gauges must not sum fleet-wide: occupancy (a 0..1
+    ratio) averages, breaker_open (a 0/1 state) takes the max; totals
+    like queue depth still sum (review finding)."""
+    snaps = []
+    for occ, brk, depth in ((0.8, 1.0, 3), (0.9, 0.0, 5)):
+        reg = MetricsRegistry()
+        reg.gauge("gravity_occupancy").set(occ)
+        reg.gauge("gravity_breaker_open", backend="pallas").set(brk)
+        reg.gauge("gravity_queue_depth").set(depth)
+        snaps.append(reg.snapshot())
+    merged = merge_snapshots(snaps)
+
+    def val(name):
+        return merged[name]["series"][0]["value"]
+
+    assert val("gravity_occupancy") == pytest.approx(0.85)
+    assert val("gravity_breaker_open") == 1.0
+    assert val("gravity_queue_depth") == 8
+
+
+# --- flight recorder ---
+
+
+@pytest.mark.fast
+def test_flight_recorder_ring_and_dump(tmp_path):
+    rec = FlightRecorder(capacity=4, out_dir=str(tmp_path), worker="w0")
+    for i in range(10):
+        rec.record("event", event="round", i=i)
+    assert len(rec) == 4  # bounded
+    path = rec.dump("request")
+    assert path and os.path.basename(path).startswith("flightrec_w0_")
+    doc = json.load(open(path))
+    assert doc["reason"] == "request" and doc["v"] == 1
+    assert [e["i"] for e in doc["entries"]] == [6, 7, 8, 9]
+    # No out_dir -> no dump, no crash.
+    assert FlightRecorder(out_dir=None).dump("request") is None
+
+
+def test_flightrec_dump_on_injected_divergence(tmp_path):
+    """A diverging slot (overflow dt) triggers an automatic flight-
+    recorder dump whose ring holds the run-up events."""
+    tele = Telemetry(out_dir=str(tmp_path), worker="div-w")
+    sched = EnsembleScheduler(slots=2, slice_steps=10, telemetry=tele)
+    bad = sched.submit(_cfg(10, steps=30, seed=7, dt=1e30))
+    sched.run_until_idle()
+    assert sched.status(bad)["status"] == "failed"
+    dumps = [f for f in os.listdir(tmp_path)
+             if f.startswith("flightrec_")]
+    assert dumps, os.listdir(tmp_path)
+    doc = json.load(open(tmp_path / sorted(dumps)[-1]))
+    assert doc["reason"] == "divergence"
+    kinds = {e.get("event") for e in doc["entries"]}
+    assert "failed" in kinds and "submitted" in kinds
+
+
+# --- tracing ---
+
+
+def test_job_trace_spans_and_export(tmp_path):
+    """An in-process scheduler job yields a full span set; the Chrome
+    export is loadable and the top-level spans cover ~all of the job's
+    end-to-end latency (the acceptance-gate shape)."""
+    tele = Telemetry(out_dir=str(tmp_path), worker="tr-w")
+    sched = EnsembleScheduler(slots=2, slice_steps=10, telemetry=tele)
+    jid = sched.submit(_cfg(10, steps=30, seed=3))
+    t0 = time.time()
+    sched.run_until_idle()
+    wall = time.time() - t0
+    job = sched.jobs[jid]
+    assert job.status == "completed"
+    spans = load_spans(str(tmp_path / "traces.jsonl"))
+    names = [s["name"] for s in spans if s["trace"] == job.trace_id]
+    for expected in ("admission", "queue", "slot_load", "round",
+                     "compile"):
+        assert expected in names, names
+    cov = span_coverage(spans, job.trace_id)
+    # Top-level spans must account for the job's latency (no spool ->
+    # no d2h/result_write tail here; rounds dominate).
+    assert cov["coverage"] is not None and cov["coverage"] > 0.5
+    assert cov["wall_s"] == pytest.approx(wall, abs=2.0)
+    doc = chrome_trace(spans, job.trace_id)
+    events = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    assert events and all(
+        set(e) >= {"name", "ts", "dur", "pid", "tid"} for e in events
+    )
+    # json round-trip: Perfetto loads strict JSON.
+    json.loads(json.dumps(doc))
+
+
+@pytest.mark.fast
+def test_autotune_probe_span_bound(tmp_path, monkeypatch):
+    """A cache-miss probe emits its span (verdict provenance) into
+    whatever trace is bound at resolve time."""
+    import gravity_tpu.autotune as at
+    from gravity_tpu.telemetry import bind, new_trace_id
+    from gravity_tpu.simulation import make_initial_state
+
+    monkeypatch.setenv("GRAVITY_TPU_TUNE_DIR", str(tmp_path / "cache"))
+    monkeypatch.setenv("GRAVITY_TPU_AUTOTUNE_MIN_N", "16")
+    tele = Telemetry(out_dir=str(tmp_path), worker="at-w")
+    cfg = _cfg(64, steps=4, force_backend="auto")
+    state = make_initial_state(cfg)
+    tr = new_trace_id()
+    with bind(tele.tracer, tr):
+        decision = at.resolve_backend_measured(
+            cfg, state, candidates=("dense", "chunked"),
+            occupancy="test",
+        )
+    assert decision.cache == "miss"
+    spans = [s for s in load_spans(str(tmp_path / "traces.jsonl"))
+             if s["trace"] == tr]
+    assert [s["name"] for s in spans] == ["autotune_probe"]
+    assert spans[0]["winner"] == decision.backend
+    assert spans[0]["cache"] == "miss"
+    # Hit path emits provenance too.
+    with bind(tele.tracer, tr):
+        d2 = at.resolve_backend_measured(
+            cfg, state, candidates=("dense", "chunked"),
+            occupancy="test",
+        )
+    assert d2.cache == "hit"
+    spans = [s for s in load_spans(str(tmp_path / "traces.jsonl"))
+             if s["trace"] == tr]
+    assert spans[-1]["cache"] == "hit"
+
+
+# --- unified JSONL spine ---
+
+
+@pytest.mark.fast
+def test_jsonl_streams_share_schema_and_timestamp_key(tmp_path):
+    """Satellite: the three emitters (block metrics, run-log sidecar,
+    serving events) all ride JsonlEventLogger — every record carries
+    the same ``ts`` key and the shared schema version ``v``."""
+    from gravity_tpu.utils.logging import RunLogger, ServingEventLogger
+    from gravity_tpu.utils.profiling import MetricsLogger
+
+    ml = MetricsLogger(str(tmp_path / "metrics.jsonl"))
+    ml.log(step=5, block_steps=5, block_s=0.1)
+    rl = RunLogger(str(tmp_path / "logs"), quiet=True)
+    rl.progress(1, 10)
+    rl.completed()
+    se = ServingEventLogger(str(tmp_path / "serving.jsonl"))
+    se.event("submitted", job="j1", n=8)
+    streams = {
+        "metrics": ml.read(),
+        "run_sidecar": rl.events.read(),
+        "serving": se.read(),
+    }
+    for name, records in streams.items():
+        assert records, name
+        for r in records:
+            assert r["v"] == 1, (name, r)
+            assert isinstance(r["ts"], float), (name, r)
+            assert "event" in r, (name, r)
+    assert streams["metrics"][0]["event"] == "block"
+    assert streams["run_sidecar"][0]["event"] == "progress"
+
+
+# --- daemon surfaces ---
+
+
+@pytest.mark.heavy
+def test_daemon_metrics_scrape_fast_while_round_stalled(tmp_path, faults):
+    """Satellite contract: /metrics is served from a snapshot outside
+    the round lock — a scrape during a stalled (in-flight) round
+    returns within a bound instead of queueing behind it."""
+    faults("stall_worker@1x3")
+    d = GravityDaemon(str(tmp_path / "spool"), slots=2, slice_steps=10,
+                      idle_sleep_s=0.01)
+    host, port = d.start()
+    try:
+        spool = d.spool_dir
+        r = request(spool, "POST", "/submit", {
+            "config": json.loads(_cfg(8, steps=200).to_json()),
+        })
+        # Wait until the worker is inside the stalled round (round 1
+        # stalls 3s while holding the daemon lock).
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if request(spool, "GET", "/healthz")["rounds"] >= 1:
+                break
+            time.sleep(0.02)
+        t0 = time.monotonic()
+        m = request(spool, "GET", "/metrics", timeout=10)
+        elapsed = time.monotonic() - t0
+        assert elapsed < 1.5, elapsed
+        assert m["worker_id"] == d.worker_id
+        # The job must still complete after the stall.
+        wait_for(spool, [r["job"]], timeout=120)
+    finally:
+        d.stop()
+
+
+@pytest.mark.heavy
+def test_daemon_prometheus_fleet_and_flightrec(tmp_path):
+    d = GravityDaemon(str(tmp_path / "spool"), slots=2, slice_steps=10,
+                      idle_sleep_s=0.01, slo_p99_ms=0.001)
+    host, port = d.start()
+    try:
+        spool = d.spool_dir
+        r = request(spool, "POST", "/submit", {
+            "config": json.loads(_cfg(10, steps=30).to_json()),
+        })
+        wait_for(spool, [r["job"]], timeout=120)
+        import urllib.request
+
+        req = urllib.request.Request(
+            f"http://{host}:{port}/metrics",
+            headers={"Accept": "text/plain"},
+        )
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            ctype = resp.headers["Content-Type"]
+            text = resp.read().decode()
+        assert ctype.startswith("text/plain")
+        parsed = parse_prometheus_text(text)
+        assert "gravity_rounds_total" in parsed
+        assert "gravity_job_latency_seconds" in parsed
+        # Fleet view aggregates this worker's snapshot.
+        f = request(spool, "GET", "/metrics?fleet=1")
+        assert f["fleet"] and d.worker_id in f["workers"]
+        assert f["classes"]["integrate"]["completed"] >= 1
+        assert f["classes"]["integrate"]["latency"]["p99_s"] is not None
+        # SLO burn visible (0.001 ms p99 target is always breached).
+        assert f["slo"]["burn"]["p99"] is True
+        assert any(e["event"] == "slo_breach"
+                   for e in d.events.read())
+        # Flight recorder over HTTP.
+        fr = request(spool, "GET", "/flightrec")
+        assert fr["entries"] > 0 and fr["path"]
+        assert os.path.exists(fr["path"])
+    finally:
+        d.stop()
+
+
+@pytest.mark.heavy
+def test_profile_endpoint_arms_per_round_capture(tmp_path):
+    """POST /profile arms a jax.profiler capture for the next N
+    rounds (zero cost while the budget is 0); the capture directory
+    gains an xplane artifact and the budget drains back to zero."""
+    import glob
+
+    d = GravityDaemon(str(tmp_path / "spool"), slots=2, slice_steps=10,
+                      idle_sleep_s=0.01)
+    d.start()
+    try:
+        spool = d.spool_dir
+        prof_dir = str(tmp_path / "prof")
+        resp = request(spool, "POST", "/profile",
+                       {"rounds": 1, "dir": prof_dir})
+        assert resp == {"profiling_rounds": 1, "dir": prof_dir}
+        r = request(spool, "POST", "/submit", {
+            "config": json.loads(_cfg(8, steps=30).to_json()),
+        })
+        wait_for(spool, [r["job"]], timeout=120)
+        assert d._profile_rounds == 0
+        files = [f for f in glob.glob(f"{prof_dir}/**/*", recursive=True)
+                 if os.path.isfile(f)]
+        assert files, "profiler capture left no artifact"
+        # Bad budgets are clean 400s.
+        code, _ = d.handle_post("/profile", {"rounds": -1})
+        assert code == 400
+    finally:
+        d.stop()
+
+
+@pytest.mark.heavy
+def test_solo_run_trace_spans(tmp_path):
+    """--trace twin for solo runs: block + checkpoint spans, run stats
+    carry the trace id, coverage ~1."""
+    from gravity_tpu.simulation import Simulator
+    from gravity_tpu.utils.checkpoint import make_checkpoint_manager
+
+    tele = Telemetry(out_dir=str(tmp_path), worker="solo-w")
+    cfg = _cfg(16, steps=20, progress_every=5,
+               checkpoint_every=10,
+               checkpoint_dir=str(tmp_path / "ckpt"))
+    mgr = make_checkpoint_manager(cfg.checkpoint_dir)
+    stats = Simulator(cfg).run(
+        checkpoint_manager=mgr, telemetry=tele
+    )
+    tr = stats["trace_id"]
+    spans = load_spans(str(tmp_path / "traces.jsonl"))
+    names = [s["name"] for s in spans if s["trace"] == tr]
+    assert names.count("block") == 4
+    assert "checkpoint" in names
+    cov = span_coverage(
+        [s for s in spans if s["name"] == "block"], tr
+    )
+    assert cov["coverage"] > 0.9
+
+
+# --- docs lint ---
+
+
+@pytest.mark.fast
+def test_docs_cover_every_event_and_metric_name():
+    """Satellite: every emitted event kind, metric name, span name,
+    and flight-recorder dump reason appears in docs/observability.md
+    — new telemetry cannot ship undocumented."""
+    from gravity_tpu.serve.jobs import sweep  # noqa: F401 — ensure
+    from gravity_tpu.telemetry.flightrec import DUMP_REASONS
+    from gravity_tpu.telemetry.tracing import SPAN_NAMES
+    from gravity_tpu.utils.logging import (
+        RecoveryEventLogger,
+        RunEventLogger,
+        ServingEventLogger,
+    )
+    from gravity_tpu.utils.profiling import MetricsLogger
+
+    doc_path = os.path.join(
+        os.path.dirname(__file__), "..", "docs", "observability.md"
+    )
+    doc = open(doc_path).read()
+    missing = []
+    for kinds in (ServingEventLogger.KINDS, RecoveryEventLogger.KINDS,
+                  RunEventLogger.KINDS, MetricsLogger.KINDS):
+        for kind in kinds:
+            if f"`{kind}`" not in doc:
+                missing.append(f"event kind {kind}")
+    for name, _typ, _help in WORKER_METRICS:
+        # Docs table metrics as `name{label,...}` — match the bare
+        # name anywhere.
+        if name not in doc:
+            missing.append(f"metric {name}")
+    for name in SPAN_NAMES:
+        if f"`{name}`" not in doc:
+            missing.append(f"span {name}")
+    for reason in DUMP_REASONS:
+        if f"`{reason}`" not in doc:
+            missing.append(f"dump reason {reason}")
+    assert not missing, (
+        "docs/observability.md is missing: " + ", ".join(missing)
+    )
