@@ -1,0 +1,202 @@
+"""Cell-list kernel sweep: cap x cutoff x density, with MFU per point,
+plus the N-scaling A/B against the rcut-masked chunked direct sum.
+
+Two modes, one JSON line per point (the crossover.py/p3m_short_ab.py
+reporting contract):
+
+- default (``--scaling``-less): the cap x cutoff x density grid at a
+  fixed N — how the tile engine's throughput moves with its static cap
+  (padding fraction), the truncation radius (cells per axis), and the
+  particle density (occupancy). Each point reports the dense-equivalent
+  pair rate (``dense_equiv_pairs_per_sec``: N*(N-1)/t — what a direct
+  sum would have needed), the EVALUATED tile rate, and the roofline
+  fields from the evaluated tiles (utils/timing.roofline at the
+  ``nlist`` flops model; mfu/peak are null off-TPU).
+
+- ``--scaling``: a fixed-DENSITY N ladder (span grows with n^(1/3), so
+  the cell grid grows with N at ~constant occupancy) timing the nlist
+  kernel against the rcut-MASKED chunked direct sum — the pair of
+  backends the autotuner arbitrates (autotune.eligible_candidates with
+  nlist_rcut > 0). This is the sub-quadratic-scaling evidence row: the
+  nlist dense-equivalent rate must RISE with N (O(N) work under an
+  O(N^2)-equivalent metric) while the chunked rate stays ~flat.
+
+Usage:
+    python benchmarks/nlist_sweep.py                  # cap x rcut x density
+    python benchmarks/nlist_sweep.py --n 16384
+    python benchmarks/nlist_sweep.py --scaling        # N ladder A/B
+    python benchmarks/nlist_sweep.py --scaling --sizes 4096 8192 16384
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from gravity_tpu.utils.platform import ensure_live_backend  # noqa: E402
+
+ensure_live_backend()
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+
+def _time_eval(fn, *args, iters: int = 3) -> float:
+    from gravity_tpu.utils.timing import sync
+
+    out = fn(*args)
+    sync(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    sync(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def _state(n: int, span: float, seed: int = 0):
+    key = jax.random.PRNGKey(seed)
+    pos = jax.random.uniform(key, (n, 3), jnp.float32) * span
+    m = jax.random.uniform(
+        jax.random.fold_in(key, 1), (n,), jnp.float32
+    ) + 0.5
+    return pos, m
+
+
+def _nlist_point(pos, m, n, rcut, cap, eps, device_kind):
+    """One measured nlist point: dense-equiv rate + evaluated-tile
+    roofline."""
+    from functools import partial
+
+    from gravity_tpu.ops.pallas_nlist import (
+        evaluated_pairs_per_eval,
+        nlist_accelerations,
+        resolve_nlist_sizing,
+    )
+    from gravity_tpu.utils.timing import roofline
+
+    side, cap_eff = resolve_nlist_sizing(np.asarray(pos), rcut, cap=cap)
+    fn = partial(
+        nlist_accelerations, rcut=rcut, side=side, cap=cap_eff, g=1.0,
+        eps=eps,
+    )
+    s = _time_eval(fn, pos, m)
+    tiles = evaluated_pairs_per_eval(side, cap_eff)
+    point = {
+        "side": side,
+        "cap": cap_eff,
+        "s_per_eval": s,
+        "dense_equiv_pairs_per_sec": n * (n - 1) / s,
+        "evaluated_pairs_per_sec": tiles / s,
+        "useful_pair_frac": min(1.0, n * 27.0 * (n / side**3) / tiles),
+    }
+    point.update(roofline(
+        tiles / s, formulation="nlist", device_kind=device_kind,
+        dtype="float32",
+    ))
+    return point
+
+
+def run_grid(args) -> int:
+    """cap x cutoff x density sweep at fixed N."""
+    device_kind = str(jax.devices()[0].device_kind)
+    n = args.n
+    # density axis: particles per rcut^3-ish volume, swept via the cube
+    # span at fixed N (denser = smaller span = higher occupancy).
+    spacings = [1.0, 2.0, 4.0]  # mean inter-particle spacings per rcut
+    caps = [0] + [8, 32, 128]  # 0 = the p95 auto fit
+    rcut_factors = [1.5, 2.5, 4.0]
+    for spacing in spacings:
+        # span so that mean spacing = span / n^(1/3).
+        base_spacing = 1.0
+        span = base_spacing * n ** (1.0 / 3.0)
+        for rf in rcut_factors:
+            rcut = rf * base_spacing * spacing
+            pos, m = _state(n, span)
+            for cap in caps:
+                point = {
+                    "mode": "grid", "n": n, "rcut": rcut,
+                    "rcut_per_spacing": rf * spacing,
+                    "cap_requested": cap,
+                    "platform": jax.devices()[0].platform,
+                }
+                point.update(_nlist_point(
+                    pos, m, n, rcut, cap, args.eps, device_kind
+                ))
+                print(json.dumps(point), flush=True)
+    return 0
+
+
+def run_scaling(args) -> int:
+    """Fixed-density N ladder: nlist vs rcut-masked chunked direct."""
+    from functools import partial
+
+    from gravity_tpu.ops.forces import pairwise_accelerations_chunked
+
+    device_kind = str(jax.devices()[0].device_kind)
+    sizes = args.sizes or [4096, 8192, 16384, 32768, 65536]
+    rows = []
+    for n in sizes:
+        span = float(n) ** (1.0 / 3.0)  # unit density
+        rcut = 2.5  # 2.5 mean spacings: ~65 neighbors per particle
+        pos, m = _state(n, span)
+        row = {
+            "mode": "scaling", "n": n, "rcut": rcut,
+            "platform": jax.devices()[0].platform,
+        }
+        row.update(_nlist_point(
+            pos, m, n, rcut, 0, args.eps, device_kind
+        ))
+        if n * (n - 1) <= args.chunked_pair_budget:
+            fn = partial(
+                pairwise_accelerations_chunked, g=1.0, eps=args.eps,
+                rcut=rcut, chunk=min(1024, n),
+            )
+            s = _time_eval(fn, pos, m)
+            row["chunked_s_per_eval"] = s
+            row["chunked_pairs_per_sec"] = n * (n - 1) / s
+            row["speedup_vs_chunked"] = s / row["s_per_eval"]
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+    # The acceptance signal in one line: the nlist dense-equiv rate must
+    # improve with N (sub-quadratic work) while chunked stays ~flat.
+    if len(rows) >= 2:
+        first, last = rows[0], rows[-1]
+        print(json.dumps({
+            "summary": True,
+            "nlist_rate_growth": last["dense_equiv_pairs_per_sec"]
+            / first["dense_equiv_pairs_per_sec"],
+            "chunked_rate_growth": (
+                last.get("chunked_pairs_per_sec", 0)
+                / first["chunked_pairs_per_sec"]
+                if first.get("chunked_pairs_per_sec")
+                and last.get("chunked_pairs_per_sec") else None
+            ),
+            "n_span": [first["n"], last["n"]],
+        }), flush=True)
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--n", type=int, default=16384,
+                   help="fixed N for the cap x cutoff x density grid")
+    p.add_argument("--eps", type=float, default=0.05)
+    p.add_argument("--scaling", action="store_true",
+                   help="run the fixed-density N ladder A/B instead")
+    p.add_argument("--sizes", type=int, nargs="+", default=None)
+    p.add_argument("--chunked-pair-budget", dest="chunked_pair_budget",
+                   type=int, default=1 << 33,
+                   help="skip the masked chunked reference above this "
+                        "directed-pair count")
+    args = p.parse_args(argv)
+    return run_scaling(args) if args.scaling else run_grid(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -170,6 +170,13 @@ def eligible_candidates(config, on_tpu: bool) -> tuple[tuple, dict]:
       over source shards can never assemble the global tree/mesh), and
       a periodic box never reaches here (pm is the only periodic
       solver).
+    - ``nlist_rcut`` > 0 switches the candidate FAMILY: the physics is
+      declared truncated-at-rcut, so the contest is the cell-list
+      kernel (``nlist``, from the fast-probe floor up — cutoff-required
+      eligibility) vs the rcut-MASKED direct sum; the full-gravity fast
+      solvers compute different physics and are excluded outright.
+      The occupancy signature already keys the verdict (cell-list cost
+      is occupancy-shaped).
     """
     from .simulation import _resolve_direct
 
@@ -187,6 +194,28 @@ def eligible_candidates(config, on_tpu: bool) -> tuple[tuple, dict]:
             f"direct sum: {pairs:.3g} pairs/eval exceeds the "
             f"{budget:.3g} probe budget on this platform"
         )
+    if config.nlist_rcut > 0.0:
+        skipped["tree/fmm/sfmm"] = (
+            "nlist_rcut declares truncated short-range physics; the "
+            "full-gravity fast solvers are not comparable"
+        )
+        if config.sharding == "ring":
+            # Same structural exclusion as the other cell-structure
+            # solvers: a ring over source shards can never assemble
+            # the global cell list — skip, don't burn a doomed probe.
+            skipped["nlist"] = (
+                "ring sharding streams sources and cannot build the "
+                "global cell list"
+            )
+        elif config.n >= fast_probe_min():
+            cands.append("nlist")
+        else:
+            skipped["nlist"] = (
+                f"n={config.n} below the fast-probe floor "
+                f"{fast_probe_min()} (the masked direct sum is cheap "
+                "there)"
+            )
+        return tuple(cands), skipped
     if config.sharding == "ring":
         skipped["tree/fmm/sfmm"] = (
             "ring sharding streams sources and cannot build a global "
@@ -228,10 +257,17 @@ def make_key(
             "tree_leaf_cap": config.tree_leaf_cap,
             "tree_ws": config.tree_ws,
             "tree_far": config.tree_far,
+            "tree_near": config.tree_near,
             "fmm_mode": config.fmm_mode,
             "chunk": config.chunk,
             "fast_chunk": config.fast_chunk,
             "cutoff": config.cutoff,
+            # The nlist family gate + sizing: a different rcut is
+            # different physics (and a different candidate set); a
+            # forced side/cap is a materially different program.
+            "nlist_rcut": config.nlist_rcut,
+            "nlist_side": config.nlist_side,
+            "nlist_cap": config.nlist_cap,
         },
     }
 

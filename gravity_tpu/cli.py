@@ -53,10 +53,12 @@ def _add_config_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--force-backend", dest="force_backend",
                    choices=["auto", "direct", "dense", "chunked", "pallas",
                             "pallas-mxu", "cpp", "tree", "fmm", "sfmm",
-                            "pm", "p3m"],
+                            "pm", "p3m", "nlist"],
                    default=None,
                    help="pallas-mxu = MXU matmul-formulation direct sum "
-                        "(Gram-trick r^2 + matmul accumulation; see "
+                        "(Gram-trick r^2 + matmul accumulation); nlist = "
+                        "cutoff-radius cell-list kernel (truncated "
+                        "short-range physics, needs --nlist-rcut; see "
                         "docs/scaling.md)")
     p.add_argument("--fmm-mode", dest="fmm_mode",
                    choices=["auto", "dense", "sparse"], default=None,
@@ -84,9 +86,30 @@ def _add_config_args(p: argparse.ArgumentParser) -> None:
                    default=None)
     p.add_argument("--p3m-cap", dest="p3m_cap", type=int, default=None)
     p.add_argument("--p3m-short", dest="p3m_short",
-                   choices=["auto", "gather", "slice"], default=None,
+                   choices=["auto", "gather", "slice", "nlist"],
+                   default=None,
                    help="short-range data movement (auto = gather-free "
-                        "shifted slices on TPU, block gathers on CPU)")
+                        "shifted slices on TPU, block gathers on CPU; "
+                        "nlist = the cell-list tile engine, "
+                        "docs/scaling.md 'Cell-list near field')")
+    p.add_argument("--nlist-rcut", dest="nlist_rcut", type=float,
+                   default=None,
+                   help="cutoff-radius cell-list truncation radius "
+                        "(declares truncated short-range physics; "
+                        "enables --force-backend nlist and its "
+                        "autotune candidacy)")
+    p.add_argument("--nlist-side", dest="nlist_side", type=int,
+                   default=None,
+                   help="static nlist cell-grid side (0 = derive from "
+                        "the initial state)")
+    p.add_argument("--nlist-cap", dest="nlist_cap", type=int,
+                   default=None,
+                   help="static nlist per-cell slot cap (0 = fit to "
+                        "the p95 occupied-cell load)")
+    p.add_argument("--tree-near", dest="tree_near",
+                   choices=["gather", "nlist"], default=None,
+                   help="octree near-field data movement (nlist = "
+                        "cell-list tile engine over the leaf blocks)")
     p.add_argument("--fast-chunk", dest="fast_chunk", type=int, default=None,
                    help="target-chunk size for tree/p3m evaluation")
     p.add_argument("--pm-assignment", dest="pm_assignment",
@@ -463,11 +486,60 @@ def cmd_run(args: argparse.Namespace) -> int:
                 ws=config.tree_ws,
                 g=config.g, cutoff=config.cutoff, eps=config.eps,
             )
+        elif sim.backend == "nlist" and sim.nlist_sizing is not None:
+            # Audit at the AS-RUN cell-list sizing (the sfmm rule:
+            # re-sizing from the evolved final state would audit a
+            # different solver than the one that ran). box rides along
+            # for completeness (periodic runs skip the audit above).
+            from functools import partial as _partial
+
+            from .ops.pallas_nlist import nlist_accelerations_vs
+
+            s_side, s_cap, _ = sim.nlist_sizing
+            kernel = _partial(
+                nlist_accelerations_vs, rcut=config.nlist_rcut,
+                side=s_side, cap=s_cap, g=config.g,
+                cutoff=config.cutoff, eps=config.eps,
+                box=config.periodic_box,
+            )
+        elif (
+            sim.backend in ("dense", "chunked")
+            and config.nlist_rcut > 0.0
+        ):
+            # Masked-direct run: the default audit kernel (full-gravity
+            # Pallas) computes different physics, so the independent
+            # truncated implementation — the nlist cell list, sized
+            # from the audited state — is the cross-check instead.
+            from functools import partial as _partial
+
+            from .ops.pallas_nlist import (
+                nlist_accelerations_vs,
+                resolve_nlist_sizing,
+            )
+
+            a_side, a_cap = resolve_nlist_sizing(
+                final.positions, config.nlist_rcut,
+                cap=config.nlist_cap, side=config.nlist_side,
+            )
+            kernel = _partial(
+                nlist_accelerations_vs, rcut=config.nlist_rcut,
+                side=a_side, cap=a_cap, g=config.g,
+                cutoff=config.cutoff, eps=config.eps,
+            )
         elif sim.backend not in ("dense", "chunked"):
             kernel = make_local_kernel(config, sim.backend)
         check = debug_check_forces(
             final.positions, final.masses,
             g=config.g, cutoff=config.cutoff, eps=config.eps,
+            # Declared-truncated family (nlist / masked direct): the
+            # oracle truncates too, so the audit measures defects, not
+            # the physics difference. Backends that IGNORE the rcut
+            # (a warned-about combination) keep the full oracle.
+            rcut=(
+                config.nlist_rcut
+                if sim.backend in ("nlist", "dense", "chunked")
+                else 0.0
+            ),
             kernel=kernel, full_acc=full_acc,
         )
         logger.log_print(
@@ -1922,6 +1994,18 @@ def cmd_tune(args: argparse.Namespace) -> int:
     on_tpu = jax.devices()[0].platform == "tpu"
     if args.sizes:
         sizes = sorted({int(s) for s in args.sizes})
+    elif config.nlist_rcut > 0.0:
+        # The nlist crossover ladder (chip-window playbook, ROADMAP
+        # item 3): with a declared truncation radius the candidate
+        # family is the rcut-masked direct sum vs the cell-list kernel
+        # (autotune.eligible_candidates), so these sizes measure the
+        # direct/nlist crossover — on TPU stretched to where the
+        # direct/nlist/sfmm boundary actually lives; on CPU bounded by
+        # the masked direct probe's own cost.
+        if on_tpu:
+            sizes = [65_536, 262_144, 1_048_576, 4_194_304]
+        else:
+            sizes = [8_192, 16_384, 32_768, 65_536, 131_072]
     elif on_tpu:
         sizes = [65_536, 131_072, 262_144, 524_288, 1_048_576]
     else:
@@ -2261,12 +2345,15 @@ def main(argv=None) -> int:
         "tune",
         help="pre-warm the backend autotune cache over a size ladder "
              "(probe-on-miss, instant-on-hit; docs/scaling.md "
-             "'Autotuned routing')",
+             "'Autotuned routing'); with --nlist-rcut the ladder "
+             "measures the direct/nlist crossover instead",
     )
     _add_config_args(p_tune)
     p_tune.add_argument("--sizes", type=int, nargs="+", default=None,
                         help="N ladder to pre-warm (default: the "
-                             "crossover.py ladder for this platform)")
+                             "crossover.py ladder for this platform; "
+                             "with --nlist-rcut, the nlist crossover "
+                             "ladder)")
     p_tune.add_argument("--refresh", action="store_true",
                         help="re-probe even on a cache hit (overwrite "
                              "the stored verdicts)")

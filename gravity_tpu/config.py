@@ -51,7 +51,9 @@ class SimulationConfig:
     # platform) | tree (octree) | fmm (dense-grid gather-free FMM,
     # slab-sharded on a mesh) | sfmm (sparse cell-list FMM — forces the
     # clustered-state layout; fmm + fmm_mode is the usual entry) |
-    # pm (FFT mesh) | p3m (FFT mesh + cell-list pair correction)
+    # pm (FFT mesh) | p3m (FFT mesh + cell-list pair correction) |
+    # nlist (cutoff-radius cell-list kernel, ops/pallas_nlist.py —
+    # TRUNCATED short-range physics; needs nlist_rcut > 0)
     force_backend: str = "auto"
     # Measurement-driven routing for force_backend='auto'
     # (gravity_tpu/autotune.py; docs/scaling.md "Autotuned routing"):
@@ -79,8 +81,26 @@ class SimulationConfig:
     p3m_cap: int = 128  # static per-cell source cap of the cell list
     # Short-range data movement: "gather" (per-target cell-block
     # gathers; CPU-friendly), "slice" (fmm-style shifted-slice pass,
-    # zero gather indices — the TPU path), "auto" = slice on TPU.
+    # zero gather indices — the TPU path), "nlist" (the cell-list tile
+    # engine, ops/pallas_nlist.py: Pallas pair tiles on TPU, jnp
+    # reference elsewhere), "auto" = measured chip winner, else slice
+    # on TPU / gather on CPU.
     p3m_short: str = "auto"
+    # Cutoff-radius cell-list backend (force_backend="nlist"; also the
+    # autotune candidate gate — with nlist_rcut > 0 'auto' probes the
+    # nlist kernel against the rcut-masked direct sum). rcut is the
+    # PHYSICS: forces are truncated at min(rcut, cell edge); 0 = no
+    # truncation declared, nlist ineligible. nlist_side/nlist_cap are
+    # the static cell-list sizing (0 = derive from the initial state
+    # via pallas_nlist.resolve_nlist_sizing; serve jobs must set
+    # nlist_side explicitly — no concrete state exists at admission).
+    nlist_rcut: float = 0.0
+    nlist_side: int = 0
+    nlist_cap: int = 0
+    # Octree near-field data movement: "gather" (per-target chunk
+    # gathers, the classic path) | "nlist" (cell-list tile engine over
+    # the leaf blocks; ws=1 only).
+    tree_near: str = "gather"
     # Target-chunk size for the fast solvers' lax.map (bigger chunks =
     # fewer sequential trips; memory per chunk ~ chunk * 27 * cap * 16 B).
     fast_chunk: int = 4096

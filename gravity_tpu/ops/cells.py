@@ -11,6 +11,24 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
+
+
+def _near_offsets(ws: int) -> np.ndarray:
+    """The (2ws+1)^3 near-neighborhood stencil (Chebyshev radius ws),
+    row-major over (dx, dy, dz) in [-ws, ws].
+
+    ONE owner for the stencil every cell-list consumer shares (tree,
+    fmm, sfmm, p3m, pallas_nlist): the offset ORDER is part of the
+    contract — the nlist Pallas kernel decodes a flat offset index back
+    to (dx, dy, dz) with the same row-major arithmetic, so a reordering
+    here would silently evaluate the wrong neighbor tiles there.
+    """
+    rng = range(-ws, ws + 1)
+    return np.array(
+        [(dx, dy, dz) for dx in rng for dy in rng for dz in rng],
+        dtype=np.int32,
+    )
 
 
 def grid_coords(points, origin, span, side: int):

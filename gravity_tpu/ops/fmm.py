@@ -69,9 +69,8 @@ import numpy as np
 from ..constants import CUTOFF_RADIUS, G
 from ..utils.compat import axis_size as _axis_size
 from ..utils.compat import shard_map as _shard_map
-from .cells import bin_to_cells, grid_coords
+from .cells import _near_offsets, bin_to_cells, grid_coords
 from .tree import (
-    _near_offsets,
     _offsets,
     _parity_mask_table,
     _quad_correction,

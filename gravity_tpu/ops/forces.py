@@ -32,21 +32,30 @@ import jax.numpy as jnp
 from ..constants import CUTOFF_RADIUS, G
 
 
-def _pair_weights(r2, masses_j, g, cutoff, eps, dtype):
-    """w_j = G * m_j / r^3 with cutoff/softening semantics, given r^2."""
+def _pair_weights(r2, masses_j, g, cutoff, eps, dtype, rcut=0.0):
+    """w_j = G * m_j / r^3 with cutoff/softening semantics, given r^2.
+
+    ``rcut`` > 0 additionally truncates at r > rcut — the declared
+    short-range physics of the nlist cell-list backend
+    (ops/pallas_nlist.py); the masked direct sum is its exact reference
+    (and autotune competitor). 0 = classic untruncated behavior.
+    """
     eps = jnp.asarray(eps, dtype)
     r2_soft = r2 + eps * eps
     # rsqrt(r2)^3; where() keeps the cutoff exact and kills the self-pair
     # (r2 == 0 -> below cutoff -> weight 0), so no NaNs ever form.
     cutoff2 = jnp.asarray(cutoff, dtype) ** 2
-    safe_r2 = jnp.where(r2_soft > cutoff2, r2_soft, jnp.asarray(1.0, dtype))
+    ok = r2_soft > cutoff2
+    rcut2 = jnp.asarray(rcut, dtype) ** 2
+    ok = jnp.logical_and(ok, jnp.logical_or(rcut2 <= 0, r2 <= rcut2))
+    safe_r2 = jnp.where(ok, r2_soft, jnp.asarray(1.0, dtype))
     inv_r = jax.lax.rsqrt(safe_r2)
     # CRITICAL fp32 ordering: inv_r**3 alone underflows to zero for
     # r > ~2e12 m (1e-39 < fp32 min normal 1.2e-38, flushed), silently
     # zeroing every distant pair's force. Folding G*m_j in before the
     # second/third reciprocal factors keeps all intermediates in range.
     w = ((jnp.asarray(g, dtype) * masses_j) * inv_r) * inv_r * inv_r
-    return jnp.where(r2_soft > cutoff2, w, jnp.asarray(0.0, dtype))
+    return jnp.where(ok, w, jnp.asarray(0.0, dtype))
 
 
 def accelerations_vs(
@@ -57,21 +66,26 @@ def accelerations_vs(
     g: float = G,
     cutoff: float = CUTOFF_RADIUS,
     eps: float = 0.0,
+    rcut: float = 0.0,
 ) -> jax.Array:
     """Accelerations on `pos_i` (M, 3) sourced by `pos_j` (K, 3)/`masses_j` (K,).
 
     The building block for every direct-sum strategy (dense, chunked, sharded
     all_gather, ring ppermute): self-pairs are excluded automatically because
-    r == 0 falls below the cutoff.
+    r == 0 falls below the cutoff. ``rcut`` > 0 truncates at r > rcut
+    (the nlist backend's declared short-range physics — this masked form
+    is its exact reference).
     """
     dtype = pos_i.dtype
     diff = pos_j[None, :, :] - pos_i[:, None, :]  # (M, K, 3)
     r2 = jnp.sum(diff * diff, axis=-1)  # (M, K)
-    w = _pair_weights(r2, masses_j[None, :], g, cutoff, eps, dtype)  # (M, K)
+    w = _pair_weights(
+        r2, masses_j[None, :], g, cutoff, eps, dtype, rcut=rcut
+    )  # (M, K)
     return jnp.einsum("mk,mkd->md", w, diff)  # (M, 3)
 
 
-@partial(jax.jit, static_argnames=("eps",))
+@partial(jax.jit, static_argnames=("eps", "rcut"))
 def pairwise_accelerations_dense(
     positions: jax.Array,
     masses: jax.Array,
@@ -79,12 +93,16 @@ def pairwise_accelerations_dense(
     g: float = G,
     cutoff: float = CUTOFF_RADIUS,
     eps: float = 0.0,
+    rcut: float = 0.0,
 ) -> jax.Array:
     """All-pairs accelerations, materializing the (N, N) tensors."""
-    return accelerations_vs(positions, positions, masses, g=g, cutoff=cutoff, eps=eps)
+    return accelerations_vs(
+        positions, positions, masses, g=g, cutoff=cutoff, eps=eps,
+        rcut=rcut,
+    )
 
 
-@partial(jax.jit, static_argnames=("chunk", "eps"))
+@partial(jax.jit, static_argnames=("chunk", "eps", "rcut"))
 def pairwise_accelerations_chunked(
     positions: jax.Array,
     masses: jax.Array,
@@ -92,6 +110,7 @@ def pairwise_accelerations_chunked(
     g: float = G,
     cutoff: float = CUTOFF_RADIUS,
     eps: float = 0.0,
+    rcut: float = 0.0,
     chunk: int = 1024,
 ) -> jax.Array:
     """All-pairs accelerations with O(N * chunk) peak memory.
@@ -107,7 +126,10 @@ def pairwise_accelerations_chunked(
     pos_chunks = positions.reshape(n // chunk, chunk, 3)
 
     def one_chunk(pos_i):
-        return accelerations_vs(pos_i, positions, masses, g=g, cutoff=cutoff, eps=eps)
+        return accelerations_vs(
+            pos_i, positions, masses, g=g, cutoff=cutoff, eps=eps,
+            rcut=rcut,
+        )
 
     acc = jax.lax.map(one_chunk, pos_chunks)
     return acc.reshape(n, 3)
@@ -166,7 +188,7 @@ def potential_energy(
 
 def wrap_with_dense_vjp(
     forward, *, g: float = G, cutoff: float = CUTOFF_RADIUS,
-    eps: float = 0.0,
+    eps: float = 0.0, rcut: float = 0.0,
 ):
     """Attach a custom VJP to a LocalKernel whose native form has no
     autodiff rule (the Pallas kernel, the C++ XLA FFI kernel): the
@@ -186,7 +208,10 @@ def wrap_with_dense_vjp(
 
     def _bwd(res, ct):
         _, vjp = jax.vjp(
-            partial(accelerations_vs, g=g, cutoff=cutoff, eps=eps), *res
+            partial(
+                accelerations_vs, g=g, cutoff=cutoff, eps=eps, rcut=rcut
+            ),
+            *res,
         )
         return vjp(ct)
 

@@ -43,9 +43,8 @@ import jax.numpy as jnp
 from jax.scipy.special import erf
 
 from ..constants import CUTOFF_RADIUS, G
-from .cells import bin_to_cells, grid_coords, map_target_chunks
+from .cells import _near_offsets, bin_to_cells, grid_coords, map_target_chunks
 from .pm import bounding_cube, cic_deposit, cic_gather
-from .tree import _near_offsets
 
 
 _SHORT_AB_FILE = "P3M_SHORT_TPU.json"
@@ -91,7 +90,7 @@ def measured_short_mode():
                 # string from an interrupted producer) must fall back
                 # to the cost model, not crash the trace.
                 if isinstance(data, dict) and data.get("winner") in (
-                    "slice", "gather"
+                    "slice", "gather", "nlist"
                 ):
                     winner = data["winner"]
             except (OSError, ValueError, TypeError):
@@ -110,7 +109,10 @@ def resolve_short_mode(short_mode: str, backend: str | None = None) -> str:
     TPU: the recorded chip A/B (:func:`measured_short_mode`) when one
     exists, else the cost-model default 'slice' (gathers are
     index-rate-limited on TPU — the failure mode the chip measured on
-    the tree backend; the slice pass is gather-free)."""
+    the tree backend; the slice pass is gather-free). 'nlist' (explicit
+    or a recorded chip winner) routes the near pass through the
+    cell-list tile engine (ops/pallas_nlist.py): the Pallas kernel on
+    TPU, its jnp reference elsewhere."""
     if short_mode != "auto":
         return short_mode
     backend = backend or jax.default_backend()
@@ -137,6 +139,19 @@ THIN_ERR_POWER = 0.607
 # interpolation-error regime the accuracy tests already pin.
 THIN_ASPECT_MAX = 0.5
 THIN_ERR_TARGET = 0.01
+# Above this n the thin-geometry remedy names the nlist near field: a
+# bigger mesh alone multiplies the binning side and with it the chunked
+# near pass's per-target gather volume, so for large runs the honest fix
+# is "finer grid + cell-list near field", not "finer grid" (which the
+# near-pass cost makes provably insufficient as a standalone remedy).
+# Below it the near pass is cheap either way and the grid note suffices.
+NLIST_NEAR_MIN_N = 32_768
+
+
+def nlist_near_eligible(n: int) -> bool:
+    """Whether the cell-list near field (``--p3m-short nlist``) is the
+    right remedy to name for this run size (see NLIST_NEAR_MIN_N)."""
+    return n >= NLIST_NEAR_MIN_N
 
 
 def thin_aspect(positions) -> float:
@@ -204,7 +219,7 @@ def check_p3m_sizing(
             # Independent of the cap note above, and reported alongside
             # it: the cap fix the first note suggests does NOT move this
             # mesh-side error, which is this warning's whole point.
-            notes.append(
+            note = (
                 f"p3m grid={grid} under-resolves this thin geometry "
                 f"(aspect {aspect:.3f}: only {aspect * grid:.0f} cells "
                 f"across the thin axis); the measured disk-sweep fit "
@@ -213,6 +228,19 @@ def check_p3m_sizing(
                 "(raising --p3m-cap does not move this error — it is "
                 "mesh-side; benchmarks/p3m_grid_sweep.py)."
             )
+            if nlist_near_eligible(n):
+                # A bigger grid alone is provably insufficient at this
+                # n: it multiplies the binning side and the chunked
+                # near pass's per-target gather volume with it. Name
+                # the complete remedy.
+                note += (
+                    " At this n, pair it with the cell-list near "
+                    "field (--p3m-short nlist, ops/pallas_nlist.py): "
+                    "the near pass stays O(N) fixed-degree tiles at "
+                    "the finer grid instead of inflating the chunked "
+                    "gather pass."
+                )
+            notes.append(note)
     return " ".join(notes) if notes else None
 
 
@@ -629,6 +657,11 @@ def _p3m_accelerations_vs_impl(
       (TPU gathers are index-rate-limited — the failure mode the chip
       measured on the tree backend). Prefers occupancy ~ ``cap``
       (sigma_cells ~ 2.0 at 1M/grid 256); see docs/scaling.md.
+    - ``"nlist"`` — the cell-list tile engine (ops/pallas_nlist.py):
+      the same (cell, slot) layout evaluated as fixed-degree Pallas
+      pair tiles on TPU (grid (S^3, 27), neighbor tiles addressed by
+      index-map arithmetic) and by the jnp shifted-slice reference
+      elsewhere; docs/scaling.md "Cell-list near field".
     - ``"auto"`` (default) — platform-keyed: "gather" off-TPU (measured
       faster on CPU, BASELINE.md round-4 A/B); on TPU the recorded chip
       A/B in P3M_SHORT_TPU.json (``benchmarks/p3m_short_ab.py``) when
@@ -672,7 +705,7 @@ def _p3m_accelerations_vs_impl(
     # index-rate-limited on TPU), with a recorded chip A/B overriding
     # the cost model (measurement-beats-model; resolve_short_mode).
     mode = resolve_short_mode(short_mode)
-    if mode == "slice":
+    if mode in ("slice", "nlist"):
         t_cap_eff = t_cap or cap
         kt = targets.shape[0]
         if _self and t_cap_eff == cap:
@@ -686,11 +719,28 @@ def _p3m_accelerations_vs_impl(
             tcells_pos, _, _, t_start, t_sort, t_sorted_ids = bin_to_cells(
                 targets, jnp.ones((kt,), dtype), t_coords, side, t_cap_eff
             )
-        near_cell = _short_range_shifted(
-            tcells_pos, t_cap_eff, cells_pos, cells_mass, cell_count,
-            cmass_hat, ccom, m_scale, span, side, cap, g, cutoff, eps,
-            alpha, rcut, dtype,
-        )
+        if mode == "nlist":
+            # Cell-list tile engine (ops/pallas_nlist.py): the Pallas
+            # kernel on TPU, its jnp shifted-slice reference elsewhere
+            # — same (cell, slot) output contract as the slice pass,
+            # so the overflow/unpermute epilogue below is shared.
+            from .pallas_nlist import nlist_short_range_cells
+
+            near_cell = nlist_short_range_cells(
+                tcells_pos, t_cap_eff, cells_pos, cells_mass,
+                cell_count, cmass_hat, ccom, m_scale, span, side, cap,
+                g, cutoff, eps, alpha, rcut, dtype,
+                impl=(
+                    "pallas" if jax.default_backend() == "tpu"
+                    else "jnp"
+                ),
+            )
+        else:
+            near_cell = _short_range_shifted(
+                tcells_pos, t_cap_eff, cells_pos, cells_mass, cell_count,
+                cmass_hat, ccom, m_scale, span, side, cap, g, cutoff, eps,
+                alpha, rcut, dtype,
+            )
         slot = jnp.arange(kt, dtype=jnp.int32) - t_start[t_sorted_ids]
         over_t = slot >= t_cap_eff
         short_sorted = near_cell[

@@ -1,0 +1,934 @@
+"""Cutoff-radius cell-list force kernel — O(N) short-range pairs on-chip.
+
+The quadratic Pallas direct sum (ops/pallas_forces.py) evaluates every
+pair; for SHORT-RANGE interactions — a declared truncation radius
+``rcut`` (``--nlist-rcut``), the P3M erfc near field, or a tree/fmm leaf
+neighborhood — almost all of that work is zeros. This module is the
+cell-list counterpart, the regime described by "Efficient GPU
+Implementation of Particle Interactions with Cutoff Radius and Few
+Particles per Cell" (arXiv 2406.16091) and the FDPS accelerator paper
+(arXiv 1907.02290):
+
+- **Sort by cell** (one argsort + O(N) scatter, the shared
+  ``ops/cells.py`` binning prologue): particles land in a dense
+  ``(side^3, cap)`` slot layout over the bounding cube (or the periodic
+  box), cell edge >= the interaction radius so the 27-neighborhood
+  covers every interacting pair.
+- **Fixed-degree tiles**: each cell's ``(t_cap, cap)`` pair tile against
+  each of its 27 neighbors is identical dense VPU work — no gather
+  indices in the hot loop (TPU gathers are index-rate-limited: the
+  measured failure mode of the octree backend), no load imbalance, no
+  data races by construction.
+- **Two implementations of the same tile math**: a Pallas TPU kernel
+  (grid ``(side^3, 27)``, neighbor tiles addressed purely by index-map
+  arithmetic on the padded cell grid — zero copies beyond the binning
+  scatter) and a pure-jnp shifted-slice reference (the CPU/tier-1 parity
+  path, also the periodic-wrap path). fp32 throughout; bf16 states run
+  bf16 operands with the same masks (the wrapping caller controls dtype).
+
+Degradation contracts (shared with tree/fmm/sfmm/p3m — bounded error,
+never dropped mass, never NaN):
+
+- **Source cap overflow**: a cell's beyond-cap remainder contributes a
+  cell-size-softened monopole at its remainder COM through the same
+  pair kernel.
+- **Target slot overflow**: overflow targets take a per-target fallback
+  — whole neighbor cells as cell-size-softened monopoles.
+- **Cube drift**: ``side`` is static (sized from the initial state);
+  the effective truncation radius is ``min(rcut, span/side)`` so a
+  shrinking bounding cube degrades the radius instead of silently
+  dropping rim pairs.
+
+Three consumers (docs/scaling.md "Cell-list near field"):
+
+(a) the P3M near field (``--p3m-short nlist``), replacing the chunked
+    per-target gather pass; (b) the octree leaf/near evaluator
+    (``--tree-near nlist``); (c) the standalone ``--force-backend
+    nlist`` for plain cutoff dynamics (truncated-at-``rcut`` softened
+    Newtonian forces — declared short-range physics, the MD regime),
+    registered as an autotune candidate against the rcut-masked direct
+    sum whenever ``nlist_rcut`` > 0.
+"""
+
+from __future__ import annotations
+
+import functools
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..constants import CUTOFF_RADIUS, G
+from .cells import _near_offsets, bin_to_cells, grid_coords
+from .pm import bounding_cube
+
+# Default static per-cell source cap when no occupancy data is available
+# (serve bucket kernels size blind; everything else goes through
+# resolve_nlist_sizing's p95-occupancy fit).
+DEFAULT_CAP = 64
+# Joint (side^3 * cap) slot budget for resolve_nlist_sizing: the padded
+# cell arrays are (side^3, cap, 3) floats — 2^23 slots = 128 MB of
+# position data at fp32, the same order as one fmm level grid.
+SLOT_BUDGET = 1 << 23
+SIDE_MAX = 96
+
+_I0 = np.int32(0)
+
+
+def _resolve_impl(impl: str) -> str:
+    """'auto' -> the platform tile engine: the Pallas kernel on TPU,
+    the jnp shifted-slice reference elsewhere (also what tier-1 parity
+    tests pin). Resolved OUTSIDE the jit boundary so the executable
+    cache is keyed on the concrete impl (same contract as p3m's
+    resolve_short_mode)."""
+    if impl == "auto":
+        return "pallas" if jax.default_backend() == "tpu" else "jnp"
+    if impl not in ("pallas", "jnp"):
+        raise ValueError(
+            f"nlist impl {impl!r}: choose 'auto', 'pallas' or 'jnp'"
+        )
+    return impl
+
+
+def resolve_nlist_sizing(
+    positions,
+    rcut: float,
+    cap: int = 0,
+    *,
+    side: int = 0,
+    box: float = 0.0,
+    side_max: int = SIDE_MAX,
+    slot_budget: int = SLOT_BUDGET,
+):
+    """Host-side (eager, concrete positions) static (side, cap) sizing
+    for a cutoff-radius cell list.
+
+    side = floor(span / rcut) (cell edge >= rcut, so the effective
+    radius starts at exactly rcut), clipped to [2, side_max]; cap is the
+    next power of two >= the p95 occupied-cell load (the sfmm
+    recommended_sparse_params criterion — mean-based caps run the pair
+    tiles at ~1% useful pairs on clustered states). When side^3 * cap
+    exceeds ``slot_budget`` the grid is halved (coarser cells stay
+    correct — coverage only needs cell >= rcut) and the cap re-fit at
+    the new occupancy. An explicit ``side``/``cap`` pins that knob and
+    fits only the other.
+    """
+    if rcut <= 0.0:
+        raise ValueError(f"nlist rcut must be > 0, got {rcut}")
+    pos = np.asarray(positions, np.float64)
+    if box > 0.0:
+        pos = np.mod(pos, box)
+        origin = np.zeros(3)
+        span = float(box)
+    else:
+        lo, hi = pos.min(axis=0), pos.max(axis=0)
+        span = float((hi - lo).max()) * 1.02 + 1e-30
+        origin = 0.5 * (hi + lo) - 0.5 * span
+    side_forced = bool(side)
+    # The periodic evaluator needs side >= 3 (at side 2 the +-1 offsets
+    # wrap onto the same neighbor twice); isolated grids floor at 2.
+    # A box/rcut < 3 then degrades the radius to the cell edge — the
+    # warning below fires — instead of crashing mid-run.
+    side_min = 3 if box > 0.0 else 2
+    if not side:
+        # Coverage wants cell >= rcut (side <= span/rcut); the DENSE
+        # layout additionally wants mean occupancy >= O(1) — every cell
+        # is 27 tiles of work whether or not anything lives in it, so a
+        # grid much finer than the particle count pays pure volume
+        # (the sfmm lesson). Coarser-than-rcut cells are always correct.
+        occ_side = max(side_min, int(np.cbrt(2.0 * max(pos.shape[0], 1))))
+        side = int(np.clip(
+            min(int(span / rcut), occ_side), side_min, side_max
+        ))
+    while True:
+        u = np.clip(
+            ((pos - origin[None, :]) / span * side).astype(np.int64),
+            0, side - 1,
+        )
+        ids = (u[:, 0] * side + u[:, 1]) * side + u[:, 2]
+        _, counts = np.unique(ids, return_counts=True)
+        p95 = float(np.percentile(counts, 95))
+        c = cap
+        if not c:
+            c = 8
+            while c < min(1024, max(8, int(np.ceil(p95)))):
+                c *= 2
+        if side**3 * c <= slot_budget or side <= side_min or side_forced:
+            if span / side < rcut:
+                # side is floored at 2 (and an explicit side is taken
+                # as given), so rcut > span/side means the effective
+                # truncation radius is the CELL EDGE, not the declared
+                # rcut — at sizing time, not the documented cube-drift
+                # case. Say so: the masked-direct reference (tests,
+                # --debug-check, the autotune competitor) truncates at
+                # the full rcut and would disagree by design.
+                import warnings
+
+                warnings.warn(
+                    f"nlist rcut={rcut:g} exceeds the cell edge "
+                    f"{span / side:g} at side={side}: the effective "
+                    "truncation radius degrades to the cell edge "
+                    "(min(rcut, span/side)). Shrink rcut below "
+                    "span/2 or raise the side for full-radius "
+                    "coverage.",
+                    stacklevel=2,
+                )
+            return side, c
+        side = max(side_min, side // 2)
+
+
+def evaluated_pairs_per_eval(side: int, cap: int, t_cap: int = 0) -> int:
+    """Pair-tile slots the kernel actually evaluates per force
+    evaluation — side^3 cells x 27 neighbors x (t_cap, cap) tiles,
+    padding included (the tiles are dense by design). The honest flop
+    base for the nlist roofline/MFU, vs the N*(N-1) *dense-equivalent*
+    rate the bench line reports as throughput."""
+    return side**3 * 27 * (t_cap or cap) * cap
+
+
+# ---------------------------------------------------------------------------
+# Pair-weight kinds: the ONE place each kernel's math lives, shared by
+# the Pallas body and the jnp sweep so the two implementations cannot
+# drift (parity is pinned in tests/test_nlist.py).
+# ---------------------------------------------------------------------------
+
+
+def _newton_w(r2, gm, params, *, cutoff, eps, use_rcut, dtype):
+    """Truncated softened-Newtonian diff-multiplier: w = G m / (r^2 +
+    eps^2)^(3/2) for cutoff^2 < r^2 + eps^2, r <= rcut_eff (params[0] =
+    rcut_eff^2, traced — min(rcut, cell edge), see module docstring),
+    r > 0. gm is premultiplied G*m (zero on padded slots)."""
+    eps2 = jnp.asarray(eps * eps, dtype)
+    r2s = r2 + eps2
+    valid = r2s > jnp.asarray(cutoff * cutoff, dtype)
+    valid = jnp.logical_and(valid, r2 > 0)
+    if use_rcut:
+        valid = jnp.logical_and(valid, r2 <= params[0])
+    safe = jnp.where(valid, r2s, jnp.asarray(1.0, dtype))
+    inv_r = jax.lax.rsqrt(safe)
+    return jnp.where(
+        valid, ((gm * inv_r) * inv_r) * inv_r, jnp.asarray(0.0, dtype)
+    )
+
+
+def _ewald_w(r2, gm, params, *, cutoff, eps, dtype):
+    """P3M short-range (erfc-remainder) diff-multiplier through the
+    cell list: params = [rcut^2, alpha] (both traced — they scale with
+    the mesh spacing). Same masks as the p3m gather/slice passes."""
+    from .p3m import _short_range_w  # trace-time; p3m imports us lazily
+
+    eps2 = jnp.asarray(eps * eps, dtype)
+    alpha = params[1].astype(dtype)
+    valid = r2 < params[0]
+    valid = jnp.logical_and(
+        valid, r2 + eps2 > jnp.asarray(cutoff * cutoff, dtype)
+    )
+    valid = jnp.logical_and(valid, r2 > 0)
+    w = _short_range_w(r2, alpha, eps2, alpha * alpha * alpha, dtype)
+    return jnp.where(valid, gm * w, jnp.asarray(0.0, dtype))
+
+
+def _pair_w(kind: str, **kw):
+    if kind == "newton":
+        return partial(_newton_w, **kw)
+    if kind == "ewald":
+        kw.pop("use_rcut", None)
+        return partial(_ewald_w, **kw)
+    raise ValueError(f"unknown nlist pair kind {kind!r}")
+
+
+def _source_overflow_channels(
+    cells_pos, cells_mass, cell_count, cmass_hat, ccom, m_scale, g,
+    cap: int, dtype,
+):
+    """(rem_w, rem_com, over): each cell's beyond-cap remainder weight
+    (G * remainder mass), COM, and overflow flag — the ONE definition of
+    the normalized-mass overflow accounting (m * x overflows fp32 at
+    astronomical scales) shared by all three consumers (the p3m near
+    field, the tree near field, the standalone backend)."""
+    pref_mhat = jnp.sum(cells_mass, axis=-1) / m_scale
+    over = cell_count > cap
+    rem_mhat = jnp.maximum(
+        jnp.where(over, cmass_hat - pref_mhat, 0.0), 0.0
+    )
+    tot_mw = ccom * cmass_hat[:, None]
+    pref_mw = jnp.sum(
+        (cells_mass / m_scale)[..., None] * cells_pos, axis=-2
+    )
+    rem_com = (tot_mw - pref_mw) / jnp.maximum(
+        rem_mhat, jnp.asarray(1e-37, dtype)
+    )[:, None]
+    rem_w = jnp.asarray(g, dtype) * rem_mhat * m_scale
+    return rem_w, rem_com, over
+
+
+def _monopole_w(kind: str, r2, w_mass, params, eps_o2, dtype):
+    """Overflow-channel monopole diff-multiplier: the pair kernel at a
+    cell-size-widened softening, masked ONLY through ``w_mass`` (zero
+    off the overflow set) — the exact contract of the sibling overflow
+    paths (p3m._short_range_shifted, tree's _monopole_acc overflow):
+    no rcut/cutoff mask on remainder monopoles, mass is never dropped."""
+    if kind == "newton":
+        inv_r = jax.lax.rsqrt(
+            jnp.maximum(r2 + eps_o2, jnp.asarray(1e-30, dtype))
+        )
+        return (w_mass * inv_r) * inv_r * inv_r
+    from .p3m import _short_range_w  # trace-time (no import cycle)
+
+    alpha = params[1].astype(dtype)
+    return w_mass * _short_range_w(
+        r2, alpha, eps_o2, alpha * alpha * alpha, dtype
+    )
+
+
+# ---------------------------------------------------------------------------
+# Pallas tile engine
+# ---------------------------------------------------------------------------
+
+
+def _nlist_kernel(
+    params_ref, tpos_ref, spos_ref, gm_ref, acc_ref, *,
+    kind, cutoff, eps, use_rcut,
+):
+    """One (cell, neighbor-offset) pair tile.
+
+    Grid is (side^3, 27) with the offset axis minor, so each cell's
+    (t_cap, 3) accumulator block stays VMEM-resident across its 27
+    neighbor tiles (the pallas_forces j-stream pattern). The neighbor
+    tile is addressed entirely by the BlockSpec index map — arithmetic
+    on the grid indices over the ws=1-padded cell grid — so the hot
+    loop issues zero gather indices. Same mixed layout as
+    ops/pallas_forces.py: targets (t_cap, 3) row-blocks sliced to
+    (t_cap, 1) columns, sources transposed (3, cap) with the slot axis
+    on lanes.
+    """
+    o = pl.program_id(1)
+
+    @pl.when(o == 0)
+    def _():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    tpos = tpos_ref[0]  # (t_cap, 3)
+    spos = spos_ref[0]  # (3, cap) transposed neighbor-cell sources
+    gm = gm_ref[0]  # (1, cap) premultiplied G*m (0 = padded slot)
+    params = params_ref[0]  # (4,) traced scalars
+
+    dx = spos[0:1, :] - tpos[:, 0:1]  # (t_cap, cap)
+    dy = spos[1:2, :] - tpos[:, 1:2]
+    dz = spos[2:3, :] - tpos[:, 2:3]
+    dtype = dx.dtype
+    r2 = dx * dx + dy * dy + dz * dz
+    w = _pair_w(
+        kind, cutoff=cutoff, eps=eps, use_rcut=use_rcut, dtype=dtype
+    )(r2, gm, params)
+    ax = jnp.sum(w * dx, axis=1, keepdims=True)  # (t_cap, 1)
+    ay = jnp.sum(w * dy, axis=1, keepdims=True)
+    az = jnp.sum(w * dz, axis=1, keepdims=True)
+    acc_ref[...] += jnp.concatenate([ax, ay, az], axis=1)[None]
+
+
+def _pallas_pair_cells(
+    tcells_pos, cells_pos, cells_gm, side, params, *,
+    kind, cutoff, eps, use_rcut, interpret,
+):
+    """Pair-tile part of the 27-neighborhood sweep via the Pallas
+    kernel. tcells_pos (side^3, t_cap, 3); cells_pos (side^3, cap, 3);
+    cells_gm (side^3, cap) premultiplied G*m. Returns (side^3, t_cap, 3)
+    accelerations in (cell, slot) layout. Isolated BCs only (the
+    periodic wrap runs the jnp sweep)."""
+    s = side
+    p = s + 2
+    n_cells = s * s * s
+    t_cap = tcells_pos.shape[1]
+    cap = cells_pos.shape[1]
+    dtype = tcells_pos.dtype
+
+    # ws=1-padded transposed source grid, flattened cell-major: the
+    # kernel's index map addresses neighbor cells as flat rows of these
+    # arrays (out-of-cube neighbors read zero-mass padding — exact
+    # no-ops, no bounds test needed).
+    pos_g = cells_pos.reshape(s, s, s, cap, 3)
+    gm_g = cells_gm.reshape(s, s, s, cap)
+    pos_p = jnp.pad(
+        jnp.swapaxes(pos_g, -1, -2), ((1, 1),) * 3 + ((0, 0), (0, 0))
+    ).reshape(p * p * p, 3, cap)
+    gm_p = jnp.pad(gm_g, ((1, 1),) * 3 + ((0, 0),))[..., None, :].reshape(
+        p * p * p, 1, cap
+    )
+    params_arr = jnp.zeros((1, 4), dtype).at[0, : params.shape[0]].set(
+        params.astype(dtype)
+    )
+
+    def neighbor_row(c, o):
+        # Flat padded row of cell c's o-th neighbor: decode c to grid
+        # coords, o to the row-major (dx, dy, dz) stencil of
+        # cells._near_offsets (dx = o // 9 - 1, ...), shift into the
+        # padded frame (+1 cancels the -1).
+        cx = c // (s * s)
+        cy = (c // s) % s
+        cz = c % s
+        return ((cx + o // 9) * p + (cy + (o // 3) % 3)) * p + (
+            cz + o % 3
+        )
+
+    kernel = functools.partial(
+        _nlist_kernel, kind=kind, cutoff=cutoff, eps=eps,
+        use_rcut=use_rcut,
+    )
+    flops_per_pair = 21
+    return pl.pallas_call(
+        kernel,
+        grid=(n_cells, 27),
+        in_specs=[
+            pl.BlockSpec((1, 4), lambda c, o: (0, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, t_cap, 3), lambda c, o: (c, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 3, cap),
+                         lambda c, o: (neighbor_row(c, o), 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, cap),
+                         lambda c, o: (neighbor_row(c, o), 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, t_cap, 3), lambda c, o: (c, 0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((n_cells, t_cap, 3), dtype),
+        cost_estimate=pl.CostEstimate(
+            flops=flops_per_pair * n_cells * 27 * t_cap * cap,
+            bytes_accessed=(n_cells * t_cap * 3 * 2
+                            + n_cells * 27 * cap * 4) * 4,
+            transcendentals=n_cells * 27 * t_cap * cap,
+        ),
+        interpret=interpret,
+    )(params_arr, tcells_pos, pos_p, gm_p)
+
+
+# ---------------------------------------------------------------------------
+# jnp shifted-slice tile engine (reference path; also the periodic path)
+# ---------------------------------------------------------------------------
+
+
+def _jnp_pair_cells(
+    tcells_pos, cells_pos, cells_gm, side, params, *,
+    kind, cutoff, eps, use_rcut, box=0.0,
+):
+    """Same tile math as the Pallas kernel via whole-grid shifted
+    slices (the fmm/p3m slice-pass data movement), plane-mapped to
+    bound the live (S^2, t_cap, cap) transient. ``box`` > 0 switches
+    the neighbor reads to periodic rolls with minimum-image position
+    shifts."""
+    s = side
+    t_cap = tcells_pos.shape[1]
+    cap = cells_pos.shape[1]
+    dtype = tcells_pos.dtype
+    pos_g = cells_pos.reshape(s, s, s, cap, 3)
+    gm_g = cells_gm.reshape(s, s, s, cap)
+    tpos_g = tcells_pos.reshape(s, s, s, t_cap, 3)
+    near = jnp.asarray(_near_offsets(1), jnp.int32)
+    pair_w = _pair_w(
+        kind, cutoff=cutoff, eps=eps, use_rcut=use_rcut, dtype=dtype
+    )
+
+    if box <= 0.0:
+        pos_p = jnp.pad(pos_g, ((1, 1),) * 3 + ((0, 0), (0, 0)))
+        gm_p = jnp.pad(gm_g, ((1, 1),) * 3 + ((0, 0),))
+
+    def one_plane(x0):
+        tpos = jax.lax.dynamic_slice(
+            tpos_g, (x0, _I0, _I0, _I0, _I0), (1, s, s, t_cap, 3)
+        ).reshape(-1, t_cap, 3)
+        c = tpos.shape[0]
+
+        def body(acc, off):
+            if box <= 0.0:
+                start = (1 + x0 + off[0], 1 + off[1], 1 + off[2])
+                spos = jax.lax.dynamic_slice(
+                    pos_p, start + (_I0, _I0), (1, s, s, cap, 3)
+                ).reshape(c, cap, 3)
+                sgm = jax.lax.dynamic_slice(
+                    gm_p, start + (_I0,), (1, s, s, cap)
+                ).reshape(c, cap)
+            else:
+                # Periodic: neighbor cell (c + off) mod S read via
+                # roll on the y/z axes + a modular x-plane pick; wrapped
+                # cells' positions get the +-box image shift so diffs
+                # are minimum-image by construction (side >= 3 and cell
+                # edge >= rcut guarantee each in-range pair appears in
+                # exactly one offset).
+                xs = (x0 + off[0]) % s
+                spos_pl = jax.lax.dynamic_slice(
+                    pos_g, (xs, _I0, _I0, _I0, _I0), (1, s, s, cap, 3)
+                )[0]
+                sgm_pl = jax.lax.dynamic_slice(
+                    gm_g, (xs, _I0, _I0, _I0), (1, s, s, cap)
+                )[0]
+                spos_pl = jnp.roll(
+                    spos_pl, (-off[1], -off[2]), axis=(0, 1)
+                )
+                sgm_pl = jnp.roll(sgm_pl, (-off[1], -off[2]), axis=(0, 1))
+                bx = jnp.asarray(box, dtype)
+                shift_x = bx * ((x0 + off[0]) // s).astype(dtype)
+                iy = jnp.arange(s, dtype=jnp.int32)
+                shift_y = bx * ((iy + off[1]) // s).astype(dtype)
+                shift_z = bx * ((iy + off[2]) // s).astype(dtype)
+                shift = jnp.zeros((s, s, 1, 3), dtype)
+                shift = shift.at[..., 0].add(shift_x)
+                shift = shift.at[..., 1].add(shift_y[:, None, None])
+                shift = shift.at[..., 2].add(shift_z[None, :, None])
+                spos = (spos_pl + shift).reshape(c, cap, 3)
+                sgm = sgm_pl.reshape(c, cap)
+
+            diff = spos[:, None, :, :] - tpos[:, :, None, :]
+            r2 = jnp.sum(diff * diff, axis=-1)  # (C, t_cap, cap)
+            w = pair_w(r2, sgm[:, None, :], params)
+            return acc + jnp.einsum("cts,ctsd->ctd", w, diff), None
+
+        acc0 = jnp.zeros((c, t_cap, 3), dtype)
+        acc, _ = jax.lax.scan(body, acc0, near)
+        return acc
+
+    planes = jax.lax.map(one_plane, jnp.arange(s, dtype=jnp.int32))
+    return planes.reshape(-1, t_cap, 3)
+
+
+def _remainder_cells(
+    tcells_pos, rem_w, rem_com, over, side, params, *,
+    kind, eps, cell_h, box=0.0,
+):
+    """Source-cap-overflow remainder: each neighbor cell's beyond-cap
+    mass as a cell-size-softened monopole through the same pair kernel
+    — (side^3, t_cap, 3), added to either tile engine's output (the
+    remainder channels are (S^3,)-sized, so this stays jnp on every
+    platform). ``eps`` is widened to max(eps, cell/2): an overflowing
+    cell's COM can sit arbitrarily close to a target."""
+    s = side
+    t_cap = tcells_pos.shape[1]
+    dtype = tcells_pos.dtype
+    tpos_g = tcells_pos.reshape(s, s, s, t_cap, 3)
+    rem_w_g = rem_w.reshape(s, s, s)
+    rem_com_g = rem_com.reshape(s, s, s, 3)
+    over_g = over.reshape(s, s, s)
+    eps_o2 = jnp.maximum(
+        jnp.asarray(eps * eps, dtype),
+        (0.5 * cell_h) * (0.5 * cell_h),
+    )
+
+    acc = jnp.zeros((s, s, s, t_cap, 3), dtype)
+    for off in _near_offsets(1):  # 27 static offsets: static slices/rolls
+        ox, oy, oz = (int(off[0]), int(off[1]), int(off[2]))
+        if box <= 0.0:
+            def shifted(a, tail_dims, ox=ox, oy=oy, oz=oz):
+                pad = ((1, 1),) * 3 + ((0, 0),) * tail_dims
+                ap = jnp.pad(a, pad)
+                return ap[
+                    1 + ox: 1 + ox + s,
+                    1 + oy: 1 + oy + s,
+                    1 + oz: 1 + oz + s,
+                ]
+
+            w_n = shifted(rem_w_g, 0)
+            com_n = shifted(rem_com_g, 1)
+            ov_n = shifted(over_g, 0)
+        else:
+            w_n = jnp.roll(rem_w_g, (-ox, -oy, -oz), axis=(0, 1, 2))
+            com_n = jnp.roll(rem_com_g, (-ox, -oy, -oz), axis=(0, 1, 2))
+            ov_n = jnp.roll(over_g, (-ox, -oy, -oz), axis=(0, 1, 2))
+            idx = np.arange(s)
+            bx = float(box)
+            shift = np.zeros((s, s, s, 3), np.float64)
+            shift[..., 0] += bx * ((idx + ox) // s)[:, None, None]
+            shift[..., 1] += bx * ((idx + oy) // s)[None, :, None]
+            shift[..., 2] += bx * ((idx + oz) // s)[None, None, :]
+            com_n = com_n + jnp.asarray(shift, dtype)
+
+        diff = jnp.where(
+            ov_n[..., None, None],
+            com_n[:, :, :, None, :] - tpos_g,
+            jnp.asarray(0.0, dtype),
+        )
+        r2 = jnp.sum(diff * diff, axis=-1)  # (S, S, S, t_cap)
+        w = _monopole_w(
+            kind, r2, w_n[..., None], params, eps_o2, dtype
+        )
+        acc = acc + w[..., None] * diff
+    return acc.reshape(-1, t_cap, 3)
+
+
+def _overflow_targets(
+    t_pos, t_coords, cell_w, ccom, side, params, *,
+    kind, eps, cell_h, box=0.0,
+):
+    """Fallback for targets beyond t_cap: the 27 neighbor cells as
+    whole-cell monopoles (cell-size softened) through the same pair
+    kernel — bounded resolution-limited degradation, only ever run for
+    the overflow minority (cond-gated by the caller). Per-target
+    gathers; periodic wraps the neighbor ids and applies the image
+    shift."""
+    m = t_pos.shape[0]
+    dtype = t_pos.dtype
+    near = jnp.asarray(_near_offsets(1), jnp.int32)
+    eps_o2 = jnp.maximum(
+        jnp.asarray(eps * eps, dtype), (0.5 * cell_h) * (0.5 * cell_h)
+    )
+
+    def body(acc, off):
+        cell = t_coords + off[None, :]
+        if box > 0.0:
+            wrapped = jnp.mod(cell, side)
+            shift = jnp.asarray(box, dtype) * (cell // side).astype(dtype)
+            in_b = jnp.ones((m,), bool)
+            cell = wrapped
+        else:
+            shift = jnp.zeros((m, 3), dtype)
+            in_b = jnp.all(
+                jnp.logical_and(cell >= 0, cell < side), axis=-1
+            )
+        ids = (
+            jnp.clip(cell[:, 0], 0, side - 1) * side
+            + jnp.clip(cell[:, 1], 0, side - 1)
+        ) * side + jnp.clip(cell[:, 2], 0, side - 1)
+        sw = jnp.where(in_b, cell_w[ids], 0.0)
+        diff = jnp.where(
+            in_b[:, None],
+            ccom[ids] + shift - t_pos,
+            jnp.asarray(0.0, dtype),
+        )
+        r2 = jnp.sum(diff * diff, axis=-1)
+        w = _monopole_w(kind, r2, sw, params, eps_o2, dtype)
+        return acc + w[:, None] * diff, None
+
+    acc, _ = jax.lax.scan(body, jnp.zeros((m, 3), dtype), near)
+    return acc
+
+
+# ---------------------------------------------------------------------------
+# P3M near-field entry (consumer a)
+# ---------------------------------------------------------------------------
+
+
+def nlist_short_range_cells(
+    tcells_pos, t_cap, cells_pos, cells_mass, cell_count, cmass_hat,
+    ccom, m_scale, span, side, cap, g, cutoff, eps, alpha, rcut, dtype,
+    *, impl: str = "jnp",
+):
+    """Drop-in replacement for p3m._short_range_shifted — same argument
+    contract, same (side^3, t_cap, 3) output in (cell, slot) layout —
+    with the erfc pair tiles evaluated by the nlist engine (Pallas on
+    TPU, jnp reference elsewhere) instead of the plane-scan slice pass.
+    The overflow-remainder monopole rides the shared jnp channel."""
+    gm = jnp.asarray(g, dtype) * cells_mass
+    params = jnp.asarray([rcut * rcut, alpha], dtype)
+    kw = dict(kind="ewald", cutoff=cutoff, eps=eps, use_rcut=True)
+    if impl == "pallas":
+        acc = _pallas_pair_cells(
+            tcells_pos, cells_pos, gm, side, params,
+            interpret=jax.default_backend() != "tpu", **kw,
+        )
+    else:
+        acc = _jnp_pair_cells(
+            tcells_pos, cells_pos, gm, side, params, **kw
+        )
+
+    # Per-cell beyond-cap remainder (normalized-mass ordering — the
+    # p3m/tree/sfmm overflow contract).
+    rem_w, rem_com, over = _source_overflow_channels(
+        cells_pos, cells_mass, cell_count, cmass_hat, ccom, m_scale,
+        g, cap, dtype,
+    )
+    acc = acc + _remainder_cells(
+        tcells_pos, rem_w, rem_com, over, side, params,
+        kind="ewald", eps=eps, cell_h=span / side,
+    )
+    return acc
+
+
+# ---------------------------------------------------------------------------
+# Octree leaf/near-field entry (consumer b)
+# ---------------------------------------------------------------------------
+
+
+def nlist_near_field(
+    targets, t_coords, cells_pos, cells_mass, cell_count, cmass, ccom,
+    m_scale, span, side, cap, g, cutoff, eps, dtype, *,
+    impl: str = "jnp", t_cap: int = 0,
+):
+    """The octree near field (``--tree-near nlist``): the exact
+    27-neighborhood pair sum over the tree's (side^3, leaf_cap) leaf
+    blocks, evaluated as fixed-degree cell tiles instead of per-target
+    chunk gathers. Plain Newtonian kernel, no truncation radius (the
+    near field is everything in the neighborhood — the far field covers
+    the rest), same overflow contracts as the gather near field:
+    beyond-cap source remainder as a cell-size-softened monopole,
+    beyond-``t_cap`` targets via the whole-cell-monopole fallback.
+    ``cmass``/``ccom`` are the leaf level's cell totals (raw mass —
+    build_octree rescales). Returns per-target accelerations in the
+    caller's target order."""
+    kt = targets.shape[0]
+    t_cap = t_cap or cap
+    cell_h = span / side
+    params = jnp.zeros((2,), dtype)  # newton without rcut: unused slots
+    gm = jnp.asarray(g, dtype) * cells_mass
+
+    tcells_pos, _, _, t_start, t_sort, t_sorted_ids = bin_to_cells(
+        targets, jnp.ones((kt,), dtype), t_coords, side, t_cap
+    )
+    kw = dict(kind="newton", cutoff=cutoff, eps=eps, use_rcut=False)
+    if impl == "pallas":
+        acc_cell = _pallas_pair_cells(
+            tcells_pos, cells_pos, gm, side, params,
+            interpret=jax.default_backend() != "tpu", **kw,
+        )
+    else:
+        acc_cell = _jnp_pair_cells(
+            tcells_pos, cells_pos, gm, side, params, **kw
+        )
+
+    rem_w, rem_com, over = _source_overflow_channels(
+        cells_pos, cells_mass, cell_count, cmass / m_scale, ccom,
+        m_scale, g, cap, dtype,
+    )
+    acc_cell = acc_cell + _remainder_cells(
+        tcells_pos, rem_w, rem_com, over, side, params,
+        kind="newton", eps=eps, cell_h=cell_h,
+    )
+
+    slot = jnp.arange(kt, dtype=jnp.int32) - t_start[t_sorted_ids]
+    over_t = slot >= t_cap
+    acc_sorted = acc_cell[t_sorted_ids, jnp.minimum(slot, t_cap - 1)]
+    acc_sorted = jax.lax.cond(
+        jnp.any(over_t),
+        lambda a: jnp.where(
+            over_t[:, None],
+            _overflow_targets(
+                targets[t_sort], t_coords[t_sort],
+                jnp.asarray(g, dtype) * cmass, ccom, side, params,
+                kind="newton", eps=eps, cell_h=cell_h,
+            ),
+            a,
+        ),
+        lambda a: a,
+        acc_sorted,
+    )
+    inv = jnp.zeros((kt,), jnp.int32).at[t_sort].set(
+        jnp.arange(kt, dtype=jnp.int32)
+    )
+    return acc_sorted[inv]
+
+
+# ---------------------------------------------------------------------------
+# Standalone cutoff-dynamics backend (consumer c)
+# ---------------------------------------------------------------------------
+
+
+def nlist_accelerations_vs(
+    targets: jax.Array,
+    positions: jax.Array,
+    masses: jax.Array,
+    *,
+    rcut: float,
+    side: int,
+    cap: int = DEFAULT_CAP,
+    t_cap: int = 0,
+    g: float = G,
+    cutoff: float = CUTOFF_RADIUS,
+    eps: float = 0.0,
+    box: float = 0.0,
+    impl: str = "auto",
+    _self: bool = False,
+) -> jax.Array:
+    """Truncated softened-Newtonian accelerations at ``targets`` from
+    sources (positions, masses): the exact pair sum over all pairs with
+    r <= min(rcut, cell edge), zero beyond — declared short-range
+    physics (``--force-backend nlist``), NOT an approximation of full
+    gravity. ``side``/``cap`` are the static cell-list sizing
+    (:func:`resolve_nlist_sizing`); ``box`` > 0 evaluates on the
+    periodic unit cell with minimum-image wrapping (jnp engine).
+    Overflow degradations per the module docstring."""
+    impl = _resolve_impl(impl)
+    if box > 0.0:
+        if side < 3:
+            raise ValueError(
+                f"periodic nlist needs side >= 3 (box/rcut >= 3); got "
+                f"side={side}"
+            )
+        impl = "jnp"  # the Pallas engine is isolated-BCs only
+    return _nlist_accelerations_impl(
+        targets, positions, masses, rcut=rcut, side=side, cap=cap,
+        t_cap=t_cap or cap, g=g, cutoff=cutoff, eps=eps, box=box,
+        impl=impl, _self=_self,
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "rcut", "side", "cap", "t_cap", "g", "cutoff", "eps", "box",
+        "impl", "_self",
+    ),
+)
+def _nlist_accelerations_impl(
+    targets, positions, masses, *, rcut, side, cap, t_cap, g, cutoff,
+    eps, box, impl, _self,
+):
+    kt = targets.shape[0]
+    dtype = positions.dtype
+    if box > 0.0:
+        origin = jnp.zeros((3,), dtype)
+        span = jnp.asarray(box, dtype)
+        positions = jnp.mod(positions, span)
+        targets = jnp.mod(targets, span)
+    else:
+        origin, span = bounding_cube(positions)
+    cell_h = span / side
+    # Effective truncation radius: min(rcut, cell edge). The 27-cell
+    # neighborhood guarantees coverage only to one cell edge, so when
+    # the (static-side) grid's cells shrink below rcut — a bounding
+    # cube that contracted since sizing — the radius degrades instead
+    # of pairs silently dropping at the rim.
+    rcut_eff2 = jnp.minimum(jnp.asarray(rcut, dtype), cell_h) ** 2
+    params = jnp.stack([rcut_eff2, jnp.asarray(0.0, dtype)])
+
+    coords = grid_coords(positions, origin, span, side)
+    cell_ids = (coords[:, 0] * side + coords[:, 1]) * side + coords[:, 2]
+    n_cells = side**3
+    (cells_pos, cells_mass, cell_count, cell_start, src_sort,
+     src_sorted_ids) = bin_to_cells(positions, masses, coords, side, cap)
+    cells_gm = jnp.asarray(g, dtype) * cells_mass
+
+    # Per-cell totals for the overflow channels (normalized-mass
+    # accumulation: m * x overflows fp32 at astronomical scales).
+    m_scale = jnp.maximum(jnp.max(masses), jnp.asarray(1e-37, dtype))
+    m_hat = masses / m_scale
+    cmass_hat = jax.ops.segment_sum(m_hat, cell_ids, num_segments=n_cells)
+    cmw = jax.ops.segment_sum(
+        m_hat[:, None] * positions, cell_ids, num_segments=n_cells
+    )
+    ccom = cmw / jnp.maximum(cmass_hat, jnp.asarray(1e-37, dtype))[:, None]
+
+    t_coords = grid_coords(targets, origin, span, side)
+    if _self and t_cap == cap:
+        # Self form: target binning is bitwise the source binning.
+        tcells_pos, t_start, t_sort, t_sorted_ids = (
+            cells_pos, cell_start, src_sort, src_sorted_ids
+        )
+    else:
+        tcells_pos, _, _, t_start, t_sort, t_sorted_ids = bin_to_cells(
+            targets, jnp.ones((kt,), dtype), t_coords, side, t_cap
+        )
+
+    kw = dict(kind="newton", cutoff=cutoff, eps=eps, use_rcut=True)
+    if impl == "pallas" and box <= 0.0:
+        acc_cell = _pallas_pair_cells(
+            tcells_pos, cells_pos, cells_gm, side, params,
+            interpret=jax.default_backend() != "tpu", **kw,
+        )
+    else:
+        acc_cell = _jnp_pair_cells(
+            tcells_pos, cells_pos, cells_gm, side, params, box=box, **kw
+        )
+
+    # Source cap overflow: remainder monopoles (bounded degradation).
+    rem_w, rem_com, over = _source_overflow_channels(
+        cells_pos, cells_mass, cell_count, cmass_hat, ccom, m_scale,
+        g, cap, dtype,
+    )
+    acc_cell = acc_cell + _remainder_cells(
+        tcells_pos, rem_w, rem_com, over, side, params,
+        kind="newton", eps=eps, cell_h=cell_h, box=box,
+    )
+
+    # Un-bin to per-target order; overflow targets take the whole-cell
+    # monopole fallback (cond-gated: well-sized runs never pay it).
+    slot = jnp.arange(kt, dtype=jnp.int32) - t_start[t_sorted_ids]
+    over_t = slot >= t_cap
+    acc_sorted = acc_cell[t_sorted_ids, jnp.minimum(slot, t_cap - 1)]
+    acc_sorted = jax.lax.cond(
+        jnp.any(over_t),
+        lambda a: jnp.where(
+            over_t[:, None],
+            _overflow_targets(
+                targets[t_sort], t_coords[t_sort],
+                jnp.asarray(g, dtype) * cmass_hat * m_scale, ccom,
+                side, params, kind="newton", eps=eps, cell_h=cell_h,
+                box=box,
+            ),
+            a,
+        ),
+        lambda a: a,
+        acc_sorted,
+    )
+    inv = jnp.zeros((kt,), jnp.int32).at[t_sort].set(
+        jnp.arange(kt, dtype=jnp.int32)
+    )
+    return acc_sorted[inv]
+
+
+def nlist_accelerations(
+    positions: jax.Array,
+    masses: jax.Array,
+    **kwargs,
+) -> jax.Array:
+    """Cutoff-truncated accelerations for all particles (targets =
+    sources)."""
+    return nlist_accelerations_vs(
+        positions, positions, masses, _self=True, **kwargs
+    )
+
+
+def make_nlist_local_kernel(
+    *,
+    rcut: float,
+    side: int,
+    cap: int = DEFAULT_CAP,
+    t_cap: int = 0,
+    g: float = G,
+    cutoff: float = CUTOFF_RADIUS,
+    eps: float = 0.0,
+    box: float = 0.0,
+    impl: str = "auto",
+):
+    """A LocalKernel closure for the Simulator/serve engine.
+
+    The jnp engine is natively differentiable; the Pallas engine (like
+    every pallas_call) has no autodiff rule, so the kernel is wrapped
+    with the dense rcut-masked VJP — the backward runs the dense jnp
+    math of the same truncated force contract
+    (ops/forces.wrap_with_dense_vjp)."""
+    impl = _resolve_impl(impl)
+    common = dict(
+        rcut=rcut, side=side, cap=cap, t_cap=t_cap, g=g, cutoff=cutoff,
+        eps=eps, box=box, impl=impl,
+    )
+
+    def _forward(pos_i, pos_j, masses_j):
+        return nlist_accelerations_vs(pos_i, pos_j, masses_j, **common)
+
+    if impl != "pallas":
+        return _forward
+    from .forces import wrap_with_dense_vjp
+
+    return wrap_with_dense_vjp(
+        _forward, g=g, cutoff=cutoff, eps=eps, rcut=rcut
+    )
+
+
+def check_nlist_sizing(n: int, side: int, cap: int) -> str | None:
+    """Warning string when the static cell list looks mis-sized for the
+    data — the check_p3m_sizing analog the Simulator surfaces at build.
+    Mean-occupancy cap check with the same 2x clustering headroom (the
+    data-driven p95 fit lives in resolve_nlist_sizing; this is the
+    cheap post-hoc sanity check for explicit knobs)."""
+    mean_occ = n / side**3
+    if cap < 2.0 * mean_occ:
+        return (
+            f"nlist cap={cap} is below 2x the mean cell occupancy "
+            f"({mean_occ:.1f} at side {side}): dense cells will "
+            "overflow to the monopole remainder on near pairs. Raise "
+            "--nlist-cap (or let resolve_nlist_sizing pick from the "
+            "data)."
+        )
+    return None

@@ -74,13 +74,12 @@ import numpy as np
 from ..constants import CUTOFF_RADIUS, G
 from ..utils.compat import axis_size as _axis_size
 from ..utils.compat import shard_map as _shard_map
-from .cells import _scatter_cells, grid_coords
+from .cells import _near_offsets, _scatter_cells, grid_coords
 from .fmm import (
     _monopole_coarse_levels,
     _quad_correction,
 )
 from .tree import (
-    _near_offsets,
     _offsets,
     _parity_mask_table,
     build_octree,

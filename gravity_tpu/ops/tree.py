@@ -51,7 +51,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..constants import CUTOFF_RADIUS, G
-from .cells import build_padded_cells, grid_coords, map_target_chunks
+from .cells import (
+    _near_offsets,
+    build_padded_cells,
+    grid_coords,
+    map_target_chunks,
+)
 
 # ---------------------------------------------------------------------------
 # Interaction-list offset table: for each parity (cell coord mod 2 per axis)
@@ -89,12 +94,9 @@ def _parity_mask_table(ws: int) -> np.ndarray:
     return table
 
 
-def _near_offsets(ws: int) -> np.ndarray:
-    rng = range(-ws, ws + 1)
-    return np.array(
-        [(dx, dy, dz) for dx in rng for dy in rng for dz in rng],
-        dtype=np.int32,
-    )
+# _near_offsets moved to ops/cells.py (one owner for the near stencil
+# shared by tree/fmm/sfmm/p3m/pallas_nlist); re-imported above so
+# existing `from .tree import _near_offsets` call sites keep working.
 
 
 # ---------------------------------------------------------------------------
@@ -440,7 +442,7 @@ def _pair_acc(pos, src_pos, src_mass, mask, g, cutoff, eps, dtype):
     jax.jit,
     static_argnames=(
         "depth", "leaf_cap", "chunk", "ws", "g", "cutoff", "eps", "far",
-        "quad",
+        "quad", "near_mode",
     ),
 )
 def tree_accelerations_vs(
@@ -457,6 +459,7 @@ def tree_accelerations_vs(
     eps: float = 0.0,
     far: str = "direct",
     quad: bool = True,
+    near_mode: str = "gather",
 ) -> jax.Array:
     """Octree accelerations at ``targets`` from sources (positions, masses).
 
@@ -480,9 +483,26 @@ def tree_accelerations_vs(
       by ~(mean occupancy x coarse levels) — TPU gathers are index-rate
       bound — at the cost of ~5-10% median force error on 3D fields
       (~1% on disks). The opt-in speed mode for gather-bound runs.
+
+    ``near_mode`` selects the near field's data movement:
+    - "gather" (default) — per-target (C, |near|) block gathers inside
+      the chunk loop (the classic path).
+    - "nlist" — the cell-list tile engine (ops/pallas_nlist.py): the
+      exact same neighborhood pair set and overflow contract, evaluated
+      as fixed-degree (leaf_cap, leaf_cap) cell tiles — the Pallas
+      kernel on TPU, its jnp reference elsewhere. ws=1 only (the tile
+      engine's stencil is the shared 27-cell neighborhood).
     """
     if far not in ("expansion", "direct"):
         raise ValueError(f"unknown far-field mode {far!r}")
+    if near_mode not in ("gather", "nlist"):
+        raise ValueError(f"unknown near-field mode {near_mode!r}")
+    if near_mode == "nlist" and ws != 1:
+        raise ValueError(
+            "near_mode='nlist' evaluates the shared 27-cell stencil "
+            f"(ws=1); got ws={ws} — use near_mode='gather' for wider "
+            "neighborhoods"
+        )
     n = positions.shape[0]
     dtype = positions.dtype
     # Quadrupole moments raise the far-field order (error theta^2 ->
@@ -558,6 +578,11 @@ def tree_accelerations_vs(
                 h_d=span / (1 << d), m_scale=m_scale,
             )
 
+        if near_mode == "nlist":
+            # Near field handled by the cell-list tile engine below —
+            # this chunk pass carries the far field only.
+            return acc
+
         # Near field: exact pairs from the neighbor leaves (capped),
         # plus a monopole correction for capped-out overflow.
         c = pos_c.shape[0]
@@ -596,7 +621,22 @@ def tree_accelerations_vs(
         acc = jax.lax.cond(over_any, add_overflow, lambda a: a, acc)
         return acc
 
-    return map_target_chunks(chunk_acc, targets, t_coords, chunk)
+    acc_far = map_target_chunks(chunk_acc, targets, t_coords, chunk)
+    if near_mode == "gather":
+        return acc_far
+
+    # --tree-near nlist: the identical neighborhood pair set + overflow
+    # contract, evaluated as fixed-degree cell tiles over the leaf
+    # blocks already built above (ops/pallas_nlist.py; Pallas on TPU,
+    # jnp reference elsewhere).
+    from .pallas_nlist import nlist_near_field
+
+    return acc_far + nlist_near_field(
+        targets, t_coords, cells_pos, cells_mass, leaf_count,
+        levels[depth][0], levels[depth][1], m_scale, span, side,
+        leaf_cap, g, cutoff, eps, dtype,
+        impl="pallas" if jax.default_backend() == "tpu" else "jnp",
+    )
 
 
 def tree_accelerations(

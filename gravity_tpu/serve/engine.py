@@ -46,8 +46,12 @@ from ..state import ParticleState
 # trivially; the Pallas kernels batch through pallas_call's vmap rule
 # (an extra grid axis). Fast solvers (tree/fmm/pm/...) are per-system
 # programs with data-dependent builds — out of scope for the ensemble
-# path (jobs big enough to want them should run solo anyway).
-ENGINE_BACKENDS = ("dense", "chunked", "pallas", "pallas-mxu")
+# path (jobs big enough to want them should run solo anyway). nlist
+# (the cutoff-radius cell-list kernel) is servable because its sizing
+# is STATIC config (nlist_side/nlist_cap ride the BatchKey extra —
+# required at submit, since no concrete state exists at admission) and
+# both its engines are vmap-safe (tests/test_nlist.py pins it).
+ENGINE_BACKENDS = ("dense", "chunked", "pallas", "pallas-mxu", "nlist")
 
 MIN_BUCKET = 16
 # Largest padded bucket the engine accepts. Every engine backend is a
@@ -156,7 +160,13 @@ def batch_key_for(
         # batched dense jnp form — one (B, n, n) contraction, the
         # measured-right small-N shape.
         backend = "dense"
-        if getattr(config, "autotune", True):
+        if config.nlist_rcut > 0.0:
+            # Declared truncated physics: of the engine's probe set
+            # only the jnp dense form honors the rcut mask (the TPU
+            # pallas candidates compute full gravity and would win the
+            # probe then trip the guard below) — route statically.
+            pass
+        elif getattr(config, "autotune", True):
             from ..autotune import resolve_engine_backend
 
             backend = resolve_engine_backend(
@@ -170,6 +180,38 @@ def batch_key_for(
                 f"backends ({'/'.join(ENGINE_BACKENDS)})"
             )
         backend = rerouted
+    if backend == "nlist" or config.nlist_rcut > 0.0:
+        # Truncated-physics jobs: the rcut (and, for the nlist kernel,
+        # its static cell-list sizing) is part of the compiled program
+        # — it rides the BatchKey so jobs with different radii never
+        # share a batch, and the kernel builder below reconstructs it.
+        if config.nlist_rcut <= 0.0:
+            raise ValueError(
+                "force_backend='nlist' needs nlist_rcut > 0 "
+                "(--nlist-rcut): the cell-list kernel computes "
+                "rcut-truncated forces"
+            )
+        if backend not in ("nlist", "dense", "chunked"):
+            # Only those three honor the rcut mask; keying a
+            # full-gravity batch as truncated would silently serve the
+            # wrong physics — a clean 400, not a mislabeled result.
+            raise ValueError(
+                f"nlist_rcut > 0 declares truncated physics, but "
+                f"force_backend {backend!r} computes full gravity and "
+                "ignores it; use nlist (or dense/chunked, which apply "
+                "the rcut mask)"
+            )
+        if backend == "nlist" and config.nlist_side <= 0:
+            raise ValueError(
+                "served nlist jobs need an explicit --nlist-side: no "
+                "concrete state exists at admission to size the cell "
+                "list from"
+            )
+        extra = tuple(extra) + (
+            ("nlist_rcut", config.nlist_rcut),
+            ("nlist_side", config.nlist_side),
+            ("nlist_cap", config.nlist_cap),
+        )
     return BatchKey(
         bucket_n=bucket_size(config.n, min_bucket),
         slots=slots,
@@ -293,9 +335,17 @@ class EnsembleEngine:
         if key not in self._kernels:
             from ..simulation import make_local_kernel
 
+            # Truncated-physics keys carry their rcut/cell-list sizing
+            # in `extra` (batch_key_for) — reconstruct them so the
+            # kernel builder applies the mask / static sizing.
+            nlist_kw = {
+                k: v for k, v in key.extra
+                if k in ("nlist_rcut", "nlist_side", "nlist_cap")
+            }
             config = SimulationConfig(
                 n=key.bucket_n, force_backend=key.backend,
                 dtype=key.dtype, g=key.g, eps=key.eps, cutoff=key.cutoff,
+                **nlist_kw,
             )
             self._kernels[key] = make_local_kernel(config, key.backend)
         return self._kernels[key]

@@ -152,6 +152,12 @@ DENSE_KICK_BUDGET = 1 << 25
 
 def _resolve_direct(config: SimulationConfig, on_tpu: bool) -> str:
     """Scale-aware choice among the EXACT direct-sum backends."""
+    if config.nlist_rcut > 0.0:
+        # Declared truncated physics (the nlist family): the exact
+        # reference is the rcut-MASKED direct sum, which only the jnp
+        # forms implement — pallas/cpp compute full gravity and would
+        # silently change the physics.
+        return "dense" if config.n <= 4096 else "chunked"
     if on_tpu and config.n >= 1024:
         return "pallas"
     if config.n <= 4096:
@@ -177,8 +183,31 @@ def _resolve_backend(config: SimulationConfig, on_tpu=None) -> str:
     overrides platform detection (tests)."""
     backend = config.force_backend
     if backend == "auto" and config.periodic_box > 0.0:
-        return "pm"  # the only periodic-capable solver
+        if config.nlist_rcut > 0.0:
+            # Declared truncated physics in a periodic box: nlist is
+            # the only periodic member of the truncated family (pm
+            # computes FULL gravity — routing there would silently
+            # discard the declared rcut).
+            return "nlist"
+        return "pm"  # the only periodic-capable FULL-gravity solver
     if backend not in ("auto", "direct"):
+        if (
+            config.nlist_rcut > 0.0
+            and backend not in ("nlist", "dense", "chunked")
+        ):
+            # Only the nlist kernel and the jnp direct forms honor the
+            # rcut mask; every other backend computes FULL gravity.
+            # The explicit choice wins, but silently is how physics
+            # bugs ship.
+            import warnings
+
+            warnings.warn(
+                f"nlist_rcut={config.nlist_rcut:g} declares truncated "
+                f"short-range physics, but force_backend={backend!r} "
+                "computes FULL gravity and ignores it (only nlist/"
+                "dense/chunked honor the rcut mask)",
+                stacklevel=2,
+            )
         _warn_n = DIRECT_SUM_WARN_N
         if (
             backend in ("pallas", "pallas-mxu")
@@ -195,6 +224,11 @@ def _resolve_backend(config: SimulationConfig, on_tpu=None) -> str:
             # full set a global tree build needs, so there is no faster
             # alternative to suggest — don't nag the merger preset.
             and config.sharding != "ring"
+            # Declared-truncated physics (nlist_rcut > 0): the masked
+            # direct sum is the exact reference of that family; the
+            # full-gravity fast solvers this warning would suggest
+            # compute different physics.
+            and config.nlist_rcut <= 0.0
         ):
             import warnings
 
@@ -212,6 +246,13 @@ def _resolve_backend(config: SimulationConfig, on_tpu=None) -> str:
     if backend == "direct":
         # Exactness guarantee without hardware knowledge: never routes
         # to an approximate solver regardless of scale.
+        return _resolve_direct(config, on_tpu)
+    if config.nlist_rcut > 0.0:
+        # Declared truncated physics: the static route stays in the
+        # exact-truncated family (the rcut-masked direct sum); the
+        # autotuner — not this crossover model — promotes the nlist
+        # kernel when it measures faster (full-gravity fast solvers
+        # are a different physics and must never be auto-routed here).
         return _resolve_direct(config, on_tpu)
     # auto: above the measured crossover a fast solver wins over any
     # direct sum — unless the ring strategy is requested (see above).
@@ -355,6 +396,69 @@ def _occupancy_t_cap(cap: int, k_targets: int, n: int, positions,
     return min(cap, max(mean_based, density_based))
 
 
+def _resolve_nlist_config(config: SimulationConfig, positions):
+    """The ONE (side, cap) resolution for the nlist backend — shared by
+    the local-kernel and unsharded builders (and reported in
+    ``Simulator.nlist_sizing``), so audits and bench lines always
+    describe the cell list the run actually used. Explicit config knobs
+    win; otherwise the sizing is fit to concrete initial positions
+    (pallas_nlist.resolve_nlist_sizing). Callers with neither (serve
+    bucket kernels size blind at admission) must set --nlist-side."""
+    if config.nlist_rcut <= 0.0:
+        raise ValueError(
+            "force_backend='nlist' needs nlist_rcut > 0 (--nlist-rcut): "
+            "the cell-list kernel computes forces TRUNCATED at rcut — "
+            "declared short-range physics, not an approximation of "
+            "full gravity"
+        )
+    from .ops.pallas_nlist import DEFAULT_CAP, resolve_nlist_sizing
+
+    side, cap = config.nlist_side, config.nlist_cap
+    if side and cap:
+        return side, cap
+    if positions is None or not getattr(
+        positions, "is_fully_addressable", True
+    ):
+        if not side:
+            raise ValueError(
+                "nlist sizing needs concrete initial positions or an "
+                "explicit --nlist-side (serve jobs must set it: no "
+                "state exists at admission)"
+            )
+        return side, cap or DEFAULT_CAP
+    return resolve_nlist_sizing(
+        np.asarray(positions), config.nlist_rcut, cap=cap, side=side,
+        box=config.periodic_box,
+    )
+
+
+def _make_nlist_kernel(config: SimulationConfig, positions=None,
+                       k_targets=None):
+    """LocalKernel for the cutoff-radius cell-list backend. The Pallas
+    tile engine on TPU (dense-vjp-wrapped: pallas_call has no autodiff
+    rule), the jnp reference engine elsewhere; the K-target hint sizes
+    the static target cap to the expected fast-rung occupancy exactly
+    like the other shifted-slice backends."""
+    import warnings
+
+    from .ops.pallas_nlist import check_nlist_sizing, make_nlist_local_kernel
+
+    side, cap = _resolve_nlist_config(config, positions)
+    note = check_nlist_sizing(config.n, side, cap)
+    if note:
+        warnings.warn(note, stacklevel=3)
+    t_cap = 0
+    if k_targets is not None:
+        t_cap = _occupancy_t_cap(
+            cap, k_targets, config.n, positions, side, "nlist kernel"
+        )
+    return make_nlist_local_kernel(
+        rcut=config.nlist_rcut, side=side, cap=cap, t_cap=t_cap,
+        g=config.g, cutoff=config.cutoff, eps=config.eps,
+        box=config.periodic_box,
+    )
+
+
 def make_local_kernel(config: SimulationConfig, backend: str,
                       positions=None, k_targets=None):
     """LocalKernel (pos_targets, pos_sources, m_sources) -> acc for the
@@ -389,7 +493,13 @@ def make_local_kernel(config: SimulationConfig, backend: str,
     if backend in ("dense", "chunked"):
         # "chunked" differs only in the unsharded full-N path below; as a
         # local kernel (slice vs sources) dense jnp is the right shape.
+        # Declared truncated physics (nlist_rcut > 0) masks the pair set
+        # at rcut — the exact reference of the nlist family.
+        if config.nlist_rcut > 0.0:
+            common = dict(common, rcut=config.nlist_rcut)
         return partial(accelerations_vs, **common)
+    if backend == "nlist":
+        return _make_nlist_kernel(config, positions, k_targets)
     if backend == "pallas":
         from .ops.pallas_forces import make_pallas_local_kernel
 
@@ -430,7 +540,8 @@ def make_local_kernel(config: SimulationConfig, backend: str,
         return partial(
             tree_accelerations_vs, depth=depth,
             leaf_cap=config.tree_leaf_cap, ws=config.tree_ws,
-            far=config.tree_far, chunk=config.fast_chunk, **common,
+            far=config.tree_far, chunk=config.fast_chunk,
+            near_mode=config.tree_near, **common,
         )
     if backend in ("fmm", "sfmm"):
         # The rectangular (targets-vs-sources) multirate kicks use the
@@ -610,6 +721,10 @@ class Simulator:
         # Which fmm layout the build resolved to (False until an
         # fmm/sfmm accel builder runs; benchmarks introspect this).
         self.fmm_sparse = False
+        # As-run nlist cell-list sizing (side, cap, evaluated pair
+        # tiles/eval) — set by the nlist accel builder; the bench
+        # harness reads it for the honest roofline.
+        self.nlist_sizing = None
 
         # State before backend resolution: plain 'auto' routes through
         # the measurement-driven autotuner (gravity_tpu/autotune.py),
@@ -630,7 +745,7 @@ class Simulator:
         self.mesh = None
         if config.sharding != "none":
             if config.sharding == "ring" and self.backend in (
-                "tree", "fmm", "sfmm", "pm", "p3m"
+                "tree", "fmm", "sfmm", "pm", "p3m", "nlist"
             ):
                 raise ValueError(
                     f"force backend {self.backend!r} needs the full source "
@@ -658,11 +773,15 @@ class Simulator:
         the same compiled block instead of retracing.
         """
         config = self.config
-        if config.periodic_box > 0.0 and self.backend != "pm":
+        if config.periodic_box > 0.0 and self.backend not in (
+            "pm", "nlist"
+        ):
             raise ValueError(
-                "periodic_box > 0 needs the periodic FFT solver "
-                f"(force_backend 'pm' or 'auto'); got {self.backend!r} — "
-                "tree/p3m/direct backends are isolated-BC"
+                "periodic_box > 0 needs a periodic-capable solver — "
+                "'pm' (full gravity, FFT) or 'nlist' (truncated "
+                f"short-range, minimum-image cell list); got "
+                f"{self.backend!r} — tree/p3m/direct backends are "
+                "isolated-BC"
             )
         # Optional per-block precompute hook (aux built inside the jitted
         # block but OUTSIDE its scan): set by backends whose accel has a
@@ -752,6 +871,19 @@ class Simulator:
         elif self.mesh is not None:
             from .parallel import make_sharded_accel2
 
+            if self.backend == "nlist":
+                # The as-run sizing for the sharded form too: audits
+                # (--debug-check) and the bench roofline read it, and
+                # re-deriving from the EVOLVED final state would audit
+                # a different cell list than the one that ran.
+                from .ops.pallas_nlist import evaluated_pairs_per_eval
+
+                side, cap = _resolve_nlist_config(
+                    config, self.state.positions
+                )
+                self.nlist_sizing = (
+                    side, cap, evaluated_pairs_per_eval(side, cap)
+                )
             self._accel2 = make_sharded_accel2(
                 self.mesh,
                 strategy=config.sharding,
@@ -874,6 +1006,14 @@ class Simulator:
         config = self.config
         n = self.state.n
         common = dict(g=config.g, cutoff=config.cutoff, eps=config.eps)
+        if (
+            self.backend in ("dense", "chunked")
+            and config.nlist_rcut > 0.0
+        ):
+            # Declared truncated physics: the rcut-masked direct sum is
+            # the exact reference of the nlist family (docs/scaling.md
+            # "Cell-list near field").
+            common = dict(common, rcut=config.nlist_rcut)
         if self.backend == "dense":
             return lambda pos, m: accelerations_vs(pos, pos, m, **common)
         if self.backend == "chunked":
@@ -883,6 +1023,32 @@ class Simulator:
             chunk = max(chunk, 1)
             return lambda pos, m: pairwise_accelerations_chunked(
                 pos, m, chunk=chunk, **common
+            )
+        if self.backend == "nlist":
+            from .ops.pallas_nlist import evaluated_pairs_per_eval
+
+            side, cap = _resolve_nlist_config(
+                config, self.state.positions
+            )
+            import warnings
+
+            from .ops.pallas_nlist import (
+                check_nlist_sizing,
+                nlist_accelerations_vs,
+            )
+
+            note = check_nlist_sizing(n, side, cap)
+            if note:
+                warnings.warn(note, stacklevel=2)
+            # The as-run sizing + evaluated-tile count, for the bench
+            # harness's honest roofline (the headline rate is
+            # dense-equivalent; MFU is computed on tiles actually run).
+            self.nlist_sizing = (
+                side, cap, evaluated_pairs_per_eval(side, cap)
+            )
+            return lambda pos, m: nlist_accelerations_vs(
+                pos, pos, m, rcut=config.nlist_rcut, side=side, cap=cap,
+                box=config.periodic_box, _self=True, **common,
             )
         if self.backend in ("pallas", "pallas-mxu", "cpp"):
             kernel = make_local_kernel(config, self.backend)
@@ -896,7 +1062,8 @@ class Simulator:
             return lambda pos, m: tree_accelerations(
                 pos, m, depth=depth, leaf_cap=config.tree_leaf_cap,
                 ws=config.tree_ws, far=config.tree_far,
-                chunk=config.fast_chunk, **common,
+                chunk=config.fast_chunk, near_mode=config.tree_near,
+                **common,
             )
         if self.backend in ("fmm", "sfmm"):
             from .ops.sfmm import sfmm_auto_decision

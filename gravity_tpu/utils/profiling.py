@@ -98,6 +98,7 @@ def debug_check_forces(
     g: Optional[float] = None,
     cutoff: Optional[float] = None,
     eps: float = 0.0,
+    rcut: float = 0.0,
     sample: int = 2048,
     seed: int = 0,
     kernel=None,
@@ -109,6 +110,10 @@ def debug_check_forces(
     ``kernel``: a LocalKernel (targets, sources, masses) -> acc; defaults
     to the Pallas kernel. Passing the active backend's kernel (tree/p3m/
     pm included) turns this into a live accuracy audit of fast solvers.
+
+    ``rcut`` > 0 truncates the jnp reference at rcut — the oracle for
+    the declared-truncated nlist family (auditing those against FULL
+    gravity would report the physics difference, not a defect).
 
     ``full_acc``: precomputed (N, 3) accelerations for ALL particles —
     for backends with no targets-vs-sources form (fmm computes the full
@@ -145,7 +150,7 @@ def debug_check_forces(
         kernel = partial(pallas_accelerations_vs, interpret=interpret,
                          g=g, cutoff=cutoff, eps=eps)
     ref = accelerations_vs(targets, positions, masses, g=g, cutoff=cutoff,
-                           eps=eps)
+                           eps=eps, rcut=rcut)
     got = kernel(targets, positions, masses)
     ref_np = np.asarray(ref)
     got_np = np.asarray(got)

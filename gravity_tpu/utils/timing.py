@@ -110,7 +110,11 @@ def pairs_per_step(n: int, *, direct_sum: bool = True) -> int:
 #   (accumulation matmul, 2*4) on the MXU + ~8 on the VPU (norm
 #   broadcast-adds, noise/cutoff compares, rsqrt, weight muls) ~= 22.
 # - "jnp" (ops/forces.py dense/chunked): same math as "vpu".
-FLOPS_PER_PAIR = {"vpu": 20.0, "mxu": 22.0, "jnp": 20.0}
+# - "nlist" (ops/pallas_nlist.py): the vpu pipeline + the rcut compare/
+#   select ~= 21, counted over the EVALUATED pair tiles (side^3 * 27 *
+#   t_cap * cap, padding included — evaluated_pairs_per_eval), not the
+#   dense-equivalent N*(N-1) rate the bench line reports as throughput.
+FLOPS_PER_PAIR = {"vpu": 20.0, "mxu": 22.0, "jnp": 20.0, "nlist": 21.0}
 
 # Peak dense-matmul TFLOP/s per chip by device kind (published specs:
 # TPU v2 46 / v3 123 / v4 275 / v5e 197 / v5p 459 / v6e 918 bf16).
@@ -188,6 +192,7 @@ def backend_formulation(backend: str) -> str:
         "dense": "jnp",
         "chunked": "jnp",
         "cpp": "jnp",
+        "nlist": "nlist",
     }.get(backend, "jnp")
 
 

@@ -6,16 +6,19 @@
 # chaos harness (2 workers, injected kill -9 mid-round, all jobs
 # complete with solo parity — scripts/chaos.sh), the job-class
 # e2e (one fit + one sweep through the live daemon with solo parity),
-# and the unified-telemetry stage (strict Prometheus scrape of the
+# the unified-telemetry stage (strict Prometheus scrape of the
 # live daemon + a Perfetto trace export whose spans cover the job's
-# e2e latency — docs/observability.md), all on CPU. Exits nonzero on
-# any failure. ~10 min on a laptop-class CPU.
+# e2e latency — docs/observability.md), and the nlist cell-list
+# near-field stage (p3m nlist-vs-gather <= 1e-5 + standalone
+# truncated-physics parity — docs/scaling.md "Cell-list near field"),
+# all on CPU. Exits nonzero on any failure. ~10 min on a laptop-class
+# CPU.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 export JAX_PLATFORMS=cpu
 
-echo "== smoke 1/7: pytest -m 'fast and not slow and not heavy' (contract + oracle-parity lane) =="
+echo "== smoke 1/8: pytest -m 'fast and not slow and not heavy' (contract + oracle-parity lane) =="
 # "fast and not slow and not heavy": module-level fast marks would
 # otherwise pull a file's slow-marked wall-clock tests into the lane
 # (pytest -m fast selects anything CARRYING the mark; it does not
@@ -24,7 +27,7 @@ echo "== smoke 1/7: pytest -m 'fast and not slow and not heavy' (contract + orac
 # item 5).
 python -m pytest tests/ -q -m "fast and not slow and not heavy" -p no:cacheprovider
 
-echo "== smoke 2/7: 2-job ensemble serving e2e (CLI daemon) =="
+echo "== smoke 2/8: 2-job ensemble serving e2e (CLI daemon) =="
 SPOOL="$(mktemp -d /tmp/gravity_smoke.XXXXXX)"
 cleanup() {
     # Best-effort daemon shutdown + spool removal.
@@ -77,7 +80,7 @@ print("ensemble e2e OK:", {j: s["status"] for j, s in statuses.items()},
       "| compiles:", metrics["compile_counts"])
 EOF
 
-echo "== smoke 3/7: async host pipeline e2e (cadence run + SIGTERM + resume) =="
+echo "== smoke 3/8: async host pipeline e2e (cadence run + SIGTERM + resume) =="
 IODIR="$(mktemp -d /tmp/gravity_smoke_io.XXXXXX)"
 trap 'cleanup; rm -rf "$IODIR"' EXIT
 # Cadence-on pipelined run; preempt@500 delivers a real SIGTERM to the
@@ -113,7 +116,7 @@ print("io-pipeline e2e OK: resumed", stats["steps"], "steps,",
       "host_gap_frac", round(stats["host_gap_frac"], 3))
 EOF
 
-echo "== smoke 4/7: autotune cache round-trip (probe-on-miss, instant-on-hit) =="
+echo "== smoke 4/8: autotune cache round-trip (probe-on-miss, instant-on-hit) =="
 TUNEDIR="$(mktemp -d /tmp/gravity_smoke_tune.XXXXXX)"
 trap 'cleanup; rm -rf "$IODIR" "$TUNEDIR"' EXIT
 # Fresh cache dir + lowered fast-probe floor so plain `auto` runs a
@@ -150,10 +153,10 @@ print("autotune round-trip OK: backend", s1["backend"],
       "| probe", round(s1["autotune_probe_ms"], 1), "ms -> hit 0 ms")
 EOF
 
-echo "== smoke 5/7: serving chaos harness (kill -9 + adoption + fencing) =="
+echo "== smoke 5/8: serving chaos harness (kill -9 + adoption + fencing) =="
 bash scripts/chaos.sh
 
-echo "== smoke 6/7: job classes through the CLI daemon (fit + sweep) =="
+echo "== smoke 6/8: job classes through the CLI daemon (fit + sweep) =="
 # One fit + one sweep submitted through the REAL daemon from stage 2
 # (still serving), asserting completion + served-vs-solo parity
 # (docs/serving.md "Job classes").
@@ -263,7 +266,7 @@ z = np.load(sys.argv[1])
 assert 'min_sep' in z.files and len(z['min_sep']) == 4, z.files
 " "$SPOOL/sweep_verdicts.npz"
 
-echo "== smoke 7/7: unified telemetry (Prometheus scrape + Perfetto trace export) =="
+echo "== smoke 7/8: unified telemetry (Prometheus scrape + Perfetto trace export) =="
 # Against the STILL-LIVE stage-2 daemon: (a) a text/plain /metrics
 # scrape must be valid Prometheus exposition (validated by the strict
 # parser the tests use) including per-class latency histograms and
@@ -306,6 +309,48 @@ assert {"admission", "round"} <= names, names
 assert summary["coverage"] is not None and summary["coverage"] >= 0.9, \
     summary
 print("perfetto export OK:", summary)
+PYEOF
+
+echo "== smoke 8/8: nlist cell-list near field (p3m parity + standalone truncated parity) =="
+# (a) The P3M near pass through the cell-list tile engine must match
+# the chunked gather near pass <= 1e-5 scaled on CPU (the ISSUE-9
+# acceptance bound); (b) the standalone nlist backend must match the
+# rcut-masked direct sum on an overflow-free sizing.
+python - <<'PYEOF'
+import jax, numpy as np
+import jax.numpy as jnp
+from gravity_tpu.ops.p3m import p3m_accelerations
+from gravity_tpu.ops.forces import pairwise_accelerations_dense
+from gravity_tpu.ops.pallas_nlist import (
+    nlist_accelerations, resolve_nlist_sizing)
+
+key = jax.random.PRNGKey(0)
+n = 2048
+pos = jax.random.uniform(key, (n, 3), jnp.float32) * 1e12
+m = jax.random.uniform(jax.random.fold_in(key, 1), (n,), jnp.float32,
+                       minval=1e25, maxval=1e26)
+kw = dict(g=6.674e-11, eps=1e9)
+
+a_g = np.asarray(p3m_accelerations(pos, m, grid=32, cap=128,
+                                   short_mode="gather", **kw))
+a_n = np.asarray(p3m_accelerations(pos, m, grid=32, cap=128,
+                                   short_mode="nlist", **kw))
+scale = np.linalg.norm(a_g, axis=1).mean()
+dev = np.abs(a_n - a_g).max() / scale
+assert dev <= 1e-5, f"p3m nlist-vs-gather scaled max {dev}"
+
+rcut = 3e11
+# cap 256 covers the densest cell at this (n=2048, side=3) sizing —
+# the parity bound needs an overflow-free cell list.
+side, cap = resolve_nlist_sizing(pos, rcut, cap=256)
+ref = np.asarray(pairwise_accelerations_dense(pos, m, rcut=rcut, **kw))
+got = np.asarray(nlist_accelerations(pos, m, rcut=rcut, side=side,
+                                     cap=cap, **kw))
+sc2 = np.linalg.norm(ref, axis=1).mean()
+dev2 = np.abs(got - ref).max() / sc2
+assert dev2 <= 1e-5, f"nlist-vs-masked-direct scaled max {dev2}"
+print("nlist near-field OK: p3m dev", float(dev),
+      "| standalone dev", float(dev2))
 PYEOF
 
 echo "== smoke: all green =="
