@@ -137,6 +137,11 @@ def _cadence_main(steps: int, backend: str) -> int:
 
     n = int(os.environ.get("BENCH_N", 2048))
     pipeline = os.environ.get("BENCH_IO_PIPELINE", "on")
+    # BENCH_LEDGER=1: ride the in-program conservation ledger through
+    # the cadence A/B — the drift series lands in the line, and the
+    # A/B demonstrates the ledger costs ~nothing (docs/observability.md
+    # "Numerics").
+    ledger = os.environ.get("BENCH_LEDGER", "") in ("1", "on", "true")
     config = SimulationConfig(
         model="plummer",
         n=n,
@@ -151,6 +156,7 @@ def _cadence_main(steps: int, backend: str) -> int:
         progress_every=int(os.environ.get("BENCH_BLOCK", 25)),
         checkpoint_every=int(os.environ.get("BENCH_CKPT_EVERY", 100)),
         io_pipeline=pipeline,
+        ledger=ledger,
     )
     stats = run_cadence_benchmark(config)
     print(json.dumps({
@@ -168,6 +174,9 @@ def _cadence_main(steps: int, backend: str) -> int:
         "checkpoint_every": stats["checkpoint_every"],
         "autotune_cache": stats.get("autotune_cache"),
         "autotune_probe_ms": stats.get("autotune_probe_ms"),
+        # The conservation-ledger drift series (BENCH_LEDGER=1;
+        # docs/observability.md "Numerics") — null when off.
+        "ledger": stats.get("ledger"),
     }))
     return 0
 

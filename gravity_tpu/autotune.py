@@ -253,6 +253,15 @@ def make_key(
         "device_kind": device_kind,
         "occupancy": occupancy,
         "knobs": {
+            # Error budget (docs/observability.md "Numerics"):
+            # routing is speed-WITHIN-budget once a budget is
+            # declared, so budgeted and unbudgeted runs must not
+            # share a verdict. Included only when set, so every
+            # pre-budget cache record keeps its hash (and its hit).
+            **(
+                {"error_budget": config.error_budget}
+                if getattr(config, "error_budget", 0.0) > 0.0 else {}
+            ),
             "tree_depth": config.tree_depth,
             "tree_leaf_cap": config.tree_leaf_cap,
             "tree_ws": config.tree_ws,
@@ -358,16 +367,31 @@ class AutotuneDecision(NamedTuple):
     timings_s: dict
     skipped: dict
     key_hash: str
+    # Measured per-candidate force-error summaries (median/p90/max rel
+    # err vs the exact oracle) — the verdict's accuracy half
+    # (docs/observability.md "Numerics"). Empty for static/off.
+    errors: Optional[dict] = None
 
 
-def _time_backend(config, backend: str, state, probe_steps: int) -> float:
-    """Seconds per step of THE REAL COMPILED STEP for one candidate:
-    build the candidate's Simulator around the shared initial state,
-    run one untimed step (compiles the block AND the fence's per-shape
-    jit — utils/timing.warm_sync), then time ``probe_steps`` steps
-    behind a genuine value-fetch fence."""
+def _time_backend(
+    config, backend: str, state, probe_steps: int
+) -> tuple[float, dict]:
+    """(seconds per step, sampled force error) of THE REAL COMPILED
+    STEP for one candidate: build the candidate's Simulator around the
+    shared initial state, run one untimed step (compiles the block AND
+    the fence's per-shape jit — utils/timing.warm_sync), then time
+    ``probe_steps`` steps behind a genuine value-fetch fence.
+
+    The error half (docs/observability.md "Numerics") audits the
+    candidate's accel on the PROBE's initial state against the exact
+    rcut-masked direct-sum oracle on a small sample — one extra force
+    evaluation per candidate, marginal next to the timing probe — so
+    every persisted verdict carries a measured accuracy alongside the
+    measured speed, and a declared ``error_budget`` can route on
+    speed-WITHIN-budget instead of raw speed."""
     from .ops.integrators import init_carry
     from .simulation import Simulator
+    from .utils.profiling import debug_check_forces
     from .utils.timing import sync, warm_sync
 
     cfg = dataclasses.replace(config, force_backend=backend)
@@ -381,7 +405,23 @@ def _time_backend(config, backend: str, state, probe_steps: int) -> float:
         st, acc, _ = sim._run_block(st, acc, n_steps=1, record=False)
         _counters["probe_steps"] += 1
     sync(st.positions)
-    return (time.perf_counter() - t0) / max(1, probe_steps)
+    per_step = (time.perf_counter() - t0) / max(1, probe_steps)
+    # Accuracy audit on the initial state (st has advanced; the probe
+    # keys on the configuration, not the trajectory): the candidate's
+    # full accel rows vs the exact oracle on a 128-target sample.
+    probe_state = sim.state
+    full = sim._self_accel2(probe_state.positions, probe_state.masses)
+    err = debug_check_forces(
+        np.asarray(probe_state.positions),
+        np.asarray(probe_state.masses),
+        g=config.g, cutoff=config.cutoff, eps=config.eps,
+        rcut=config.nlist_rcut, sample=128,
+        full_acc=np.asarray(full),
+    )
+    return per_step, {
+        k: err[k]
+        for k in ("median_rel_err", "p90_rel_err", "max_rel_err")
+    }
 
 
 def resolve_backend_measured(
@@ -445,6 +485,7 @@ def resolve_backend_measured(
             return AutotuneDecision(
                 rec["winner"], "hit", 0.0,
                 rec.get("timings_s", {}), rec.get("skipped", {}), h,
+                rec.get("errors"),
             )
 
     def _static() -> str:
@@ -483,9 +524,10 @@ def resolve_backend_measured(
     t0_wall = time.time()
     probe_started_ns = time.time_ns()  # the record's fencing stamp
     timings: dict[str, float] = {}
+    errors: dict[str, dict] = {}
     for backend in candidates:
         try:
-            timings[backend] = _time_backend(
+            timings[backend], errors[backend] = _time_backend(
                 config, backend, state, probe_steps
             )
             _counters["probes"] += 1
@@ -501,7 +543,29 @@ def resolve_backend_measured(
         return AutotuneDecision(
             _static(), "static", probe_ms, {}, skipped, h
         )
-    winner = min(timings, key=timings.get)
+    # Speed-WITHIN-budget (docs/observability.md "Numerics"): with an
+    # error budget declared, candidates whose measured p90 force error
+    # exceeds it are out of contention — a fast wrong answer is not a
+    # winner. If nothing fits the budget, fall back to the raw-speed
+    # contest (the run's own sentinel will catch and heal the breach);
+    # the exclusions are persisted so the routing is auditable.
+    contenders = dict(timings)
+    budget = float(getattr(config, "error_budget", 0.0) or 0.0)
+    if budget > 0.0:
+        fit = {
+            b: t for b, t in timings.items()
+            if errors.get(b, {}).get("p90_rel_err", 0.0) <= budget
+        }
+        if fit:
+            for b in timings:
+                if b not in fit:
+                    skipped[b] = (
+                        f"over error budget: p90 rel err "
+                        f"{errors[b]['p90_rel_err']:.3e} > "
+                        f"{budget:.3e}"
+                    )
+            contenders = fit
+    winner = min(contenders, key=contenders.get)
     from .telemetry import tracing as _tracing
 
     # Probe span + verdict provenance (docs/observability.md): the
@@ -511,12 +575,18 @@ def resolve_backend_measured(
         "autotune_probe", t0_wall, probe_ms / 1e3, cache="miss",
         winner=winner, key_hash=h,
         timings_ms={k: round(v * 1e3, 3) for k, v in timings.items()},
+        errors={
+            k: round(v.get("p90_rel_err", 0.0), 9)
+            for k, v in errors.items()
+        },
         skipped=sorted(skipped),
     )
     _store_record(h, {
         "key": key,
         "winner": winner,
         "timings_s": timings,
+        "errors": errors,
+        "error_budget": budget or None,
         "skipped": skipped,
         "probe_steps": probe_steps,
         "probe_ms": round(probe_ms, 3),
@@ -525,7 +595,9 @@ def resolve_backend_measured(
             "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
         ),
     }, stamp_ns=probe_started_ns)
-    return AutotuneDecision(winner, "miss", probe_ms, timings, skipped, h)
+    return AutotuneDecision(
+        winner, "miss", probe_ms, timings, skipped, h, errors
+    )
 
 
 def engine_candidates(on_tpu: bool) -> tuple:

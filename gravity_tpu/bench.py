@@ -191,13 +191,37 @@ def _round_num(path: str) -> int:
     return int(m.group(1)) if m else -1
 
 
+def _read_jsonl(path: str) -> list:
+    """Rows of a JSONL artifact (torn/blank lines tolerated)."""
+    import json
+
+    out = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    out.append(json.loads(line))
+                except ValueError:
+                    continue
+    except OSError:
+        return []
+    return out
+
+
 def collect_bench_rounds(root: str = ".") -> dict:
     """Fold the per-round ``BENCH_r*.json`` / ``MULTICHIP_r*.json``
     artifacts into structured rows. Each BENCH row carries the parsed
     headline (pairs/s, n, backend, platform, avg step time) plus any
     newer fields present (mfu, achieved_tflops, host_gap_frac,
     autotune_cache) — older rounds predate those and show as None.
-    Pure file reading: no device, no config."""
+    Also folds the PR-9 nlist artifacts — the ``NLIST_SWEEP_CPU.json``
+    fixed-density scaling ladder, the ``NLIST_TUNE_CPU.json`` probe
+    transcript, and the committed ``tuning/`` verdicts — which the
+    report previously predated and silently dropped. Pure file
+    reading: no device, no config."""
     import glob
     import json
     import os
@@ -243,7 +267,84 @@ def collect_bench_rounds(root: str = ".") -> dict:
             "skipped": doc.get("skipped"),
             "rc": doc.get("rc"),
         })
-    return {"bench": bench_rows, "multichip": multichip_rows}
+    # nlist scaling ladder (benchmarks/nlist_sweep.py --scaling): the
+    # sub-quadratic signature rows — dense-equivalent rate vs the
+    # masked chunked reference per n.
+    nlist_sweep = [
+        {
+            "n": r.get("n"),
+            "rcut": r.get("rcut"),
+            "platform": r.get("platform"),
+            "side": r.get("side"),
+            "cap": r.get("cap"),
+            "s_per_eval": r.get("s_per_eval"),
+            "dense_equiv_pairs_per_s": r.get(
+                "dense_equiv_pairs_per_sec"
+            ),
+            "speedup_vs_chunked": r.get("speedup_vs_chunked"),
+        }
+        for r in _read_jsonl(
+            os.path.join(root, "NLIST_SWEEP_CPU.json")
+        )
+        if r.get("n") is not None
+    ]
+    # nlist tune transcript (`gravity_tpu tune --nlist-rcut`): the
+    # measured direct-vs-nlist verdict per ladder size.
+    nlist_tune = [
+        {
+            "n": r.get("n"),
+            "winner": r.get("backend"),
+            "cache": r.get("cache"),
+            "probe_ms": r.get("probe_ms"),
+            "timings_s": r.get("timings_s"),
+        }
+        for r in _read_jsonl(
+            os.path.join(root, "NLIST_TUNE_CPU.json")
+        )
+        if r.get("n") is not None
+    ]
+    # Committed tuning verdicts (the pre-warmed routing cache shipped
+    # in-repo under tuning/): what a cold fleet routes on.
+    verdicts = []
+    for path in sorted(glob.glob(os.path.join(root, "tuning", "*.json"))):
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if not isinstance(rec, dict) or "winner" not in rec:
+            continue
+        key = rec.get("key") or {}
+        timings = rec.get("timings_s") or {}
+        winner = rec.get("winner")
+        runner_up = None
+        if len(timings) > 1 and winner in timings:
+            others = {
+                b: t for b, t in timings.items() if b != winner
+            }
+            runner_up = min(others, key=others.get)
+        errors = rec.get("errors") or {}
+        verdicts.append({
+            "n": key.get("n"),
+            "platform": key.get("platform"),
+            "occupancy": key.get("occupancy"),
+            "winner": winner,
+            "winner_s": timings.get(winner),
+            "runner_up": runner_up,
+            "runner_up_s": timings.get(runner_up),
+            "winner_p90_err": (errors.get(winner) or {}).get(
+                "p90_rel_err"
+            ),
+            "candidates": key.get("candidates"),
+        })
+    verdicts.sort(key=lambda r: (r["n"] or 0, r["winner"] or ""))
+    return {
+        "bench": bench_rows,
+        "multichip": multichip_rows,
+        "nlist_sweep": nlist_sweep,
+        "nlist_tune": nlist_tune,
+        "tuning_verdicts": verdicts,
+    }
 
 
 def _fmt(v, spec: str = "", none: str = "-") -> str:
@@ -301,4 +402,56 @@ def format_bench_report(data: dict) -> str:
         )
     if not data.get("multichip"):
         lines.append("  (no MULTICHIP_r*.json rounds found)")
+    if data.get("nlist_sweep"):
+        lines.append("")
+        lines.append("== nlist scaling ladder (NLIST_SWEEP_CPU.json) ==")
+        lines.append(
+            f"{'n':>9} {'side':>5} {'cap':>4} {'s/eval':>9} "
+            f"{'dense-eq pairs/s':>16} {'vs chunked':>10}"
+        )
+        for row in data["nlist_sweep"]:
+            lines.append(
+                f"{_fmt(row['n'], 'd'):>9} "
+                f"{_fmt(row['side']):>5} "
+                f"{_fmt(row['cap']):>4} "
+                f"{_fmt(row['s_per_eval'], '.3f'):>9} "
+                f"{_fmt(row['dense_equiv_pairs_per_s'], '.2e'):>16} "
+                f"{_fmt(row['speedup_vs_chunked'], '.1f'):>9}x"
+            )
+    if data.get("nlist_tune"):
+        lines.append("")
+        lines.append("== nlist tune ladder (NLIST_TUNE_CPU.json) ==")
+        lines.append(
+            f"{'n':>9} {'winner':>8} {'cache':>6} "
+            f"{'nlist s':>8} {'chunked s':>10}"
+        )
+        for row in data["nlist_tune"]:
+            t = row.get("timings_s") or {}
+            lines.append(
+                f"{_fmt(row['n'], 'd'):>9} "
+                f"{_fmt(row['winner']):>8} "
+                f"{_fmt(row['cache']):>6} "
+                f"{_fmt(t.get('nlist'), '.3f'):>8} "
+                f"{_fmt(t.get('chunked'), '.3f'):>10}"
+            )
+    if data.get("tuning_verdicts"):
+        lines.append("")
+        lines.append("== committed tuning verdicts (tuning/) ==")
+        lines.append(
+            f"{'n':>9} {'platform':>8} {'winner':>8} {'s/step':>8} "
+            f"{'runner-up':>18} {'p90 err':>8}"
+        )
+        for row in data["tuning_verdicts"]:
+            ru = (
+                f"{row['runner_up']} {_fmt(row['runner_up_s'], '.3f')}s"
+                if row.get("runner_up") else "-"
+            )
+            lines.append(
+                f"{_fmt(row['n'], 'd'):>9} "
+                f"{_fmt(row['platform']):>8} "
+                f"{_fmt(row['winner']):>8} "
+                f"{_fmt(row['winner_s'], '.3f'):>8} "
+                f"{ru:>18} "
+                f"{_fmt(row['winner_p90_err'], '.1e'):>8}"
+            )
     return "\n".join(lines)

@@ -174,8 +174,29 @@ def _add_config_args(p: argparse.ArgumentParser) -> None:
                    help="write a JSONL metrics stream next to the log")
     p.add_argument("--metrics-energy", dest="metrics_energy",
                    action="store_true", default=None,
-                   help="include total-energy drift per block (one O(N^2/"
-                        "chunk) eval per block - opt-in)")
+                   help="DEPRECATED alias for --ledger (the in-program "
+                        "conservation ledger; docs/observability.md)")
+    p.add_argument("--ledger", action="store_true", default=None,
+                   help="in-program conservation ledger: per-block "
+                        "energy/momentum/angular-momentum/COM drift as "
+                        "an async device companion (fp64 host "
+                        "accumulation; metrics JSONL + run stats — "
+                        "docs/observability.md \"Numerics\")")
+    p.add_argument("--sentinel-every", dest="sentinel_every", type=int,
+                   default=None,
+                   help="accuracy sentinel cadence in blocks: probe the "
+                        "active backend's force error on --sentinel-k "
+                        "sampled targets vs the exact oracle (0 = off; "
+                        "forced on by --error-budget)")
+    p.add_argument("--sentinel-k", dest="sentinel_k", type=int,
+                   default=None,
+                   help="sampled sentinel targets per probe (default 64)")
+    p.add_argument("--error-budget", dest="error_budget", type=float,
+                   default=None,
+                   help="largest acceptable sentinel p90 relative force "
+                        "error; a breach dumps the flight recorder and "
+                        "aborts (exit 2) — or HEALS under --auto-recover "
+                        "(leaf-cap re-size / exact-physics reroute)")
     p.add_argument("--profile", action="store_true", default=None,
                    help="capture a jax.profiler trace of the run")
     p.add_argument("--trace", action="store_true", default=None,
@@ -226,6 +247,13 @@ def build_config(args: argparse.Namespace) -> SimulationConfig:
         val = getattr(args, field.name, None)
         if val is not None:
             config = dataclasses.replace(config, **{field.name: val})
+    if config.metrics_energy and not config.ledger:
+        # Simulator re-raises this as a DeprecationWarning, which the
+        # default filter hides outside __main__ — CLI users get it on
+        # stderr.
+        print("warning: --metrics-energy is a deprecated alias for "
+              "--ledger (docs/observability.md \"Numerics\")",
+              file=sys.stderr)
     return config
 
 
@@ -240,12 +268,17 @@ def _print_failure_json(e) -> int:
     """One clean stderr JSON line + exit 2 for a recovery-subsystem
     failure — `run` and `resume` share it so both surfaces keep the
     same operator contract (docs/robustness.md exit codes)."""
-    from .simulation import SimulationDiverged
+    from .simulation import AccuracyBreach, SimulationDiverged
     from .supervisor import EXIT_FAILED
     from .utils.faults import BackendUnavailable
 
     if isinstance(e, SimulationDiverged):
         payload = {"error": "diverged", "last_finite_step": e.step,
+                   "message": str(e)}
+    elif isinstance(e, AccuracyBreach):
+        payload = {"error": "accuracy_breach", "step": e.step,
+                   "backend": e.backend,
+                   "p90_rel_err": e.p90_rel_err, "budget": e.budget,
                    "message": str(e)}
     elif isinstance(e, BackendUnavailable):
         payload = {"error": "backend_unavailable", "message": str(e)}
@@ -271,7 +304,11 @@ def cmd_run(args: argparse.Namespace) -> int:
         )
         return 1
 
-    from .simulation import SimulationDiverged, SimulationPreempted
+    from .simulation import (
+        AccuracyBreach,
+        SimulationDiverged,
+        SimulationPreempted,
+    )
     from .supervisor import EXIT_FAILED, EXIT_PREEMPTED
     from .utils.faults import BackendUnavailable, TransientFault
 
@@ -342,14 +379,17 @@ def cmd_run(args: argparse.Namespace) -> int:
             os.path.join(config.log_dir, f"metrics_{logger.timestamp}.jsonl")
         )
     telemetry = None
-    if config.trace:
+    if config.trace or config.error_budget > 0.0:
         import os
 
         from .telemetry import Telemetry
 
         # Spans land in <log_dir>/traces.jsonl (shared across runs —
         # trace-export filters by trace id); flight-recorder dumps in
-        # the same directory.
+        # the same directory. An --error-budget arms the bundle too:
+        # the breach workflow's flight-recorder dump needs a recorder
+        # with the run's history in it (docs/observability.md
+        # "Numerics").
         telemetry = Telemetry(
             out_dir=config.log_dir, worker=f"run-{os.getpid()}"
         )
@@ -423,8 +463,10 @@ def cmd_run(args: argparse.Namespace) -> int:
                       + config.checkpoint_dir,
         }), file=sys.stderr)
         return EXIT_PREEMPTED
-    except (SimulationDiverged, TransientFault, BackendUnavailable) as e:
-        # Clean failure (divergence past the retry budget, exhausted
+    except (SimulationDiverged, AccuracyBreach, TransientFault,
+            BackendUnavailable) as e:
+        # Clean failure (divergence past the retry budget, an
+        # error-budget breach past the heal budget, exhausted
         # transient backoff, or a fully-failed backend ladder): the
         # watchdog/cadence checkpoints hold the last good state; a
         # one-line JSON error + exit 2 instead of a traceback.
@@ -433,7 +475,20 @@ def cmd_run(args: argparse.Namespace) -> int:
     if sup is not None:
         sim = sup.last_sim  # the simulator of the completed final leg
 
-    if config.debug_check and config.periodic_box > 0.0:
+    _truncated_family = (
+        sim is not None
+        and config.nlist_rcut > 0.0
+        and sim.backend in ("nlist", "dense", "chunked")
+    )
+    if (
+        config.debug_check
+        and config.periodic_box > 0.0
+        and not _truncated_family
+    ):
+        # Full periodic gravity has no direct-sum oracle; the
+        # TRUNCATED family (nlist / masked direct) audits fine — its
+        # minimum-image oracle is exact for rcut < box/2 (the family's
+        # own constraint), so those runs fall through to the audit.
         logger.log_print(
             "debug-check skipped: the jnp direct-sum oracle is isolated-"
             "BC and cannot audit the periodic solver (use "
@@ -520,11 +575,13 @@ def cmd_run(args: argparse.Namespace) -> int:
             a_side, a_cap = resolve_nlist_sizing(
                 final.positions, config.nlist_rcut,
                 cap=config.nlist_cap, side=config.nlist_side,
+                box=config.periodic_box,
             )
             kernel = _partial(
                 nlist_accelerations_vs, rcut=config.nlist_rcut,
                 side=a_side, cap=a_cap, g=config.g,
                 cutoff=config.cutoff, eps=config.eps,
+                box=config.periodic_box,
             )
         elif sim.backend not in ("dense", "chunked"):
             kernel = make_local_kernel(config, sim.backend)
@@ -540,6 +597,9 @@ def cmd_run(args: argparse.Namespace) -> int:
                 if sim.backend in ("nlist", "dense", "chunked")
                 else 0.0
             ),
+            # Periodic truncated runs: minimum-image oracle (the gate
+            # above only lets the truncated family through here).
+            box=config.periodic_box,
             kernel=kernel, full_acc=full_acc,
         )
         logger.log_print(
@@ -1721,6 +1781,10 @@ def cmd_serve(args: argparse.Namespace) -> int:
         max_requeues=args.max_requeues,
         slo_p99_ms=args.slo_p99_ms,
         slo_occupancy=args.slo_occupancy,
+        error_budget=args.serve_error_budget,
+        sentinel_every=args.serve_sentinel_every,
+        sentinel_k=args.serve_sentinel_k,
+        ledger_every=args.ledger_every,
     )
     host, port = daemon.start()
     print(json.dumps({
@@ -2027,6 +2091,10 @@ def cmd_tune(args: argparse.Namespace) -> int:
             "timings_s": {
                 k: round(v, 6) for k, v in decision.timings_s.items()
             },
+            # Measured accuracy per candidate (docs/observability.md
+            # "Numerics"): the verdict's error half rides the
+            # transcript too.
+            "errors": decision.errors,
             "skipped": decision.skipped,
             "tuning_dir": tuning_dir(),
         }), flush=True)
@@ -2142,6 +2210,29 @@ def main(argv=None) -> int:
                          help="round-occupancy SLO (0..1): rounds "
                               "below it emit slo_breach events + burn "
                               "flags in /metrics")
+    p_serve.add_argument("--error-budget", dest="serve_error_budget",
+                         type=float, default=0.0,
+                         help="accuracy SLO: largest acceptable "
+                              "sentinel p90 relative force error; a "
+                              "breach emits one accuracy_breach event, "
+                              "dumps the flight recorder, and trips "
+                              "the backend's breaker so admission "
+                              "reroutes down the exact-physics ladder "
+                              "(docs/observability.md 'Numerics')")
+    p_serve.add_argument("--sentinel-every",
+                         dest="serve_sentinel_every", type=int,
+                         default=8,
+                         help="accuracy-sentinel cadence in scheduling "
+                              "rounds (0 = off); feeds the per-backend "
+                              "gravity_force_error_rel histogram")
+    p_serve.add_argument("--sentinel-k", dest="serve_sentinel_k",
+                         type=int, default=64,
+                         help="sampled sentinel targets per probe")
+    p_serve.add_argument("--ledger-every", dest="ledger_every",
+                         type=int, default=1,
+                         help="per-slot conservation-ledger cadence in "
+                              "rounds (0 = off); feeds the per-job "
+                              "drift gauges")
     p_serve.set_defaults(fn=cmd_serve)
 
     p_submit = sub.add_parser(

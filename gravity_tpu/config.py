@@ -176,7 +176,33 @@ class SimulationConfig:
     checkpoint_every: int = 0  # 0 = disabled
     checkpoint_dir: str = "checkpoints"
     metrics: bool = False  # JSONL per-block metrics stream
-    metrics_energy: bool = False  # add per-block total-energy drift (costly)
+    # DEPRECATED alias for `ledger` (PR-4's consume-time energy sample;
+    # its partial pipeline re-serialization is fixed by the in-program
+    # ledger, which this flag now enables — docs/observability.md
+    # "Numerics").
+    metrics_energy: bool = False
+    # In-program conservation ledger: energy / momentum / angular
+    # momentum / COM drift computed as an async device companion of
+    # every block (fp64 host accumulation, ops/diagnostics ledger_*),
+    # reported per block in the metrics JSONL and summarized in run
+    # stats — near-zero host cost by construction (the dispatch rides
+    # the block's own consume fence).
+    ledger: bool = False
+    # Accuracy sentinel: every `sentinel_every` blocks, probe the
+    # active backend's force error on `sentinel_k` sampled targets
+    # against the exact direct-sum oracle (rcut-masked / minimum-image
+    # for the truncated nlist family), in-program and async like the
+    # ledger. 0 = off (forced to 1 when an error budget is set).
+    sentinel_every: int = 0
+    sentinel_k: int = 64
+    # Error budget: the largest acceptable sentinel p90 relative force
+    # error. 0 = observe only. > 0 makes accuracy a runtime SLO: a
+    # breach dumps the flight recorder and raises AccuracyBreach —
+    # fatal standalone (exit 2, like divergence), HEALED under
+    # --auto-recover (leaf-cap re-size / exact-physics reroute) and by
+    # the serving layer's breaker reroute (docs/observability.md
+    # "Numerics").
+    error_budget: float = 0.0
     profile: bool = False  # capture a jax.profiler trace of the run
     # Span tracing (docs/observability.md): emit the run's lifecycle
     # spans (blocks, checkpoints, divergence/preemption markers) as

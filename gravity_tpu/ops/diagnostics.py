@@ -162,3 +162,262 @@ def radial_density_profile(state: ParticleState, bins: int = 32):
 def energy_drift(initial_energy, current_energy) -> jnp.ndarray:
     """|dE / E0| — the standard symplectic-integrator quality metric."""
     return jnp.abs((current_energy - initial_energy) / initial_energy)
+
+
+# --- the in-program conservation ledger (docs/observability.md
+# "Numerics") ---
+#
+# The ledger is the jit-dispatchable half of the conserved-quantity
+# diagnostics: everything the run loop wants to watch per block
+# (energy, momentum, angular momentum, COM) computed as DEVICE scalars
+# in normalized-mass form so every intermediate stays inside fp32
+# range (the same trick the host diagnostics above use), then rescaled
+# to float64 ON THE HOST at consume time. Because the device half is a
+# pure jitted function of the state, the run loop dispatches it as an
+# async companion right after each block (the ``_finite_fn`` pattern)
+# instead of at consume time — which is what retires the PR-4
+# ``--metrics-energy`` re-serialization (docs/scaling.md).
+
+# Order of the O(N) ledger components ``ledger_vec`` returns. The
+# potential-energy term travels separately (`pe`/`pe_scale`): its
+# cheapest formulation depends on scale and backend, so the Simulator
+# picks the device function (dense pair scan / tree / fmm) and tags
+# the conversion kind for :func:`ledger_host`.
+# Largest N whose ledger energy term is priced as the exact dense pair
+# scan (pe_hat_dense, O(N^2) per observation). Above it the solo
+# Simulator swaps in the jittable scaled tree/fmm potential sums; the
+# serve engine's vmapped twin — which has no vmap-safe tree PE — drops
+# the energy term instead (pe_kind "none": momentum/angmom/COM drift
+# stay) rather than pay slots * N^2 per round. Truncated (rcut) runs
+# are exempt: their shifted pair sum is the only honest energy.
+LEDGER_DENSE_MAX = 16_384
+
+LEDGER_VEC_FIELDS = (
+    "m_scale", "m_sum_hat", "ke_hat",
+    "px_hat", "py_hat", "pz_hat",
+    "lx_hat", "ly_hat", "lz_hat",
+    "comx", "comy", "comz",
+    "r2_hat",
+)
+
+
+def ledger_vec(positions, velocities, masses) -> jnp.ndarray:
+    """The O(N) conserved-quantity components of one system as a (13,)
+    device vector (see :data:`LEDGER_VEC_FIELDS`), jit- and vmap-safe.
+
+    Normalized-mass contract (host rescale in :func:`ledger_host`):
+    ``m_sum = m_scale * m_sum_hat``, ``KE = m_scale * ke_hat``,
+    ``P = m_scale * (px,py,pz)_hat``, ``L = m_scale * (lx,ly,lz)_hat``
+    (about the origin), ``com`` is absolute, and ``r2_hat`` is the
+    mass-weighted mean squared COM-centric radius (``r_rms =
+    sqrt(r2_hat)`` — the drift metrics' length scale). Zero-mass
+    padding lanes contribute nothing to any term, so the vmapped serve
+    twin needs no explicit masking; an all-empty slot returns zeros
+    (m_scale clamps to tiny)."""
+    dtype = positions.dtype
+    m_scale = jnp.maximum(
+        jnp.max(masses), jnp.asarray(jnp.finfo(dtype).tiny, dtype)
+    )
+    m_hat = masses / m_scale
+    m_sum_hat = jnp.sum(m_hat)
+    v2 = jnp.sum(velocities * velocities, axis=-1)
+    ke_hat = 0.5 * jnp.sum(m_hat * v2)
+    p_hat = jnp.sum(m_hat[:, None] * velocities, axis=0)
+    l_hat = jnp.sum(
+        m_hat[:, None] * jnp.cross(positions, velocities), axis=0
+    )
+    w = m_hat / jnp.maximum(
+        m_sum_hat, jnp.asarray(jnp.finfo(dtype).tiny, dtype)
+    )
+    com = jnp.sum(w[:, None] * positions, axis=0)
+    d = positions - com[None, :]
+    r2_hat = jnp.sum(w * jnp.sum(d * d, axis=-1))
+    return jnp.stack([
+        m_scale, m_sum_hat, ke_hat,
+        p_hat[0], p_hat[1], p_hat[2],
+        l_hat[0], l_hat[1], l_hat[2],
+        com[0], com[1], com[2],
+        r2_hat,
+    ])
+
+
+def _pe_rows_hat(pos_i, positions, m_hat, cutoff, eps, rcut, box=0.0):
+    """Per-target dimensionless potential rows sum_j m_hat_j * k(r):
+    k = 1/r_soft untruncated; with ``rcut`` > 0 the TRUNCATED family's
+    shifted kernel k = 1/r_soft - 1/rcut_soft for r <= rcut, 0 beyond
+    — the potential whose negative gradient is the rcut-masked force
+    (continuous at the cutoff), so truncated-physics runs get an
+    honestly conserved energy instead of a jumpy unshifted sum."""
+    dtype = positions.dtype
+    diff = positions[None, :, :] - pos_i[:, None, :]
+    if box > 0.0:
+        # Minimum-image separations: the truncated family's periodic
+        # pair potential (valid for rcut < box/2, its own constraint).
+        b = jnp.asarray(box, dtype)
+        diff = diff - b * jnp.round(diff / b)
+    r2 = jnp.sum(diff * diff, axis=-1)
+    r2_soft = r2 + jnp.asarray(eps, dtype) ** 2
+    cutoff2 = jnp.asarray(cutoff, dtype) ** 2
+    ok = r2_soft > cutoff2
+    rcut2 = jnp.asarray(rcut, dtype) ** 2
+    ok = jnp.logical_and(ok, jnp.logical_or(rcut2 <= 0, r2 <= rcut2))
+    safe = jnp.where(ok, r2_soft, jnp.asarray(1.0, dtype))
+    k = jax.lax.rsqrt(safe)
+    if rcut > 0.0:
+        k = k - jax.lax.rsqrt(
+            rcut2 + jnp.asarray(eps, dtype) ** 2
+        )
+    k = jnp.where(ok, k, jnp.asarray(0.0, dtype))
+    return jnp.sum(m_hat[None, :] * k, axis=1)
+
+
+def pe_hat_dense(
+    positions, masses, *, cutoff: float = CUTOFF_RADIUS,
+    eps: float = 0.0, rcut: float = 0.0, box: float = 0.0,
+    chunk: int = 4096,
+) -> jnp.ndarray:
+    """Dimensionless pair-potential double sum ``s_hat`` (jittable,
+    O(N*chunk) memory): ``PE = -0.5 * g * m_scale^2 * s_hat`` with
+    ``m_scale = max(masses)`` — the ledger's dense/chunked energy term
+    (conventions match :func:`~gravity_tpu.ops.forces.potential_energy`
+    exactly for rcut=0). The Simulator swaps in the tree/fmm scaled
+    sums above the dense bound (simulation.LEDGER_DENSE_MAX)."""
+    dtype = positions.dtype
+    n = positions.shape[0]
+    m_scale = jnp.maximum(
+        jnp.max(masses), jnp.asarray(jnp.finfo(dtype).tiny, dtype)
+    )
+    m_hat = masses / m_scale
+    if n <= chunk:
+        rows = _pe_rows_hat(
+            positions, positions, m_hat, cutoff, eps, rcut, box
+        )
+        return jnp.sum(m_hat * rows)
+    n_padded = ((n + chunk - 1) // chunk) * chunk
+    pos_p = jnp.pad(positions, ((0, n_padded - n), (0, 0)))
+    pos_chunks = pos_p.reshape(n_padded // chunk, chunk, 3)
+    rows = jax.lax.map(
+        lambda pos_i: _pe_rows_hat(
+            pos_i, positions, m_hat, cutoff, eps, rcut, box
+        ),
+        pos_chunks,
+    ).reshape(n_padded)[:n]
+    return jnp.sum(m_hat * rows)
+
+
+def ledger_host(vec, pe=None, pe_scale=None, *, g: float = G,
+                pe_kind: str = "dense", ext=None) -> dict:
+    """Host-float64 ledger from the device components: ``vec`` from
+    :func:`ledger_vec` (or one slot row of the vmapped serve twin),
+    ``pe``/``pe_scale`` from the chosen potential path. ``pe_kind``:
+    ``dense``/``tree`` (PE = -0.5 g pe_scale^2 pe — pe_scale defaults
+    to the vec's m_scale), ``fmm`` (PE = -0.5 pe_scale pe; g and one
+    mass power pre-folded — ops/fmm._fmm_pe_scaled's contract),
+    ``pm`` (PE = pe_scale^2 pe; the periodic mesh core's mean-mass
+    normalization — ops/periodic._potential_core, 0.5 and g folded
+    in), ``absolute`` (pe IS the f64 potential energy), ``none`` (no
+    energy term; ``energy`` comes back None). ``ext`` is the
+    normalized external-field energy ``sum(m_hat * phi_ext)`` (device
+    scalar; rescaled by the vec's m_scale) — --external runs conserve
+    KE + PE_self + PE_ext, so omitting it would report spurious
+    drift."""
+    import numpy as np
+
+    v = {
+        k: np.float64(np.asarray(x))
+        for k, x in zip(LEDGER_VEC_FIELDS, np.asarray(vec))
+    }
+    m_scale = v["m_scale"]
+    out = {
+        "m_sum": m_scale * v["m_sum_hat"],
+        "kinetic": m_scale * v["ke_hat"],
+        "momentum": m_scale * np.array(
+            [v["px_hat"], v["py_hat"], v["pz_hat"]], np.float64
+        ),
+        "ang_mom": m_scale * np.array(
+            [v["lx_hat"], v["ly_hat"], v["lz_hat"]], np.float64
+        ),
+        "com": np.array(
+            [v["comx"], v["comy"], v["comz"]], np.float64
+        ),
+        "r_rms": np.sqrt(max(v["r2_hat"], 0.0)),
+    }
+    if pe is None or pe_kind == "none":
+        out["potential"] = None
+        out["energy"] = None
+        return out
+    pe64 = np.float64(np.asarray(pe))
+    scale = (
+        np.float64(np.asarray(pe_scale))
+        if pe_scale is not None else m_scale
+    )
+    if pe_kind in ("dense", "tree"):
+        potential = np.float64(-0.5 * g) * scale * scale * pe64
+    elif pe_kind == "fmm":
+        potential = np.float64(-0.5) * scale * pe64
+    elif pe_kind == "pm":
+        potential = scale * scale * pe64
+    elif pe_kind == "absolute":
+        potential = pe64
+    else:
+        raise ValueError(f"unknown pe_kind {pe_kind!r}")
+    if ext is not None:
+        potential = potential + m_scale * np.float64(np.asarray(ext))
+    out["potential"] = potential
+    out["energy"] = out["kinetic"] + potential
+    return out
+
+
+def ledger_drift(l0: dict, l: dict, *, com_frame: bool = True) -> dict:
+    """Relative drift of the conserved quantities between two host
+    ledgers (docs/observability.md "Numerics" defines the scales):
+
+    - ``energy_drift``   = |E - E0| / |E0|   (None when either E is)
+    - ``momentum_drift`` = |P - P0| / p_ref, p_ref = sqrt(2 KE0 m_sum)
+      (the system's characteristic momentum — |P0| itself is ~0 for
+      COM-frame ICs, which would make the naive ratio explode)
+    - ``angmom_drift``   = |L - L0| / max(|L0|, p_ref * r_rms0)
+    - ``com_drift``      = |com - com0| / r_rms0 (absolute COM motion
+      in units of the initial mass-weighted RMS radius; suppressed via
+      ``com_frame=False`` for periodic boxes, where coordinates wrap)
+    """
+    import numpy as np
+
+    tiny = np.float64(1e-300)
+    out: dict = {}
+    if l0.get("energy") is not None and l.get("energy") is not None:
+        out["energy_drift"] = float(
+            abs(l["energy"] - l0["energy"])
+            / max(abs(l0["energy"]), tiny)
+        )
+    else:
+        out["energy_drift"] = None
+    p_ref = np.sqrt(
+        max(2.0 * max(l0["kinetic"], 0.0) * max(l0["m_sum"], 0.0), 0.0)
+    )
+    if p_ref <= 0.0 and l0.get("potential") is not None:
+        # Cold-start ICs (zero initial velocities) have KE0 = 0; fall
+        # back to the virial momentum scale sqrt(2 |PE0| m_sum) — the
+        # momentum the collapse will generate — instead of letting the
+        # tiny guard blow the ratio up to ~1e290.
+        p_ref = np.sqrt(
+            2.0 * abs(l0["potential"]) * max(l0["m_sum"], 0.0)
+        )
+    out["momentum_drift"] = float(
+        np.linalg.norm(l["momentum"] - l0["momentum"])
+        / max(p_ref, tiny)
+    )
+    l_ref = max(
+        float(np.linalg.norm(l0["ang_mom"])), p_ref * l0["r_rms"], tiny
+    )
+    out["angmom_drift"] = float(
+        np.linalg.norm(l["ang_mom"] - l0["ang_mom"]) / l_ref
+    )
+    if com_frame:
+        out["com_drift"] = float(
+            np.linalg.norm(l["com"] - l0["com"])
+            / max(l0["r_rms"], tiny)
+        )
+    else:
+        out["com_drift"] = None
+    return out

@@ -67,6 +67,7 @@ def accelerations_vs(
     cutoff: float = CUTOFF_RADIUS,
     eps: float = 0.0,
     rcut: float = 0.0,
+    box: float = 0.0,
 ) -> jax.Array:
     """Accelerations on `pos_i` (M, 3) sourced by `pos_j` (K, 3)/`masses_j` (K,).
 
@@ -74,10 +75,16 @@ def accelerations_vs(
     all_gather, ring ppermute): self-pairs are excluded automatically because
     r == 0 falls below the cutoff. ``rcut`` > 0 truncates at r > rcut
     (the nlist backend's declared short-range physics — this masked form
-    is its exact reference).
-    """
+    is its exact reference). ``box`` > 0 applies the minimum-image
+    convention to each pair separation — the rcut-masked PERIODIC
+    oracle for the nlist family (only meaningful with rcut < box/2,
+    where each pair has one dominant image; it is NOT an Ewald sum and
+    cannot reference full periodic gravity)."""
     dtype = pos_i.dtype
     diff = pos_j[None, :, :] - pos_i[:, None, :]  # (M, K, 3)
+    if box > 0.0:
+        b = jnp.asarray(box, dtype)
+        diff = diff - b * jnp.round(diff / b)
     r2 = jnp.sum(diff * diff, axis=-1)  # (M, K)
     w = _pair_weights(
         r2, masses_j[None, :], g, cutoff, eps, dtype, rcut=rcut

@@ -91,6 +91,20 @@ class CircuitBreaker:
             self.opened_ts = now
         return False
 
+    def trip(self, now: Optional[float] = None) -> bool:
+        """Force the breaker OPEN regardless of the failure count —
+        the accuracy sentinel's error-budget breach
+        (docs/observability.md "Numerics"): a backend measured to be
+        serving wrong answers is degraded exactly like one that cannot
+        build, so admission reroutes down the exact-physics ladder.
+        Returns True when this call newly opened it."""
+        now = time.time() if now is None else now
+        was_open = self.state == "open"
+        self.state = "open"
+        self.opened_ts = now
+        self._trial_pending = False
+        return not was_open
+
     def record_success(self) -> bool:
         """Count one success; returns True when it CLOSED an open/half-
         open breaker."""

@@ -293,6 +293,11 @@ class EnsembleEngine:
         self._round_fns: dict[BatchKey, object] = {}
         self._kernels: dict[BatchKey, object] = {}
         self._seed_fns: dict[BatchKey, object] = {}
+        # Numerics observatory (docs/observability.md "Numerics"):
+        # per-key jitted ledger + accuracy-probe programs, cached like
+        # the round fns (the scheduler calls them at its own cadence).
+        self._ledger_fns: dict[BatchKey, object] = {}
+        self._probe_fns: dict[tuple, object] = {}
         self.compile_counts: dict[BatchKey, int] = {}
         # Optional telemetry hook (a FlightRecorder, or anything with
         # .record(kind, **fields)): (re)trace marks land in the crash
@@ -553,6 +558,153 @@ class EnsembleEngine:
             positions=st.positions[:n],
             velocities=st.velocities[:n],
             masses=st.masses[:n],
+        )
+
+    # --- the numerics observatory (docs/observability.md "Numerics") ---
+
+    @staticmethod
+    def _key_rcut(key: BatchKey) -> float:
+        """The truncated family's declared rcut for this key (0 = full
+        gravity) — rides BatchKey.extra (batch_key_for)."""
+        try:
+            return float(dict(key.extra).get("nlist_rcut", 0.0) or 0.0)
+        except (TypeError, ValueError):
+            return 0.0
+
+    @staticmethod
+    def _state_batch(batch):
+        """The (slots, n, …) state-carrying batch: the EnsembleBatch
+        itself, or the ``base`` an integration class wraps around it
+        (SweepBatch/WatchBatch carry their extra slot accumulators
+        beside an untouched integrate-shaped base)."""
+        return getattr(batch, "base", batch)
+
+    def _ledger_applicable(self, key: BatchKey, batch) -> bool:
+        """Whether this key's batches carry an integrating
+        (positions, velocities, masses) state whose conserved
+        quantities are meaningful — every integration class does; fit
+        opts out (``conserves = False``: its lanes hold the
+        optimizer's moving GUESS, not a trajectory)."""
+        cls = self._job_class(key)
+        if cls is not None and not getattr(cls, "conserves", True):
+            return False
+        inner = self._state_batch(batch)
+        return (
+            hasattr(inner, "positions")
+            and hasattr(inner, "velocities")
+            and hasattr(inner, "masses")
+        )
+
+    def batch_ledger(self, batch) -> Optional[np.ndarray]:
+        """Per-slot conservation-ledger components of a (returned,
+        live) batch: a ``(slots, 14)`` host array — the 13
+        :data:`~gravity_tpu.ops.diagnostics.LEDGER_VEC_FIELDS` plus
+        the dense dimensionless pair-potential sum — the vmapped twin
+        of the solo run's ledger companion. Zero-mass padding lanes
+        are inert by construction (every term is mass-weighted), so
+        one program serves every occupancy. None for keys without an
+        integrating state (fit). Convert one row with
+        :func:`slot_ledger_host`."""
+        key = batch.key
+        if not self._ledger_applicable(key, batch):
+            return None
+        fn = self._ledger_fns.get(key)
+        if fn is None:
+            from ..ops.diagnostics import ledger_vec, pe_hat_dense
+
+            rcut = self._key_rcut(key)
+            with_pe = self._ledger_pe_kind(key) != "none"
+
+            def one(pos, vel, m):
+                vec = ledger_vec(pos, vel, m)
+                if not with_pe:
+                    # Above the dense bound (and untruncated) the
+                    # O(N^2) pair scan would dwarf a fast-solver
+                    # round's own force work: energy drift is dropped
+                    # for this key, the O(N) terms stay.
+                    return jnp.concatenate(
+                        [vec, jnp.zeros((1,), vec.dtype)]
+                    )
+                pe = pe_hat_dense(
+                    pos, m, cutoff=key.cutoff, eps=key.eps, rcut=rcut
+                )
+                return jnp.concatenate([vec, pe[None]])
+
+            fn = jax.jit(jax.vmap(one))
+            self._ledger_fns[key] = fn
+        inner = self._state_batch(batch)
+        return np.asarray(
+            fn(inner.positions, inner.velocities, inner.masses)
+        )
+
+    def _ledger_pe_kind(self, key: BatchKey) -> str:
+        """Energy-term pricing for this key's ledger: the dense pair
+        scan up to LEDGER_DENSE_MAX (always for the truncated family,
+        whose shifted sum is the only honest energy), ``none`` above
+        it — the vmapped twin has no vmap-priced tree/fmm PE, and
+        slots * N^2 per round would dwarf a fast solver's own force
+        work (the solo crossover's reasoning; momentum/angmom/COM
+        drift remain O(N))."""
+        from ..ops.diagnostics import LEDGER_DENSE_MAX
+
+        if self._key_rcut(key) > 0.0 or key.bucket_n <= LEDGER_DENSE_MAX:
+            return "dense"
+        return "none"
+
+    def slot_ledger_host(self, row, key: BatchKey) -> dict:
+        """Host-float64 ledger from one :meth:`batch_ledger` row."""
+        from ..ops.diagnostics import ledger_host
+
+        kind = self._ledger_pe_kind(key)
+        return ledger_host(
+            row[:13], pe=row[13] if kind != "none" else None,
+            g=key.g, pe_kind=kind,
+        )
+
+    def state_ledger(self, state: ParticleState, key: BatchKey) -> dict:
+        """The t0 ledger baseline of one job's (unpadded) state —
+        computed at admission so drift is measured from the actual
+        initial conditions, not the end of the first round."""
+        from ..ops.diagnostics import ledger_host, ledger_vec, pe_hat_dense
+
+        vec = ledger_vec(state.positions, state.velocities, state.masses)
+        kind = self._ledger_pe_kind(key)
+        if kind == "none":
+            return ledger_host(vec, pe=None, g=key.g, pe_kind="none")
+        pe = pe_hat_dense(
+            state.positions, state.masses, cutoff=key.cutoff,
+            eps=key.eps, rcut=self._key_rcut(key),
+        )
+        return ledger_host(vec, pe=pe, g=key.g, pe_kind=kind)
+
+    def probe_slot_accuracy(self, batch, slot: int, k: int = 64):
+        """Accuracy-sentinel probe of ONE occupied slot's lane: the
+        key's compiled kernel vs the exact (rcut-masked) direct-sum
+        oracle on ``k`` fixed sampled targets. Returns the (k,)
+        relative errors (host), or None for keys without a state. One
+        jitted program per (key, k), cached — the probe costs roughly
+        one extra single-lane force evaluation, amortized by the
+        scheduler's cadence."""
+        key = batch.key
+        if not self._ledger_applicable(key, batch):
+            return None
+        fn = self._probe_fns.get((key, k))
+        if fn is None:
+            from ..utils.profiling import (
+                make_force_error_probe,
+                sentinel_indices,
+            )
+
+            idx = sentinel_indices(key.bucket_n, k)
+            fn = jax.jit(make_force_error_probe(
+                self._kernel(key), idx=idx, g=key.g,
+                cutoff=key.cutoff, eps=key.eps,
+                rcut=self._key_rcut(key),
+            ))
+            self._probe_fns[(key, k)] = fn
+        inner = self._state_batch(batch)
+        return np.asarray(
+            fn(inner.positions[slot], inner.masses[slot])
         )
 
     # --- the hot path ---

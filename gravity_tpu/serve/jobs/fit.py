@@ -160,6 +160,11 @@ def _key_params(key) -> dict:
 class FitJob(JobClass):
     name = "fit"
     units = "iters"
+    # The ledger/sentinel gate (docs/observability.md "Numerics"): fit
+    # lanes carry the optimizer's moving guess, not an integrating
+    # trajectory — drift against t0 would measure the optimizer, not
+    # the solver.
+    conserves = False
 
     # --- admission ---
 

@@ -53,6 +53,12 @@ class JobClass:
     #: surfaced in /status and docs; internal classes (sweep members)
     #: are not directly submittable over the API
     submittable: bool = True
+    #: whether this class's batch lanes hold an INTEGRATING state whose
+    #: conserved quantities are meaningful — the gate for the per-slot
+    #: conservation ledger and the accuracy sentinel
+    #: (docs/observability.md "Numerics"). fit opts out: its lanes
+    #: carry the optimizer's moving guess, not a trajectory.
+    conserves: bool = True
 
     # --- admission ---
 

@@ -152,6 +152,12 @@ class Job:
     # Local-only: the BatchKey this job was queued under (breaker
     # reroutes can change the computed key between enqueue and lookup).
     key_cache: Optional[BatchKey] = None
+    # Numerics observatory (docs/observability.md "Numerics"): the
+    # t0 conservation-ledger baseline (local-only — recomputed from
+    # the deterministic ICs after a respool) and the latest measured
+    # drift (persisted in the record / surfaced in /status).
+    ledger0: Optional[dict] = None
+    drift: Optional[dict] = None
 
     @property
     def steps(self) -> int:
@@ -183,6 +189,7 @@ class Job:
             "fence": self.fence,
             "requeues": self.requeues,
             "trace_id": self.trace_id,
+            "drift": self.drift,
         }
 
 
@@ -353,6 +360,10 @@ class EnsembleScheduler:
         telemetry: Optional[Telemetry] = None,
         slo_p99_ms: Optional[float] = None,
         slo_occupancy: Optional[float] = None,
+        error_budget: float = 0.0,
+        sentinel_every: int = 8,
+        sentinel_k: int = 64,
+        ledger_every: int = 1,
     ):
         if slots < 1 or slice_steps < 1 or yield_rounds < 1:
             raise ValueError(
@@ -388,6 +399,21 @@ class EnsembleScheduler:
         self.slo_p99_ms = slo_p99_ms
         self.slo_occupancy = slo_occupancy
         self._slo_burn: dict = {"p99": False, "occupancy": False}
+        # Numerics observatory (docs/observability.md "Numerics"):
+        # every `ledger_every` rounds the per-slot conservation ledger
+        # refreshes each resident job's drift gauges; every
+        # `sentinel_every` rounds one resident lane's force error is
+        # probed against the exact oracle. `error_budget` > 0 turns
+        # the probe into an SLO: an over-budget p90 raises an
+        # edge-triggered accuracy_breach event, dumps the flight
+        # recorder, and TRIPS the backend's breaker so admission
+        # reroutes down the exact-physics ladder (the burn clears when
+        # a later probe measures back under budget).
+        self.error_budget = float(error_budget or 0.0)
+        self.sentinel_every = max(0, int(sentinel_every))
+        self.sentinel_k = max(1, int(sentinel_k))
+        self.ledger_every = max(0, int(ledger_every))
+        self._accuracy_burn: dict = {}
         self._last_occupancy: Optional[float] = None
         self._last_adoption_dump = 0.0
         # 0 = unbounded (in-process consumers); the daemon defaults to
@@ -975,6 +1001,15 @@ class EnsembleScheduler:
                 if self.leases is not None else 0
             ),
             "slo": self.slo_status(),
+            "numerics": {
+                "error_budget": self.error_budget or None,
+                "sentinel_every": self.sentinel_every,
+                "sentinel_k": self.sentinel_k,
+                "ledger_every": self.ledger_every,
+                "accuracy_burn": {
+                    k: v for k, v in self._accuracy_burn.items() if v
+                },
+            },
             "flightrec": {
                 "entries": len(recorder),
                 "dumps": recorder.dumps,
@@ -1055,9 +1090,20 @@ class EnsembleScheduler:
 
     def _persist(self, job: Job) -> bool:
         """Write the job record; False = fencing rejected it (we lost
-        ownership to an adopter — local state re-synced from disk)."""
+        ownership to an adopter — local state re-synced from disk).
+
+        An already-UNOWNED job never writes at all: a fenced write
+        absorbed the adopter's record — INCLUDING its fence — as the
+        local truth (``_apply_record``), so a later write from this
+        zombie would carry the adopter's own token and PASS
+        validation, clobbering the owner's record and emitting a
+        duplicate terminal event (the chaos-2 exactly-one-completed
+        invariant; surfaced when slower admissions let a fenced
+        admission write land before the resident copy finished)."""
         if self.spool is None:
             return True
+        if not job.owned:
+            return False
         landed = self.spool.write_job(job)
         if not landed:
             # Fenced out: a newer claim (our adopter) owns this job —
@@ -1251,6 +1297,14 @@ class EnsembleScheduler:
         job.status = status
         job.error = error
         job.finished_ts = time.time()
+        # The drift gauges are the registry's only per-job label
+        # dimension: drop the finished job's series so the exposition
+        # stays bounded over the daemon's lifetime (the last value
+        # lives on in job.drift / the spool record).
+        for gname in (
+            "gravity_job_energy_drift", "gravity_job_momentum_drift"
+        ):
+            self.telemetry.registry.remove_series(gname, job=job.id)
         if not self._persist(job):
             # Fenced: an adopter owns the outcome — no terminal event
             # from the zombie (exactly one completed/failed per job in
@@ -1361,6 +1415,24 @@ class EnsembleScheduler:
                         reason=f"backend {key.backend} unavailable")
             self._persist(job)
             return False
+        if (
+            job.ledger0 is None
+            and job.steps_done == 0
+            and self.ledger_every
+            and getattr(get_class(job.job_type), "conserves", True)
+        ):
+            # The drift baseline is the job's ACTUAL t0 state (fresh
+            # admissions only; an evict/resume keeps its original
+            # baseline, an adopted mid-flight job baselines at first
+            # observation). Computed INSIDE the slot_load span window
+            # (emitted below) so its first-shape compile stays
+            # attributed in the job's trace — the coverage gate tiles
+            # a job's wall-clock from its top-level spans. Telemetry
+            # must never fail an admission.
+            try:
+                job.ledger0 = self.engine.state_ledger(state, key)
+            except Exception:  # noqa: BLE001
+                job.ledger0 = None
         if job.trace_id:
             self.telemetry.tracer.emit(
                 "slot_load", job.trace_id, t_load,
@@ -1488,6 +1560,144 @@ class EnsembleScheduler:
                 self._rotor = (self._rotor + i + 1) % n
                 return key
         return None
+
+    def _observe_numerics(
+        self, key: BatchKey, batch, slots, occupied, res
+    ) -> Optional[dict]:
+        """The numerics observatory's per-round step
+        (docs/observability.md "Numerics"): refresh every finite
+        resident job's conservation-ledger drift (gauges + /status),
+        and — at the sentinel cadence — probe one resident lane's
+        force error against the exact oracle, feeding the per-backend
+        error histogram and the error-budget breach check. Returns the
+        probe info (for the child-span emission in the accounting
+        loop) or None. Telemetry must never fail a round: every
+        device-touching step is individually absorbed."""
+        reg = self.telemetry.registry
+        # rounds_run was already incremented for THIS round; -1 so the
+        # first round of a fresh worker lands on the cadence (a short
+        # daemon must still produce drift gauges and probe samples).
+        tick = self.rounds_run - 1
+        led = None
+        if self.ledger_every and tick % self.ledger_every == 0:
+            try:
+                led = self.engine.batch_ledger(batch)
+            except Exception:  # noqa: BLE001
+                led = None
+        if led is not None:
+            from ..ops.diagnostics import ledger_drift
+
+            for slot in occupied:
+                if not bool(res.finite[slot]):
+                    continue
+                job = self.jobs.get(slots[slot])
+                if job is None:
+                    continue
+                try:
+                    cur = self.engine.slot_ledger_host(led[slot], key)
+                except Exception:  # noqa: BLE001
+                    continue
+                if job.ledger0 is None:
+                    # Adopted/evicted mid-flight with no baseline:
+                    # first observation becomes it (drift measured
+                    # from here on — documented limitation).
+                    job.ledger0 = cur
+                    continue
+                drift = ledger_drift(job.ledger0, cur)
+                job.drift = drift
+                if drift["energy_drift"] is not None:
+                    reg.gauge(
+                        "gravity_job_energy_drift", job=job.id
+                    ).set(drift["energy_drift"])
+                reg.gauge(
+                    "gravity_job_momentum_drift", job=job.id
+                ).set(drift["momentum_drift"])
+        probe = None
+        if self.sentinel_every \
+                and tick % self.sentinel_every == 0:
+            slot = next(
+                (s for s in occupied if bool(res.finite[s])), None
+            )
+            if slot is not None and slots[slot] in self.jobs:
+                from ..utils.faults import accuracy_breach_due
+                from ..utils.profiling import sentinel_summary
+
+                t0 = time.time()
+                try:
+                    rel = self.engine.probe_slot_accuracy(
+                        batch, slot, k=self.sentinel_k
+                    )
+                except Exception:  # noqa: BLE001
+                    rel = None
+                if rel is not None:
+                    summary = sentinel_summary(rel)
+                    injected = accuracy_breach_due(self.rounds_run)
+                    if injected:
+                        # Injected solver overload (fault spec
+                        # accuracy_breach@R): the breach workflow runs
+                        # through its real path on CPU.
+                        summary = dict(
+                            summary, p90_rel_err=1.0, max_rel_err=1.0,
+                            injected=True,
+                        )
+                    hist = reg.histogram(
+                        "gravity_force_error_rel", backend=key.backend
+                    )
+                    if injected:
+                        hist.observe(1.0)
+                    else:
+                        for v in rel:
+                            hist.observe(float(v))
+                    reg.counter(
+                        "gravity_sentinel_probes_total",
+                        backend=key.backend,
+                    ).inc()
+                    probe = {
+                        "job": slots[slot], "slot": slot,
+                        "backend": key.backend, "t0": t0,
+                        "dur_s": time.time() - t0, **summary,
+                    }
+                    self._check_accuracy_budget(key, probe)
+        return probe
+
+    def _check_accuracy_budget(self, key: BatchKey, probe: dict) -> None:
+        """Edge-triggered error-budget enforcement: one
+        ``accuracy_breach`` event + flight-recorder dump + breaker
+        trip per under->over transition; the burn clears when a later
+        probe measures back under budget (which re-enables the
+        breaker's success-close path)."""
+        if self.error_budget <= 0.0:
+            return
+        backend = key.backend
+        burning = probe["p90_rel_err"] > self.error_budget
+        was = self._accuracy_burn.get(backend, False)
+        if burning and not was:
+            self.telemetry.registry.counter(
+                "gravity_accuracy_breaches_total", backend=backend
+            ).inc()
+            self._event(
+                "accuracy_breach", backend=backend, job=probe["job"],
+                p90_rel_err=probe["p90_rel_err"],
+                budget=self.error_budget,
+                injected=bool(probe.get("injected", False)),
+            )
+            self._dump_flightrec("accuracy_breach")
+            if self.breakers.get(backend).trip():
+                # The supervisor-heal hook, serving edition: an open
+                # breaker reroutes every subsequent keying down the
+                # exact-physics ladder (serve/breaker.py) — wrong
+                # answers are degraded exactly like kernels that
+                # cannot build.
+                self._event(
+                    "breaker_open", backend=backend,
+                    failures=self.breakers.get(backend).failures,
+                    error=(
+                        f"accuracy breach: sentinel p90 rel err "
+                        f"{probe['p90_rel_err']:.3e} > budget "
+                        f"{self.error_budget:.3e}"
+                    ),
+                )
+        self._accuracy_burn[backend] = burning
 
     def run_round(self) -> Optional[dict]:
         """One scheduling round: pick a key, fill its slots, advance its
@@ -1622,20 +1832,33 @@ class EnsembleScheduler:
                             reason="round failed; restarting clean")
                 self._persist(job)
             raise
-        round_s = time.perf_counter() - t0
-        self._last_round_s = round_s
         self._batches[key] = batch
         self.rounds_run += 1
         compiled = (
             self.engine.compile_counts.get(key, 0) > compiles_before
         )
+        # Numerics observatory (docs/observability.md "Numerics"):
+        # per-slot ledger drift + the cadenced accuracy probe run on
+        # the LIVE returned batch, before completed jobs free their
+        # slots below. The probe can trip the backend's breaker
+        # (budget breach) — so it runs BEFORE the success gate — and
+        # its cost is INSIDE round_s, so the per-job round spans keep
+        # tiling the job's wall-clock (the trace-coverage contract).
+        probe = self._observe_numerics(key, batch, slots, occupied, res)
+        if not self._accuracy_burn.get(key.backend) \
+                and self.breakers.success(key.backend):
+            # A backend in accuracy burn must NOT close its breaker on
+            # mere compute success: it runs fine, it is just measured
+            # WRONG — only a clean probe (which clears the burn flag)
+            # re-opens the gate.
+            self._event("breaker_closed", backend=key.backend)
+        round_s = time.perf_counter() - t0
+        self._last_round_s = round_s
         reg = self.telemetry.registry
         reg.counter("gravity_rounds_total").inc()
         reg.histogram("gravity_round_seconds").observe(round_s)
         if compiled:
             reg.counter("gravity_compiles_total").inc()
-        if self.breakers.success(key.backend):
-            self._event("breaker_closed", backend=key.backend)
 
         # Class hook BEFORE accounting: event emission / follow-up
         # submission sees round-start unit counts, and a job completing
@@ -1646,6 +1869,16 @@ class EnsembleScheduler:
         real_pairs = 0.0
         for slot in occupied:
             job = self.jobs[slots[slot]]
+            if not job.owned:
+                # Adopted away mid-round: a fenced write during this
+                # round synced the adopter's record over our copy.
+                # Drop the resident lane silently — the owner's
+                # events/result are the only ones that count, and
+                # burning further rounds on it would only produce more
+                # fenced writes (and, without the _persist unowned
+                # guard, a duplicate terminal event).
+                self._free_slot(key, slot)
+                continue
             advanced = int(res.advanced[slot])
             job.steps_done += advanced
             job.resident_rounds += 1
@@ -1668,6 +1901,18 @@ class EnsembleScheduler:
                         "compile", job.trace_id, t0_wall, round_s,
                         parent=rid, bucket=key.bucket_n,
                         backend=key.backend,
+                    )
+                if probe is not None and probe["job"] == job.id:
+                    # The sentinel's cost + verdict as a CHILD of the
+                    # probed job's round span (docs/observability.md
+                    # "Numerics").
+                    self.telemetry.tracer.emit(
+                        "sentinel", job.trace_id, probe["t0"],
+                        probe["dur_s"], parent=rid, job=job.id,
+                        backend=probe["backend"],
+                        median_rel_err=probe["median_rel_err"],
+                        p90_rel_err=probe["p90_rel_err"],
+                        max_rel_err=probe["max_rel_err"],
                     )
             if not bool(res.finite[slot]):
                 # Per-slot watchdog: the engine already rolled the lane

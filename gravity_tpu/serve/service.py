@@ -82,6 +82,10 @@ class GravityDaemon:
         max_requeues: int = 5,
         slo_p99_ms: Optional[float] = None,
         slo_occupancy: Optional[float] = None,
+        error_budget: float = 0.0,
+        sentinel_every: int = 8,
+        sentinel_k: int = 64,
+        ledger_every: int = 1,
     ):
         self.spool_dir = spool_dir
         self.host = host
@@ -103,6 +107,8 @@ class GravityDaemon:
             lease_ttl_s=lease_ttl_s, max_queue=max_queue,
             max_requeues=max_requeues,
             slo_p99_ms=slo_p99_ms, slo_occupancy=slo_occupancy,
+            error_budget=error_budget, sentinel_every=sentinel_every,
+            sentinel_k=sentinel_k, ledger_every=ledger_every,
         )
         self.telemetry = self.scheduler.telemetry
         self.lock = threading.Lock()

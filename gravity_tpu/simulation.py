@@ -143,6 +143,14 @@ MERGE_GRID_THRESHOLD = 32_768
 # O(N log N) tree potential instead of the dense O(N^2) pair scan (which
 # would cost more than the force step it monitors; ops/tree.py).
 ENERGY_TREE_THRESHOLD = 16_384
+# Above this N the in-program conservation ledger's energy term switches
+# from the chunked dense pair scan (exact, O(N^2) per block) to the
+# jittable scaled tree/fmm potential sums — same crossover logic as the
+# consume-time sample above, but BOTH paths stay async-dispatchable
+# device programs (docs/observability.md "Numerics"). Defined in
+# ops/diagnostics so the serve engine's vmapped twin shares the bound
+# without importing this module.
+from .ops.diagnostics import LEDGER_DENSE_MAX  # noqa: E402
 # Multirate fast kicks with K * N pair entries at or under this budget
 # use the exact dense (K, N) rectangular kernel; above it the
 # shifted-slice backends serve the kicks with occupancy-scaled target
@@ -656,6 +664,29 @@ class SimulationDiverged(RuntimeError):
         self.step = step
 
 
+class AccuracyBreach(RuntimeError):
+    """The accuracy sentinel measured a force error past the declared
+    ``--error-budget`` (docs/observability.md "Numerics"). The state is
+    FINITE — unlike divergence there is nothing to roll back; the
+    supervisor heals by re-sizing the solver (leaf caps) or rerouting
+    down the exact-physics ladder and continues from the last consumed
+    block. Standalone runs exit 2 with a structured error, exactly like
+    the other watchdogs."""
+
+    def __init__(self, step: int, backend: str, p90_rel_err: float,
+                 budget: float):
+        super().__init__(
+            f"accuracy breach at step {step}: backend {backend!r} "
+            f"sentinel p90 relative force error {p90_rel_err:.3e} "
+            f"exceeds the error budget {budget:.3e} (raise the budget, "
+            "re-size the solver, or run with --auto-recover to heal)"
+        )
+        self.step = step
+        self.backend = backend
+        self.p90_rel_err = p90_rel_err
+        self.budget = budget
+
+
 class SimulationPreempted(KeyboardInterrupt):
     """SIGTERM (scheduler preemption) converted to an exception.
 
@@ -897,6 +928,10 @@ class Simulator:
         else:
             self._accel2 = self._unsharded_accel2()
 
+        # Self-gravity accel BEFORE the external-field wrap: the
+        # accuracy sentinel's exact oracle is the direct sum of
+        # self-gravity only, so the probe must audit this form.
+        self._self_accel2 = self._accel2
         self._ext_phi = None
         ext = None
         if config.external:
@@ -999,6 +1034,193 @@ class Simulator:
         self._snapshot_fn = jax.jit(
             lambda st: jax.tree_util.tree_map(jnp.copy, st)
         )
+        self._build_observatory()
+
+    def _build_observatory(self) -> None:
+        """The numerics observatory's jitted companions
+        (docs/observability.md "Numerics"): the conservation-ledger
+        device function + host converter and the accuracy-sentinel
+        probe. Both are pure functions of the state the run loop
+        dispatches asynchronously right after each block — the
+        ``_finite_fn`` pattern — so neither can re-serialize the host
+        pipeline the way the PR-4 consume-time ``--metrics-energy``
+        sample did."""
+        import warnings
+
+        config = self.config
+        self._ledger_on = bool(config.ledger or config.metrics_energy)
+        if config.metrics_energy and not config.ledger:
+            warnings.warn(
+                "--metrics-energy is a deprecated alias for the "
+                "in-program conservation ledger (--ledger): the "
+                "per-block energy sample is now an async device "
+                "companion of the block instead of a consume-time "
+                "dispatch (docs/observability.md \"Numerics\")",
+                DeprecationWarning, stacklevel=3,
+            )
+        budget = float(config.error_budget or 0.0)
+        sent_every = int(config.sentinel_every or 0)
+        if budget > 0.0 and sent_every <= 0:
+            # A declared budget with no cadence means "watch every
+            # block": an un-probed budget cannot breach.
+            sent_every = 1
+        self._ledger_fn = None
+        self._ledger_convert = None
+        self._sentinel_fn = None
+        self._sentinel_every = sent_every
+        if not self._ledger_on and sent_every <= 0:
+            return
+        truncated = config.nlist_rcut > 0.0 and self.backend in (
+            "nlist", "dense", "chunked"
+        )
+        rcut = config.nlist_rcut if truncated else 0.0
+
+        if self._ledger_on:
+            from .ops.diagnostics import (
+                ledger_host,
+                ledger_vec,
+                pe_hat_dense,
+            )
+
+            n = self.state.n
+            tiny = jnp.finfo(self.dtype).tiny
+            if config.periodic_box > 0.0 and not truncated:
+                # Full periodic gravity: the conserved energy is the
+                # mesh potential the solver actually integrates.
+                from .ops.periodic import _potential_core
+
+                def pe_dev(pos, m):
+                    m_mean = jnp.mean(m)
+                    mw = m / jnp.maximum(m_mean, tiny)
+                    return _potential_core(
+                        pos, mw, (0.0, 0.0, 0.0), config.periodic_box,
+                        grid=config.pm_grid, g=config.g,
+                        eps=config.eps,
+                        assignment=config.pm_assignment,
+                    ), m_mean
+
+                pe_kind = "pm"
+            elif truncated or n <= LEDGER_DENSE_MAX:
+                # Exact chunked pair scan (with the truncated family's
+                # shifted rcut kernel + minimum image when periodic).
+                def pe_dev(pos, m):
+                    return pe_hat_dense(
+                        pos, m, cutoff=config.cutoff, eps=config.eps,
+                        rcut=rcut, box=config.periodic_box,
+                    ), jnp.maximum(jnp.max(m), tiny)
+
+                pe_kind = "dense"
+            elif jax.devices()[0].platform == "tpu":
+                # Large-n fast-solver runs price the energy term with
+                # the same gather-free fmm potential the consume-time
+                # sample used — but its jitted scaled core, so the
+                # dispatch stays async.
+                from .ops.fmm import _clamp_slab, _fmm_pe_scaled
+
+                depth = self._ledger_tree_depth()
+                slab = _clamp_slab(4, depth, config.tree_leaf_cap)
+
+                def pe_dev(pos, m):
+                    return _fmm_pe_scaled(
+                        pos, m, depth=depth,
+                        leaf_cap=config.tree_leaf_cap,
+                        ws=config.tree_ws, g=config.g,
+                        cutoff=config.cutoff, eps=config.eps,
+                        slab=slab,
+                    )
+
+                pe_kind = "fmm"
+            else:
+                from .ops.tree import _tree_pe_scaled
+
+                depth = self._ledger_tree_depth()
+
+                def pe_dev(pos, m):
+                    return _tree_pe_scaled(
+                        pos, m, depth=depth,
+                        leaf_cap=config.tree_leaf_cap,
+                        chunk=config.fast_chunk, ws=config.tree_ws,
+                        cutoff=config.cutoff, eps=config.eps,
+                        quad=True,
+                    )
+
+                pe_kind = "tree"
+
+            ext_phi = self._ext_phi
+
+            def ledger_device(st: ParticleState) -> dict:
+                pe, scale = pe_dev(st.positions, st.masses)
+                out = {
+                    "vec": ledger_vec(
+                        st.positions, st.velocities, st.masses
+                    ),
+                    "pe": pe,
+                    "pe_scale": scale,
+                }
+                if ext_phi is not None:
+                    # --external runs conserve KE + PE_self + PE_ext:
+                    # the replaced --metrics-energy path included the
+                    # field's potential (self.energy()), so must the
+                    # ledger. Normalized masses keep the device sum in
+                    # fp32 range; ledger_host rescales by m_scale.
+                    m_scale = jnp.maximum(
+                        jnp.max(st.masses), tiny
+                    )
+                    out["ext"] = jnp.sum(
+                        (st.masses / m_scale) * ext_phi(st.positions)
+                    )
+                return out
+
+            self._ledger_fn = jax.jit(ledger_device)
+            self._ledger_convert = lambda dev: ledger_host(
+                dev["vec"], dev.get("pe"), dev.get("pe_scale"),
+                g=config.g, pe_kind=pe_kind, ext=dev.get("ext"),
+            )
+
+        if sent_every > 0:
+            if config.periodic_box > 0.0 and not truncated:
+                warnings.warn(
+                    "accuracy sentinel disabled: full periodic gravity "
+                    "has no exact direct-sum oracle (the minimum-image "
+                    "reference only covers the rcut-truncated nlist "
+                    "family)",
+                    stacklevel=3,
+                )
+                self._sentinel_every = 0
+            else:
+                from .utils.profiling import (
+                    full_set_probe_kernel,
+                    make_force_error_probe,
+                    sentinel_indices,
+                )
+
+                idx = sentinel_indices(
+                    self.state.n, config.sentinel_k, config.seed
+                )
+                # The probe audits the run's OWN compiled accel (the
+                # sharded/fast-solver form included) against the exact
+                # oracle on K fixed targets — one extra force
+                # evaluation per probe, amortized by the cadence.
+                self._sentinel_fn = jax.jit(make_force_error_probe(
+                    full_set_probe_kernel(self._self_accel2, idx),
+                    idx=idx, g=config.g, cutoff=config.cutoff,
+                    eps=config.eps, rcut=rcut,
+                    box=config.periodic_box if truncated else 0.0,
+                ))
+
+    def _ledger_tree_depth(self) -> int:
+        """Depth for the ledger's large-n tree/fmm potential term —
+        the same resolution rule as the consume-time energy sample
+        (one host pass, cached per Simulator)."""
+        depth = getattr(self, "_energy_tree_depth", None)
+        if depth is None:
+            from .ops.tree import recommended_depth_data
+
+            depth = self.config.tree_depth or recommended_depth_data(
+                self.state.positions, self.config.tree_leaf_cap
+            )
+            self._energy_tree_depth = depth
+        return depth
 
     def _unsharded_accel2(self):
         """(positions, masses) -> accelerations for the resolved backend."""
@@ -1457,6 +1679,23 @@ class Simulator:
         state = self.state
         acc = init_carry(self.accel_fn, state)
         self._e0 = None
+        # Numerics observatory (docs/observability.md "Numerics"):
+        # the conservation ledger's t0 baseline, the sentinel cadence
+        # counter, and the per-run aggregates the stats report.
+        ledger_on = self._ledger_on and self._ledger_fn is not None
+        sent_every = (
+            self._sentinel_every if self._sentinel_fn is not None
+            else 0
+        )
+        ledger0 = None
+        ledger_last = None
+        drift_last = None
+        max_energy_drift = None
+        ledger_blocks = 0
+        sent_stats = {"probes": 0, "max_rel_err": None, "last": None}
+        blocks_dispatched = 0
+        if ledger_on:
+            ledger0 = self._ledger_convert(self._ledger_fn(state))
         timer = StepTimer()
         timer.start()
         gap = HostGapTimer()
@@ -1519,10 +1758,30 @@ class Simulator:
                         if config.nan_check else None
                     )
                     snap = self._snapshot_fn(new_state)
+                    # Observatory companions ride the same async
+                    # dispatch as the finiteness verdict: their values
+                    # are fetched at consume time through the block's
+                    # own fence, so the ledger/sentinel can never
+                    # re-serialize the pipeline (the --metrics-energy
+                    # fix).
+                    led = (
+                        self._ledger_fn(new_state) if ledger_on
+                        else None
+                    )
+                    sent = (
+                        self._sentinel_fn(
+                            new_state.positions, new_state.masses
+                        )
+                        if sent_every
+                        and blocks_dispatched % sent_every == 0
+                        else None
+                    )
+                    blocks_dispatched += 1
                     state = new_state
                     step += n_steps
                     blk, pending = pending, (
-                        step - n_steps, n_steps, snap, finite, traj
+                        step - n_steps, n_steps, snap, finite, traj,
+                        led, sent,
                     )
                     if blk is None:
                         continue  # depth-1 pipeline priming: no block
@@ -1543,13 +1802,28 @@ class Simulator:
                     )
                     last_good = prev_state
                     step += n_steps
-                    blk = (step - n_steps, n_steps, state, None, traj)
+                    led = (
+                        self._ledger_fn(state) if ledger_on else None
+                    )
+                    sent = (
+                        self._sentinel_fn(
+                            state.positions, state.masses
+                        )
+                        if sent_every
+                        and blocks_dispatched % sent_every == 0
+                        else None
+                    )
+                    blocks_dispatched += 1
+                    blk = (
+                        step - n_steps, n_steps, state, None, traj,
+                        led, sent,
+                    )
             else:
                 # Dispatching is done; drain the final in-flight block.
                 blk, pending = pending, None
 
             # --- consume one finished block (k, while k+1 computes) ---
-            prev_step, blk_steps, bstate, finite, traj = blk
+            prev_step, blk_steps, bstate, finite, traj, led, sent = blk
             end_step = prev_step + blk_steps
             finite_ok = True
             if pipelined:
@@ -1633,6 +1907,51 @@ class Simulator:
             self.state, self._last_step = bstate, end_step
             if pipelined:
                 last_good = bstate
+            # Observatory consume: the companions dispatched with this
+            # block are finished (the fence above proves the block is),
+            # so these reads are cheap scalar fetches, not dispatches.
+            drift = None
+            if led is not None:
+                ledger_last = self._ledger_convert(led)
+                ledger_blocks += 1
+                drift = diagnostics.ledger_drift(
+                    ledger0, ledger_last,
+                    com_frame=config.periodic_box <= 0.0,
+                )
+                drift_last = drift
+                if drift["energy_drift"] is not None:
+                    max_energy_drift = max(
+                        max_energy_drift or 0.0, drift["energy_drift"]
+                    )
+            sent_summary = None
+            if sent is not None:
+                from .utils.profiling import sentinel_summary
+
+                sent_summary = sentinel_summary(np.asarray(sent))
+                if _faults.accuracy_breach_due(end_step):
+                    # Injected solver overload: the breach workflow
+                    # (event, dump, heal) runs through its real path.
+                    sent_summary = dict(
+                        sent_summary, p90_rel_err=1.0,
+                        max_rel_err=1.0, injected=True,
+                    )
+                sent_stats["probes"] += 1
+                sent_stats["last"] = sent_summary
+                sent_stats["max_rel_err"] = max(
+                    sent_stats["max_rel_err"] or 0.0,
+                    sent_summary["max_rel_err"],
+                )
+                if tracer is not None:
+                    # Provenance span: the probe itself ran inside the
+                    # async window, so only the measured values are
+                    # reportable, not a wall-clock extent.
+                    tracer.emit(
+                        "sentinel", trace_id, _time.time(), 0.0,
+                        step=end_step, backend=self.backend,
+                        median_rel_err=sent_summary["median_rel_err"],
+                        p90_rel_err=sent_summary["p90_rel_err"],
+                        max_rel_err=sent_summary["max_rel_err"],
+                    )
             # Injected preemption: a real SIGTERM to this process, so the
             # handler -> SimulationPreempted -> checkpoint path below is
             # what actually gets exercised.
@@ -1702,26 +2021,40 @@ class Simulator:
                     # integrator drift.
                     acc = init_carry(self.accel_fn, state)
                     self._e0 = None
+                    if ledger_on:
+                        # Re-baseline the ledger: a merger physically
+                        # dissipates kinetic energy (and exchanges
+                        # momentum with the removed tracer), which is
+                        # not integrator drift. The merge path already
+                        # synced, so this eager fetch is free.
+                        ledger0 = self._ledger_convert(
+                            self._ledger_fn(state)
+                        )
             if metrics_logger is not None:
                 from .utils.timing import pairs_per_step
 
                 extra = {}
-                if config.metrics_energy:
-                    # self.energy() includes the external field's
-                    # potential energy, keeping drift meaningful under
-                    # --external. (It reads self.state — the consumed
-                    # block's snapshot under the pipeline. Known limit:
-                    # dispatched at consume time it queues behind the
-                    # in-flight block and partially re-serializes the
-                    # pipeline — docs/scaling.md.)
-                    e = float(self.energy())
-                    if self._e0 is None:
-                        self._e0 = e
-                    extra["total_energy"] = e
-                    extra["energy_drift"] = (
-                        abs((e - self._e0) / self._e0)
-                        if self._e0 else None
-                    )
+                if drift is not None:
+                    # The in-program ledger's block record — the
+                    # total_energy/energy_drift keys keep the old
+                    # --metrics-energy stream schema; the momentum/
+                    # angular-momentum/COM drifts are the new series
+                    # (docs/observability.md "Numerics").
+                    if ledger_last["energy"] is not None:
+                        extra["total_energy"] = float(
+                            ledger_last["energy"]
+                        )
+                    extra["energy_drift"] = drift["energy_drift"]
+                    extra["momentum_drift"] = drift["momentum_drift"]
+                    extra["angmom_drift"] = drift["angmom_drift"]
+                    extra["com_drift"] = drift["com_drift"]
+                if sent_summary is not None:
+                    extra["force_err_median"] = sent_summary[
+                        "median_rel_err"
+                    ]
+                    extra["force_err_p90"] = sent_summary[
+                        "p90_rel_err"
+                    ]
                 # Only direct-sum backends report pairs_per_sec; fast
                 # solvers do asymptotically less work than the dense
                 # N*(N-1) count, so their rate carries the honest
@@ -1754,6 +2087,36 @@ class Simulator:
                     prev_step, end_step, config.checkpoint_every
                 ):
                     _save_cadence(end_step, bstate)
+            if (
+                sent_summary is not None
+                and config.error_budget > 0.0
+                and sent_summary["p90_rel_err"] > config.error_budget
+            ):
+                # Error-budget breach: raised AFTER this block's
+                # trajectory/checkpoint writes so a supervised heal
+                # continues a gap-free run from self._last_step. The
+                # state is finite — the supervisor reroutes/re-sizes
+                # rather than rolling back (docs/observability.md
+                # "Numerics").
+                if logger is not None:
+                    logger.log_print(
+                        f"ACCURACY BREACH at step {end_step}: "
+                        f"{self.backend} sentinel p90 rel err "
+                        f"{sent_summary['p90_rel_err']:.3e} > budget "
+                        f"{config.error_budget:.3e}"
+                    )
+                if telemetry is not None:
+                    telemetry.recorder.record(
+                        "event", event="accuracy_breach",
+                        step=end_step, backend=self.backend,
+                        p90_rel_err=sent_summary["p90_rel_err"],
+                        budget=config.error_budget,
+                    )
+                    telemetry.recorder.dump("accuracy_breach")
+                raise AccuracyBreach(
+                    end_step, self.backend,
+                    sent_summary["p90_rel_err"], config.error_budget,
+                )
           # Normal completion: drain the background writer INSIDE the
           # try so a failed trajectory/checkpoint write fails the run
           # instead of vanishing with the thread.
@@ -1843,6 +2206,31 @@ class Simulator:
         stats["autotune_probe_ms"] = self.autotune["probe_ms"]
         stats["host_gap_frac"] = gap.host_gap_frac
         self.last_host_gap_frac = gap.host_gap_frac
+        if ledger_on:
+            # The drift series' run-level summary (docs/observability
+            # .md "Numerics") — consumed by the BENCH JSON line and the
+            # cadence A/B alongside host_gap_frac.
+            stats["ledger"] = {
+                "blocks": ledger_blocks,
+                "max_energy_drift": max_energy_drift,
+                **(drift_last or {}),
+            }
+            if ledger_last is not None \
+                    and ledger_last["energy"] is not None:
+                stats["total_energy"] = float(ledger_last["energy"])
+        if sent_every:
+            stats["sentinel"] = {
+                "backend": self.backend,
+                "every": sent_every,
+                "k": int(self.config.sentinel_k),
+                "probes": sent_stats["probes"],
+                "max_rel_err": sent_stats["max_rel_err"],
+                **{
+                    k: sent_stats["last"][k]
+                    for k in ("median_rel_err", "p90_rel_err")
+                    if sent_stats["last"] is not None
+                },
+            }
         if trace_id is not None:
             stats["trace_id"] = trace_id
         if config.merge_radius > 0.0:

@@ -41,6 +41,7 @@ from typing import Optional
 
 from .config import SimulationConfig
 from .simulation import (
+    AccuracyBreach,
     SimulationDiverged,
     SimulationPreempted,
     Simulator,
@@ -161,6 +162,13 @@ class RunSupervisor:
         self._start_comp = start_comp
         self.diverge_retries = 0
         self.transient_retries = 0
+        self.accuracy_retries = 0
+        # Whether the leaf-cap re-size rung of the accuracy heal has
+        # been spent (docs/observability.md "Numerics"): the first
+        # breach of a tree-family run re-sizes the cap to the
+        # data-driven recommendation; a recurrence reroutes down the
+        # exact-physics ladder instead of re-sizing forever.
+        self._releafed = False
         self.degraded_from: Optional[str] = None
         # The Simulator of the successfully completed final leg (None
         # until the run returns) — cmd_run's --debug-check audits it.
@@ -227,6 +235,83 @@ class RunSupervisor:
                 return None  # nothing sane to degrade to
         return next_rung(backend, ladder)
 
+    def _accuracy_heal(self, e: AccuracyBreach, sim) -> None:
+        """Heal an error-budget breach (docs/observability.md
+        "Numerics"). The state is finite — nothing rolls back; the
+        SOLVER is wrong for the data. Two rungs, in order:
+
+        1. **Leaf-cap re-size** (tree/fmm/sfmm, once): the classic
+           overload is an under-capped dense core degrading to
+           monopole fallbacks (the PR-7 fmm-disk failure); re-size the
+           cap to ``ops/tree.recommended_leaf_cap`` measured on the
+           CURRENT state and rebuild.
+        2. **Exact-physics reroute**: replace the approximate solver
+           with the scale-appropriate EXACT direct-sum backend (the
+           supervisor's ladder floor — accuracy beats speed once the
+           budget is blown).
+
+        Raises the breach when the retry budget is spent or no rung
+        applies. Mutates ``self.config`` for every later leg."""
+        if self.accuracy_retries >= self.policy.max_retries:
+            raise e
+        self.accuracy_retries += 1
+        config = self.config
+        if (
+            e.backend in ("tree", "fmm", "sfmm")
+            and not self._releafed
+        ):
+            self._releafed = True
+            from .ops.tree import (
+                recommended_depth_data,
+                recommended_leaf_cap,
+            )
+
+            positions = (
+                sim.final_state().positions if sim is not None
+                else None
+            )
+            if positions is not None:
+                depth = config.tree_depth or recommended_depth_data(
+                    positions, config.tree_leaf_cap
+                )
+                new_cap = recommended_leaf_cap(positions, depth)
+                if new_cap > config.tree_leaf_cap:
+                    self._event(
+                        "retry", kind="accuracy", step=e.step,
+                        backend=e.backend,
+                        leaf_cap=new_cap,
+                        from_leaf_cap=config.tree_leaf_cap,
+                        attempt=self.accuracy_retries,
+                    )
+                    self.config = dataclasses.replace(
+                        config, tree_leaf_cap=new_cap
+                    )
+                    return
+        # Exact-physics reroute: the measured-wrong approximate solver
+        # is replaced outright (an exact backend that breaches — only
+        # possible via injection or a kernel defect — walks the same
+        # ladder as a build failure).
+        from .simulation import _resolve_direct
+
+        import jax as _jax
+
+        if e.backend in ("tree", "fmm", "sfmm", "pm", "p3m"):
+            nxt = _resolve_direct(
+                config, _jax.devices()[0].platform == "tpu"
+            )
+        else:
+            nxt = next_rung(e.backend, self.policy.backend_ladder)
+        if nxt is None or nxt == e.backend:
+            raise e
+        self._event(
+            "degraded", from_backend=e.backend, to_backend=nxt,
+            error=str(e),
+        )
+        self.degraded_from = self.degraded_from or e.backend
+        self.config = dataclasses.replace(
+            config, force_backend=nxt
+        )
+
     def _backoff(self, error: Exception, at_step) -> None:
         """Count, log, and sleep one transient retry (raises when the
         budget is exhausted)."""
@@ -248,11 +333,13 @@ class RunSupervisor:
         if (
             self.diverge_retries
             or self.transient_retries
+            or self.accuracy_retries
             or self.degraded_from
         ):
             stats["supervisor"] = {
                 "diverge_retries": self.diverge_retries,
                 "transient_retries": self.transient_retries,
+                "accuracy_retries": self.accuracy_retries,
                 "degraded_from": self.degraded_from,
                 "backend": self.config.force_backend,
             }
@@ -394,6 +481,24 @@ class RunSupervisor:
                 if halvings == 0 and sim is not None:
                     # Transient errors don't corrupt state: continue
                     # from the last finite in-memory block.
+                    state = sim.final_state()
+                    step = sim._last_step
+                continue
+            except AccuracyBreach as e:
+                # The sentinel's error-budget watchdog fired: the state
+                # is FINITE (the solver is inaccurate, not diverging),
+                # so continue from the last consumed block with a
+                # healed solver — leaf-cap re-size or exact-physics
+                # reroute (_accuracy_heal raises past the retry
+                # budget). The breach event itself (+ flight-recorder
+                # dump) was already recorded by the run's telemetry;
+                # this is the recovery-stream twin.
+                self._event(
+                    "accuracy_breach", step=e.step, backend=e.backend,
+                    p90_rel_err=e.p90_rel_err, budget=e.budget,
+                )
+                self._accuracy_heal(e, sim)
+                if halvings == 0 and sim is not None:
                     state = sim.final_state()
                     step = sim._last_step
                 continue

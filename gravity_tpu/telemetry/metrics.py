@@ -34,6 +34,15 @@ DEFAULT_TIME_BUCKETS = (
     1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0, 600.0,
 )
 
+# Relative-error buckets for the accuracy sentinel's per-backend force
+# error histogram (docs/observability.md "Numerics"): log-spaced from
+# fp32 round-off (~1e-7, where the exact direct sums live) up through
+# the fast solvers' accuracy classes (1e-3..1e-2) to outright overload
+# (>0.1 — the PR-7 fmm-disk regime the sentinel exists to catch).
+ERROR_BUCKETS = (
+    1e-7, 1e-6, 1e-5, 1e-4, 3e-4, 1e-3, 3e-3, 1e-2, 3e-2, 0.1, 0.3, 1.0,
+)
+
 # Every instrument the serving worker registers (docs/observability.md
 # must table each name — tests/test_telemetry.py lints that). Kept as
 # data so the docs-lint and the scheduler cannot drift.
@@ -64,7 +73,26 @@ WORKER_METRICS = (
      "SLO breach transitions (edge-triggered), by slo"),
     ("gravity_flightrec_dumps_total", "counter",
      "Flight-recorder dumps written by this worker"),
+    # The numerics observatory (docs/observability.md "Numerics").
+    ("gravity_force_error_rel", "histogram",
+     "Sampled relative force error vs the exact oracle, by backend "
+     "(accuracy sentinel probes)"),
+    ("gravity_sentinel_probes_total", "counter",
+     "Accuracy-sentinel probes run, by backend"),
+    ("gravity_accuracy_breaches_total", "counter",
+     "Error-budget breach transitions (edge-triggered), by backend"),
+    ("gravity_job_energy_drift", "gauge",
+     "Per-job |dE/E0| conservation-ledger drift, by job"),
+    ("gravity_job_momentum_drift", "gauge",
+     "Per-job |dP|/p_ref conservation-ledger drift, by job"),
 )
+
+# Per-family bucket overrides for declare_worker_metrics: histograms
+# default to the latency buckets, which are meaningless for relative
+# errors.
+WORKER_METRIC_BUCKETS = {
+    "gravity_force_error_rel": ERROR_BUCKETS,
+}
 
 _NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
 _LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
@@ -197,6 +225,18 @@ class MetricsRegistry:
                 fam["series"][key] = inst
             return inst
 
+    def remove_series(self, name: str, **labels) -> None:
+        """Drop one labeled series. Per-job label dimensions (the
+        drift gauges) call this at job finish so a long-lived daemon's
+        exposition, published snapshot, and registry memory stay
+        bounded — every other label set (backend/class) is finite by
+        construction."""
+        key = tuple(sorted((k, str(v)) for k, v in labels.items()))
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is not None:
+                fam["series"].pop(key, None)
+
     def counter(self, name: str, **labels) -> Counter:
         return self._instrument(name, "counter", labels)
 
@@ -247,6 +287,12 @@ class MetricsRegistry:
 GAUGE_MERGE = {
     "gravity_occupancy": "mean",
     "gravity_breaker_open": "max",
+    # Per-job drift gauges: a job is owned by one worker at a time,
+    # but an adoption can leave the dead worker's last published
+    # snapshot carrying the same series — max reports the worst
+    # observed drift instead of a nonsense sum.
+    "gravity_job_energy_drift": "max",
+    "gravity_job_momentum_drift": "max",
 }
 
 
@@ -502,5 +548,7 @@ def declare_worker_metrics(registry: MetricsRegistry) -> MetricsRegistry:
     """Register the serving worker's full instrument set (families
     only; label series materialize on first touch)."""
     for name, typ, help_ in WORKER_METRICS:
-        registry.declare(name, typ, help_)
+        registry.declare(
+            name, typ, help_, buckets=WORKER_METRIC_BUCKETS.get(name)
+        )
     return registry

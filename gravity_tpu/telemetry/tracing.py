@@ -38,7 +38,7 @@ from ..utils.logging import JsonlEventLogger
 SPAN_NAMES = (
     "admission", "autotune_probe", "queue", "slot_load", "compile",
     "round", "d2h", "result_write", "adopted",
-    "block", "checkpoint",
+    "block", "checkpoint", "sentinel",
 )
 
 

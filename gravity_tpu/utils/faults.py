@@ -51,6 +51,12 @@ failure modes of docs/robustness.md, each at its real code point):
     drop_result_write@K     silently drop a result ``.npz`` write
                             (crash-between-status-and-result window;
                             Spool.write_result)
+    accuracy_breach@R       force the accuracy sentinel's next probe at
+                            or after step/round R to report an
+                            over-any-budget error (the injected solver
+                            overload behind the breach-workflow e2e —
+                            scheduler sentinel step and the solo
+                            Simulator's probe consume it; fires once)
 
 Example: ``GRAVITY_TPU_FAULTS="transient@10x2,diverge@20"``.
 """
@@ -95,7 +101,7 @@ class _Fault:
 
 SERVING_KINDS = (
     "crash_worker", "stall_worker", "stale_lease",
-    "torn_spool_write", "drop_result_write",
+    "torn_spool_write", "drop_result_write", "accuracy_breach",
 )
 
 
@@ -313,4 +319,19 @@ def drop_result_due() -> bool:
     plan._result_writes += 1
     return plan._take(
         "drop_result_write", lambda f: seq >= f.step
+    ) is not None
+
+
+def accuracy_breach_due(at: int) -> bool:
+    """Should the sentinel probe at step/round ``at`` report an
+    injected over-budget error? (The deterministic solver-overload
+    stand-in: the caller replaces the measured probe errors with a
+    value above any sane budget, so the full breach workflow — event,
+    flight-recorder dump, breaker trip / supervisor heal — runs
+    through its real code path on CPU. Fires once.)"""
+    plan = active()
+    if plan is None:
+        return False
+    return plan._take(
+        "accuracy_breach", lambda f: at >= f.step
     ) is not None

@@ -189,7 +189,14 @@ class RecoveryEventLogger(JsonlEventLogger):
     Event-specific keys ride along (step, dt, backend, backoff_s, ...).
     """
 
-    KINDS = ("diverged", "rolled_back", "retry", "degraded", "preempted")
+    KINDS = (
+        "diverged", "rolled_back", "retry", "degraded", "preempted",
+        # Numerics observatory (docs/observability.md "Numerics"): the
+        # accuracy sentinel measured a force error past --error-budget;
+        # the supervisor heals by leaf-cap re-size or an exact-physics
+        # reroute (both audited via the existing retry/degraded kinds).
+        "accuracy_breach",
+    )
 
 
 class ServingEventLogger(JsonlEventLogger):
@@ -219,6 +226,13 @@ class ServingEventLogger(JsonlEventLogger):
     (docs/observability.md "SLO flags"): edge-triggered when the
     worker's p99 latency crosses ``--slo-p99-ms`` or round occupancy
     falls below ``--slo-occupancy``.
+
+    ``accuracy_breach`` is the numerics observatory's error-budget
+    signal (docs/observability.md "Numerics"): edge-triggered when an
+    accuracy-sentinel probe's p90 relative force error exceeds the
+    worker's ``--error-budget``; the breach dumps the flight recorder
+    and trips the backend's circuit breaker so admission reroutes down
+    the exact-physics ladder.
     """
 
     KINDS = (
@@ -227,5 +241,5 @@ class ServingEventLogger(JsonlEventLogger):
         "adopted", "fenced", "breaker_open", "breaker_closed",
         "shed", "poisoned",
         "encounter", "merger", "followup_submitted",
-        "slo_breach",
+        "slo_breach", "accuracy_breach",
     )

@@ -99,13 +99,15 @@ def debug_check_forces(
     cutoff: Optional[float] = None,
     eps: float = 0.0,
     rcut: float = 0.0,
+    box: float = 0.0,
     sample: int = 2048,
     seed: int = 0,
     kernel=None,
     full_acc=None,
 ) -> dict:
     """Cross-check a force kernel against the pure-jnp direct sum on (a
-    sample of) live state. Returns {max_rel_err, median_rel_err, n_checked}.
+    sample of) live state. Returns {max_rel_err, p90_rel_err,
+    median_rel_err, n_checked}.
 
     ``kernel``: a LocalKernel (targets, sources, masses) -> acc; defaults
     to the Pallas kernel. Passing the active backend's kernel (tree/p3m/
@@ -114,6 +116,11 @@ def debug_check_forces(
     ``rcut`` > 0 truncates the jnp reference at rcut — the oracle for
     the declared-truncated nlist family (auditing those against FULL
     gravity would report the physics difference, not a defect).
+    ``box`` > 0 additionally applies the minimum-image convention to
+    the oracle's pair separations, so the periodic nlist evaluator can
+    be audited across the wrap boundary (valid for rcut < box/2 — the
+    truncated family's own constraint; the full-gravity periodic
+    solver stays un-auditable by this oracle).
 
     ``full_acc``: precomputed (N, 3) accelerations for ALL particles —
     for backends with no targets-vs-sources form (fmm computes the full
@@ -150,14 +157,99 @@ def debug_check_forces(
         kernel = partial(pallas_accelerations_vs, interpret=interpret,
                          g=g, cutoff=cutoff, eps=eps)
     ref = accelerations_vs(targets, positions, masses, g=g, cutoff=cutoff,
-                           eps=eps, rcut=rcut)
+                           eps=eps, rcut=rcut, box=box)
     got = kernel(targets, positions, masses)
-    ref_np = np.asarray(ref)
-    got_np = np.asarray(got)
+    # float64 BEFORE the division: on an fp32 array the +1e-300 guard
+    # underflows to zero, and a zero-reference row (possible only with
+    # the rcut-masked oracle — an isolated particle has no neighbor)
+    # would divide 0/0 into NaN.
+    ref_np = np.asarray(ref, np.float64)
+    got_np = np.asarray(got, np.float64)
     denom = np.linalg.norm(ref_np, axis=1) + 1e-300
     rel = np.linalg.norm(got_np - ref_np, axis=1) / denom
     return {
         "max_rel_err": float(rel.max()),
+        "p90_rel_err": float(np.percentile(rel, 90)),
         "median_rel_err": float(np.median(rel)),
         "n_checked": int(targets.shape[0]),
+    }
+
+
+def sentinel_indices(n: int, k: int, seed: int = 0) -> np.ndarray:
+    """The K fixed target rows an in-program accuracy sentinel probes —
+    ONE derivation (sorted, deterministic per seed) shared by the solo
+    Simulator, the serve engine, and the tests, so a probe is
+    reproducible across restarts and its indices can be baked into the
+    jitted probe as a static constant."""
+    k = max(1, min(int(k), n))
+    if k >= n:
+        return np.arange(n)
+    return np.sort(
+        np.random.RandomState(seed).choice(n, k, replace=False)
+    )
+
+
+def make_force_error_probe(
+    kernel, *, idx, g: float, cutoff: float, eps: float = 0.0,
+    rcut: float = 0.0, box: float = 0.0,
+):
+    """Build the jittable half of the accuracy sentinel
+    (docs/observability.md "Numerics"): ``probe(positions, masses) ->
+    (K,) relative force errors`` of ``kernel`` (a LocalKernel
+    ``(targets, sources, masses) -> acc``) against the exact direct-sum
+    oracle on the K fixed sampled targets ``idx`` — the
+    :func:`debug_check_forces` oracle moved in-program, so the run
+    loop can dispatch it asynchronously as a block companion instead
+    of a host round-trip. rcut/box select the truncated / minimum-
+    image oracle for the nlist family.
+
+    ``kernel=None`` probes a FULL-SET accel function instead: pass
+    ``full_accel(positions, masses) -> (N, 3)`` via the ``kernel``
+    slot wrapped by :func:`full_set_probe_kernel` (backends like fmm
+    have no targets-vs-sources form)."""
+    import jax.numpy as jnp
+
+    from ..ops.forces import accelerations_vs
+
+    idx_const = np.asarray(idx, np.int32)
+
+    def probe(positions, masses):
+        targets = positions[idx_const]
+        ref = accelerations_vs(
+            targets, positions, masses, g=g, cutoff=cutoff, eps=eps,
+            rcut=rcut, box=box,
+        )
+        got = kernel(targets, positions, masses)
+        denom = jnp.linalg.norm(ref, axis=1) + jnp.asarray(
+            1e-30, ref.dtype
+        )
+        return jnp.linalg.norm(got - ref, axis=1) / denom
+
+    return probe
+
+
+def full_set_probe_kernel(full_accel, idx):
+    """Adapt a full-set accel fn ``(positions, masses) -> (N, 3)`` to
+    the sentinel's LocalKernel slot: the backend evaluates its whole
+    set and the probe compares the K sampled rows (the fmm/sfmm/pm
+    path — one extra force evaluation per probe, amortized by the
+    sentinel cadence)."""
+    idx_const = np.asarray(idx, np.int32)
+
+    def kernel(targets, positions, masses):
+        del targets
+        return full_accel(positions, masses)[idx_const]
+
+    return kernel
+
+
+def sentinel_summary(rel_errors) -> dict:
+    """Host summary of one probe's (K,) relative errors — the fields
+    the metrics stream, run stats, and the breach check consume."""
+    rel = np.asarray(rel_errors, np.float64)
+    return {
+        "median_rel_err": float(np.median(rel)),
+        "p90_rel_err": float(np.percentile(rel, 90)),
+        "max_rel_err": float(rel.max()),
+        "n_checked": int(rel.shape[0]),
     }

@@ -142,8 +142,16 @@ start_worker "$SPOOL2" chaos-d ""
 D_PID=${PIDS[-1]}
 wait_for_daemon "$SPOOL2" chaos-d
 # stale_lease@1x60: at round 1 worker C backdates its leases and stops
-# heartbeating for 60s — alive, integrating, but adoptable.
-start_worker "$SPOOL2" chaos-c "stale_lease@1x60"
+# heartbeating for 60s — alive, integrating, but adoptable. The
+# bounded stall_worker@3x3 pins the race DETERMINISTICALLY: C pauses 3s
+# mid-flight at round 3, guaranteeing worker D's reaper (interval
+# ttl/4 = 1.25s) adopts while C still has rounds left — without it,
+# a fast box can let C finish all its rounds inside the ~1.25s
+# adoption lag, leaving no late writes to fence (measured flaky in
+# BOTH directions: the pre-fix tree also produced a DUPLICATE
+# completed event when a fenced admission write absorbed the
+# adopter's fence — the scheduler now hard-stops unowned writes).
+start_worker "$SPOOL2" chaos-c "stale_lease@1x60,stall_worker@3x3"
 C_PID=${PIDS[-1]}
 wait_for_daemon "$SPOOL2" chaos-c
 

@@ -11,6 +11,10 @@
 # e2e latency — docs/observability.md), and the nlist cell-list
 # near-field stage (p3m nlist-vs-gather <= 1e-5 + standalone
 # truncated-physics parity — docs/scaling.md "Cell-list near field"),
+# and the numerics-observatory stage (strict-parsed drift gauges +
+# force-error histogram off the live daemon, then an injected-overload
+# --error-budget breach: one accuracy_breach event + flightrec dump +
+# breaker trip — docs/observability.md "Numerics"),
 # all on CPU. Exits nonzero on any failure. ~10 min on a laptop-class
 # CPU.
 set -euo pipefail
@@ -18,7 +22,7 @@ cd "$(dirname "$0")/.."
 
 export JAX_PLATFORMS=cpu
 
-echo "== smoke 1/8: pytest -m 'fast and not slow and not heavy' (contract + oracle-parity lane) =="
+echo "== smoke 1/9: pytest -m 'fast and not slow and not heavy' (contract + oracle-parity lane) =="
 # "fast and not slow and not heavy": module-level fast marks would
 # otherwise pull a file's slow-marked wall-clock tests into the lane
 # (pytest -m fast selects anything CARRYING the mark; it does not
@@ -27,7 +31,7 @@ echo "== smoke 1/8: pytest -m 'fast and not slow and not heavy' (contract + orac
 # item 5).
 python -m pytest tests/ -q -m "fast and not slow and not heavy" -p no:cacheprovider
 
-echo "== smoke 2/8: 2-job ensemble serving e2e (CLI daemon) =="
+echo "== smoke 2/9: 2-job ensemble serving e2e (CLI daemon) =="
 SPOOL="$(mktemp -d /tmp/gravity_smoke.XXXXXX)"
 cleanup() {
     # Best-effort daemon shutdown + spool removal.
@@ -80,7 +84,7 @@ print("ensemble e2e OK:", {j: s["status"] for j, s in statuses.items()},
       "| compiles:", metrics["compile_counts"])
 EOF
 
-echo "== smoke 3/8: async host pipeline e2e (cadence run + SIGTERM + resume) =="
+echo "== smoke 3/9: async host pipeline e2e (cadence run + SIGTERM + resume) =="
 IODIR="$(mktemp -d /tmp/gravity_smoke_io.XXXXXX)"
 trap 'cleanup; rm -rf "$IODIR"' EXIT
 # Cadence-on pipelined run; preempt@500 delivers a real SIGTERM to the
@@ -116,7 +120,7 @@ print("io-pipeline e2e OK: resumed", stats["steps"], "steps,",
       "host_gap_frac", round(stats["host_gap_frac"], 3))
 EOF
 
-echo "== smoke 4/8: autotune cache round-trip (probe-on-miss, instant-on-hit) =="
+echo "== smoke 4/9: autotune cache round-trip (probe-on-miss, instant-on-hit) =="
 TUNEDIR="$(mktemp -d /tmp/gravity_smoke_tune.XXXXXX)"
 trap 'cleanup; rm -rf "$IODIR" "$TUNEDIR"' EXIT
 # Fresh cache dir + lowered fast-probe floor so plain `auto` runs a
@@ -153,10 +157,10 @@ print("autotune round-trip OK: backend", s1["backend"],
       "| probe", round(s1["autotune_probe_ms"], 1), "ms -> hit 0 ms")
 EOF
 
-echo "== smoke 5/8: serving chaos harness (kill -9 + adoption + fencing) =="
+echo "== smoke 5/9: serving chaos harness (kill -9 + adoption + fencing) =="
 bash scripts/chaos.sh
 
-echo "== smoke 6/8: job classes through the CLI daemon (fit + sweep) =="
+echo "== smoke 6/9: job classes through the CLI daemon (fit + sweep) =="
 # One fit + one sweep submitted through the REAL daemon from stage 2
 # (still serving), asserting completion + served-vs-solo parity
 # (docs/serving.md "Job classes").
@@ -266,7 +270,7 @@ z = np.load(sys.argv[1])
 assert 'min_sep' in z.files and len(z['min_sep']) == 4, z.files
 " "$SPOOL/sweep_verdicts.npz"
 
-echo "== smoke 7/8: unified telemetry (Prometheus scrape + Perfetto trace export) =="
+echo "== smoke 7/9: unified telemetry (Prometheus scrape + Perfetto trace export) =="
 # Against the STILL-LIVE stage-2 daemon: (a) a text/plain /metrics
 # scrape must be valid Prometheus exposition (validated by the strict
 # parser the tests use) including per-class latency histograms and
@@ -311,7 +315,7 @@ assert summary["coverage"] is not None and summary["coverage"] >= 0.9, \
 print("perfetto export OK:", summary)
 PYEOF
 
-echo "== smoke 8/8: nlist cell-list near field (p3m parity + standalone truncated parity) =="
+echo "== smoke 8/9: nlist cell-list near field (p3m parity + standalone truncated parity) =="
 # (a) The P3M near pass through the cell-list tile engine must match
 # the chunked gather near pass <= 1e-5 scaled on CPU (the ISSUE-9
 # acceptance bound); (b) the standalone nlist backend must match the
@@ -352,5 +356,122 @@ assert dev2 <= 1e-5, f"nlist-vs-masked-direct scaled max {dev2}"
 print("nlist near-field OK: p3m dev", float(dev),
       "| standalone dev", float(dev2))
 PYEOF
+
+echo "== smoke 9/9: numerics observatory (drift gauges + error histogram scrape, injected accuracy breach) =="
+# (a) Strict-parse the LIVE stage-2 daemon's Prometheus text and
+# assert the numerics families are present with real series: the
+# per-backend force-error histogram (sentinel probes ran — default
+# cadence) and the per-job conservation-ledger drift gauges. The
+# drift gauges are LIVE-job series (dropped at finish so the only
+# per-job label dimension stays bounded over the daemon's lifetime):
+# submit a long job and catch it in flight, then assert the series
+# is gone once it completes.
+python - "$SPOOL" <<'PYEOF'
+import sys, time, urllib.request
+from gravity_tpu.serve import request, wait_for
+from gravity_tpu.serve.service import find_daemon
+from gravity_tpu.telemetry import parse_prometheus_text
+
+spool = sys.argv[1]
+host, port = find_daemon(spool)
+
+
+def scrape():
+    req = urllib.request.Request(f"http://{host}:{port}/metrics",
+                                 headers={"Accept": "text/plain"})
+    text = urllib.request.urlopen(req, timeout=30).read().decode()
+    return parse_prometheus_text(text)  # strict: raises on bad text
+
+
+r = request(spool, "POST", "/submit", {"config": {
+    "model": "random", "n": 12, "steps": 2000, "dt": 3600.0,
+    "integrator": "leapfrog", "force_backend": "dense",
+}})
+jid = r["job"]
+drift = {}
+for _ in range(300):  # ~100 rounds of in-flight window
+    parsed = scrape()
+    drift = {
+        dict(labels).get("job"): v
+        for (_name, labels), v in parsed["gravity_job_energy_drift"]
+        ["samples"].items()
+    }
+    if jid in drift:
+        break
+    time.sleep(0.1)
+assert jid in drift, "no in-flight drift gauge for the live job"
+assert all(0.0 <= v < 1e-2 for v in drift.values()), drift
+hist = parsed["gravity_force_error_rel"]["samples"]
+count = sum(v for (name, _labels), v in hist.items()
+            if name == "gravity_force_error_rel_count")
+assert count > 0, "no sentinel probe samples in the live scrape"
+probes = parsed["gravity_sentinel_probes_total"]["samples"]
+assert probes and all(v >= 1 for v in probes.values()), probes
+wait_for(spool, [jid], timeout=300)
+gone = {
+    dict(labels).get("job")
+    for (_name, labels) in scrape()["gravity_job_energy_drift"]
+    ["samples"]
+}
+assert jid not in gone, "finished job's drift series not dropped"
+print("numerics scrape OK:", int(count), "error samples, in-flight "
+      "drift gauge present, dropped at finish")
+PYEOF
+
+# (b) Injected-overload breach e2e on a FRESH daemon armed with an
+# error budget: fault spec accuracy_breach@2 forces one over-budget
+# probe -> exactly one accuracy_breach event, a flight-recorder dump
+# with that reason, and the backend's breaker tripped open at the
+# moment of breach (admission reroute armed).
+NUMDIR="$(mktemp -d /tmp/gravity_smoke_num.XXXXXX)"
+trap 'cleanup; rm -rf "$IODIR" "$TUNEDIR" "$NUMDIR"' EXIT
+GRAVITY_TPU_FAULTS="accuracy_breach@2" \
+python -m gravity_tpu serve --spool-dir "$NUMDIR" --slots 2 \
+    --slice-steps 10 --sentinel-every 1 --error-budget 1e-3 \
+    >"$NUMDIR/serve.stdout" 2>&1 &
+NUM_PID=$!
+for _ in $(seq 1 100); do
+    [ -f "$NUMDIR/daemon.json" ] && break
+    sleep 0.2
+done
+[ -f "$NUMDIR/daemon.json" ] || {
+    echo "numerics daemon never came up"; cat "$NUMDIR/serve.stdout";
+    exit 1;
+}
+python - "$NUMDIR" <<'PYEOF'
+import json, os, sys
+from gravity_tpu.serve import request, wait_for
+
+spool = sys.argv[1]
+r = request(spool, "POST", "/submit", {"config": {
+    "model": "random", "n": 12, "steps": 120, "dt": 3600.0,
+    "integrator": "leapfrog", "force_backend": "dense",
+}})
+wait_for(spool, [r["job"]], timeout=180)
+events = [json.loads(l) for l in
+          open(f"{spool}/serving_events.jsonl") if l.strip()]
+breaches = [e for e in events if e["event"] == "accuracy_breach"]
+assert len(breaches) == 1, breaches
+assert breaches[0]["injected"] is True, breaches
+assert breaches[0]["p90_rel_err"] > 1e-3, breaches
+dumps = [f for f in os.listdir(spool) if f.startswith("flightrec_")]
+reasons = {json.load(open(os.path.join(spool, f)))["reason"]
+           for f in dumps}
+assert "accuracy_breach" in reasons, reasons
+# The breach tripped the breaker (breaker_open in the same stream).
+assert any(e["event"] == "breaker_open"
+           and "accuracy breach" in str(e.get("error", ""))
+           for e in events), events
+print("breach e2e OK: 1 accuracy_breach event, dump reasons", reasons)
+PYEOF
+python - "$NUMDIR" <<'EOF' 2>/dev/null || true
+import json, sys, urllib.request
+info = json.load(open(f"{sys.argv[1]}/daemon.json"))
+req = urllib.request.Request(
+    f"http://{info['host']}:{info['port']}/shutdown", data=b"{}",
+    method="POST")
+urllib.request.urlopen(req, timeout=5).read()
+EOF
+kill "$NUM_PID" 2>/dev/null || true
 
 echo "== smoke: all green =="

@@ -55,10 +55,11 @@ def _cfg(n, **kw):
     return SimulationConfig(n=n, **kw)
 
 
-def _fake_probe(timings, unavailable=(), broken=()):
-    """A _time_backend stub with canned per-backend seconds that still
-    honors the probe-step counter contract (the serve test asserts on
-    it)."""
+def _fake_probe(timings, unavailable=(), broken=(), errors=None):
+    """A _time_backend stub with canned per-backend (seconds, error)
+    results that still honors the probe-step counter contract (the
+    serve test asserts on it). ``errors`` maps backend -> p90 rel err
+    (default 0 — exact)."""
 
     def fake(config, backend, state, probe_steps):
         if backend in unavailable:
@@ -66,7 +67,11 @@ def _fake_probe(timings, unavailable=(), broken=()):
         if backend in broken:
             raise ValueError(f"{backend} sizing check failed")
         at._counters["probe_steps"] += probe_steps
-        return timings[backend]
+        p90 = (errors or {}).get(backend, 0.0)
+        return timings[backend], {
+            "median_rel_err": p90, "p90_rel_err": p90,
+            "max_rel_err": p90,
+        }
 
     return fake
 

@@ -1,0 +1,718 @@
+"""Numerics observatory (docs/observability.md "Numerics"): the
+in-program conservation ledger (solo + per-slot serve twin), the
+accuracy sentinel, error-budget SLOs (breach -> flightrec dump ->
+supervisor heal / breaker reroute), the autotune probe-error field and
+speed-within-budget routing, and the previously-untested
+debug_check_forces combinations (vmapped serve path, rcut-masked
+periodic oracle).
+"""
+
+import dataclasses
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gravity_tpu.config import SimulationConfig
+from gravity_tpu.ops import diagnostics
+from gravity_tpu.simulation import (
+    AccuracyBreach,
+    Simulator,
+    make_initial_state,
+)
+
+
+def _cfg(n, steps=20, **kw):
+    kw.setdefault("model", "random")
+    kw.setdefault("dt", 3600.0)
+    kw.setdefault("integrator", "leapfrog")
+    kw.setdefault("force_backend", "dense")
+    return SimulationConfig(n=n, steps=steps, **kw)
+
+
+# Overloaded fmm on the clustered disk: leaf cap far below
+# ops/tree.recommended_leaf_cap (256 at this depth), so the dense core
+# degrades to monopole fallbacks — measured sentinel p90 rel err ~0.66
+# against the <=2% accuracy class. The acceptance configuration.
+def _overloaded_fmm_cfg(**kw):
+    kw.setdefault("error_budget", 0.02)
+    kw.setdefault("steps", 10)
+    return SimulationConfig(
+        model="disk", n=256, dt=2.0e-3, g=1.0, eps=0.05,
+        integrator="leapfrog", force_backend="fmm", fmm_mode="dense",
+        tree_depth=3, tree_leaf_cap=4, progress_every=5,
+        sentinel_k=64, **kw,
+    )
+
+
+# --- ledger unit contracts ---
+
+
+@pytest.mark.fast
+def test_ledger_matches_host_diagnostics():
+    """ledger_vec + ledger_host reproduce the existing host-side
+    diagnostics (energy/momentum/L/COM) at astronomical scales —
+    the fp32-safe normalized-mass contract holds end to end."""
+    st = make_initial_state(_cfg(64, seed=3))
+    vec = diagnostics.ledger_vec(
+        st.positions, st.velocities, st.masses
+    )
+    pe = diagnostics.pe_hat_dense(st.positions, st.masses)
+    led = diagnostics.ledger_host(vec, pe=pe, pe_kind="dense")
+    e_ref = float(diagnostics.total_energy(st))
+    p_ref = np.asarray(diagnostics.total_momentum(st), np.float64)
+    l_ref = np.asarray(diagnostics.total_angular_momentum(st))
+    com_ref = np.asarray(diagnostics.center_of_mass(st), np.float64)
+    assert led["energy"] == pytest.approx(e_ref, rel=1e-5)
+    np.testing.assert_allclose(led["momentum"], p_ref, rtol=1e-4)
+    np.testing.assert_allclose(led["ang_mom"], l_ref, rtol=1e-4)
+    np.testing.assert_allclose(led["com"], com_ref, rtol=1e-5)
+    # Self-drift is ~0 on every axis.
+    drift = diagnostics.ledger_drift(led, led)
+    assert drift["energy_drift"] == 0.0
+    assert drift["momentum_drift"] == 0.0
+    assert drift["angmom_drift"] == 0.0
+    assert drift["com_drift"] == 0.0
+
+
+@pytest.mark.fast
+def test_ledger_zero_mass_padding_inert():
+    """The vmapped serve twin's contract: zero-mass padding rows change
+    no ledger component (every term is mass-weighted)."""
+    st = make_initial_state(_cfg(32, seed=5))
+    padded, _ = st.pad_to(64)
+    for a, b in zip(
+        diagnostics.ledger_vec(
+            st.positions, st.velocities, st.masses
+        ),
+        diagnostics.ledger_vec(
+            padded.positions, padded.velocities, padded.masses
+        ),
+    ):
+        assert float(a) == pytest.approx(float(b), rel=1e-6)
+    pe_a = diagnostics.pe_hat_dense(st.positions, st.masses)
+    pe_b = diagnostics.pe_hat_dense(padded.positions, padded.masses)
+    assert float(pe_a) == pytest.approx(float(pe_b), rel=1e-6)
+
+
+@pytest.mark.fast
+def test_truncated_ledger_energy_conserved():
+    """The rcut-shifted pair potential is the one whose gradient IS the
+    masked force, so a truncated-physics run conserves the ledger's
+    energy (the unshifted sum would jump as pairs cross rcut)."""
+    rcut = 2.0e11
+    cfg = _cfg(
+        48, steps=60, force_backend="dense", nlist_rcut=rcut,
+        eps=1e9, ledger=True, progress_every=15, seed=2,
+    )
+    stats = Simulator(cfg).run()
+    assert stats["ledger"]["max_energy_drift"] is not None
+    assert stats["ledger"]["max_energy_drift"] < 5e-3
+
+
+@pytest.mark.fast
+def test_ledger_cold_start_momentum_scale():
+    """Cold-start ICs (zero velocities, KE0 = 0) fall back to the
+    virial momentum scale sqrt(2 |PE0| m_sum) for p_ref — fp32
+    round-off in the first blocks must not read as ~1e290 drift
+    through the 1e-300 tiny guard."""
+    from gravity_tpu.state import ParticleState
+
+    st = make_initial_state(_cfg(64, seed=7))
+    cold = ParticleState(
+        st.positions, jnp.zeros_like(st.velocities), st.masses
+    )
+
+    def led(s):
+        vec = diagnostics.ledger_vec(
+            s.positions, s.velocities, s.masses
+        )
+        pe = diagnostics.pe_hat_dense(s.positions, s.masses)
+        return diagnostics.ledger_host(vec, pe=pe, pe_kind="dense")
+
+    l0 = led(cold)
+    assert l0["kinetic"] == 0.0
+    # Round-off-sized velocity noise (~1e-7 of the virial speed).
+    v_vir = float(
+        np.sqrt(2.0 * abs(l0["potential"]) / l0["m_sum"])
+    )
+    noisy = ParticleState(
+        cold.positions,
+        jnp.full_like(cold.velocities, 1e-7 * v_vir),
+        cold.masses,
+    )
+    drift = diagnostics.ledger_drift(l0, led(noisy))
+    assert drift["momentum_drift"] < 1e-3
+    assert drift["angmom_drift"] < 1.0
+
+
+@pytest.mark.fast
+def test_ledger_includes_external_potential():
+    """--external runs conserve KE + PE_self + PE_ext: the ledger's
+    energy must match Simulator.energy() (which the replaced
+    --metrics-energy sample used) including the field term."""
+    # g=1 disk units: the fp32 consume-time reference overflows at the
+    # random model's astronomical scales (the overflow the ledger's
+    # normalized-mass form exists to avoid), so parity is checked
+    # where the reference itself is finite.
+    cfg = SimulationConfig(
+        model="disk", n=32, g=1.0, dt=2.0e-3, eps=0.05, steps=40,
+        integrator="leapfrog", force_backend="dense", seed=9,
+        ledger=True, external="plummer:gm=50.0,a=2.0",
+        progress_every=10,
+    )
+    sim = Simulator(cfg)
+    stats = sim.run()
+    e_ref = float(sim.energy())
+    fs = sim.final_state()
+    ext_e = float(
+        jnp.sum(fs.masses * sim._ext_phi(fs.positions))
+    )
+    # Guard: the field term is material at this configuration —
+    # otherwise the parity below wouldn't detect its omission.
+    assert abs(ext_e) > 1e-3 * abs(e_ref)
+    assert stats["total_energy"] == pytest.approx(e_ref, rel=1e-3)
+
+
+# --- the solo run ledger ---
+
+
+def test_ledger_bitwise_parity_and_alias(tmp_path):
+    """Satellite: ledger-on / ledger-off (and the deprecated
+    --metrics-energy alias) produce BITWISE identical trajectories and
+    final states — the companion only reads. Pins the scaling.md
+    known-issue removal."""
+    from gravity_tpu.utils.trajectory import TrajectoryWriter
+
+    def run(tag, **kw):
+        cfg = _cfg(
+            32, steps=40, seed=7, progress_every=10,
+            trajectory_every=1, io_pipeline="on", **kw,
+        )
+        w = TrajectoryWriter(str(tmp_path / tag), 32, every=1)
+        sim = Simulator(cfg)
+        stats = sim.run(trajectory_writer=w)
+        frames = []
+        import glob
+
+        for f in sorted(glob.glob(str(tmp_path / tag / "*.npy"))):
+            frames.append(np.load(f))
+        return stats, np.concatenate(frames, axis=0)
+
+    s_off, t_off = run("off")
+    with pytest.deprecated_call():
+        s_alias, t_alias = run("alias", metrics_energy=True)
+    s_on, t_on = run("on", ledger=True)
+    assert np.array_equal(t_off, t_on)
+    assert np.array_equal(t_off, t_alias)
+    np.testing.assert_array_equal(
+        np.asarray(s_off["final_state"].positions),
+        np.asarray(s_on["final_state"].positions),
+    )
+    # The alias really maps onto the ledger (drift series present).
+    assert "ledger" in s_alias and "ledger" in s_on
+    assert s_alias["ledger"]["energy_drift"] == pytest.approx(
+        s_on["ledger"]["energy_drift"]
+    )
+    assert "ledger" not in s_off
+
+
+def test_ledger_drift_small_for_symplectic_run(tmp_path):
+    """Leapfrog conserves: drift on every ledger axis stays tiny, and
+    the metrics JSONL carries the full per-block series."""
+    from gravity_tpu.utils.profiling import MetricsLogger
+
+    ml = MetricsLogger(str(tmp_path / "m.jsonl"))
+    cfg = _cfg(
+        48, steps=40, eps=1e9, ledger=True, progress_every=10, seed=1
+    )
+    stats = Simulator(cfg).run(metrics_logger=ml)
+    led = stats["ledger"]
+    assert led["blocks"] == 4
+    assert led["max_energy_drift"] < 1e-4
+    assert led["momentum_drift"] < 1e-6
+    assert led["angmom_drift"] < 1e-5
+    recs = ml.read()
+    assert len(recs) == 4
+    for r in recs:
+        for k in ("total_energy", "energy_drift", "momentum_drift",
+                  "angmom_drift", "com_drift"):
+            assert k in r, (k, r)
+
+
+@pytest.mark.slow
+def test_ledger_large_n_uses_scaled_tree_pe():
+    """Above LEDGER_DENSE_MAX the energy term rides the jitted tree
+    (CPU) scaled potential — still async-dispatchable, still a sane
+    drift."""
+    cfg = _cfg(
+        20_000, steps=4, model="plummer", eps=1e9,
+        force_backend="chunked", ledger=True, progress_every=2,
+    )
+    stats = Simulator(cfg).run()
+    assert stats["ledger"]["energy_drift"] is not None
+    assert stats["ledger"]["energy_drift"] < 1e-2
+
+
+# --- the accuracy sentinel ---
+
+
+def test_sentinel_exact_backend_near_zero(tmp_path):
+    """A direct-sum backend audits against its own oracle: the probe's
+    error is fp-roundoff, the stats carry the probe summary, and the
+    span stream (with telemetry) carries the sentinel span."""
+    from gravity_tpu.telemetry import Telemetry, load_spans
+
+    tele = Telemetry(out_dir=str(tmp_path), worker="sent-w")
+    cfg = _cfg(
+        48, steps=20, eps=1e9, sentinel_every=1, sentinel_k=16,
+        progress_every=10,
+    )
+    stats = Simulator(cfg).run(telemetry=tele)
+    sent = stats["sentinel"]
+    assert sent["probes"] == 2
+    assert sent["max_rel_err"] < 1e-4
+    names = [
+        s["name"]
+        for s in load_spans(str(tmp_path / "traces.jsonl"))
+        if s["trace"] == stats["trace_id"]
+    ]
+    assert names.count("sentinel") == 2
+
+
+def test_sentinel_flags_overloaded_fmm():
+    """The acceptance overload: an fmm run with the leaf cap far below
+    recommended_leaf_cap measures a large sentinel error on the disk
+    (no budget -> observe-only; the stats expose the smoking gun the
+    PR-7 regression never had)."""
+    cfg = _overloaded_fmm_cfg(error_budget=0.0, sentinel_every=1,
+                              steps=10)
+    stats = Simulator(cfg).run()
+    assert stats["sentinel"]["p90_rel_err"] > 0.1
+
+
+def test_error_budget_breach_unsupervised(tmp_path):
+    """Budget + overload, no supervisor: AccuracyBreach raises after
+    the probed block's writes, and the armed telemetry bundle records
+    the event + dumps the flight recorder (reason accuracy_breach)."""
+    from gravity_tpu.telemetry import Telemetry
+
+    tele = Telemetry(out_dir=str(tmp_path), worker="breach-w")
+    cfg = _overloaded_fmm_cfg(steps=10)
+    with pytest.raises(AccuracyBreach) as ei:
+        Simulator(cfg).run(telemetry=tele)
+    assert ei.value.backend == "fmm"
+    assert ei.value.p90_rel_err > cfg.error_budget
+    dumps = [
+        f for f in os.listdir(tmp_path) if f.startswith("flightrec_")
+    ]
+    assert dumps
+    doc = json.load(open(tmp_path / sorted(dumps)[-1]))
+    assert doc["reason"] == "accuracy_breach"
+    kinds = [
+        e.get("event") for e in doc["entries"]
+        if e.get("kind") == "event"
+    ]
+    assert kinds.count("accuracy_breach") == 1
+
+
+def test_injected_breach_via_fault_spec(faults):
+    """accuracy_breach@STEP forces an over-budget probe on an exact
+    backend — the deterministic breach path every platform can run."""
+    faults("accuracy_breach@10")
+    cfg = _cfg(
+        24, steps=40, eps=1e9, error_budget=1e-3, sentinel_every=1,
+        progress_every=10,
+    )
+    with pytest.raises(AccuracyBreach) as ei:
+        Simulator(cfg).run()
+    assert ei.value.p90_rel_err == 1.0
+
+
+def test_supervisor_heals_breach_by_releaf(tmp_path):
+    """The acceptance e2e: overloaded fmm + budget under supervision
+    breaches, the supervisor re-sizes the leaf cap to the data-driven
+    recommendation, and the run COMPLETES with the healing audited in
+    the recovery events."""
+    from gravity_tpu.supervisor import RunSupervisor
+    from gravity_tpu.telemetry import Telemetry
+    from gravity_tpu.utils.logging import RecoveryEventLogger
+
+    tele = Telemetry(out_dir=str(tmp_path), worker="heal-w")
+    events = RecoveryEventLogger(str(tmp_path / "recovery.jsonl"))
+    cfg = _overloaded_fmm_cfg(
+        steps=20, auto_recover=True,
+        checkpoint_dir=str(tmp_path / "ckpt"),
+    )
+    sup = RunSupervisor(cfg, events=events, telemetry=tele)
+    stats = sup.run()
+    assert stats["steps"] > 0
+    assert stats["supervisor"]["accuracy_retries"] >= 1
+    kinds = [e["event"] for e in events.read()]
+    assert "accuracy_breach" in kinds
+    retries = [
+        e for e in events.read()
+        if e["event"] == "retry" and e.get("kind") == "accuracy"
+    ]
+    assert retries and retries[0]["leaf_cap"] > cfg.tree_leaf_cap
+    # The healed config is the data-driven cap; the run finished on it.
+    assert sup.config.tree_leaf_cap == retries[0]["leaf_cap"]
+    # The breach dumped the recorder.
+    assert any(
+        f.startswith("flightrec_") for f in os.listdir(tmp_path)
+    )
+
+
+def test_supervisor_heals_breach_by_exact_reroute(tmp_path):
+    """The second heal rung: with the releaf rung already spent, the
+    supervisor reroutes the breaching approximate solver to the EXACT
+    direct backend and the run completes there."""
+    from gravity_tpu.supervisor import RunSupervisor
+    from gravity_tpu.utils.logging import RecoveryEventLogger
+
+    events = RecoveryEventLogger(str(tmp_path / "recovery.jsonl"))
+    cfg = _overloaded_fmm_cfg(
+        steps=20, auto_recover=True,
+        checkpoint_dir=str(tmp_path / "ckpt"),
+    )
+    sup = RunSupervisor(cfg, events=events)
+    sup._releafed = True  # rung 1 spent: force the reroute rung
+    stats = sup.run()
+    assert stats["supervisor"]["degraded_from"] == "fmm"
+    assert sup.config.force_backend in ("dense", "chunked", "cpp")
+    degr = [e for e in events.read() if e["event"] == "degraded"]
+    assert degr and degr[0]["from_backend"] == "fmm"
+
+
+# --- serve: per-slot ledger + sentinel + breach ---
+
+
+def test_serve_drift_gauges_and_error_histogram(tmp_path):
+    """Per-job drift gauges + the per-backend force-error histogram
+    land in the registry and render as STRICT-parseable Prometheus
+    text (the live-scrape acceptance shape, in-process)."""
+    from gravity_tpu.serve import EnsembleScheduler
+    from gravity_tpu.telemetry import (
+        Telemetry,
+        parse_prometheus_text,
+        prometheus_text,
+    )
+
+    tele = Telemetry(out_dir=str(tmp_path), worker="obs-w")
+    sched = EnsembleScheduler(
+        slots=2, slice_steps=10, telemetry=tele, sentinel_every=1,
+    )
+    jid = sched.submit(_cfg(12, steps=40, seed=4))
+    # One round: the job is still RESIDENT — its drift gauges are live.
+    sched.run_round()
+    parsed = parse_prometheus_text(
+        prometheus_text(sched.metrics_snapshot()["registry"])
+    )
+    hist = parsed["gravity_force_error_rel"]["samples"]
+    count = hist[(
+        "gravity_force_error_rel_count", (("backend", "dense"),)
+    )]
+    assert count >= 16  # >= one probe's K samples
+    drift_gauge = parsed["gravity_job_energy_drift"]["samples"]
+    assert any(
+        dict(labels).get("job") == jid
+        for (_name, labels) in drift_gauge
+    )
+    assert parsed["gravity_sentinel_probes_total"]["samples"]
+    sched.run_until_idle()
+    job = sched.jobs[jid]
+    assert job.status == "completed"
+    assert job.drift is not None
+    assert job.drift["energy_drift"] < 1e-3
+    assert sched.status(jid)["drift"]["energy_drift"] is not None
+    # Finish drops the per-job series (the registry's only per-job
+    # label dimension stays bounded); the value lives on in job.drift.
+    parsed = parse_prometheus_text(
+        prometheus_text(sched.metrics_snapshot()["registry"])
+    )
+    assert not any(
+        dict(labels).get("job") == jid
+        for (_name, labels)
+        in parsed["gravity_job_energy_drift"]["samples"]
+    )
+
+
+def test_serve_breach_trips_breaker_and_dumps(tmp_path, faults):
+    """The serving breach workflow: an injected overload raises
+    exactly ONE edge-triggered accuracy_breach event, dumps the flight
+    recorder, trips the backend's breaker (admission reroute armed),
+    and compute success alone cannot close it while the burn holds."""
+    from gravity_tpu.serve import EnsembleScheduler
+    from gravity_tpu.telemetry import Telemetry
+    from gravity_tpu.utils.logging import ServingEventLogger
+
+    tele = Telemetry(out_dir=str(tmp_path), worker="sbr-w")
+    ev = ServingEventLogger(str(tmp_path / "serving.jsonl"))
+    faults("accuracy_breach@2")
+    sched = EnsembleScheduler(
+        slots=2, slice_steps=10, telemetry=tele, events=ev,
+        sentinel_every=2, error_budget=1e-3,
+    )
+    jid = sched.submit(_cfg(12, steps=200, seed=6))
+    # Drive rounds one at a time so we can observe the tripped breaker
+    # BEFORE a later clean probe clears the burn.
+    tripped = False
+    for _ in range(4):
+        sched.run_round()
+        if sched.breakers.get("dense").state == "open":
+            tripped = True
+            # Burn holds: a successful round must NOT close it.
+            sched.run_round()
+            assert sched.breakers.get("dense").state == "open"
+            break
+    assert tripped
+    sched.run_until_idle()
+    assert sched.jobs[jid].status == "completed"
+    breaches = [
+        e for e in ev.read() if e["event"] == "accuracy_breach"
+    ]
+    assert len(breaches) == 1
+    assert breaches[0]["injected"] is True
+    dumps = [
+        json.load(open(tmp_path / f))
+        for f in os.listdir(tmp_path) if f.startswith("flightrec_")
+    ]
+    assert "accuracy_breach" in {d["reason"] for d in dumps}
+    # The next CLEAN probe cleared the burn and the breaker closed on
+    # the following success.
+    assert not sched._accuracy_burn.get("dense")
+    assert sched.breakers.get("dense").state == "closed"
+
+
+def test_serve_fit_class_opts_out_of_ledger():
+    """fit lanes carry the optimizer's guess, not a trajectory —
+    conserves=False keeps drift gauges honest."""
+    from gravity_tpu.serve.jobs import get_class
+
+    assert get_class("fit").conserves is False
+    for name in ("integrate", "sweep-member", "watch"):
+        assert getattr(get_class(name), "conserves", True) is True
+
+
+@pytest.mark.fast
+def test_serve_ledger_drops_energy_above_dense_bound():
+    """Above LEDGER_DENSE_MAX an untruncated key's vmapped ledger
+    drops the O(N^2) dense energy term (slots * N^2 per round would
+    dwarf a fast solver's force work); the O(N) momentum/angmom/COM
+    terms stay, and the truncated family keeps its shifted sum (the
+    only honest energy it has)."""
+    from gravity_tpu.serve.engine import BatchKey, EnsembleEngine
+
+    eng = EnsembleEngine()
+    small = BatchKey(
+        1024, 2, "dense", "float32", "leapfrog", 6.674e-11, 1e9, 0.0
+    )
+    big = small._replace(
+        bucket_n=diagnostics.LEDGER_DENSE_MAX * 2, backend="fmm"
+    )
+    big_rcut = big._replace(extra=(("nlist_rcut", 1e11),))
+    assert eng._ledger_pe_kind(small) == "dense"
+    assert eng._ledger_pe_kind(big) == "none"
+    assert eng._ledger_pe_kind(big_rcut) == "dense"
+    st = make_initial_state(_cfg(48, seed=11))
+    led = eng.state_ledger(st, big)
+    assert led["energy"] is None
+    assert led["potential"] is None
+    assert float(np.linalg.norm(led["momentum"])) >= 0.0
+    drift = diagnostics.ledger_drift(led, led)
+    assert drift["energy_drift"] is None
+    assert drift["momentum_drift"] == 0.0
+
+
+# --- debug_check_forces: previously-untested combinations ---
+
+
+def test_debug_check_on_vmapped_serve_batch():
+    """Satellite: the oracle audits a slots-batched engine lane —
+    zero-mass padding is inert as targets AND sources, so the padded
+    lane checks clean against the unpadded oracle."""
+    from gravity_tpu.serve.engine import EnsembleEngine, batch_key_for
+    from gravity_tpu.utils.profiling import debug_check_forces
+
+    cfg = _cfg(20, steps=10, seed=8)
+    engine = EnsembleEngine()
+    key = batch_key_for(cfg, slots=2)
+    batch = engine.new_batch(key)
+    st = make_initial_state(cfg)
+    batch = engine.load_slot(batch, 0, st, dt=cfg.dt, steps=10)
+    batch, res = engine.run_slice(batch, 10)
+    assert bool(res.finite[0])
+    # Audit the evolved padded lane with the key's own kernel: the
+    # oracle sums over ALL padded rows (zero-mass -> inert).
+    check = debug_check_forces(
+        np.asarray(batch.positions[0]),
+        np.asarray(batch.masses[0]),
+        g=key.g, cutoff=key.cutoff, eps=key.eps,
+        kernel=engine._kernel(key),
+    )
+    assert check["max_rel_err"] < 1e-5
+    assert check["n_checked"] == key.bucket_n
+    # And the per-slot probe entry point agrees.
+    rel = engine.probe_slot_accuracy(batch, 0, k=16)
+    assert rel is not None and float(np.max(rel)) < 1e-5
+
+
+def test_debug_check_rcut_oracle_at_periodic_boundary():
+    """Satellite: the rcut-masked minimum-image oracle audits the
+    periodic nlist evaluator across the wrap boundary — and the
+    isolated (box=0) oracle provably DISAGREES there, proving the
+    boundary pairs are what the box argument fixes."""
+    from gravity_tpu.ops.pallas_nlist import nlist_accelerations_vs
+    from gravity_tpu.utils.profiling import debug_check_forces
+    from functools import partial
+
+    box = 1.0e12
+    rcut = 1.2e11
+    rng = np.random.RandomState(0)
+    n = 96
+    pos = rng.uniform(0.0, box, size=(n, 3)).astype(np.float32)
+    # Guaranteed boundary-straddling pair within rcut (min-image).
+    pos[0] = (0.02e12, 0.5e12, 0.5e12)
+    pos[1] = (0.97e12, 0.5e12, 0.5e12)
+    masses = rng.uniform(1e25, 1e26, size=(n,)).astype(np.float32)
+    kernel = partial(
+        nlist_accelerations_vs, rcut=rcut, side=8, cap=64,
+        g=6.674e-11, eps=1e9, box=box,
+    )
+    periodic = debug_check_forces(
+        pos, masses, eps=1e9, rcut=rcut, box=box, kernel=kernel,
+    )
+    assert periodic["max_rel_err"] < 1e-4, periodic
+    isolated = debug_check_forces(
+        pos, masses, eps=1e9, rcut=rcut, kernel=kernel,
+    )
+    assert isolated["max_rel_err"] > 1e-2, (
+        "isolated oracle should disagree at the boundary", isolated
+    )
+
+
+# --- autotune: measured errors + speed-within-budget ---
+
+
+def test_autotune_verdict_carries_errors_and_budget_routes(
+    tmp_path, monkeypatch
+):
+    """Probe verdicts persist per-candidate measured force errors, and
+    a declared budget excludes over-budget candidates from the contest
+    (the overloaded tree loses to the exact direct sum regardless of
+    speed). The budget joins the cache key: budgeted and unbudgeted
+    runs never share a verdict."""
+    import gravity_tpu.autotune as at
+
+    monkeypatch.setenv("GRAVITY_TPU_TUNE_DIR", str(tmp_path / "c"))
+    cfg = SimulationConfig(
+        model="disk", n=512, g=1.0, dt=2e-3, eps=0.05,
+        integrator="leapfrog", force_backend="auto",
+        tree_depth=3, tree_leaf_cap=4, error_budget=1e-4,
+    )
+    state = make_initial_state(cfg)
+    d = at.resolve_backend_measured(
+        cfg, state, candidates=("tree", "dense"), occupancy="t",
+    )
+    assert d.cache == "miss"
+    assert d.errors is not None
+    assert d.errors["tree"]["p90_rel_err"] > 1e-2  # overloaded
+    assert d.errors["dense"]["p90_rel_err"] < 1e-5  # exact
+    assert d.backend == "dense"
+    assert "over error budget" in d.skipped.get("tree", "")
+    # Key sensitivity: the same config WITHOUT a budget is a different
+    # key (no stale cross-hit), and pre-budget keys keep their hash.
+    k_budget = at.key_hash(at.make_key(
+        cfg, candidates=("tree", "dense"), platform="cpu",
+        device_kind="cpu", occupancy="t",
+    ))
+    cfg0 = dataclasses.replace(cfg, error_budget=0.0)
+    k_plain = at.key_hash(at.make_key(
+        cfg0, candidates=("tree", "dense"), platform="cpu",
+        device_kind="cpu", occupancy="t",
+    ))
+    assert k_budget != k_plain
+    # Cache hit round-trips the errors field.
+    d2 = at.resolve_backend_measured(
+        cfg, state, candidates=("tree", "dense"), occupancy="t",
+    )
+    assert d2.cache == "hit"
+    assert d2.errors["tree"]["p90_rel_err"] == pytest.approx(
+        d.errors["tree"]["p90_rel_err"]
+    )
+
+
+# --- bench report folds the nlist artifacts ---
+
+
+@pytest.mark.fast
+def test_bench_report_folds_nlist_and_tuning_artifacts(tmp_path):
+    """Satellite: the trend report folds NLIST_SWEEP_CPU.json /
+    NLIST_TUNE_CPU.json / committed tuning/ verdicts instead of
+    silently dropping them (it predated the nlist family)."""
+    from gravity_tpu.bench import collect_bench_rounds, format_bench_report
+
+    (tmp_path / "NLIST_SWEEP_CPU.json").write_text(
+        json.dumps({
+            "mode": "scaling", "n": 4096, "rcut": 2.5,
+            "platform": "cpu", "side": 6, "cap": 32,
+            "s_per_eval": 0.112,
+            "dense_equiv_pairs_per_sec": 1.5e8,
+            "speedup_vs_chunked": 3.4,
+        }) + "\n"
+    )
+    (tmp_path / "NLIST_TUNE_CPU.json").write_text(
+        json.dumps({
+            "n": 8192, "backend": "nlist", "cache": "miss",
+            "probe_ms": 8038.0,
+            "timings_s": {"chunked": 0.809, "nlist": 0.146},
+        }) + "\n"
+    )
+    tdir = tmp_path / "tuning"
+    tdir.mkdir()
+    (tdir / "abc.json").write_text(json.dumps({
+        "key": {"n": 8192, "platform": "cpu", "occupancy": "occ2^0",
+                "candidates": ["chunked", "nlist"]},
+        "winner": "nlist",
+        "timings_s": {"chunked": 0.809, "nlist": 0.146},
+        "errors": {"nlist": {"p90_rel_err": 2e-6},
+                   "chunked": {"p90_rel_err": 0.0}},
+    }))
+    data = collect_bench_rounds(str(tmp_path))
+    assert data["nlist_sweep"][0]["speedup_vs_chunked"] == 3.4
+    assert data["nlist_tune"][0]["winner"] == "nlist"
+    v = data["tuning_verdicts"][0]
+    assert v["winner"] == "nlist" and v["runner_up"] == "chunked"
+    assert v["winner_p90_err"] == 2e-6
+    text = format_bench_report(data)
+    assert "nlist scaling ladder" in text
+    assert "nlist tune ladder" in text
+    assert "committed tuning verdicts" in text
+    # The REAL repo artifacts parse too (regression against format
+    # drift in the committed files).
+    repo_root = os.path.join(os.path.dirname(__file__), "..")
+    real = collect_bench_rounds(repo_root)
+    assert len(real["nlist_sweep"]) >= 4
+    assert len(real["tuning_verdicts"]) >= 4
+    format_bench_report(real)
+
+
+# --- faults grammar ---
+
+
+@pytest.mark.fast
+def test_accuracy_breach_fault_grammar():
+    from gravity_tpu.utils import faults as fmod
+
+    plan = fmod.install("accuracy_breach@3")
+    try:
+        assert not fmod.accuracy_breach_due(2)
+        assert fmod.accuracy_breach_due(3)
+        assert not fmod.accuracy_breach_due(4)  # fires once
+    finally:
+        fmod.reset()
+    with pytest.raises(ValueError):
+        fmod.FaultPlan.parse("accuracy_breach")  # needs @STEP

@@ -134,6 +134,55 @@ def test_zombie_writes_fenced_exactly_one_completed_event(tmp_path):
     b.close_io()
 
 
+def test_fence_absorption_cannot_rearm_zombie_writes(tmp_path):
+    """A fenced MID-FLIGHT write (the post-adoption admission-persist
+    shape) absorbs the adopter's record — fence included — as local
+    truth. The absorbed token must not re-arm the zombie's later
+    writes: the unowned job never writes again, emits no terminal
+    event, and its resident lane is dropped at the next round.
+    Regression: the zombie's finish write used to PASS fencing with
+    the absorbed token, emitting a duplicate completed event over the
+    owner's record (chaos scenario 2's exactly-one-completed
+    invariant; reproduced on the pre-fix tree whenever an admission
+    landed after adoption)."""
+    spool_dir = str(tmp_path / "spool")
+    ev_path = str(tmp_path / "events.jsonl")
+    config = _cfg(8, steps=40, seed=9)
+    a = _sched(spool_dir, ServingEventLogger(
+        ev_path, context={"worker": "a"}), "a", lease_ttl_s=300.0)
+    jid = a.submit(config, job_id="absorb-job")
+    a.run_round()  # admitted + one slice; 3 rounds of work left
+    a.leases.suspend(600.0)
+    a.leases.backdate()
+
+    b = _sched(spool_dir, ServingEventLogger(
+        ev_path, context={"worker": "b"}), "b", lease_ttl_s=300.0)
+    b.housekeeping()
+    b.run_until_idle()
+    assert b.status(jid)["status"] == "completed"
+    owner_fence = b.jobs[jid].fence
+
+    # The zombie's mid-flight persist is fenced and absorbs the
+    # owner's record — including the HIGHER fence.
+    assert a._persist(a.jobs[jid]) is False
+    assert not a.jobs[jid].owned
+    assert a.jobs[jid].fence == owner_fence
+    # Driving the zombie on: the unowned resident is dropped, nothing
+    # further is written, no terminal event comes from it.
+    for _ in range(6):
+        a.run_round()
+    a.drain_io()
+    assert a.active_count == 0  # the adopted-away lane was released
+    completed = _events_of(ev_path, "completed")
+    assert len(completed) == 1 and completed[0]["worker"] == "b"
+    rec = json.load(open(os.path.join(spool_dir, "jobs",
+                                      f"{jid}.json")))
+    assert rec["status"] == "completed"
+    assert rec["fence"] == owner_fence
+    a.close_io()
+    b.close_io()
+
+
 @pytest.mark.fast
 def test_completed_without_result_is_rerun_not_trusted(tmp_path, faults):
     """drop_result_write: the record says completed but the .npz never
