@@ -12,9 +12,12 @@ PYTEST := env JAX_PLATFORMS=cpu python -m pytest
 smoke:
 	bash scripts/smoke.sh
 
-# Serving-layer chaos harness: 2 workers on one spool under injected
-# kill -9 / stale-lease faults — adoption, fencing, solo parity
-# (docs/robustness.md "Fleet failure modes"). Also smoke stage 5.
+# Serving-layer chaos harness: workers on one spool under injected
+# kill -9 / stale-lease faults — adoption, fencing, solo parity, and
+# the sharded adoption-resume scenario (docs/robustness.md "Fleet
+# failure modes" + "Sharded & long-job failure modes"). Scenarios run
+# in per-scenario subshells; ANY failure exits nonzero. Also smoke
+# stages 5 (scenarios 1-2) and 10 (scenario 3).
 chaos:
 	bash scripts/chaos.sh
 

@@ -1785,6 +1785,7 @@ def cmd_serve(args: argparse.Namespace) -> int:
         sentinel_every=args.serve_sentinel_every,
         sentinel_k=args.serve_sentinel_k,
         ledger_every=args.ledger_every,
+        progress_every=args.serve_progress_every,
     )
     host, port = daemon.start()
     print(json.dumps({
@@ -2228,6 +2229,16 @@ def main(argv=None) -> int:
     p_serve.add_argument("--sentinel-k", dest="serve_sentinel_k",
                          type=int, default=64,
                          help="sampled sentinel targets per probe")
+    p_serve.add_argument("--progress-every",
+                         dest="serve_progress_every", type=int,
+                         default=1,
+                         help="scheduling rounds between durable "
+                              "mid-run progress snapshots per running "
+                              "job (fenced, checksummed; adoption "
+                              "resumes from the last verified one "
+                              "instead of step 0 — docs/robustness.md "
+                              "'Sharded & long-job failure modes'); "
+                              "0 disables (default 1)")
     p_serve.add_argument("--ledger-every", dest="ledger_every",
                          type=int, default=1,
                          help="per-slot conservation-ledger cadence in "
@@ -2248,8 +2259,10 @@ def main(argv=None) -> int:
                                "differentiable rollout) | sweep "
                                "(perturbed-IC stability survey) | "
                                "watch (close-encounter events + "
-                               "auto follow-up); docs/serving.md "
-                               "'Job classes'")
+                               "auto follow-up) | sharded-integrate "
+                               "(one big-n job across the device "
+                               "mesh as an exclusive resident); "
+                               "docs/serving.md 'Job classes'")
     p_submit.add_argument("--params", default=None,
                           help="job-class payload as inline JSON or "
                                "@file (e.g. '{\"members\": 64}' for "

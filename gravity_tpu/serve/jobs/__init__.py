@@ -11,6 +11,10 @@ Importing this package registers the built-in traffic classes:
 - ``watch`` — event-driven runs: in-program close-encounter / merger
   detection raising events through the serving stream, with optional
   auto-submitted high-resolution follow-up jobs
+- ``sharded-integrate`` — one big-n job across the device mesh as an
+  exclusive single-slot resident (allgather/ring shard_map forms),
+  degrading down the elastic ladder (fewer devices -> solo -> dense)
+  on mesh loss and resuming from durable progress snapshots
 """
 
 from .fit import FitJob, fit_solo  # noqa: F401
@@ -22,6 +26,7 @@ from .registry import (  # noqa: F401
     get_class,
     job_types,
 )
+from .sharded import ShardedIntegrateJob  # noqa: F401
 from .sweep import (  # noqa: F401
     SweepJob,
     SweepMemberJob,

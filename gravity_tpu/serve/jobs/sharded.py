@@ -1,0 +1,465 @@
+"""The ``sharded-integrate`` job class — one big-n job across the
+device mesh, under the SAME lease/adoption/breaker contracts as every
+other traffic class (ROADMAP item 1's scale half).
+
+The vmap ensemble engine stops at ``MAX_BUCKET`` by design: its batched
+direct sum materializes (slots, n, n) pair intermediates. Above that a
+job should not share a bucket with anyone — it should BE the bucket.
+This class keys every job into an exclusive single-slot batch whose
+program shards the particle axis over a named device mesh
+(parallel/sharded.py: ``allgather`` = the MPI backend's
+compute-my-slice-against-everyone loop reborn as ``lax.all_gather`` +
+local kernel; ``ring`` = the systolic ``ppermute`` ring), so a single
+10M-body job occupies the whole engine slice for its rounds while
+still flowing through the ordinary admission queue, TTL leases,
+fencing, adoption, requeue caps, and round accounting.
+
+Failure handling walks the ELASTIC degrade ladder
+(supervisor.next_rung on ``sharded/<devices>/<local>`` backend names,
+docs/robustness.md "Sharded & long-job failure modes"):
+
+    sharded/D/local -> sharded/D//2/local -> ... -> local (solo)
+                    -> exact-physics ladder -> dense floor
+
+A mesh that cannot build (fewer devices than the form wants, an
+injected ``mesh_fail``) raises ``BackendUnavailable`` at slot load; a
+stalled collective (``collective_stall@RxS``) raises it from the round
+— both strike the form's per-backend circuit breaker, so requeues and
+new submissions re-key onto a rung that runs, each attempt counted
+against ``max_requeues``. Combined with the scheduler's durable
+mid-run progress snapshots, a re-sharded or adopted job resumes from
+its last verified snapshot instead of step 0 — for an hours-long
+sharded run, adoption is recovery, not a do-over.
+
+Snapshot note: the slot snapshot gathers the sharded state to host
+(``np.asarray`` over the addressable shards) before it rides the
+background HostWriter into the spool — in a single-process mesh that
+is the full state; a true multi-host deployment would gather per-host
+shards (the lease/fencing protocol is already multi-host-safe).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Optional
+
+import numpy as np
+
+from ...utils.faults import (
+    BackendUnavailable,
+    collective_stall_secs,
+    mesh_fail_due,
+)
+from ...state import ParticleState
+from .registry import (
+    JobClass,
+    JobValidationError,
+    params_state,
+    register,
+    validate_params_state,
+)
+
+# Local kernels the sharded form can run per shard (each must speak the
+# rectangular (targets, sources, m_sources) signature the mesh
+# strategies feed). 'auto'/'direct' resolve at keying time.
+SHARDED_LOCAL_BACKENDS = ("dense", "chunked", "pallas", "pallas-mxu")
+
+STRATEGIES = ("allgather", "ring")
+
+# Where 'auto' flips the solo/local kernel from the one-shot dense
+# contraction to the chunked form (above it the (n, n) intermediate of
+# a single dense evaluation is the memory risk, exactly the engine's
+# MAX_BUCKET reasoning applied per shard).
+AUTO_DENSE_MAX = 8192
+
+
+def sharded_backend_name(devices: int, local: str) -> str:
+    return f"sharded/{devices}/{local}" if devices > 1 else local
+
+
+def parse_backend(backend: str) -> tuple[int, str]:
+    """(devices, local_kernel) of any sharded-class backend string —
+    bare local names are the solo form (devices=1)."""
+    from ...supervisor import parse_sharded_backend
+
+    devices, local = parse_sharded_backend(backend)
+    if devices is None:
+        return 1, backend
+    return devices, local
+
+
+@dataclasses.dataclass
+class ShardedBatch:
+    """The exclusive single-'slot' batch: ONE system, particle axis
+    sharded over the mesh (or local, for the solo rungs). remaining /
+    n_real keep the engine's (slots,)-array shape so the scheduler's
+    accounting indexes them exactly like any other batch."""
+
+    key: object
+    positions: object  # (bucket, 3) — sharded over the mesh
+    velocities: object
+    masses: object
+    acc: object
+    dt: np.ndarray  # (1,)
+    remaining: np.ndarray  # (1,) int64
+    n_real: np.ndarray  # (1,) int32
+    slices_run: int = 0
+
+
+class ShardedIntegrateJob(JobClass):
+    name = "sharded-integrate"
+    units = "steps"
+    # The per-slot vmapped ledger/sentinel machinery assumes a
+    # (slots, n, ...) batch; the sharded batch is a single sharded
+    # system. Conservation for these runs is the solo ledger's job —
+    # opt out of the engine twin rather than half-support it.
+    conserves = False
+
+    # --- admission ---
+
+    def validate(self, config, params):
+        params = dict(params or {})
+        unknown = set(params) - {"devices", "strategy", "state"}
+        if unknown:
+            raise JobValidationError(
+                f"sharded-integrate params {sorted(unknown)} unknown "
+                "(takes devices, strategy, and an optional inline "
+                "'state')"
+            )
+        devices = params.get("devices")
+        if devices is not None:
+            try:
+                devices = int(devices)
+            except (TypeError, ValueError):
+                raise JobValidationError(
+                    "sharded-integrate: devices must be an integer "
+                    "(omit it to use every local device)"
+                ) from None
+            if not 1 <= devices <= 65536:
+                raise JobValidationError(
+                    f"sharded-integrate: devices={devices} out of "
+                    "range [1, 65536]"
+                )
+            params["devices"] = devices
+        strategy = params.get("strategy", "allgather")
+        if strategy not in STRATEGIES:
+            raise JobValidationError(
+                f"sharded-integrate: strategy {strategy!r} is not one "
+                f"of {STRATEGIES}"
+            )
+        params["strategy"] = strategy
+        if config.force_backend not in ("auto", "direct") \
+                and config.force_backend not in SHARDED_LOCAL_BACKENDS:
+            raise JobValidationError(
+                f"sharded-integrate: force_backend "
+                f"{config.force_backend!r} has no per-shard local "
+                f"kernel (one of auto/direct/"
+                f"{'/'.join(SHARDED_LOCAL_BACKENDS)})"
+            )
+        validate_params_state(config, params)
+        return params
+
+    def batch_key(self, config, params, *, slots: int, min_bucket: int,
+                  reroute=None):
+        """The exclusive key: slots is ALWAYS 1 (the job is the batch),
+        the backend string carries the elastic form
+        (``sharded/<devices>/<local>``), and the bucket pads n up to a
+        multiple of the form's device count so the particle axis
+        shards evenly. Unlike the vmap classes there is NO bucket cap
+        — a 10M-body job is exactly what this class exists for."""
+        import jax
+
+        from ...models import MODELS
+        from .. import engine as _engine
+
+        if config.model not in MODELS:
+            raise JobValidationError(
+                f"unknown model {config.model!r}; one of "
+                f"{sorted(MODELS)}"
+            )
+        if config.integrator not in (
+            "euler", "leapfrog", "verlet", "yoshida4"
+        ):
+            raise JobValidationError(
+                f"integrator {config.integrator!r} is not servable "
+                "(fixed-dt euler/leapfrog/verlet/yoshida4)"
+            )
+        for knob, val, default in (
+            ("adaptive", config.adaptive, False),
+            ("merge_radius", config.merge_radius, 0.0),
+            ("periodic_box", config.periodic_box, 0.0),
+            ("external", config.external, ""),
+            ("sharding", config.sharding, "none"),
+            ("nlist_rcut", config.nlist_rcut, 0.0),
+        ):
+            if val != default:
+                raise JobValidationError(
+                    f"config.{knob}={val!r} is not servable by "
+                    "sharded-integrate; run it solo via `run`"
+                )
+        local = config.force_backend
+        if local in ("auto", "direct"):
+            local = "dense" if config.n <= AUTO_DENSE_MAX else "chunked"
+        devices = params.get("devices") or len(jax.devices())
+        backend = sharded_backend_name(max(1, int(devices)), local)
+        if reroute is not None:
+            rerouted = reroute(backend)
+            d, loc = parse_backend(rerouted)
+            if d == 1 and loc not in SHARDED_LOCAL_BACKENDS:
+                raise JobValidationError(
+                    f"reroute {backend!r} -> {rerouted!r} left the "
+                    "sharded-integrate ladder"
+                )
+            backend = rerouted
+        d, _loc = parse_backend(backend)
+        bucket = -(-config.n // d) * d  # ceil to a multiple of d
+        return _engine.BatchKey(
+            bucket_n=bucket,
+            slots=1,
+            backend=backend,
+            dtype=config.dtype,
+            integrator=config.integrator,
+            g=config.g,
+            eps=config.eps,
+            cutoff=config.cutoff,
+            job_type=self.name,
+            extra=(("strategy", params.get("strategy", "allgather")),),
+        )
+
+    def initial_state(self, job):
+        from ...simulation import make_initial_state
+
+        return params_state(job.params) or make_initial_state(job.config)
+
+    # --- engine-side program family ---
+
+    def _mesh_for(self, engine, key):
+        """The key's device mesh (None for solo forms), cached per key.
+        Failure here — too few devices, an injected ``mesh_fail`` — is
+        the mesh-loss event the elastic ladder degrades on: a typed
+        ``BackendUnavailable`` the admission path counts on the form's
+        breaker and requeues through the reroute."""
+        import jax
+        from jax.sharding import Mesh
+
+        devices, _local = parse_backend(key.backend)
+        if devices <= 1:
+            return None
+        meshes = getattr(engine, "_sharded_meshes", None)
+        if meshes is None:
+            meshes = engine._sharded_meshes = {}
+        if key in meshes:
+            return meshes[key]
+        if mesh_fail_due():
+            raise BackendUnavailable(
+                key.backend, "mesh build failed (injected mesh_fail)"
+            )
+        avail = jax.devices()
+        if len(avail) < devices:
+            raise BackendUnavailable(
+                key.backend,
+                f"mesh wants {devices} devices, {len(avail)} visible",
+            )
+        mesh = Mesh(np.asarray(avail[:devices]), ("shard",))
+        meshes[key] = mesh
+        return mesh
+
+    def _local_kernel(self, engine, key):
+        """The per-shard rectangular kernel, cached in the engine's
+        kernel table under this key (engine._kernel would try to build
+        the composite backend NAME; the sharded key's kernel is the
+        LOCAL half only)."""
+        if key not in engine._kernels:
+            from ...config import SimulationConfig
+            from ...simulation import make_local_kernel
+
+            _devices, local = parse_backend(key.backend)
+            config = SimulationConfig(
+                n=key.bucket_n, force_backend=local, dtype=key.dtype,
+                g=key.g, eps=key.eps, cutoff=key.cutoff,
+            )
+            engine._kernels[key] = make_local_kernel(config, local)
+        return engine._kernels[key]
+
+    def _accel_fn(self, engine, key):
+        """(positions, masses) -> accelerations for this key's form:
+        the shard_map'd mesh program, or the bare local kernel solo."""
+        kernel = self._local_kernel(engine, key)
+        mesh = self._mesh_for(engine, key)
+        if mesh is None:
+            return lambda pos, m: kernel(pos, pos, m)
+        from ...parallel.sharded import make_sharded_accel2
+
+        strategy = dict(key.extra).get("strategy", "allgather")
+        return make_sharded_accel2(
+            mesh, strategy=strategy, local_kernel=kernel,
+            g=key.g, cutoff=key.cutoff, eps=key.eps,
+        )
+
+    def build_round_fn(self, engine, key):
+        import jax
+        import jax.numpy as jnp
+
+        from ...ops.integrators import make_step_fn
+
+        accel = self._accel_fn(engine, key)
+
+        def round_fn(pos, vel, mass, acc, dt, remaining, n_real, *,
+                     n_steps):
+            engine._mark_compile(key)
+            state = ParticleState(pos, vel, mass)
+            step = make_step_fn(
+                key.integrator, lambda p: accel(p, mass), dt
+            )
+
+            def body(carry, i):
+                st, a = carry
+                new_st, new_a = step(st, a)
+                take = i < remaining
+                st = jax.tree_util.tree_map(
+                    lambda old, new: jnp.where(take, new, old),
+                    st, new_st,
+                )
+                a = jnp.where(take, new_a, a)
+                return (st, a), None
+
+            (out, acc_out), _ = jax.lax.scan(
+                body, (state, acc), jnp.arange(n_steps)
+            )
+            real = jnp.arange(pos.shape[0]) < n_real
+            fin = jnp.all(jnp.where(
+                real[:, None], jnp.isfinite(out.positions), True
+            )) & jnp.all(jnp.where(
+                real[:, None], jnp.isfinite(out.velocities), True
+            ))
+            # In-program rollback, the engine's donation contract: a
+            # non-finite run returns its round-start carry.
+            keep = lambda new, old: jnp.where(fin, new, old)  # noqa: E731
+            return (
+                keep(out.positions, pos), keep(out.velocities, vel),
+                keep(acc_out, acc), fin,
+            )
+
+        return jax.jit(
+            round_fn, static_argnames=("n_steps",),
+            donate_argnums=(0, 1, 3),
+        )
+
+    def new_batch(self, engine, key):
+        """All-empty exclusive batch. The mesh is NOT built here: batch
+        creation runs outside the admission try, and a mesh that cannot
+        build must surface as the slot-load BackendUnavailable the
+        breaker/requeue machinery consumes (load_slot builds it)."""
+        import jax.numpy as jnp
+
+        from ...simulation import resolve_dtype
+
+        n = key.bucket_n
+        dtype = resolve_dtype(key.dtype)
+        return ShardedBatch(
+            key=key,
+            positions=jnp.zeros((n, 3), dtype),
+            velocities=jnp.zeros((n, 3), dtype),
+            masses=jnp.zeros((n,), dtype),
+            acc=jnp.zeros((n, 3), dtype),
+            dt=np.zeros((1,), np.float64),
+            remaining=np.zeros((1,), np.int64),
+            n_real=np.zeros((1,), np.int32),
+        )
+
+    def load_slot(self, engine, batch, slot, state, *, dt, steps, job):
+        import jax
+
+        from ...parallel.mesh import particle_sharding
+        from ...simulation import resolve_dtype
+
+        key = batch.key
+        mesh = self._mesh_for(engine, key)  # BackendUnavailable here
+        n_real = state.n
+        padded, _ = state.astype(resolve_dtype(key.dtype)).pad_to(
+            key.bucket_n
+        )
+        pos, vel, mass = (
+            padded.positions, padded.velocities, padded.masses
+        )
+        if mesh is not None:
+            sharding = particle_sharding(mesh)
+            pos = jax.device_put(pos, sharding)
+            vel = jax.device_put(vel, sharding)
+            mass = jax.device_put(mass, sharding)
+        if key not in engine._seed_fns:
+            accel = self._accel_fn(engine, key)
+            engine._seed_fns[key] = jax.jit(accel)
+        acc0 = engine._seed_fns[key](pos, mass)
+        return dataclasses.replace(
+            batch,
+            positions=pos, velocities=vel, masses=mass, acc=acc0,
+            dt=np.array([dt], np.float64),
+            remaining=np.array([steps], np.int64),
+            n_real=np.array([n_real], np.int32),
+        )
+
+    def clear_slot(self, engine, batch, slot):
+        import jax.numpy as jnp
+
+        return dataclasses.replace(
+            batch,
+            masses=jnp.zeros_like(batch.masses),
+            remaining=np.zeros((1,), np.int64),
+            n_real=np.zeros((1,), np.int32),
+        )
+
+    def slot_snapshot(self, engine, batch, slot):
+        """Device-array slices, NOT a host fetch: slicing mints fresh
+        buffers (safe against next-round donation), and the actual
+        D2H — for a 10M-body job, hundreds of MB — happens where the
+        consumer wants it: the background writer's np.asarray for
+        progress snapshots, overlapping the next round's compute."""
+        n = int(batch.n_real[0])
+        return ParticleState(
+            positions=batch.positions[:n],
+            velocities=batch.velocities[:n],
+            masses=batch.masses[:n],
+        ), {}
+
+    def run_slice(self, engine, batch, slice_steps):
+        import jax.numpy as jnp
+
+        from ..engine import SliceResult, account_slice, budget_i32
+
+        key = batch.key
+        stall = collective_stall_secs(batch.slices_run)
+        if stall > 0:
+            # A hung collective: the slice blocks, then the runtime
+            # reports the failure — the round fails with the typed
+            # error the breaker counts, and the job's durable progress
+            # snapshot (not step 0) is the restart point.
+            time.sleep(stall)
+            raise BackendUnavailable(
+                key.backend,
+                f"collective stalled {stall:.1f}s (injected)",
+            )
+        fn = engine.round_fn(key)
+        dtype = batch.positions.dtype
+        pos, vel, acc, finite = fn(
+            batch.positions, batch.velocities, batch.masses, batch.acc,
+            jnp.asarray(batch.dt[0], dtype),
+            jnp.asarray(budget_i32(batch.remaining)[0], jnp.int32),
+            jnp.asarray(batch.n_real[0], jnp.int32),
+            n_steps=slice_steps,
+        )
+        advanced, remaining, finite_np = account_slice(
+            batch.remaining, batch.n_real, slice_steps,
+            np.asarray(finite),
+        )
+        new_batch = dataclasses.replace(
+            batch, positions=pos, velocities=vel, acc=acc,
+            remaining=remaining, slices_run=batch.slices_run + 1,
+        )
+        return new_batch, SliceResult(
+            advanced=advanced, finite=finite_np
+        )
+
+
+register(ShardedIntegrateJob())

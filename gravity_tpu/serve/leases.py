@@ -107,6 +107,25 @@ def _pid_alive(pid: int, start: Optional[str] = None) -> bool:
     return True
 
 
+def entry_alive(info: dict) -> bool:
+    """Is a registry/daemon.json endpoint record's worker still alive,
+    as far as we can tell from HERE? Same-host entries get the precise
+    (pid, starttime) instance probe; a REMOTE host's pid cannot be
+    probed locally — treat it as alive and let TTLs / connection
+    attempts decide. The ONE liveness rule shared by client failover
+    (service.find_daemon/_live_workers) and the scheduler's
+    worker-registry reaper, so the two can never disagree about which
+    workers are dead."""
+    host = info.get("host_name")
+    if host is not None and host != _local_host():
+        return True
+    try:
+        pid = int(info.get("pid", 0) or 0)
+    except (TypeError, ValueError):
+        pid = 0
+    return _pid_alive(pid, info.get("pid_start"))
+
+
 @dataclasses.dataclass(frozen=True)
 class Lease:
     job_id: str

@@ -214,9 +214,15 @@ class Spool:
         # cannot reach a peer's scheduler, but every worker shares the
         # spool).
         self.cancels_dir = os.path.join(root, "cancel")
+        # Durable mid-run progress snapshots (docs/robustness.md
+        # "Sharded & long-job failure modes"): per-job checksummed
+        # state+extras at a round boundary, so adoption resumes a long
+        # job from its last verified snapshot instead of step 0.
+        self.progress_dir = os.path.join(root, "progress")
         os.makedirs(self.jobs_dir, exist_ok=True)
         os.makedirs(self.results_dir, exist_ok=True)
         os.makedirs(self.cancels_dir, exist_ok=True)
+        os.makedirs(self.progress_dir, exist_ok=True)
         self.leases: Optional[LeaseManager] = None
 
     def request_cancel(self, job_id: str) -> None:
@@ -302,6 +308,9 @@ class Spool:
         array serialization runs OUTSIDE the lease lock (it is the
         heavy part); only the validate + ``os.replace`` are in the
         critical section."""
+        from ..utils.faults import disk_full_due
+
+        disk_full_due()  # injected ENOSPC: absorbed per job upstream
         path = self.result_path(job_id)
         if drop_result_due():
             # Injected lost write: report success like a writer that
@@ -332,6 +341,154 @@ class Spool:
             return None
         with np.load(path) as z:
             return {k: z[k] for k in z.files}
+
+    # --- durable mid-run progress (docs/robustness.md "Sharded &
+    # long-job failure modes") ---
+
+    def progress_meta_path(self, job_id: str) -> str:
+        return os.path.join(self.progress_dir, f"{job_id}.json")
+
+    def _progress_file(self, job_id: str, tag: str) -> str:
+        return os.path.join(self.progress_dir, f"{job_id}.{tag}.npz")
+
+    def write_progress(
+        self, job_id: str, step: int, arrays: dict, extras: dict,
+        fence: Optional[int] = None,
+    ) -> Optional[str]:
+        """Persist one fenced, checksummed progress snapshot: the
+        job's state arrays (plus any array-valued evict extras) as an
+        ``.npz``, and a meta record carrying (step, SHA-256 of the
+        array bytes, fence, JSON extras). Two snapshot files alternate
+        (``<id>.a.npz`` / ``<id>.b.npz``) with the meta listing the
+        newest first, so a torn latest write — caught by the checksum
+        at read time — falls back to the PREVIOUS verified snapshot
+        instead of step 0 (the PR-2 corrupt-checkpoint posture).
+
+        Serialization and hashing run OUTSIDE the lease lock (the
+        heavy half); fence validation, the ``os.replace``, and the
+        meta write share one critical section, so a zombie's stale
+        snapshot can never overwrite its adopter's newer one — the
+        write returns None instead (``fenced``)."""
+        import hashlib
+
+        from ..utils.faults import disk_full_due, torn_progress_due
+
+        disk_full_due()  # injected ENOSPC: fails THIS job's write only
+        meta = read_json_retry(self.progress_meta_path(job_id))
+        entries = list((meta or {}).get("entries") or [])
+        prev_file = entries[0].get("file", "") if entries else ""
+        tag = "b" if prev_file.endswith(".a.npz") else "a"
+        path = self._progress_file(job_id, tag)
+        # Serialize STRAIGHT to the tmp file and stream-hash it: an
+        # in-memory payload copy would transiently double-to-triple
+        # the host footprint per snapshot — hundreds of MB per round
+        # for exactly the huge jobs this feature targets.
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            np.savez(f, **{k: np.asarray(v) for k, v in arrays.items()})
+        hasher = hashlib.sha256()
+        with open(tmp, "rb") as f:
+            for chunk in iter(lambda: f.read(1 << 20), b""):
+                hasher.update(chunk)
+        checksum = hasher.hexdigest()
+        entry = {
+            "file": os.path.basename(path), "step": int(step),
+            "checksum": checksum, "fence": fence, "ts": time.time(),
+            "extras": extras,
+        }
+        new_meta = {
+            "v": 1, "job": job_id, "entries": [entry] + entries[:1],
+        }
+        torn = torn_progress_due()
+        # The heavy disk write happened OUTSIDE the lease flock (the
+        # write_result pattern): a multi-hundred-MB snapshot pinned
+        # under the spool-wide lock would block every peer's heartbeat
+        # renewal — the durability feature inducing the very lease
+        # expiry it exists to recover from. Only the fence check, the
+        # renames, and the small meta write share the critical section.
+
+        def _land() -> None:
+            if torn:
+                # Injected torn write: truncated bytes land under the
+                # full payload's checksum — the reader's verification
+                # must reject this entry and fall back.
+                size = os.path.getsize(tmp)
+                with open(tmp, "rb") as src, open(path, "wb") as dst:
+                    dst.write(src.read(max(1, size // 3)))
+                try:
+                    os.remove(tmp)
+                except OSError:
+                    pass
+            else:
+                os.replace(tmp, path)
+            # Meta via direct tmp+replace, NOT atomic_write_json: that
+            # helper is the torn_spool_write injection point and a
+            # progress publish must not consume chaos tokens aimed at
+            # job/lease records.
+            mtmp = f"{self.progress_meta_path(job_id)}.tmp.{os.getpid()}"
+            with open(mtmp, "w") as f:
+                f.write(json.dumps(new_meta))
+            os.replace(mtmp, self.progress_meta_path(job_id))
+
+        if self.leases is None or fence is None:
+            _land()
+            return path
+        with self.leases.locked():
+            if not self.leases.fence_ok(
+                job_id, fence, lambda: self.record_fence(job_id)
+            ):
+                try:
+                    os.remove(tmp)
+                except OSError:
+                    pass
+                return None
+            _land()
+        return path
+
+    def load_progress(self, job_id: str) -> Optional[dict]:
+        """The last VERIFIED progress snapshot: walks the meta entries
+        newest-first, checks each file's SHA-256 against the recorded
+        checksum, and returns ``{"step", "arrays", "extras", "fence"}``
+        for the first that verifies — None when no entry does (torn
+        writes, missing files, no snapshot yet)."""
+        import hashlib
+        import io
+
+        meta = read_json_retry(self.progress_meta_path(job_id))
+        for entry in (meta or {}).get("entries") or []:
+            try:
+                path = os.path.join(
+                    self.progress_dir, str(entry["file"])
+                )
+                with open(path, "rb") as f:
+                    payload = f.read()
+                if hashlib.sha256(payload).hexdigest() \
+                        != entry["checksum"]:
+                    continue
+                with np.load(io.BytesIO(payload)) as z:
+                    arrays = {k: z[k] for k in z.files}
+                return {
+                    "step": int(entry["step"]),
+                    "arrays": arrays,
+                    "extras": entry.get("extras") or {},
+                    "fence": entry.get("fence"),
+                }
+            except (OSError, KeyError, TypeError, ValueError):
+                continue
+        return None
+
+    def clear_progress(self, job_id: str) -> None:
+        """Drop a terminal job's snapshot files (the record/result are
+        the durable truth from here on)."""
+        for path in (
+            self.progress_meta_path(job_id),
+            self._progress_file(job_id, "a"),
+            self._progress_file(job_id, "b"),
+        ):
+            try:
+                os.remove(path)
+            except OSError:
+                pass
 
 
 class EnsembleScheduler:
@@ -364,6 +521,7 @@ class EnsembleScheduler:
         sentinel_every: int = 8,
         sentinel_k: int = 64,
         ledger_every: int = 1,
+        progress_every: int = 1,
     ):
         if slots < 1 or slice_steps < 1 or yield_rounds < 1:
             raise ValueError(
@@ -413,6 +571,13 @@ class EnsembleScheduler:
         self.sentinel_every = max(0, int(sentinel_every))
         self.sentinel_k = max(1, int(sentinel_k))
         self.ledger_every = max(0, int(ledger_every))
+        # Durable mid-run progress (docs/robustness.md "Sharded &
+        # long-job failure modes"): every `progress_every` resident
+        # rounds each running job's (state, extras, units-done) rides
+        # the background HostWriter into a fenced, checksummed spool
+        # snapshot, so adoption/respool resumes from there instead of
+        # step 0. 0 disables (restart-clean semantics everywhere).
+        self.progress_every = max(0, int(progress_every))
         self._accuracy_burn: dict = {}
         self._last_occupancy: Optional[float] = None
         self._last_adoption_dump = 0.0
@@ -663,15 +828,36 @@ class EnsembleScheduler:
         self.jobs[job_id] = job
         if resident:
             self._enqueue(key, job_id)
+        else:
+            self._parents.add(job_id)
+        try:
+            self._persist(job, raise_oserr=True)
+        except OSError as e:
+            # Admission must be DURABLE-or-rejected: unwind the local
+            # enqueue and fail the submit (HTTP 500) rather than hand
+            # the client an id no worker could ever adopt or respool.
+            # No `submitted` event has been emitted yet — the durable
+            # stream never records a lifecycle that will have no
+            # terminal event (the spool_error from _persist is the
+            # audit trail).
+            self.jobs.pop(job_id, None)
+            self._parents.discard(job_id)
+            if key is not None and job_id in self._pending.get(key, []):
+                self._pending[key].remove(job_id)
+            if self.leases is not None:
+                self.leases.release(job_id)
+            raise RuntimeError(
+                f"submit rejected: spool cannot persist the job "
+                f"record ({e})"
+            ) from e
+        if resident:
             self._event("submitted", job=job_id, n=config.n,
                         bucket=key.bucket_n, priority=priority,
                         job_type=job_type)
         else:
-            self._parents.add(job_id)
             self._event("submitted", job=job_id, n=config.n,
                         priority=priority, job_type=job_type,
                         members=admits)
-        self._persist(job)
         self.telemetry.registry.counter(
             "gravity_jobs_submitted_total", **{"class": job_type}
         ).inc()
@@ -1088,9 +1274,16 @@ class EnsembleScheduler:
             ).inc()
         return path
 
-    def _persist(self, job: Job) -> bool:
+    def _persist(self, job: Job, raise_oserr: bool = False) -> bool:
         """Write the job record; False = fencing rejected it (we lost
         ownership to an adopter — local state re-synced from disk).
+
+        ``raise_oserr`` (the ADMISSION persist): a disk that cannot
+        take the record must fail the submit honestly — accepting a
+        job whose spool record never landed would be accept-and-maybe-
+        lose (no peer could ever adopt it). Every later persist runs
+        mid-round and degrades instead (typed ``spool_error``): one
+        full disk must not respool a whole bucket of batchmates.
 
         An already-UNOWNED job never writes at all: a fenced write
         absorbed the adopter's record — INCLUDING its fence — as the
@@ -1104,7 +1297,19 @@ class EnsembleScheduler:
             return True
         if not job.owned:
             return False
-        landed = self.spool.write_job(job)
+        try:
+            landed = self.spool.write_job(job)
+        except OSError as e:
+            # Disk full (ENOSPC) or any other I/O failure persisting
+            # the record: degrade durability for THIS job — typed
+            # spool_error, local state stays the truth — instead of
+            # letting the OSError surface as a generic round failure
+            # that respools every batchmate.
+            self._event("spool_error", job=job.id, error=str(e),
+                        write="record")
+            if raise_oserr:
+                raise
+            return True
         if not landed:
             # Fenced out: a newer claim (our adopter) owns this job —
             # its record is the truth; stop believing our local copy.
@@ -1172,7 +1377,7 @@ class EnsembleScheduler:
                 try:
                     if events is not None:
                         events.event("spool_error", job=job.id,
-                                     error=str(e))
+                                     error=str(e), write="result")
                 except Exception:  # noqa: BLE001 — the event log likely
                     pass  # shares the failing disk; stay un-sticky
                 return
@@ -1197,11 +1402,143 @@ class EnsembleScheduler:
             job.result_data = None
             if leases is not None:
                 leases.release(job.id)
+            # The result is the durable truth now — the mid-run
+            # progress snapshot has nothing left to resume.
+            spool.clear_progress(job.id)
 
         if self._io is None:  # after close_io: degrade to a sync write
             _write()
         else:
             self._io.submit(_write)
+
+    @staticmethod
+    def _split_extras(extras: dict) -> tuple[dict, dict]:
+        """(array-valued, JSON-valued) halves of an evict-extras dict:
+        arrays ride the snapshot ``.npz`` under ``extra.<key>`` names,
+        everything JSON-native (fit loss/iteration counters, watch
+        event logs and detector flags) rides the meta record."""
+        arrs: dict = {}
+        meta: dict = {}
+        for k, v in (extras or {}).items():
+            if isinstance(v, (bool, int, float, str, list, dict)) \
+                    or v is None:
+                meta[k] = v
+            else:
+                arrs[f"extra.{k}"] = v
+        return arrs, meta
+
+    def _spool_progress_async(self, job: Job, state, extras: dict
+                              ) -> None:
+        """Queue one durable progress snapshot of a RUNNING job (state
+        + merged evict extras at its current unit count) onto the
+        background writer — the D2H and disk bytes overlap the next
+        round's compute, exactly like result spooling. BEST-EFFORT:
+        when the writer queue is full (disk slower than rounds), the
+        snapshot is SKIPPED rather than stalling the round loop to
+        spool-write throughput — the previous snapshot stays the
+        resume point and the next cadence tries again. Failures are
+        absorbed per job (``spool_error``); a fenced write (we lost
+        the job to an adopter mid-flight) logs ``fenced``."""
+        spool, events, leases = self.spool, self.events, self.leases
+        fence = job.fence if leases is not None else None
+        tracer, trace_id = self.telemetry.tracer, job.trace_id
+        job_id, step = job.id, job.steps_done
+        arr_extras, meta_extras = self._split_extras(extras)
+        arrays = {
+            "positions": state.positions,
+            "velocities": state.velocities,
+            "masses": state.masses,
+            **arr_extras,
+        }
+
+        def _write() -> None:
+            try:
+                t0 = time.time()
+                path = spool.write_progress(
+                    job_id, step, arrays, meta_extras, fence=fence
+                )
+                if trace_id:
+                    tracer.emit(
+                        "progress_snapshot", trace_id, t0,
+                        time.time() - t0, job=job_id, step=step,
+                        fenced=path is None,
+                    )
+            except Exception as e:  # noqa: BLE001 — a failed snapshot
+                # (full disk, injected ENOSPC) degrades durability for
+                # THIS job only: it keeps running, the previous
+                # snapshot stays the resume point, nothing else trips.
+                try:
+                    if events is not None:
+                        events.event("spool_error", job=job_id,
+                                     error=str(e), write="progress")
+                except Exception:  # noqa: BLE001 — the event log
+                    pass  # likely shares the failing disk
+                return
+            if path is None:
+                try:
+                    if events is not None:
+                        events.event("fenced", job=job_id, fence=fence,
+                                     write="progress")
+                except Exception:  # noqa: BLE001
+                    pass
+
+        if self._io is None:
+            _write()
+        elif not self._io.try_submit(_write, reserve=2):
+            # Queue crowded: drop THIS snapshot (the recorder keeps
+            # the skip auditable). The reserve leaves headroom for the
+            # MANDATORY result writes' blocking submits, so snapshot
+            # traffic can never couple round latency to disk speed.
+            self.telemetry.recorder.record(
+                "event", event="progress_skipped", job=job_id, step=step
+            )
+
+    def _resume_from_progress(self, job: Job) -> Optional[int]:
+        """Try to restore a requeued/adopted job from its last verified
+        progress snapshot: populates ``state`` / ``extra_state`` /
+        ``steps_done`` (the evict/resume triple, so the continuation
+        reproduces what an uninterrupted run would have computed) and
+        returns the resume step, or None to restart clean from 0."""
+        if self.spool is None or not self.progress_every:
+            return None
+        snap = self.spool.load_progress(job.id)
+        if snap is None:
+            return None
+        try:
+            step = int(snap["step"])
+            if not 0 < step <= job.steps:
+                return None
+            arrays = snap["arrays"]
+            state = ParticleState.create(
+                arrays["positions"], arrays["velocities"],
+                arrays["masses"],
+            )
+        except (KeyError, TypeError, ValueError):
+            return None
+        extras = dict(snap.get("extras") or {})
+        for k, v in arrays.items():
+            if k.startswith("extra."):
+                extras[k[len("extra."):]] = v
+        job.state = state
+        job.extra_state = extras or None
+        job.steps_done = step
+        self.telemetry.registry.gauge(
+            "gravity_job_resume_step", job=job.id
+        ).set(float(step))
+        return step
+
+    def _clear_progress_async(self, job_id: str) -> None:
+        """Clear a job's progress snapshots BEHIND any queued snapshot
+        write: the clear rides the same FIFO writer, so a snapshot
+        still in the queue when the job goes terminal lands first and
+        is then removed — a synchronous clear here would execute
+        before the queued write and orphan the re-created files for
+        the life of the spool (terminal records are never re-scanned).
+        """
+        if self._io is None:
+            self.spool.clear_progress(job_id)
+        else:
+            self._io.submit(self.spool.clear_progress, job_id)
 
     def drain_io(self) -> None:
         """Block until every queued spool write has finished. Result-
@@ -1302,7 +1639,8 @@ class EnsembleScheduler:
         # stays bounded over the daemon's lifetime (the last value
         # lives on in job.drift / the spool record).
         for gname in (
-            "gravity_job_energy_drift", "gravity_job_momentum_drift"
+            "gravity_job_energy_drift", "gravity_job_momentum_drift",
+            "gravity_job_resume_step",
         ):
             self.telemetry.registry.remove_series(gname, job=job.id)
         if not self._persist(job):
@@ -1336,6 +1674,13 @@ class EnsembleScheduler:
             status if status in ServingEventLogger.KINDS else "failed",
             job=job.id, steps_done=job.steps_done, error=error,
         )
+        if self.spool is not None and status != "completed":
+            # failed/cancelled: the snapshot is dead weight. A
+            # COMPLETED job keeps its progress until the result .npz
+            # lands (cleared in the writer callback) — if the owner
+            # dies inside that window, the adopter's re-run resumes
+            # from the snapshot instead of step 0.
+            self._clear_progress_async(job.id)
         if self.leases is not None and status != "completed":
             # failed/cancelled: nothing further to write — release now.
             # A completed job keeps its lease until its .npz lands
@@ -1806,6 +2151,12 @@ class EnsembleScheduler:
                 job.finished_ts = None
                 job.error = None
                 job.active_s = 0.0
+                # Resume from the last verified progress snapshot when
+                # one exists (the failed round's work is lost, but
+                # every snapshotted round before it is not); the
+                # requeue still counts — resumability does not blunt
+                # the poison-pill cap.
+                resume_step = self._resume_from_progress(job)
                 job.requeues += 1
                 if job.requeues > self.max_requeues:
                     # Poison pill: this job has now taken down its
@@ -1828,8 +2179,15 @@ class EnsembleScheduler:
                                  error=f"requeue rejected: {e}")
                     continue
                 self._enqueue(new_key, job_id)
-                self._event("respooled", job=job_id,
-                            reason="round failed; restarting clean")
+                self._event(
+                    "respooled", job=job_id,
+                    reason=(
+                        "round failed; resuming from snapshot"
+                        if resume_step else
+                        "round failed; restarting clean"
+                    ),
+                    resume_step=resume_step or 0,
+                )
                 self._persist(job)
             raise
         self._batches[key] = batch
@@ -1963,6 +2321,24 @@ class EnsembleScheduler:
                     self._spool_result_async(job, arrays)
                 self._free_slot(key, slot)
                 self._finish(job, "completed")
+            elif (
+                self.spool is not None
+                and self.progress_every
+                and job.resident_rounds % self.progress_every == 0
+            ):
+                # Durable mid-run progress: the still-running job's
+                # verified round-boundary state (plus its evict extras
+                # — optimizer moments, detector flags) rides the
+                # background writer into a fenced, checksummed spool
+                # snapshot. Adoption/respool resumes HERE instead of
+                # step 0 (docs/robustness.md "Sharded & long-job
+                # failure modes"). The slot slices are fresh device
+                # buffers, so next round's donation cannot invalidate
+                # the queued fetch.
+                state, extra = self.engine.slot_snapshot(batch, slot)
+                self._spool_progress_async(
+                    job, state, {**(job.extra_state or {}), **extra}
+                )
         self._check_parents()
 
         metrics = {
@@ -2073,6 +2449,7 @@ class EnsembleScheduler:
         self._next_scan = now + self.reap_interval_s
         self._scan_spool()
         self._consume_cancel_markers()
+        self._reap_worker_registry()
         # Keep the published snapshot fresh even while idle (an idle
         # replica still answers /metrics and the fleet view).
         self._publish_metrics(min_interval_s=self.reap_interval_s)
@@ -2122,6 +2499,57 @@ class EnsembleScheduler:
                 self._event("cancelled", job=job_id,
                             reason="spool-level cancel (unclaimable "
                                    "record)")
+
+    def _reap_worker_registry(self) -> None:
+        """Delete dead SAME-HOST worker endpoint/metrics registry
+        files: ``workers/<id>.json`` is only removed by a clean stop,
+        so a SIGKILL'd worker leaves an entry every client failover
+        and ``fleet-status`` scan must pid-probe forever. Liveness is
+        (pid, starttime) process-INSTANCE identity; remote hosts'
+        entries are untouchable from here (their pids mean nothing
+        locally) and unreadable/torn entries are left for a later
+        scan."""
+        from .leases import entry_alive
+
+        workers_dir = os.path.join(self.spool.root, "workers")
+        try:
+            names = os.listdir(workers_dir)
+        except OSError:
+            return
+        for name in names:
+            if not name.endswith(".json") \
+                    or name.endswith(".metrics.json"):
+                continue
+            wid = name[:-len(".json")]
+            if wid == self.worker_id:
+                continue
+            info = read_json_retry(os.path.join(workers_dir, name))
+            if not isinstance(info, dict):
+                continue
+            # The SAME liveness rule client failover uses: remote
+            # entries always count as alive (unprobeable from here).
+            if entry_alive(info):
+                continue
+            reaped = False
+            try:
+                os.remove(os.path.join(workers_dir, name))
+                reaped = True
+            except OSError:
+                pass  # a racing peer won, or the dir is read-only:
+                # either way the reap is not OURS to announce
+            try:
+                os.remove(os.path.join(
+                    workers_dir, f"{wid}.metrics.json"
+                ))
+            except OSError:
+                pass
+            if reaped:
+                # Gated on the endpoint remove actually succeeding:
+                # an unremovable entry (read-only spool) must not
+                # re-emit worker_reaped every 1.25s scan forever, and
+                # of two racing survivors only the winner announces.
+                self._event("worker_reaped", worker_id=wid,
+                            pid=info.get("pid"))
 
     def _on_lease_lost(self, job_id: str) -> None:
         """A heartbeat discovered a peer adopted this job (our lease
@@ -2323,6 +2751,7 @@ class EnsembleScheduler:
             self._finish(job, "completed")
             if self.leases is not None:
                 self.leases.release(job_id)
+            self._clear_progress_async(job_id)
             return
         if not getattr(get_class(job.job_type), "resident", True):
             # A sweep parent: nothing to enqueue — its members are
@@ -2353,6 +2782,11 @@ class EnsembleScheduler:
         job.finished_ts = None
         job.error = None
         job.active_s = 0.0
+        # Adoption-as-recovery: resume from the dead owner's (or our
+        # own pre-restart) last verified progress snapshot — the steps
+        # already paid for are not re-executed. The requeue counter
+        # still bumps below: resumability never blunts max_requeues.
+        resume_step = self._resume_from_progress(job)
         if was_started:
             job.requeues += 1
             if job.requeues > self.max_requeues:
@@ -2380,7 +2814,18 @@ class EnsembleScheduler:
         self._enqueue(key, job.id)
         if adopted_from and adopted_from != self.worker_id:
             self._event("adopted", job=job.id,
-                        from_worker=adopted_from, fence=job.fence)
+                        from_worker=adopted_from, fence=job.fence,
+                        resume_step=resume_step or 0)
+            if resume_step:
+                # The resilience headline: adoption resumed mid-run
+                # work instead of re-running it (docs/robustness.md
+                # "Sharded & long-job failure modes").
+                self._event(
+                    "adopted_resumed", job=job.id,
+                    from_worker=adopted_from, fence=job.fence,
+                    resume_step=resume_step,
+                )
         else:
-            self._event("respooled", job=job.id)
+            self._event("respooled", job=job.id,
+                        resume_step=resume_step or 0)
         self._persist(job)

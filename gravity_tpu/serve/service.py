@@ -52,7 +52,12 @@ import numpy as np
 from ..config import SimulationConfig
 from ..utils.hostio import atomic_write_json
 from ..utils.logging import ServingEventLogger
-from .leases import _local_host, _pid_alive, pid_start, read_json_retry
+from .leases import (
+    _local_host,
+    entry_alive,
+    pid_start,
+    read_json_retry,
+)
 from .scheduler import EnsembleScheduler, QueueFull, Spool, default_worker_id
 
 DAEMON_FILE = "daemon.json"
@@ -86,6 +91,7 @@ class GravityDaemon:
         sentinel_every: int = 8,
         sentinel_k: int = 64,
         ledger_every: int = 1,
+        progress_every: int = 1,
     ):
         self.spool_dir = spool_dir
         self.host = host
@@ -109,6 +115,7 @@ class GravityDaemon:
             slo_p99_ms=slo_p99_ms, slo_occupancy=slo_occupancy,
             error_budget=error_budget, sentinel_every=sentinel_every,
             sentinel_k=sentinel_k, ledger_every=ledger_every,
+            progress_every=progress_every,
         )
         self.telemetry = self.scheduler.telemetry
         self.lock = threading.Lock()
@@ -659,17 +666,9 @@ class DaemonUnreachable(RuntimeError):
     pass
 
 
-def _entry_alive(info: dict) -> bool:
-    """Is a registry/daemon.json endpoint's worker still alive, as far
-    as we can tell from HERE? Same-host entries get the precise
-    (pid, starttime) probe; a REMOTE host's pid cannot be probed
-    locally — treat it as alive and let the connection attempt decide
-    (never declare a healthy remote daemon dead from a local pid)."""
-    host = info.get("host_name")
-    if host is not None and host != _local_host():
-        return True
-    return _pid_alive(int(info.get("pid", 0) or 0),
-                      info.get("pid_start"))
+# The one registry-liveness rule, shared with the scheduler's
+# worker-registry reaper (serve/leases.py).
+_entry_alive = entry_alive
 
 
 def _live_workers(spool_dir: str) -> list[dict]:

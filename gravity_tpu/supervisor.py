@@ -72,13 +72,43 @@ def next_rung(
 ) -> Optional[str]:
     """The next rung down the exact-physics degrade ladder, or None at
     (or off) the bottom. ``cpp``'s only safe fallback is the jnp direct
-    sum — same platform, same physics."""
+    sum — same platform, same physics.
+
+    Sharded forms (``sharded/<devices>/<local>`` — the serve layer's
+    ``sharded-integrate`` keys, serve/jobs/sharded.py) walk the
+    ELASTIC half of the ladder first: a mesh that cannot build or a
+    collective that stalls re-shards to half the devices, down to the
+    solo form of the same local kernel, and only then the classic
+    exact-physics rungs — mesh loss degrades capacity before it ever
+    degrades the kernel."""
+    if backend.startswith("sharded/"):
+        devices, local = parse_sharded_backend(backend)
+        if devices is None:
+            return None
+        if devices // 2 >= 2:
+            return f"sharded/{devices // 2}/{local}"
+        return local  # solo form of the same local kernel
     if backend == "cpp":
         return "chunked"
     if backend not in ladder:
         return None
     i = ladder.index(backend)
     return ladder[i + 1] if i + 1 < len(ladder) else None
+
+
+def parse_sharded_backend(backend: str):
+    """``sharded/<devices>/<local>`` -> (devices, local); (None, None)
+    for anything that does not parse (callers treat it as off-ladder)."""
+    parts = backend.split("/", 2)
+    if len(parts) != 3 or parts[0] != "sharded":
+        return None, None
+    try:
+        devices = int(parts[1])
+    except ValueError:
+        return None, None
+    if devices < 1 or not parts[2]:
+        return None, None
+    return devices, parts[2]
 
 
 @dataclasses.dataclass

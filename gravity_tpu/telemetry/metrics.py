@@ -85,6 +85,11 @@ WORKER_METRICS = (
      "Per-job |dE/E0| conservation-ledger drift, by job"),
     ("gravity_job_momentum_drift", "gauge",
      "Per-job |dP|/p_ref conservation-ledger drift, by job"),
+    # Durable mid-run progress (docs/robustness.md "Sharded &
+    # long-job failure modes").
+    ("gravity_job_resume_step", "gauge",
+     "Units restored from the last verified progress snapshot when a "
+     "requeued/adopted job resumed mid-run, by job"),
 )
 
 # Per-family bucket overrides for declare_worker_metrics: histograms

@@ -37,7 +37,7 @@ from ..utils.logging import JsonlEventLogger
 # lint asserts coverage). Serving lifecycle first, solo-run spans last.
 SPAN_NAMES = (
     "admission", "autotune_probe", "queue", "slot_load", "compile",
-    "round", "d2h", "result_write", "adopted",
+    "round", "d2h", "result_write", "adopted", "progress_snapshot",
     "block", "checkpoint", "sentinel",
 )
 

@@ -57,6 +57,28 @@ failure modes of docs/robustness.md, each at its real code point):
                             overload behind the breach-workflow e2e —
                             scheduler sentinel step and the solo
                             Simulator's probe consume it; fires once)
+    mesh_fail@K             fail the (K+1)-th sharded device-mesh build
+                            with BackendUnavailable — a slice losing a
+                            chip / ICI link at mesh construction; the
+                            elastic degrade ladder must re-shard to
+                            fewer devices (``xCOUNT`` fails COUNT
+                            consecutive builds; serve/jobs/sharded.py)
+    collective_stall@RxS    at sharded scheduling round R, stall the
+                            collective S seconds then fail the slice
+                            with BackendUnavailable — a hung
+                            all-gather/ppermute surfacing as a
+                            collective timeout (the round fails, the
+                            breaker strikes, the job resumes from its
+                            progress snapshot on a lower rung)
+    torn_progress_write@K   tear the (K+1)-th durable progress-snapshot
+                            array write: truncated bytes land under the
+                            real checksum, so the reader must reject
+                            the entry and fall back to the previous
+                            verified snapshot (Spool.write_progress)
+    disk_full@K             the (K+1)-th spool result/progress write
+                            raises ENOSPC — the full-disk case that
+                            must fail THAT job with a typed
+                            ``spool_error`` event and trip nothing else
 
 Example: ``GRAVITY_TPU_FAULTS="transient@10x2,diverge@20"``.
 """
@@ -102,6 +124,8 @@ class _Fault:
 SERVING_KINDS = (
     "crash_worker", "stall_worker", "stale_lease",
     "torn_spool_write", "drop_result_write", "accuracy_breach",
+    "mesh_fail", "collective_stall", "torn_progress_write",
+    "disk_full",
 )
 
 
@@ -115,6 +139,9 @@ class FaultPlan:
         # such writes happened before", not a simulation step.
         self._spool_writes = 0
         self._result_writes = 0
+        self._mesh_builds = 0
+        self._progress_writes = 0
+        self._durable_writes = 0
 
     @staticmethod
     def parse(spec: str) -> "FaultPlan":
@@ -320,6 +347,60 @@ def drop_result_due() -> bool:
     return plan._take(
         "drop_result_write", lambda f: seq >= f.step
     ) is not None
+
+
+def mesh_fail_due() -> bool:
+    """One injected sharded mesh-build failure due? Counted per build
+    attempt (serve/jobs/sharded.py raises BackendUnavailable on True,
+    so the elastic degrade ladder walks through its real path)."""
+    plan = active()
+    if plan is None:
+        return False
+    seq = plan._mesh_builds
+    plan._mesh_builds += 1
+    return plan._take("mesh_fail", lambda f: seq >= f.step) is not None
+
+
+def collective_stall_secs(round_no: int) -> float:
+    """Seconds a due ``collective_stall`` pins the sharded slice before
+    failing it (0 = not due). The caller sleeps, then raises
+    BackendUnavailable — the shape of a hung collective surfacing as a
+    timeout on the sharded form."""
+    plan = active()
+    if plan is None:
+        return 0.0
+    return float(_take_once_with_payload(
+        plan, "collective_stall", lambda f: round_no >= f.step
+    ))
+
+
+def torn_progress_due() -> bool:
+    """One torn progress-snapshot array write due?
+    (Spool.write_progress — the checksum must catch it.)"""
+    plan = active()
+    if plan is None:
+        return False
+    seq = plan._progress_writes
+    plan._progress_writes += 1
+    return plan._take(
+        "torn_progress_write", lambda f: seq >= f.step
+    ) is not None
+
+
+def disk_full_due() -> None:
+    """Raise an injected ENOSPC when a ``disk_full`` fault is due —
+    consumed at the spool's result and progress write entry points."""
+    plan = active()
+    if plan is None:
+        return
+    seq = plan._durable_writes
+    plan._durable_writes += 1
+    if plan._take("disk_full", lambda f: seq >= f.step) is not None:
+        import errno
+
+        raise OSError(
+            errno.ENOSPC, "No space left on device (injected disk_full)"
+        )
 
 
 def accuracy_breach_due(at: int) -> bool:

@@ -121,6 +121,28 @@ class HostWriter:
             raise RuntimeError("HostWriter is closed")
         self._q.put((fn, args, kwargs))
 
+    def try_submit(self, fn, *args, reserve: int = 0, **kwargs) -> bool:
+        """Non-blocking :meth:`submit` for BEST-EFFORT work (progress
+        snapshots): returns False instead of blocking when the queue
+        is full, so an optional write can be skipped rather than
+        throttling the producer to disk speed. ``reserve`` keeps that
+        many queue slots free for MANDATORY writers: without headroom,
+        best-effort traffic could saturate the bounded queue and the
+        mandatory blocking ``submit`` (results, checkpoints) would
+        stall the producer anyway — the exact stall best-effort
+        semantics exist to avoid."""
+        self._raise_pending()
+        if self._closed:
+            raise RuntimeError("HostWriter is closed")
+        if reserve > 0 and \
+                self._q.qsize() >= max(1, self._q.maxsize - reserve):
+            return False
+        try:
+            self._q.put_nowait((fn, args, kwargs))
+            return True
+        except queue.Full:
+            return False
+
     def barrier(self) -> None:
         """Block until every submitted task has run; raise the first
         background failure if one occurred."""

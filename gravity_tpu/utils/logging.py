@@ -233,13 +233,22 @@ class ServingEventLogger(JsonlEventLogger):
     worker's ``--error-budget``; the breach dumps the flight recorder
     and trips the backend's circuit breaker so admission reroutes down
     the exact-physics ladder.
+
+    ``adopted_resumed`` is the durable-progress half of adoption
+    (docs/robustness.md "Sharded & long-job failure modes"): the
+    adopter restored the dead owner's job from its last verified
+    mid-run progress snapshot — ``resume_step`` counts the units that
+    were NOT re-executed. ``worker_reaped`` records housekeeping
+    deleting a dead same-host worker's registry entry, so failover
+    and fleet scans stop pid-probing a SIGKILL'd worker forever.
     """
 
     KINDS = (
         "submitted", "admitted", "yielded", "round", "completed",
         "failed", "cancelled", "respooled", "spool_error",
-        "adopted", "fenced", "breaker_open", "breaker_closed",
-        "shed", "poisoned",
+        "adopted", "adopted_resumed", "fenced",
+        "breaker_open", "breaker_closed",
+        "shed", "poisoned", "worker_reaped",
         "encounter", "merger", "followup_submitted",
         "slo_breach", "accuracy_breach",
     )

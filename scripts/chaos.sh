@@ -1,7 +1,8 @@
 #!/usr/bin/env bash
 # Serving-layer chaos harness (`make chaos`; docs/robustness.md "Fleet
-# failure modes"): two CLI daemon workers share ONE spool directory and
-# run a mixed ensemble under injected faults (utils/faults.py).
+# failure modes" + "Sharded & long-job failure modes"): CLI daemon
+# workers share ONE spool directory and run ensembles under injected
+# faults (utils/faults.py).
 #
 #   Scenario 1 — kill -9 + adoption: worker A claims 8 mixed-size jobs
 #   and is SIGKILLed mid-round (crash_worker@2 — a real, un-catchable
@@ -16,7 +17,17 @@
 #   one of its late writes must be fenced — exactly one completed
 #   event per job, record fences owned by the adopter.
 #
-# Exits nonzero on any violated invariant. CPU-only; ~2-4 min.
+#   Scenario 3 — sharded adoption-resume: worker E runs ONE
+#   sharded-integrate job over a 2-device CPU mesh and is SIGKILLed
+#   mid-run. Survivor F must adopt AND RESUME from the last fenced,
+#   checksummed progress snapshot (resume step > 0), complete the job
+#   exactly once with <=1e-5 parity to an uninterrupted solo run, and
+#   re-execute strictly fewer steps than a from-zero respool.
+#
+# Usage: chaos.sh [scenario...]   (default: all). Each scenario runs
+# in its own subshell (a fresh `bash $0 --one N`), so one scenario's
+# failure cannot mask another's and the harness exits nonzero when ANY
+# requested scenario fails — verified exit-code propagation.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -34,9 +45,16 @@ cleanup() {
 }
 trap cleanup EXIT
 
-start_worker() { # spool worker_id faults_spec -> appends pid to PIDS
-    local spool=$1 wid=$2 faults=${3:-}
-    GRAVITY_TPU_FAULTS="$faults" python -m gravity_tpu serve \
+start_worker() { # spool worker_id faults_spec [cpu_devices] -> PIDS+=
+    local spool=$1 wid=$2 faults=${3:-} devices=${4:-}
+    # Inherit the caller's XLA_FLAGS unless a scenario pins its own
+    # virtual device count (scenario 3's CPU mesh).
+    local xla="${XLA_FLAGS:-}"
+    if [ -n "$devices" ]; then
+        xla="--xla_force_host_platform_device_count=$devices"
+    fi
+    GRAVITY_TPU_FAULTS="$faults" XLA_FLAGS="$xla" \
+        python -m gravity_tpu serve \
         --spool-dir "$spool" --slots 2 --slice-steps 10 \
         --lease-ttl-s 5 --worker-id "$wid" \
         >"$spool/$wid.stdout" 2>&1 &
@@ -59,19 +77,20 @@ EOF
     return 1
 }
 
-echo "== chaos 1/2: kill -9 a worker mid-round -> adoption, parity, no double-run =="
-SPOOL1=$(mktemp -d /tmp/gravity_chaos1.XXXXXX)
-DIRS+=("$SPOOL1")
-# Survivor first; the doomed worker starts second so daemon.json (last
-# writer wins) routes the submissions to it.
-start_worker "$SPOOL1" chaos-b ""
-B1_PID=${PIDS[-1]}
-wait_for_daemon "$SPOOL1" chaos-b
-start_worker "$SPOOL1" chaos-a "crash_worker@2"
-A1_PID=${PIDS[-1]}
-wait_for_daemon "$SPOOL1" chaos-a
+scenario_1() {
+    echo "== chaos 1: kill -9 a worker mid-round -> adoption, parity, no double-run =="
+    SPOOL1=$(mktemp -d /tmp/gravity_chaos1.XXXXXX)
+    DIRS+=("$SPOOL1")
+    # Survivor first; the doomed worker starts second so daemon.json
+    # (last writer wins) routes the submissions to it.
+    start_worker "$SPOOL1" chaos-b ""
+    B1_PID=${PIDS[-1]}
+    wait_for_daemon "$SPOOL1" chaos-b
+    start_worker "$SPOOL1" chaos-a "crash_worker@2"
+    A1_PID=${PIDS[-1]}
+    wait_for_daemon "$SPOOL1" chaos-a
 
-python - "$SPOOL1" <<'EOF'
+    python - "$SPOOL1" <<'EOF'
 import json, sys
 from gravity_tpu.config import SimulationConfig
 from gravity_tpu.serve import request
@@ -90,15 +109,15 @@ json.dump(ids, open(f"{spool}/chaos_ids.json", "w"))
 print("submitted:", len(ids), "jobs")
 EOF
 
-# The injected SIGKILL must actually land (exit 137 = 128 + SIGKILL).
-RC=0; wait "$A1_PID" || RC=$?
-[ "$RC" -eq 137 ] || {
-    echo "worker chaos-a should have died by SIGKILL, exit $RC";
-    cat "$SPOOL1/chaos-a.stdout"; exit 1;
-}
-echo "worker chaos-a SIGKILLed as injected (exit $RC)"
+    # The injected SIGKILL must actually land (exit 137 = 128 + KILL).
+    RC=0; wait "$A1_PID" || RC=$?
+    [ "$RC" -eq 137 ] || {
+        echo "worker chaos-a should have died by SIGKILL, exit $RC";
+        cat "$SPOOL1/chaos-a.stdout"; exit 1;
+    }
+    echo "worker chaos-a SIGKILLed as injected (exit $RC)"
 
-python - "$SPOOL1" <<'EOF'
+    python - "$SPOOL1" <<'EOF'
 import json, sys
 import numpy as np
 from gravity_tpu.config import SimulationConfig
@@ -133,29 +152,32 @@ for e in adopted:
 print("chaos 1 OK:", len(ids), "jobs completed with solo parity |",
       len(adopted), "adopted by chaos-b | one completed event per job")
 EOF
-kill "$B1_PID" 2>/dev/null || true
+    kill "$B1_PID" 2>/dev/null || true
+}
 
-echo "== chaos 2/2: stale leases -> adoption of a LIVE zombie, fencing =="
-SPOOL2=$(mktemp -d /tmp/gravity_chaos2.XXXXXX)
-DIRS+=("$SPOOL2")
-start_worker "$SPOOL2" chaos-d ""
-D_PID=${PIDS[-1]}
-wait_for_daemon "$SPOOL2" chaos-d
-# stale_lease@1x60: at round 1 worker C backdates its leases and stops
-# heartbeating for 60s — alive, integrating, but adoptable. The
-# bounded stall_worker@3x3 pins the race DETERMINISTICALLY: C pauses 3s
-# mid-flight at round 3, guaranteeing worker D's reaper (interval
-# ttl/4 = 1.25s) adopts while C still has rounds left — without it,
-# a fast box can let C finish all its rounds inside the ~1.25s
-# adoption lag, leaving no late writes to fence (measured flaky in
-# BOTH directions: the pre-fix tree also produced a DUPLICATE
-# completed event when a fenced admission write absorbed the
-# adopter's fence — the scheduler now hard-stops unowned writes).
-start_worker "$SPOOL2" chaos-c "stale_lease@1x60,stall_worker@3x3"
-C_PID=${PIDS[-1]}
-wait_for_daemon "$SPOOL2" chaos-c
+scenario_2() {
+    echo "== chaos 2: stale leases -> adoption of a LIVE zombie, fencing =="
+    SPOOL2=$(mktemp -d /tmp/gravity_chaos2.XXXXXX)
+    DIRS+=("$SPOOL2")
+    start_worker "$SPOOL2" chaos-d ""
+    D_PID=${PIDS[-1]}
+    wait_for_daemon "$SPOOL2" chaos-d
+    # stale_lease@1x60: at round 1 worker C backdates its leases and
+    # stops heartbeating for 60s — alive, integrating, but adoptable.
+    # The bounded stall_worker@3x3 pins the race DETERMINISTICALLY: C
+    # pauses 3s mid-flight at round 3, guaranteeing worker D's reaper
+    # (interval ttl/4 = 1.25s) adopts while C still has rounds left —
+    # without it, a fast box can let C finish all its rounds inside
+    # the ~1.25s adoption lag, leaving no late writes to fence
+    # (measured flaky in BOTH directions: the pre-fix tree also
+    # produced a DUPLICATE completed event when a fenced admission
+    # write absorbed the adopter's fence — the scheduler now
+    # hard-stops unowned writes).
+    start_worker "$SPOOL2" chaos-c "stale_lease@1x60,stall_worker@3x3"
+    C_PID=${PIDS[-1]}
+    wait_for_daemon "$SPOOL2" chaos-c
 
-python - "$SPOOL2" <<'EOF'
+    python - "$SPOOL2" <<'EOF'
 import json, sys, time
 import numpy as np
 from gravity_tpu.config import SimulationConfig
@@ -201,6 +223,125 @@ for i, (jid, n) in enumerate(zip(ids, (8, 12))):
 print("chaos 2 OK: live-zombie jobs adopted by chaos-d,",
       len(fenced), "fenced write(s), one completed event per job")
 EOF
-kill "$C_PID" "$D_PID" 2>/dev/null || true
+    kill "$C_PID" "$D_PID" 2>/dev/null || true
+}
 
+scenario_3() {
+    echo "== chaos 3: SIGKILL mid-sharded-job -> adopt + RESUME from progress snapshot =="
+    SPOOL3=$(mktemp -d /tmp/gravity_chaos3.XXXXXX)
+    DIRS+=("$SPOOL3")
+    # Both workers see a 2-device CPU mesh (the survivor must be able
+    # to rebuild the sharded form). Survivor first, doomed second.
+    start_worker "$SPOOL3" chaos-f "" 2
+    F_PID=${PIDS[-1]}
+    wait_for_daemon "$SPOOL3" chaos-f
+    # crash_worker@5: five 10-step rounds of the 120-step job land
+    # (with at least the round-4 snapshot durably down), then the
+    # un-catchable SIGKILL.
+    start_worker "$SPOOL3" chaos-e "crash_worker@5" 2
+    E_PID=${PIDS[-1]}
+    wait_for_daemon "$SPOOL3" chaos-e
+
+    python - "$SPOOL3" <<'EOF'
+import json, sys
+from gravity_tpu.config import SimulationConfig
+from gravity_tpu.serve import request
+
+spool = sys.argv[1]
+cfg = SimulationConfig(n=48, steps=120, seed=11, model="random",
+                       dt=3600.0, integrator="leapfrog",
+                       force_backend="dense")
+resp = request(spool, "POST", "/submit",
+               {"config": json.loads(cfg.to_json()),
+                "job_type": "sharded-integrate",
+                "params": {"devices": 2}},
+               retries=5)
+assert "job" in resp, resp
+json.dump({"job": resp["job"]}, open(f"{spool}/chaos3_job.json", "w"))
+print("submitted sharded-integrate job:", resp["job"])
+EOF
+
+    RC=0; wait "$E_PID" || RC=$?
+    [ "$RC" -eq 137 ] || {
+        echo "worker chaos-e should have died by SIGKILL, exit $RC";
+        cat "$SPOOL3/chaos-e.stdout"; exit 1;
+    }
+    echo "worker chaos-e SIGKILLed as injected (exit $RC)"
+
+    python - "$SPOOL3" <<'EOF'
+import json, sys
+import numpy as np
+from gravity_tpu.config import SimulationConfig
+from gravity_tpu.serve import request, wait_for
+from gravity_tpu.simulation import Simulator
+
+spool = sys.argv[1]
+jid = json.load(open(f"{spool}/chaos3_job.json"))["job"]
+steps, slice_steps = 120, 10
+statuses = wait_for(spool, [jid], timeout=300)
+assert statuses[jid]["status"] == "completed", statuses
+
+events = [json.loads(l) for l in open(f"{spool}/serving_events.jsonl")]
+resumed = [e for e in events if e["event"] == "adopted_resumed"
+           and e["job"] == jid]
+assert resumed, "survivor did not resume from the progress snapshot"
+assert {e["worker"] for e in resumed} == {"chaos-f"}, resumed
+resume_step = resumed[-1]["resume_step"]
+assert resume_step > 0, resumed  # resumed mid-run, NOT from step 0
+# Strictly fewer re-executed steps than a from-zero respool: count
+# the survivor's actual sharded rounds.
+f_rounds = [e for e in events if e["event"] == "round"
+            and e["worker"] == "chaos-f"
+            and e.get("job_type") == "sharded-integrate"]
+assert f_rounds, events
+re_executed = len(f_rounds) * slice_steps
+assert re_executed < steps, (re_executed, steps)
+assert re_executed <= steps - resume_step + slice_steps, \
+    (re_executed, resume_step)
+# Exactly one completed event, fence owned by the adopter.
+completed = [e for e in events if e["event"] == "completed"
+             and e["job"] == jid]
+assert len(completed) == 1, completed
+rec = json.load(open(f"{spool}/jobs/{jid}.json"))
+assert rec["fence"] >= 2, rec
+# <=1e-5 parity with the UNINTERRUPTED solo run.
+cfg = SimulationConfig(n=48, steps=120, seed=11, model="random",
+                       dt=3600.0, integrator="leapfrog",
+                       force_backend="dense")
+resp = request(spool, "GET", f"/result?job={jid}")
+got = np.asarray(resp["positions"], np.float32)
+solo = np.asarray(Simulator(cfg).run()["final_state"].positions)
+rel = float(np.max(np.abs(got - solo) / np.maximum(np.abs(solo), 1e-30)))
+assert rel <= 1e-5, rel
+print("chaos 3 OK: resumed at step", resume_step, "| survivor ran",
+      len(f_rounds), "rounds (", re_executed, "of", steps, "steps )",
+      "| parity", rel)
+EOF
+    kill "$F_PID" 2>/dev/null || true
+}
+
+if [ "${1:-}" = "--one" ]; then
+    "scenario_$2"
+    exit 0
+fi
+
+SCENARIOS=("$@")
+[ ${#SCENARIOS[@]} -eq 0 ] && SCENARIOS=(1 2 3)
+FAILED=0
+for s in "${SCENARIOS[@]}"; do
+    # Each scenario runs in its own shell so its `set -e` semantics
+    # are never suppressed by the runner's conditional — the exit
+    # code propagates verbatim.
+    if bash "$0" --one "$s"; then
+        echo "== chaos scenario $s: OK =="
+    else
+        rc=$?
+        echo "== chaos scenario $s: FAILED (exit $rc) =="
+        FAILED=1
+    fi
+done
+if [ "$FAILED" -ne 0 ]; then
+    echo "== chaos: FAILURES above =="
+    exit 1
+fi
 echo "== chaos: all green =="

@@ -15,6 +15,10 @@
 # force-error histogram off the live daemon, then an injected-overload
 # --error-budget breach: one accuracy_breach event + flightrec dump +
 # breaker trip — docs/observability.md "Numerics"),
+# and the sharded adoption-resume chaos stage (SIGKILL a worker
+# mid-sharded-job on a 2-device CPU mesh -> the survivor resumes from
+# the durable progress snapshot — docs/robustness.md "Sharded &
+# long-job failure modes"),
 # all on CPU. Exits nonzero on any failure. ~10 min on a laptop-class
 # CPU.
 set -euo pipefail
@@ -22,7 +26,7 @@ cd "$(dirname "$0")/.."
 
 export JAX_PLATFORMS=cpu
 
-echo "== smoke 1/9: pytest -m 'fast and not slow and not heavy' (contract + oracle-parity lane) =="
+echo "== smoke 1/10: pytest -m 'fast and not slow and not heavy' (contract + oracle-parity lane) =="
 # "fast and not slow and not heavy": module-level fast marks would
 # otherwise pull a file's slow-marked wall-clock tests into the lane
 # (pytest -m fast selects anything CARRYING the mark; it does not
@@ -31,7 +35,7 @@ echo "== smoke 1/9: pytest -m 'fast and not slow and not heavy' (contract + orac
 # item 5).
 python -m pytest tests/ -q -m "fast and not slow and not heavy" -p no:cacheprovider
 
-echo "== smoke 2/9: 2-job ensemble serving e2e (CLI daemon) =="
+echo "== smoke 2/10: 2-job ensemble serving e2e (CLI daemon) =="
 SPOOL="$(mktemp -d /tmp/gravity_smoke.XXXXXX)"
 cleanup() {
     # Best-effort daemon shutdown + spool removal.
@@ -84,7 +88,7 @@ print("ensemble e2e OK:", {j: s["status"] for j, s in statuses.items()},
       "| compiles:", metrics["compile_counts"])
 EOF
 
-echo "== smoke 3/9: async host pipeline e2e (cadence run + SIGTERM + resume) =="
+echo "== smoke 3/10: async host pipeline e2e (cadence run + SIGTERM + resume) =="
 IODIR="$(mktemp -d /tmp/gravity_smoke_io.XXXXXX)"
 trap 'cleanup; rm -rf "$IODIR"' EXIT
 # Cadence-on pipelined run; preempt@500 delivers a real SIGTERM to the
@@ -120,7 +124,7 @@ print("io-pipeline e2e OK: resumed", stats["steps"], "steps,",
       "host_gap_frac", round(stats["host_gap_frac"], 3))
 EOF
 
-echo "== smoke 4/9: autotune cache round-trip (probe-on-miss, instant-on-hit) =="
+echo "== smoke 4/10: autotune cache round-trip (probe-on-miss, instant-on-hit) =="
 TUNEDIR="$(mktemp -d /tmp/gravity_smoke_tune.XXXXXX)"
 trap 'cleanup; rm -rf "$IODIR" "$TUNEDIR"' EXIT
 # Fresh cache dir + lowered fast-probe floor so plain `auto` runs a
@@ -157,10 +161,10 @@ print("autotune round-trip OK: backend", s1["backend"],
       "| probe", round(s1["autotune_probe_ms"], 1), "ms -> hit 0 ms")
 EOF
 
-echo "== smoke 5/9: serving chaos harness (kill -9 + adoption + fencing) =="
-bash scripts/chaos.sh
+echo "== smoke 5/10: serving chaos harness (kill -9 + adoption + fencing) =="
+bash scripts/chaos.sh 1 2
 
-echo "== smoke 6/9: job classes through the CLI daemon (fit + sweep) =="
+echo "== smoke 6/10: job classes through the CLI daemon (fit + sweep) =="
 # One fit + one sweep submitted through the REAL daemon from stage 2
 # (still serving), asserting completion + served-vs-solo parity
 # (docs/serving.md "Job classes").
@@ -270,7 +274,7 @@ z = np.load(sys.argv[1])
 assert 'min_sep' in z.files and len(z['min_sep']) == 4, z.files
 " "$SPOOL/sweep_verdicts.npz"
 
-echo "== smoke 7/9: unified telemetry (Prometheus scrape + Perfetto trace export) =="
+echo "== smoke 7/10: unified telemetry (Prometheus scrape + Perfetto trace export) =="
 # Against the STILL-LIVE stage-2 daemon: (a) a text/plain /metrics
 # scrape must be valid Prometheus exposition (validated by the strict
 # parser the tests use) including per-class latency histograms and
@@ -315,7 +319,7 @@ assert summary["coverage"] is not None and summary["coverage"] >= 0.9, \
 print("perfetto export OK:", summary)
 PYEOF
 
-echo "== smoke 8/9: nlist cell-list near field (p3m parity + standalone truncated parity) =="
+echo "== smoke 8/10: nlist cell-list near field (p3m parity + standalone truncated parity) =="
 # (a) The P3M near pass through the cell-list tile engine must match
 # the chunked gather near pass <= 1e-5 scaled on CPU (the ISSUE-9
 # acceptance bound); (b) the standalone nlist backend must match the
@@ -357,7 +361,7 @@ print("nlist near-field OK: p3m dev", float(dev),
       "| standalone dev", float(dev2))
 PYEOF
 
-echo "== smoke 9/9: numerics observatory (drift gauges + error histogram scrape, injected accuracy breach) =="
+echo "== smoke 9/10: numerics observatory (drift gauges + error histogram scrape, injected accuracy breach) =="
 # (a) Strict-parse the LIVE stage-2 daemon's Prometheus text and
 # assert the numerics families are present with real series: the
 # per-backend force-error histogram (sentinel probes ran — default
@@ -473,5 +477,15 @@ req = urllib.request.Request(
 urllib.request.urlopen(req, timeout=5).read()
 EOF
 kill "$NUM_PID" 2>/dev/null || true
+
+echo "== smoke 10/10: sharded adoption-resume chaos (SIGKILL mid-sharded-job -> resume from snapshot) =="
+# Chaos scenario 3 through the real CLI daemon on a 2-device CPU mesh:
+# a worker running a sharded-integrate job is SIGKILLed mid-run; the
+# survivor adopts, RESUMES from the last fenced progress snapshot
+# (resume step > 0), completes exactly once with <=1e-5 parity to an
+# uninterrupted solo run, and re-executes strictly fewer steps than a
+# from-zero respool (docs/robustness.md "Sharded & long-job failure
+# modes").
+bash scripts/chaos.sh 3
 
 echo "== smoke: all green =="

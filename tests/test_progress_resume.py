@@ -1,0 +1,472 @@
+"""Durable mid-run progress + adoption-resume (docs/robustness.md
+"Sharded & long-job failure modes"): fenced, checksummed snapshots in
+the spool; adoption/respool resumes every job class from its last
+verified snapshot instead of step 0 with uninterrupted-run parity;
+torn writes fall back; zombies are fenced; full disks fail one job's
+durability and nothing else; dead workers' registry files are reaped.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from gravity_tpu.config import SimulationConfig
+from gravity_tpu.serve import EnsembleScheduler, Spool
+from gravity_tpu.simulation import Simulator
+from gravity_tpu.utils.logging import ServingEventLogger
+
+
+def _cfg(n, steps=30, **kw):
+    kw.setdefault("model", "random")
+    kw.setdefault("dt", 3600.0)
+    kw.setdefault("integrator", "leapfrog")
+    kw.setdefault("force_backend", "dense")
+    return SimulationConfig(n=n, steps=steps, **kw)
+
+
+def _sched(spool_dir, ev_path, worker, **kw):
+    kw.setdefault("slots", 2)
+    kw.setdefault("slice_steps", 10)
+    kw.setdefault("reap_interval_s", 0.0)
+    kw.setdefault("lease_ttl_s", 300.0)
+    return EnsembleScheduler(
+        spool=Spool(spool_dir), worker_id=worker,
+        events=ServingEventLogger(ev_path, context={"worker": worker}),
+        **kw,
+    )
+
+
+def _max_rel(a, b):
+    a, b = np.asarray(a), np.asarray(b)
+    return float(np.max(np.abs(a - b) / np.maximum(np.abs(b), 1e-30)))
+
+
+def _events(path, kind=None):
+    evs = [json.loads(l) for l in open(path) if l.strip()]
+    return [e for e in evs if kind is None or e["event"] == kind]
+
+
+def _die(sched):
+    """Simulated kill -9: progress already queued lands (the writer
+    thread outlives the 'kill'), then leases lapse and never renew."""
+    sched.drain_io()
+    sched.leases.suspend(600.0)
+    sched.leases.backdate()
+
+
+# --- Spool progress primitives ---
+
+
+@pytest.mark.fast
+def test_progress_roundtrip_alternates_and_keeps_two(tmp_path):
+    spool = Spool(str(tmp_path / "s"))
+    arr1 = {"positions": np.ones((4, 3)), "velocities": np.zeros((4, 3)),
+            "masses": np.ones((4,)), "extra.m_adam": np.full((4, 3), 2.0)}
+    assert spool.write_progress("j", 10, arr1, {"iter_done": 1})
+    snap = spool.load_progress("j")
+    assert snap["step"] == 10
+    assert snap["extras"] == {"iter_done": 1}
+    np.testing.assert_array_equal(snap["arrays"]["extra.m_adam"],
+                                  arr1["extra.m_adam"])
+    arr2 = dict(arr1, positions=np.full((4, 3), 7.0))
+    assert spool.write_progress("j", 20, arr2, {})
+    snap = spool.load_progress("j")
+    assert snap["step"] == 20
+    np.testing.assert_array_equal(snap["arrays"]["positions"],
+                                  arr2["positions"])
+    # Two alternating files + one meta on disk; clear removes all.
+    names = sorted(os.listdir(spool.progress_dir))
+    assert names == ["j.a.npz", "j.b.npz", "j.json"]
+    spool.clear_progress("j")
+    assert os.listdir(spool.progress_dir) == []
+    assert spool.load_progress("j") is None
+
+
+@pytest.mark.fast
+def test_torn_progress_write_falls_back_to_previous(tmp_path, faults):
+    spool = Spool(str(tmp_path / "s"))
+    arrs = {"positions": np.ones((2, 3)), "velocities": np.zeros((2, 3)),
+            "masses": np.ones((2,))}
+    assert spool.write_progress("j", 10, arrs, {})
+    faults("torn_progress_write@0")
+    assert spool.write_progress(
+        "j", 20, dict(arrs, positions=np.full((2, 3), 9.0)), {}
+    )
+    # The newest entry's bytes are torn: the checksum rejects it and
+    # the PREVIOUS verified snapshot is the resume point.
+    snap = spool.load_progress("j")
+    assert snap is not None and snap["step"] == 10
+    np.testing.assert_array_equal(snap["arrays"]["positions"],
+                                  arrs["positions"])
+
+
+@pytest.mark.fast
+def test_zombie_progress_write_is_fenced(tmp_path):
+    from gravity_tpu.serve.leases import LeaseManager
+
+    root = str(tmp_path / "s")
+    spool = Spool(root)
+    a = LeaseManager(root, "a", ttl_s=300.0)
+    spool.attach_leases(a)
+    lease_a = a.claim("j")
+    assert spool.write_progress(
+        "j", 10, {"positions": np.ones((2, 3)),
+                  "velocities": np.zeros((2, 3)),
+                  "masses": np.ones((2,))}, {}, fence=lease_a.fence,
+    )
+    a.backdate()
+    b = LeaseManager(root, "b", ttl_s=300.0)
+    lease_b = b.claim("j")
+    assert lease_b.fence > lease_a.fence
+    spool_b = Spool(root)
+    spool_b.attach_leases(b)
+    assert spool_b.write_progress(
+        "j", 20, {"positions": np.full((2, 3), 5.0),
+                  "velocities": np.zeros((2, 3)),
+                  "masses": np.ones((2,))}, {}, fence=lease_b.fence,
+    )
+    # The zombie's stale snapshot is REJECTED — the adopter's newer
+    # one stands untouched.
+    assert spool.write_progress(
+        "j", 12, {"positions": np.zeros((2, 3)),
+                  "velocities": np.zeros((2, 3)),
+                  "masses": np.ones((2,))}, {}, fence=lease_a.fence,
+    ) is None
+    snap = spool_b.load_progress("j")
+    assert snap["step"] == 20
+    np.testing.assert_array_equal(
+        snap["arrays"]["positions"], np.full((2, 3), 5.0)
+    )
+
+
+# --- adoption-resume parity, all four vmap classes ---
+
+
+def test_adoption_resumes_integrate_with_parity(tmp_path):
+    spool_dir, ev = str(tmp_path / "spool"), str(tmp_path / "ev.jsonl")
+    cfg = _cfg(10, steps=40, seed=3)
+    a = _sched(spool_dir, ev, "a")
+    jid = a.submit(cfg, job_id="res-int")
+    a.run_round(); a.run_round()
+    assert a.jobs[jid].steps_done == 20
+    _die(a)
+    b = _sched(spool_dir, ev, "b")
+    b.housekeeping()
+    job = b.jobs[jid]
+    assert job.owned and job.steps_done == 20  # resumed, not step 0
+    # max_requeues counting unchanged: the adoption restart still
+    # bumps the persisted counter.
+    assert job.requeues == 1
+    b.run_until_idle()
+    assert b.status(jid)["status"] == "completed"
+    solo = Simulator(cfg).run()["final_state"]
+    assert _max_rel(b.result(jid).positions, solo.positions) <= 1e-5
+    resumed = _events(ev, "adopted_resumed")
+    assert resumed and resumed[0]["resume_step"] == 20
+    assert resumed[0]["from_worker"] == "a"
+    # Resume gauge set at adoption, dropped at finish.
+    snap = b.metrics_snapshot()["registry"]
+    fam = snap.get("gravity_job_resume_step") or {}
+    assert all(
+        dict(s.get("labels") or {}).get("job") != jid
+        for s in fam.get("series", [])
+    )
+    b.drain_io()
+    assert b.spool.load_progress(jid) is None  # cleared at completion
+    b.close_io(); a.close_io()
+
+
+def test_adoption_resumes_fit_with_optimizer_moments(tmp_path):
+    """Fit resumes mid-OPTIMIZATION: Adam moments + iteration counter
+    ride the snapshot, so the adopter's continuation equals an
+    uninterrupted run's fitted parameters."""
+    from test_serve_jobs import _fit_params
+
+    cfg = _cfg(6, steps=20, seed=4)
+    _st, params = _fit_params(cfg, iters=4)
+    # Uninterrupted reference through the SAME serving machinery.
+    ref_dir, ref_ev = str(tmp_path / "ref"), str(tmp_path / "rev.jsonl")
+    ref = _sched(ref_dir, ref_ev, "r")
+    rid = ref.submit(cfg, job_type="fit", params=dict(params))
+    ref.run_until_idle()
+    assert ref.jobs[rid].status == "completed"
+    ref_v = np.asarray(ref.result_data(rid)["velocities"])
+
+    spool_dir, ev = str(tmp_path / "spool"), str(tmp_path / "ev.jsonl")
+    a = _sched(spool_dir, ev, "a")
+    jid = a.submit(cfg, job_id="res-fit", job_type="fit",
+                   params=dict(params))
+    a.run_round(); a.run_round()  # 2 of 4 iterations (rollout=20)
+    done_at_death = a.jobs[jid].steps_done
+    assert 0 < done_at_death < 4
+    _die(a)
+    b = _sched(spool_dir, ev, "b")
+    b.housekeeping()
+    job = b.jobs[jid]
+    assert job.steps_done == done_at_death
+    # The optimizer state survived the snapshot round-trip.
+    assert {"v", "m_adam", "v_adam", "iter_done"} <= set(job.extra_state)
+    b.run_until_idle()
+    assert b.status(jid)["status"] == "completed"
+    got_v = np.asarray(b.result_data(jid)["velocities"])
+    assert _max_rel(got_v, ref_v) <= 1e-5
+    assert _events(ev, "adopted_resumed")
+    b.close_io(); a.close_io(); ref.close_io()
+
+
+def test_adoption_resumes_sweep_members_with_verdict_parity(tmp_path):
+    cfg = _cfg(8, steps=30, seed=7)
+    params = {"members": 2, "spread": 0.03}
+    ref_dir, ref_ev = str(tmp_path / "ref"), str(tmp_path / "rev.jsonl")
+    ref = _sched(ref_dir, ref_ev, "r")
+    rid = ref.submit(cfg, job_type="sweep", params=dict(params))
+    ref.run_until_idle()
+    ref_arrays = ref.result_data(rid)
+
+    spool_dir, ev = str(tmp_path / "spool"), str(tmp_path / "ev.jsonl")
+    a = _sched(spool_dir, ev, "a")
+    jid = a.submit(cfg, job_id="res-sweep", job_type="sweep",
+                   params=dict(params))
+    a.run_round()  # both members advance 10 of 30
+    _die(a)
+    b = _sched(spool_dir, ev, "b")
+    b.housekeeping()
+    resumed_members = [
+        j for j in b.jobs.values()
+        if j.job_type == "sweep-member" and j.steps_done > 0
+    ]
+    assert resumed_members, "members did not resume from snapshots"
+    b.run_until_idle()
+    assert b.status(jid)["status"] == "completed"
+    got = b.result_data(jid)
+    assert list(got["completed"]) == [1, 1]
+    for k in ("min_sep", "energy_drift"):
+        assert _max_rel(got[k], ref_arrays[k]) <= 1e-5, k
+    assert _events(ev, "adopted_resumed")
+    b.close_io(); a.close_io(); ref.close_io()
+
+
+def test_adoption_resumes_watch_with_detector_flags(tmp_path):
+    """Watch resumes mid-run with its detector carries ('was inside'
+    flags) and accumulated event log restored — the adopter's final
+    event set equals an uninterrupted run's, no duplicates/drops."""
+    cfg = _cfg(6, steps=30, seed=2)
+    # A radius wide enough that random-cube bodies cross it.
+    params = {"radius": 5e11, "max_events": 8}
+    ref_dir, ref_ev = str(tmp_path / "ref"), str(tmp_path / "rev.jsonl")
+    ref = _sched(ref_dir, ref_ev, "r")
+    rid = ref.submit(cfg, job_type="watch", params=dict(params))
+    ref.run_until_idle()
+    ref_arrays = ref.result_data(rid)
+
+    spool_dir, ev = str(tmp_path / "spool"), str(tmp_path / "ev.jsonl")
+    a = _sched(spool_dir, ev, "a")
+    jid = a.submit(cfg, job_id="res-watch", job_type="watch",
+                   params=dict(params))
+    a.run_round()
+    _die(a)
+    b = _sched(spool_dir, ev, "b")
+    b.housekeeping()
+    job = b.jobs[jid]
+    assert job.steps_done == 10
+    assert "in_enc" in (job.extra_state or {})  # flags restored
+    b.run_until_idle()
+    assert b.status(jid)["status"] == "completed"
+    got = b.result_data(jid)
+    np.testing.assert_array_equal(
+        got["event_step"], ref_arrays["event_step"]
+    )
+    np.testing.assert_array_equal(got["event_i"], ref_arrays["event_i"])
+    assert b.jobs[jid].result_payload == ref.jobs[rid].result_payload
+    b.close_io(); a.close_io(); ref.close_io()
+
+
+def test_progress_disabled_restarts_clean(tmp_path):
+    """--progress-every 0: the pre-PR restart-from-zero semantics are
+    still selectable (and still correct)."""
+    spool_dir, ev = str(tmp_path / "spool"), str(tmp_path / "ev.jsonl")
+    cfg = _cfg(8, steps=20, seed=6)
+    a = _sched(spool_dir, ev, "a", progress_every=0)
+    jid = a.submit(cfg, job_id="no-prog")
+    a.run_round()
+    _die(a)
+    assert Spool(spool_dir).load_progress(jid) is None
+    b = _sched(spool_dir, ev, "b", progress_every=0)
+    b.housekeeping()
+    assert b.jobs[jid].steps_done == 0  # clean restart
+    b.run_until_idle()
+    solo = Simulator(cfg).run()["final_state"]
+    assert _max_rel(b.result(jid).positions, solo.positions) <= 1e-5
+    assert not _events(ev, "adopted_resumed")
+    b.close_io(); a.close_io()
+
+
+# --- disk-full hardening ---
+
+
+def test_disk_full_result_write_fails_job_durability_only(
+    tmp_path, faults
+):
+    """ENOSPC on the result .npz: THAT job's durability degrades
+    (typed spool_error, result served from memory) — no round failure,
+    batchmates and later jobs untouched."""
+    spool_dir, ev = str(tmp_path / "spool"), str(tmp_path / "ev.jsonl")
+    # progress_every=0 so the injected token hits the RESULT write.
+    sched = _sched(spool_dir, ev, "w", progress_every=0)
+    faults("disk_full@0")
+    j1 = sched.submit(_cfg(8, steps=10, seed=1))
+    j2 = sched.submit(_cfg(8, steps=10, seed=2))
+    sched.run_until_idle()
+    assert sched.jobs[j1].status == "completed"
+    assert sched.jobs[j2].status == "completed"
+    errs = _events(ev, "spool_error")
+    assert len(errs) == 1 and "injected disk_full" in errs[0]["error"]
+    assert errs[0]["write"] == "result"
+    failed_job = errs[0]["job"]
+    other = j2 if failed_job == j1 else j1
+    # The failed job still serves its result from memory; the other
+    # job's .npz landed on "disk".
+    assert sched.result(failed_job) is not None
+    assert os.path.exists(sched.spool.result_path(other))
+    assert not os.path.exists(sched.spool.result_path(failed_job))
+    assert not _events(ev, "failed")
+    recorder_kinds = [
+        e.get("event") for e in sched.telemetry.recorder.snapshot()
+    ]
+    assert "round_error" not in recorder_kinds
+    sched.close_io()
+
+
+def test_disk_full_progress_write_keeps_job_running(tmp_path, faults):
+    spool_dir, ev = str(tmp_path / "spool"), str(tmp_path / "ev.jsonl")
+    sched = _sched(spool_dir, ev, "w")
+    faults("disk_full@0")  # the FIRST durable write = round-1 progress
+    jid = sched.submit(_cfg(8, steps=30, seed=3))
+    sched.run_until_idle()
+    assert sched.jobs[jid].status == "completed"
+    errs = _events(ev, "spool_error")
+    assert errs and errs[0]["write"] == "progress"
+    # Later snapshots and the result landed normally.
+    assert os.path.exists(sched.spool.result_path(jid))
+    sched.close_io()
+
+
+def test_record_write_oserror_is_spool_error_not_round_failure(
+    tmp_path, monkeypatch
+):
+    spool_dir, ev = str(tmp_path / "spool"), str(tmp_path / "ev.jsonl")
+    sched = _sched(spool_dir, ev, "w")
+    jid = sched.submit(_cfg(8, steps=10, seed=4))
+    real = sched.spool.write_job
+    calls = {"n": 0}
+
+    def flaky(job):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise OSError(28, "No space left on device")
+        return real(job)
+
+    monkeypatch.setattr(sched.spool, "write_job", flaky)
+    sched.run_until_idle()
+    assert sched.jobs[jid].status == "completed"
+    errs = _events(ev, "spool_error")
+    assert any(e.get("write") == "record" for e in errs)
+    sched.close_io()
+
+
+def test_disk_full_at_admission_rejects_submit(tmp_path, monkeypatch):
+    """The ADMISSION persist must be durable-or-rejected: accepting a
+    job whose spool record never landed would be accept-and-maybe-lose
+    (no peer could ever adopt it after a crash)."""
+    spool_dir, ev = str(tmp_path / "spool"), str(tmp_path / "ev.jsonl")
+    sched = _sched(spool_dir, ev, "w")
+
+    def enospc(job):
+        raise OSError(28, "No space left on device")
+
+    monkeypatch.setattr(sched.spool, "write_job", enospc)
+    with pytest.raises(RuntimeError, match="cannot persist"):
+        sched.submit(_cfg(8, steps=10), job_id="doomed")
+    # The local enqueue was unwound and the lease released: nothing
+    # ghost-queued, and the id is reusable once the disk recovers.
+    assert "doomed" not in sched.jobs
+    assert sched.queue_depth == 0
+    # No phantom lifecycle in the durable stream: a rejected submit
+    # emits no `submitted` event (the spool_error is the audit trail).
+    assert not _events(ev, "submitted")
+    assert _events(ev, "spool_error")
+    monkeypatch.undo()
+    jid = sched.submit(_cfg(8, steps=10), job_id="doomed")
+    sched.run_until_idle()
+    assert sched.jobs[jid].status == "completed"
+    sched.close_io()
+
+
+def test_terminal_clear_serializes_behind_queued_snapshot(tmp_path):
+    """A snapshot still queued in the HostWriter when its job goes
+    terminal must land BEFORE the clear — a synchronous clear would
+    execute first and the late write would orphan re-created snapshot
+    files forever (terminal records are never re-scanned)."""
+    import threading
+
+    from gravity_tpu.state import ParticleState
+
+    spool_dir, ev = str(tmp_path / "spool"), str(tmp_path / "ev.jsonl")
+    sched = _sched(spool_dir, ev, "w")
+    jid = sched.submit(_cfg(8, steps=30, seed=5))
+    job = sched.jobs[jid]
+    gate = threading.Event()
+    sched._io.submit(gate.wait)  # wedge the writer
+    state = ParticleState.create(
+        np.ones((8, 3)), np.zeros((8, 3)), np.ones((8,))
+    )
+    job.steps_done = 10
+    sched._spool_progress_async(job, state, {})  # queued, not landed
+    assert sched.cancel(jid)  # terminal -> clear queued BEHIND it
+    gate.set()
+    sched.drain_io()
+    assert sched.spool.load_progress(jid) is None
+    assert os.listdir(sched.spool.progress_dir) == []
+    sched.close_io()
+
+
+# --- worker-registry reaping ---
+
+
+@pytest.mark.fast
+def test_housekeeping_reaps_dead_same_host_worker_entries(tmp_path):
+    from gravity_tpu.serve.leases import _local_host, pid_start
+    from gravity_tpu.utils.hostio import atomic_write_json
+
+    spool_dir, ev = str(tmp_path / "spool"), str(tmp_path / "ev.jsonl")
+    sched = _sched(spool_dir, ev, "live-w")
+    workers = os.path.join(spool_dir, "workers")
+    os.makedirs(workers, exist_ok=True)
+    host = _local_host()
+    # Dead same-host entry (pid long gone) + its metrics file.
+    atomic_write_json(os.path.join(workers, "dead-w.json"),
+                      {"host": "127.0.0.1", "port": 1, "pid": 2 ** 22,
+                       "pid_start": "1", "host_name": host,
+                       "worker_id": "dead-w"})
+    open(os.path.join(workers, "dead-w.metrics.json"), "w").write("{}")
+    # Live same-host entry (our own pid instance).
+    atomic_write_json(os.path.join(workers, "live-peer.json"),
+                      {"host": "127.0.0.1", "port": 2,
+                       "pid": os.getpid(),
+                       "pid_start": pid_start(os.getpid()),
+                       "host_name": host, "worker_id": "live-peer"})
+    # Remote-host entry: unprobeable from here, must survive.
+    atomic_write_json(os.path.join(workers, "remote-w.json"),
+                      {"host": "10.0.0.9", "port": 3, "pid": 1,
+                       "host_name": "elsewhere",
+                       "worker_id": "remote-w"})
+    sched.housekeeping()
+    left = sorted(os.listdir(workers))
+    assert "dead-w.json" not in left
+    assert "dead-w.metrics.json" not in left
+    assert {"live-peer.json", "remote-w.json"} <= set(left)
+    reaped = _events(ev, "worker_reaped")
+    assert reaped and reaped[0]["worker_id"] == "dead-w"
+    sched.close_io()

@@ -1,0 +1,287 @@
+"""The ``sharded-integrate`` job class (serve/jobs/sharded.py): one
+big-n job across the device mesh as an exclusive single-slot resident,
+under the ordinary admission/lease/breaker contracts — plus the
+elastic degrade ladder (mesh loss -> fewer devices -> solo -> dense
+floor, supervisor.next_rung) it heals through. The conftest pins 8
+virtual CPU devices, so real 2/4/8-way meshes run in-process.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from gravity_tpu.config import SimulationConfig
+from gravity_tpu.serve import EnsembleScheduler, Spool
+from gravity_tpu.serve.jobs import JobValidationError, get_class
+from gravity_tpu.simulation import Simulator
+from gravity_tpu.supervisor import next_rung, parse_sharded_backend
+from gravity_tpu.utils.logging import ServingEventLogger
+
+
+def _cfg(n, steps=30, **kw):
+    kw.setdefault("model", "random")
+    kw.setdefault("dt", 3600.0)
+    kw.setdefault("integrator", "leapfrog")
+    kw.setdefault("force_backend", "dense")
+    return SimulationConfig(n=n, steps=steps, **kw)
+
+
+def _max_rel(a, b):
+    return float(
+        np.max(np.abs(np.asarray(a) - np.asarray(b))
+               / np.maximum(np.abs(np.asarray(b)), 1e-30))
+    )
+
+
+# --- the elastic ladder (supervisor.next_rung) ---
+
+
+@pytest.mark.fast
+def test_next_rung_walks_elastic_then_exact_ladder():
+    assert next_rung("sharded/8/dense") == "sharded/4/dense"
+    assert next_rung("sharded/4/dense") == "sharded/2/dense"
+    assert next_rung("sharded/2/dense") == "dense"  # solo form
+    assert next_rung("sharded/2/pallas") == "pallas"
+    assert next_rung("pallas") == "chunked"  # classic ladder resumes
+    # Odd device counts halve toward solo too.
+    assert next_rung("sharded/6/chunked") == "sharded/3/chunked"
+    assert next_rung("sharded/3/chunked") == "chunked"
+    # Unparseable sharded forms fall off the ladder, not crash.
+    assert next_rung("sharded/x/dense") is None
+    assert next_rung("sharded/") is None
+
+
+@pytest.mark.fast
+def test_parse_sharded_backend():
+    assert parse_sharded_backend("sharded/4/dense") == (4, "dense")
+    assert parse_sharded_backend("dense") == (None, None)
+    assert parse_sharded_backend("sharded/0/dense") == (None, None)
+    assert parse_sharded_backend("sharded/4/") == (None, None)
+
+
+# --- keying + validation ---
+
+
+@pytest.mark.fast
+def test_sharded_key_is_exclusive_and_mesh_padded():
+    cls = get_class("sharded-integrate")
+    cfg = _cfg(10)
+    params = cls.validate(cfg, {"devices": 4})
+    key = cls.batch_key(cfg, params, slots=4, min_bucket=16)
+    assert key.slots == 1  # exclusive: the job IS the batch
+    assert key.backend == "sharded/4/dense"
+    assert key.bucket_n == 12  # ceil(10/4)*4 — shards evenly
+    assert dict(key.extra)["strategy"] == "allgather"
+    # Solo form keys to the bare local backend, no padding constraint.
+    solo = cls.batch_key(
+        cfg, cls.validate(cfg, {"devices": 1}), slots=4, min_bucket=16
+    )
+    assert solo.backend == "dense" and solo.bucket_n == 10
+    # No bucket cap: a far-beyond-MAX_BUCKET n keys fine.
+    big = _cfg(100_000, force_backend="chunked")
+    bkey = cls.batch_key(
+        big, cls.validate(big, {"devices": 8}), slots=4, min_bucket=16
+    )
+    assert bkey.bucket_n == 100_000 and bkey.backend == "sharded/8/chunked"
+
+
+@pytest.mark.fast
+def test_sharded_validation_rejections():
+    cls = get_class("sharded-integrate")
+    cfg = _cfg(8)
+    for params, match in (
+        ({"strategy": "mpi"}, "strategy"),
+        ({"devices": "many"}, "devices"),
+        ({"devices": 0}, "out of range"),
+        ({"bogus": 1}, "unknown"),
+    ):
+        with pytest.raises(JobValidationError, match=match):
+            cls.validate(cfg, params)
+    with pytest.raises(JobValidationError, match="local kernel"):
+        cls.validate(_cfg(8, force_backend="tree"), {})
+    with pytest.raises(JobValidationError, match="not servable"):
+        cls.batch_key(
+            _cfg(8, periodic_box=1.0), cls.validate(_cfg(8), {}),
+            slots=2, min_bucket=16,
+        )
+    with pytest.raises(JobValidationError, match="integrator"):
+        cls.batch_key(
+            _cfg(8, integrator="rk4", adaptive=True),
+            cls.validate(_cfg(8), {}), slots=2, min_bucket=16,
+        )
+
+
+# --- served parity, mesh + solo forms ---
+
+
+def test_sharded_job_matches_solo_run_on_mesh():
+    cfg = _cfg(24, steps=40, seed=5)
+    with EnsembleScheduler(slots=2, slice_steps=10) as sched:
+        jid = sched.submit(cfg, job_type="sharded-integrate",
+                           params={"devices": 4})
+        assert sched.jobs[jid].key_cache.backend == "sharded/4/dense"
+        sched.run_until_idle()
+        assert sched.jobs[jid].status == "completed", \
+            sched.jobs[jid].error
+        got = sched.result(jid)
+        solo = Simulator(cfg).run()["final_state"]
+        assert _max_rel(got.positions, solo.positions) <= 1e-5
+        assert _max_rel(got.velocities, solo.velocities) <= 1e-5
+
+
+def test_sharded_solo_form_and_ring_strategy():
+    cfg = _cfg(16, steps=20, seed=9)
+    with EnsembleScheduler(slots=2, slice_steps=10) as sched:
+        solo_id = sched.submit(cfg, job_type="sharded-integrate",
+                               params={"devices": 1})
+        ring_id = sched.submit(cfg, job_type="sharded-integrate",
+                               params={"devices": 4,
+                                       "strategy": "ring"})
+        sched.run_until_idle()
+        ref = np.asarray(
+            Simulator(cfg).run()["final_state"].positions
+        )
+        for jid in (solo_id, ring_id):
+            assert sched.jobs[jid].status == "completed", \
+                sched.jobs[jid].error
+            assert _max_rel(sched.result(jid).positions, ref) <= 1e-5
+
+
+# --- elastic degradation under injected faults ---
+
+
+def test_mesh_fail_walks_elastic_ladder_to_completion(
+    tmp_path, faults
+):
+    """Every mesh build fails (injected mesh loss): the breaker opens
+    per sharded form and the requeue re-keys down the elastic ladder —
+    8 -> 4 -> 2 -> solo dense — where the job completes with parity.
+    Each rung is an audited breaker_open + respooled event pair."""
+    faults("mesh_fail@0x99")
+    ev_path = str(tmp_path / "ev.jsonl")
+    cfg = _cfg(16, steps=20, seed=7)
+    with EnsembleScheduler(
+        slots=2, slice_steps=10, breaker_threshold=1,
+        events=ServingEventLogger(ev_path), max_requeues=5,
+    ) as sched:
+        jid = sched.submit(cfg, job_type="sharded-integrate",
+                           params={"devices": 8})
+        sched.run_until_idle()
+        job = sched.jobs[jid]
+        assert job.status == "completed", job.error
+        # The winning form was the solo floor of the SAME local kernel.
+        assert job.key_cache.backend == "dense"
+        assert job.requeues == 3  # one per failed rung: 8, 4, 2
+        ref = np.asarray(
+            Simulator(cfg).run()["final_state"].positions
+        )
+        assert _max_rel(sched.result(jid).positions, ref) <= 1e-5
+    events = [json.loads(l) for l in open(ev_path)]
+    opened = [e["backend"] for e in events
+              if e["event"] == "breaker_open"]
+    assert opened == [
+        "sharded/8/dense", "sharded/4/dense", "sharded/2/dense"
+    ], opened
+
+
+def test_collective_stall_fails_round_and_resumes_from_snapshot(
+    tmp_path, faults
+):
+    """A hung collective at the second slice fails the round with the
+    typed error; the job respools FROM ITS PROGRESS SNAPSHOT (the
+    first slice's 10 steps are not re-executed) and completes with
+    parity on the retry."""
+    faults("collective_stall@1x1")
+    spool_dir = str(tmp_path / "spool")
+    ev_path = str(tmp_path / "ev.jsonl")
+    cfg = _cfg(12, steps=30, seed=13)
+    with EnsembleScheduler(
+        slots=2, slice_steps=10, spool=Spool(spool_dir),
+        events=ServingEventLogger(ev_path), worker_id="w",
+        lease_ttl_s=300.0, reap_interval_s=0.0,
+    ) as sched:
+        jid = sched.submit(cfg, job_type="sharded-integrate",
+                           params={"devices": 2})
+        sched.run_round()
+        sched.drain_io()  # the round-1 snapshot must be durable
+        with pytest.raises(Exception, match="collective stall"):
+            sched.run_round()
+        sched.run_until_idle()
+        job = sched.jobs[jid]
+        assert job.status == "completed", job.error
+        assert job.requeues == 1
+        ref = np.asarray(
+            Simulator(cfg).run()["final_state"].positions
+        )
+        assert _max_rel(sched.result(jid).positions, ref) <= 1e-5
+    events = [json.loads(l) for l in open(ev_path)]
+    respooled = [e for e in events if e["event"] == "respooled"]
+    assert respooled and respooled[-1]["resume_step"] == 10, respooled
+
+
+def test_mesh_fail_requeues_capped_by_poison(tmp_path, faults):
+    """Persistent mesh failure with the breaker held closed (no
+    reroute): the job burns one requeue per admission attempt and goes
+    terminal poisoned at the cap instead of spinning forever."""
+    faults("mesh_fail@0x99")
+    ev_path = str(tmp_path / "ev.jsonl")
+    with EnsembleScheduler(
+        slots=2, slice_steps=10, breaker_threshold=99,
+        events=ServingEventLogger(ev_path), max_requeues=2,
+    ) as sched:
+        jid = sched.submit(_cfg(8, steps=20),
+                           job_type="sharded-integrate",
+                           params={"devices": 4})
+        sched.run_until_idle()
+        job = sched.jobs[jid]
+        assert job.status == "failed"
+        assert "poisoned" in (job.error or "")
+    events = [json.loads(l) for l in open(ev_path)]
+    assert any(e["event"] == "poisoned" for e in events)
+
+
+# --- fault grammar + docs pins ---
+
+
+@pytest.mark.fast
+def test_new_fault_spec_grammar():
+    from gravity_tpu.utils.faults import FaultPlan, install, reset
+
+    plan = FaultPlan.parse(
+        "mesh_fail@2x3,collective_stall@1x5,"
+        "torn_progress_write@0,disk_full@1x2"
+    )
+    kinds = [f.kind for f in plan._faults]
+    assert kinds == ["mesh_fail", "collective_stall",
+                     "torn_progress_write", "disk_full"]
+    try:
+        install("collective_stall@1x5")
+        from gravity_tpu.utils.faults import collective_stall_secs
+
+        assert collective_stall_secs(0) == 0.0
+        assert collective_stall_secs(1) == 5.0
+        assert collective_stall_secs(2) == 0.0  # fires once
+    finally:
+        reset()
+
+
+@pytest.mark.fast
+def test_docs_pin_every_fault_spec_kind():
+    """Satellite docs-lint: every injectable fault kind — solo and
+    serving — appears in docs/robustness.md's fault tables."""
+    import os
+
+    from gravity_tpu.utils.faults import SERVING_KINDS
+
+    doc = open(os.path.join(
+        os.path.dirname(__file__), "..", "docs", "robustness.md"
+    )).read()
+    missing = [
+        kind for kind in
+        ("diverge", "transient", "preempt", "backend") + SERVING_KINDS
+        if f"`{kind}" not in doc
+    ]
+    assert not missing, (
+        "docs/robustness.md fault tables missing: " + ", ".join(missing)
+    )
