@@ -3,7 +3,7 @@
 
 PYTEST := env JAX_PLATFORMS=cpu python -m pytest
 
-.PHONY: smoke chaos fast test nightly
+.PHONY: smoke chaos fast test nightly lint
 
 # The documented pre-push check: the -m fast contract lane plus the
 # serving e2es through the real CLI daemon — 2-job ensemble, chaos
@@ -20,6 +20,13 @@ smoke:
 # stages 5 (scenarios 1-2) and 10 (scenario 3).
 chaos:
 	bash scripts/chaos.sh
+
+# The AST invariant analyzer (docs/static-analysis.md): donation
+# safety, trace purity, fenced spool writes, flock weight, telemetry
+# and fault-spec drift. Exit 1 on any non-baselined finding. Also a
+# tier-1 test (tests/test_lint.py) and smoke stage 11/11.
+lint:
+	env JAX_PLATFORMS=cpu python -m gravity_tpu lint
 
 fast:
 	$(PYTEST) tests/ -q -m 'fast and not slow and not heavy'

@@ -1,0 +1,26 @@
+"""gravity_tpu.analysis — the AST invariant analyzer behind
+``gravity_tpu lint`` / ``make lint`` / ``tests/test_lint.py``
+(docs/static-analysis.md).
+
+Pure-AST (nothing in the analyzed tree is imported), per-file
+parallel, with six checkers encoding the repo's hard-won invariants:
+donation-safety, trace-purity, fenced-write, flock-weight,
+telemetry-drift, fault-coverage.
+"""
+
+from .checkers import CHECKER_IDS, CHECKERS, make_checkers
+from .core import Baseline, Checker, Finding
+from .driver import analyze_file, collect_files, main, run_analysis
+
+__all__ = [
+    "Baseline",
+    "CHECKERS",
+    "CHECKER_IDS",
+    "Checker",
+    "Finding",
+    "analyze_file",
+    "collect_files",
+    "main",
+    "run_analysis",
+    "make_checkers",
+]

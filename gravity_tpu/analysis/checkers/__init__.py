@@ -1,0 +1,36 @@
+"""The checker registry — data-driven so PR 13+ adds a rule by
+appending one class (docs/static-analysis.md "Adding a checker")."""
+
+from __future__ import annotations
+
+from .donation import DonationSafety
+from .faultspec import FaultCoverage
+from .fencing import FencedWrite
+from .flockweight import FlockWeight
+from .purity import TracePurity
+from .telemetry_drift import TelemetryDrift
+
+CHECKERS = (
+    DonationSafety,
+    TracePurity,
+    FencedWrite,
+    FlockWeight,
+    TelemetryDrift,
+    FaultCoverage,
+)
+
+CHECKER_IDS = tuple(cls.id for cls in CHECKERS)
+
+
+def make_checkers(ids=None):
+    """Instantiate the registry (optionally a subset by id)."""
+    if ids is None:
+        return [cls() for cls in CHECKERS]
+    ids = list(ids)
+    unknown = set(ids) - set(CHECKER_IDS)
+    if unknown:
+        raise ValueError(
+            f"unknown checker ids {sorted(unknown)}; "
+            f"known: {list(CHECKER_IDS)}"
+        )
+    return [cls() for cls in CHECKERS if cls.id in ids]

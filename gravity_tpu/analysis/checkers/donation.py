@@ -1,0 +1,186 @@
+"""donation-safety: a name passed at a donated position of a
+``donate_argnums`` call site must not be read again in the same scope
+(the PR-4 class: jax marks donated buffers deleted on every platform,
+so a later read is a runtime error on TPU and a silent correctness
+hazard behind ``donation_supported()`` guards on CPU).
+
+Detection is lexical, per scope, in execution-ish order:
+
+1. Collect every callable the module marks as donating — assignments
+   like ``f = jax.jit(g, donate_argnums=(0, 1))`` (names AND
+   ``self.attr`` targets), ``@partial(jax.jit, donate_argnums=...)``
+   decorators, and one level of aliasing (``h = f`` / ``h = f if p
+   else g``) — with the donated positional indices.
+2. Walk each scope's statements in order. A statement is processed as
+   loads -> donations -> stores: ``state, acc = run_block(state, acc)``
+   re-binds its own carries and stays clean, while a later
+   ``energy(state)`` after ``run_block(state, acc)`` without a re-bind
+   is flagged.
+
+Stores anywhere in a later statement (any branch) clear the name —
+the checker prefers a missed diagonal case over false positives.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import (
+    Checker, call_name, dotted_name, iter_statements, walk_statement,
+)
+
+# Transform entry points whose result donates when donate_argnums /
+# donate_argnames is present.
+_DONATING_WRAPPERS = ("jit", "pjit", "pmap")
+
+
+def _donated_positions(call: ast.Call):
+    """The constant donated argnums of a jit/pjit/pmap call, else None."""
+    tail = call_name(call).rsplit(".", 1)[-1]
+    if tail not in _DONATING_WRAPPERS and tail != "partial":
+        return None
+    if tail == "partial":
+        # functools.partial(jax.jit, donate_argnums=...) as decorator.
+        if not call.args:
+            return None
+        inner = call.args[0]
+        if dotted_name(inner).rsplit(".", 1)[-1] not in _DONATING_WRAPPERS:
+            return None
+    for kw in call.keywords:
+        if kw.arg in ("donate_argnums", "donate_argnames"):
+            if kw.arg == "donate_argnames":
+                # Positions unknown statically; treat every positional
+                # arg of the call site as potentially donated.
+                return "all"
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                return frozenset((v.value,))
+            if isinstance(v, (ast.Tuple, ast.List)):
+                out = set()
+                for el in v.elts:
+                    if not (isinstance(el, ast.Constant)
+                            and isinstance(el.value, int)):
+                        return "all"
+                    out.add(el.value)
+                return frozenset(out)
+            return "all"
+    return None
+
+
+class DonationSafety(Checker):
+    id = "donation-safety"
+    invariant = ("a buffer donated to a jitted call is never read "
+                 "again in the donating scope")
+    bug_class = "PR-4 use-after-donation"
+    hint = ("re-bind the name from the call's result, copy before the "
+            "donating call, or drop donate_argnums for this arg")
+
+    def check(self, ctx):
+        donors = self._collect_donors(ctx.tree)
+        if not donors:
+            return []
+        findings = []
+        for scope in self._scopes(ctx.tree):
+            findings.extend(self._check_scope(ctx, scope, donors))
+        return [
+            f for f in findings
+            if not ctx.line_suppressed(f.line, self.id)
+        ]
+
+    # --- donor collection ---
+
+    def _collect_donors(self, tree: ast.Module) -> dict:
+        """{terminal name: donated positions} for donating callables;
+        keys are simple names and attribute tails (``self.f`` -> "f")."""
+        donors: dict = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign) and isinstance(
+                    node.value, ast.Call):
+                pos = _donated_positions(node.value)
+                if pos is not None:
+                    for tgt in node.targets:
+                        name = dotted_name(tgt).rsplit(".", 1)[-1]
+                        if name:
+                            donors[name] = pos
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if isinstance(dec, ast.Call):
+                        pos = _donated_positions(dec)
+                        if pos is not None:
+                            donors[node.name] = pos
+        # One aliasing level: run_block = self._donated_fn (incl. the
+        # `a if p else b` router idiom) inherits the donated positions.
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+                continue
+            tgt = node.targets[0]
+            if not isinstance(tgt, ast.Name) or tgt.id in donors:
+                continue
+            sources = [node.value]
+            if isinstance(node.value, ast.IfExp):
+                sources = [node.value.body, node.value.orelse]
+            merged = None
+            for src in sources:
+                name = dotted_name(src).rsplit(".", 1)[-1]
+                if name in donors:
+                    pos = donors[name]
+                    if merged is None:
+                        merged = pos
+                    elif merged != pos:
+                        merged = "all"
+            if merged is not None:
+                donors[tgt.id] = merged
+        return donors
+
+    def _scopes(self, tree: ast.Module):
+        yield tree
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield node
+
+    # --- the lexical dataflow walk ---
+
+    def _check_scope(self, ctx, scope, donors):
+        findings = []
+        dead: dict = {}   # name -> (donor callee, donation line)
+        for stmt in iter_statements(scope.body):
+            loads, stores, donations = self._classify(stmt, donors)
+            for name, node in loads:
+                if name in dead:
+                    callee, dline = dead[name]
+                    findings.append(ctx.finding(
+                        self, node,
+                        f"`{name}` is read after being donated to "
+                        f"`{callee}` at line {dline} — the donated "
+                        f"buffer is deleted by XLA",
+                        key=f"{ctx.qualname(scope) or '<module>'}:{name}",
+                    ))
+                    del dead[name]   # one finding per donation
+            for name, callee, line in donations:
+                dead[name] = (callee, line)
+            for name in stores:
+                dead.pop(name, None)
+        return findings
+
+    def _classify(self, stmt, donors):
+        loads, stores, donations = [], set(), []
+        for node in walk_statement(stmt):
+            if isinstance(node, ast.Name):
+                if isinstance(node.ctx, ast.Load):
+                    loads.append((node.id, node))
+                else:   # Store / Del both end the dead range
+                    stores.add(node.id)
+            elif isinstance(node, ast.Call):
+                callee = call_name(node)
+                tail = callee.rsplit(".", 1)[-1]
+                pos = donors.get(tail)
+                if pos is None:
+                    continue
+                for i, arg in enumerate(node.args):
+                    if pos != "all" and i not in pos:
+                        continue
+                    if isinstance(arg, ast.Name):
+                        donations.append(
+                            (arg.id, callee or tail, node.lineno)
+                        )
+        return loads, stores, donations

@@ -1,0 +1,246 @@
+"""trace-purity: host-side effects must not be traced into jitted
+bodies (the recompile/leak class: a ``time.time()`` inside a scanned
+step function executes ONCE at trace time and bakes a constant into
+the program; ``np.random`` silently freezes entropy; ``open``/``os.*``
+do host I/O per retrace; ``float()``/``.item()``/Python ``if`` on a
+tracer raise ``TracerConversionError`` or force a recompile per
+value).
+
+Reachability, not decoration, defines "inside jit": the checker marks
+every local function passed to a trace entry point (``jax.jit``,
+``lax.scan``, ``while_loop``, ``fori_loop``, ``cond``, ``lax.map``,
+``shard_map``, ``vmap``, ``grad``, ``remat`` — or decorated by one)
+and propagates through same-module direct calls to a fixpoint.
+
+Tracer-typed judgments (``float(p)``, ``p.item()``, ``if p:``) are
+only flagged for parameters of scan-family body functions — a scan
+carry or loop index is ALWAYS a tracer, while a jitted function's
+parameter may be a static argument. Host calls wrapped in the
+sanctioned escape hatches (``jax.debug.*``, ``jax.pure_callback``,
+``io_callback``) are allowed.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..core import Checker, call_name, dotted_name
+
+# Trace entry points: dotted-name tail -> positional indices holding
+# the traced callable. Data-driven: extending coverage is one row.
+TRACE_ENTRY_ARGS = {
+    "jit": (0,), "pjit": (0,), "pmap": (0,), "vmap": (0,),
+    "grad": (0,), "value_and_grad": (0,), "remat": (0,),
+    "checkpoint": (0,), "scan": (0,), "map": (0,),
+    "shard_map": (0,), "while_loop": (0, 1), "fori_loop": (2,),
+    "cond": (1, 2), "custom_vjp": (0,), "custom_jvp": (0,),
+}
+
+# Entry points whose body-function parameters are ALWAYS tracers
+# (carries, loop indices, operands) — never static arguments.
+TRACER_PARAM_ENTRIES = ("scan", "while_loop", "fori_loop", "cond", "map")
+
+# Tails that collide with non-jax names (builtin ``map``, orbax
+# ``checkpoint`` helpers, ad-hoc ``cond`` variables): only treat the
+# call as a trace entry when its dotted name is jax-qualified.
+AMBIGUOUS_TAILS = {
+    "map": ("lax.map",),
+    "cond": ("lax.cond",),
+    "checkpoint": ("jax.checkpoint",),
+    "remat": ("jax.remat", "ad_checkpoint.remat"),
+}
+
+
+def _entry_tail(callee: str):
+    """The TRACE_ENTRY_ARGS key for a dotted callee, or None."""
+    tail = callee.rsplit(".", 1)[-1]
+    if tail not in TRACE_ENTRY_ARGS:
+        return None
+    quals = AMBIGUOUS_TAILS.get(tail)
+    if quals and not any(callee == q or callee.endswith("." + q)
+                         for q in quals):
+        return None
+    return tail
+
+# Host-effect call prefixes that must not execute under trace.
+IMPURE_PREFIXES = (
+    "time.", "np.random.", "numpy.random.", "random.", "os.",
+)
+IMPURE_EXACT = ("open", "input")
+# Pure/ubiquitous exceptions inside the flagged prefixes.
+IMPURE_ALLOW_PREFIXES = ("os.path.",)
+# Sanctioned host-escape wrappers: a call that is an argument of one
+# of these is deliberate host traffic, not a leak.
+CALLBACK_WRAPPERS = (
+    "jax.debug", "debug.print", "debug.callback", "pure_callback",
+    "io_callback", "host_callback",
+)
+
+
+def _is_impure(callee: str) -> bool:
+    if callee in IMPURE_EXACT:
+        return True
+    if any(callee.startswith(p) for p in IMPURE_ALLOW_PREFIXES):
+        return False
+    return any(callee.startswith(p) for p in IMPURE_PREFIXES)
+
+
+class TracePurity(Checker):
+    id = "trace-purity"
+    invariant = ("functions reachable from jit/scan/shard_map bodies "
+                 "perform no host-side effects or tracer coercions")
+    bug_class = "trace-time constant baking / tracer leak / recompile storm"
+    hint = ("hoist the host call out of the traced body, or route it "
+            "through jax.debug.callback / jax.pure_callback")
+
+    def check(self, ctx):
+        defs = self._local_defs(ctx.tree)
+        roots, tracer_roots = self._roots(ctx.tree, defs)
+        reachable = self._propagate(roots, defs)
+        findings = []
+        for fname in sorted(reachable):
+            for fn in defs[fname]:
+                findings.extend(self._check_body(
+                    ctx, fn, tracer_params=(
+                        self._params(fn) if fname in tracer_roots else ()
+                    ),
+                ))
+        return [
+            f for f in findings
+            if not ctx.line_suppressed(f.line, self.id)
+        ]
+
+    def _local_defs(self, tree):
+        defs: dict = {}
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs.setdefault(node.name, []).append(node)
+        return defs
+
+    def _roots(self, tree, defs):
+        roots, tracer_roots = set(), set()
+
+        def mark(arg, as_tracer):
+            name = dotted_name(arg).rsplit(".", 1)[-1]
+            if name in defs:
+                roots.add(name)
+                if as_tracer:
+                    tracer_roots.add(name)
+
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                tail = _entry_tail(call_name(node))
+                if tail:
+                    for i in TRACE_ENTRY_ARGS[tail]:
+                        if i < len(node.args):
+                            mark(node.args[i],
+                                 tail in TRACER_PARAM_ENTRIES)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    target = dec.func if isinstance(dec, ast.Call) else dec
+                    if _entry_tail(dotted_name(target)):
+                        roots.add(node.name)
+                    elif dotted_name(target).rsplit(".", 1)[-1] == \
+                            "partial" and isinstance(dec, ast.Call) \
+                            and dec.args:
+                        if _entry_tail(dotted_name(dec.args[0])):
+                            roots.add(node.name)
+        return roots, tracer_roots
+
+    def _propagate(self, roots, defs):
+        reachable = set(roots)
+        frontier = list(roots)
+        while frontier:
+            fname = frontier.pop()
+            for fn in defs.get(fname, ()):
+                for node in ast.walk(fn):
+                    if isinstance(node, ast.Call):
+                        tail = call_name(node).rsplit(".", 1)[-1]
+                        if tail in defs and tail not in reachable:
+                            reachable.add(tail)
+                            frontier.append(tail)
+        return reachable
+
+    @staticmethod
+    def _params(fn) -> tuple:
+        """Tracer-carrying parameters: the NON-defaulted positionals
+        only. scan/while/fori/cond pass exactly the carry/operand
+        positions; a defaulted trailing param is the static
+        closure-capture idiom (``def body(c, x, cfg=cfg):``)."""
+        a = fn.args
+        pos = [*a.posonlyargs, *a.args]
+        if a.defaults:
+            pos = pos[: -len(a.defaults)]
+        return tuple(p.arg for p in pos if p.arg != "self")
+
+    def _in_callback(self, ctx, node) -> bool:
+        for anc in ctx.ancestors(node):
+            if isinstance(anc, ast.Call):
+                cname = call_name(anc)
+                if any(w in cname for w in CALLBACK_WRAPPERS):
+                    return True
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return False
+        return False
+
+    def _check_body(self, ctx, fn, tracer_params):
+        findings = []
+        qual = ctx.qualname(fn) or fn.name
+        tracer_params = set(tracer_params)
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                callee = call_name(node)
+                if _is_impure(callee) and not self._in_callback(ctx, node):
+                    findings.append(ctx.finding(
+                        self, node,
+                        f"host-side call `{callee}` inside "
+                        f"`{qual}`, which is traced into a jitted/"
+                        f"scanned body",
+                        key=f"{qual}:{callee}",
+                    ))
+                elif (isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "item"
+                        and isinstance(node.func.value, ast.Name)
+                        and node.func.value.id in tracer_params):
+                    findings.append(ctx.finding(
+                        self, node,
+                        f"`.item()` on tracer parameter "
+                        f"`{node.func.value.id}` of `{qual}` forces a "
+                        f"device sync under trace",
+                        key=f"{qual}:{node.func.value.id}.item",
+                    ))
+                elif (callee in ("float", "int", "bool")
+                        and len(node.args) == 1
+                        and isinstance(node.args[0], ast.Name)
+                        and node.args[0].id in tracer_params):
+                    findings.append(ctx.finding(
+                        self, node,
+                        f"`{callee}()` on tracer parameter "
+                        f"`{node.args[0].id}` of `{qual}` raises at "
+                        f"trace time",
+                        key=f"{qual}:{callee}({node.args[0].id})",
+                    ))
+            elif isinstance(node, (ast.If, ast.While)):
+                name = self._tracer_test_name(node.test, tracer_params)
+                if name is not None:
+                    findings.append(ctx.finding(
+                        self, node,
+                        f"Python `{type(node).__name__.lower()}` on "
+                        f"tracer parameter `{name}` of `{qual}` — use "
+                        f"`jnp.where`/`lax.cond` instead",
+                        key=f"{qual}:if:{name}",
+                    ))
+        return findings
+
+    @staticmethod
+    def _tracer_test_name(test, tracer_params):
+        if isinstance(test, ast.Name) and test.id in tracer_params:
+            return test.id
+        if isinstance(test, ast.Compare) and isinstance(test.left, ast.Name) \
+                and test.left.id in tracer_params:
+            return test.left.id
+        if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not) \
+                and isinstance(test.operand, ast.Name) \
+                and test.operand.id in tracer_params:
+            return test.operand.id
+        return None

@@ -2003,6 +2003,16 @@ def cmd_trace_export(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_lint(args: argparse.Namespace) -> int:
+    """`gravity_tpu lint` — the AST invariant analyzer
+    (docs/static-analysis.md). Device-free; argument parsing lives in
+    analysis.driver so `make lint`, tests, and fleet tooling share one
+    flag surface."""
+    from .analysis.driver import main as lint_main
+
+    return lint_main(args.lint_args)
+
+
 def cmd_fleet_status(args: argparse.Namespace) -> int:
     """Fleet-wide serving health: every live worker's snapshot from
     the shared spool, aggregated (per-class p50/p95/p99, occupancy,
@@ -2139,6 +2149,13 @@ def cmd_bench(args: argparse.Namespace) -> int:
 
 
 def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "lint":
+        # Short-circuit before the big parser: lint is device-free and
+        # owns its flag surface (argparse REMAINDER would swallow a
+        # leading `--format`); the subparser below still lists it in
+        # `gravity_tpu --help`.
+        return cmd_lint(argparse.Namespace(lint_args=argv[1:]))
     parser = argparse.ArgumentParser(prog="gravity_tpu")
     sub = parser.add_subparsers(dest="command", required=True)
 
@@ -2512,12 +2529,25 @@ def main(argv=None) -> int:
                          help="include the merged metric registry dump")
     p_fleet.set_defaults(fn=cmd_fleet_status)
 
+    p_lint = sub.add_parser(
+        "lint",
+        help="AST invariant analyzer: donation safety, trace purity, "
+             "fenced writes, flock weight, telemetry/fault drift "
+             "(docs/static-analysis.md); exits 1 on non-baselined "
+             "findings",
+    )
+    # One source of truth for lint's flags: everything after `lint`
+    # forwards to the analysis driver's own parser (`lint --help`
+    # included).
+    p_lint.add_argument("lint_args", nargs=argparse.REMAINDER)
+    p_lint.set_defaults(fn=cmd_lint)
+
     args = parser.parse_args(argv)
     # traj and the serving CLIENT verbs never touch the device (they
     # talk JSON to files / the daemon) — skip the backend probe there.
     if args.command not in (
         "traj", "submit", "status", "result", "cancel",
-        "trace-export", "fleet-status",
+        "trace-export", "fleet-status", "lint",
     ) and not (
         # bench --report only globs local round JSONs — device-free.
         args.command == "bench" and getattr(args, "report", False)

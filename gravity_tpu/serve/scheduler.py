@@ -421,14 +421,13 @@ class Spool:
                     pass
             else:
                 os.replace(tmp, path)
-            # Meta via direct tmp+replace, NOT atomic_write_json: that
-            # helper is the torn_spool_write injection point and a
-            # progress publish must not consume chaos tokens aimed at
-            # job/lease records.
-            mtmp = f"{self.progress_meta_path(job_id)}.tmp.{os.getpid()}"
-            with open(mtmp, "w") as f:
-                f.write(json.dumps(new_meta))
-            os.replace(mtmp, self.progress_meta_path(job_id))
+            # fault_injection=False: the progress stream has its own
+            # torn_progress_write hook (above) and must not consume
+            # torn_spool_write chaos tokens aimed at job/lease records.
+            atomic_write_json(
+                self.progress_meta_path(job_id), new_meta,
+                fault_injection=False,
+            )
 
         if self.leases is None or fence is None:
             _land()
@@ -1227,16 +1226,12 @@ class EnsembleScheduler:
             path = os.path.join(
                 workers_dir, f"{self.worker_id}.metrics.json"
             )
-            # Direct tmp+replace (NOT atomic_write_json): that helper
-            # is the torn_spool_write fault-injection point, and a
-            # metrics publish must not consume a chaos token aimed at
+            # fault_injection=False: a best-effort metrics publish
+            # must not consume a torn_spool_write chaos token aimed at
             # job/lease records.
-            tmp = f"{path}.tmp.{os.getpid()}"
             try:
                 os.makedirs(workers_dir, exist_ok=True)
-                with open(tmp, "w") as f:
-                    f.write(json.dumps(snap))
-                os.replace(tmp, path)
+                atomic_write_json(path, snap, fault_injection=False)
             except OSError:
                 pass  # metrics publication must never fail serving
 

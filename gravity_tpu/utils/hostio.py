@@ -56,24 +56,32 @@ def read_json_retry(
     return None
 
 
-def atomic_write_json(path: str, obj: dict) -> None:
+def atomic_write_json(
+    path: str, obj: dict, *, fault_injection: bool = True
+) -> None:
     """Write ``obj`` as JSON to ``path`` via tmp-file + ``os.replace``
     so readers never observe a half-written file — the one durable-write
-    idiom every spool/lease/registry record in the serving layer shares.
+    idiom every spool/lease/registry record in the serving layer shares
+    (the fenced-write lint pins every spool-family writer to it).
 
     Fault injection: an armed ``torn_spool_write`` spec
     (utils/faults.py) makes this call write a TRUNCATED document
     directly to ``path`` instead — simulating the non-atomic writer /
     crash-mid-write a reader's torn-JSON handling must survive — while
     returning success, exactly like a process that died right after the
-    bad write."""
+    bad write. ``fault_injection=False`` opts a stream OUT of that
+    injection point: best-effort non-spool-record writes (metrics
+    publication, progress META records with their own
+    ``torn_progress_write`` hook) must not consume chaos tokens aimed
+    at job/lease records."""
     payload = json.dumps(obj)
-    from .faults import torn_write_due
+    if fault_injection:
+        from .faults import torn_write_due
 
-    if torn_write_due():
-        with open(path, "w") as f:
-            f.write(payload[: max(1, len(payload) // 3)])
-        return
+        if torn_write_due():
+            with open(path, "w") as f:
+                f.write(payload[: max(1, len(payload) // 3)])
+            return
     tmp = f"{path}.tmp.{os.getpid()}"
     with open(tmp, "w") as f:
         f.write(payload)

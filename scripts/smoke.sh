@@ -19,6 +19,9 @@
 # mid-sharded-job on a 2-device CPU mesh -> the survivor resumes from
 # the durable progress snapshot — docs/robustness.md "Sharded &
 # long-job failure modes"),
+# and the static-analysis stage (`gravity_tpu lint` over a planted-
+# violation fixture tree asserting exit 1 + finding format, then the
+# real tree asserting exit 0 — docs/static-analysis.md),
 # all on CPU. Exits nonzero on any failure. ~10 min on a laptop-class
 # CPU.
 set -euo pipefail
@@ -26,7 +29,7 @@ cd "$(dirname "$0")/.."
 
 export JAX_PLATFORMS=cpu
 
-echo "== smoke 1/10: pytest -m 'fast and not slow and not heavy' (contract + oracle-parity lane) =="
+echo "== smoke 1/11: pytest -m 'fast and not slow and not heavy' (contract + oracle-parity lane) =="
 # "fast and not slow and not heavy": module-level fast marks would
 # otherwise pull a file's slow-marked wall-clock tests into the lane
 # (pytest -m fast selects anything CARRYING the mark; it does not
@@ -35,7 +38,7 @@ echo "== smoke 1/10: pytest -m 'fast and not slow and not heavy' (contract + ora
 # item 5).
 python -m pytest tests/ -q -m "fast and not slow and not heavy" -p no:cacheprovider
 
-echo "== smoke 2/10: 2-job ensemble serving e2e (CLI daemon) =="
+echo "== smoke 2/11: 2-job ensemble serving e2e (CLI daemon) =="
 SPOOL="$(mktemp -d /tmp/gravity_smoke.XXXXXX)"
 cleanup() {
     # Best-effort daemon shutdown + spool removal.
@@ -88,7 +91,7 @@ print("ensemble e2e OK:", {j: s["status"] for j, s in statuses.items()},
       "| compiles:", metrics["compile_counts"])
 EOF
 
-echo "== smoke 3/10: async host pipeline e2e (cadence run + SIGTERM + resume) =="
+echo "== smoke 3/11: async host pipeline e2e (cadence run + SIGTERM + resume) =="
 IODIR="$(mktemp -d /tmp/gravity_smoke_io.XXXXXX)"
 trap 'cleanup; rm -rf "$IODIR"' EXIT
 # Cadence-on pipelined run; preempt@500 delivers a real SIGTERM to the
@@ -124,7 +127,7 @@ print("io-pipeline e2e OK: resumed", stats["steps"], "steps,",
       "host_gap_frac", round(stats["host_gap_frac"], 3))
 EOF
 
-echo "== smoke 4/10: autotune cache round-trip (probe-on-miss, instant-on-hit) =="
+echo "== smoke 4/11: autotune cache round-trip (probe-on-miss, instant-on-hit) =="
 TUNEDIR="$(mktemp -d /tmp/gravity_smoke_tune.XXXXXX)"
 trap 'cleanup; rm -rf "$IODIR" "$TUNEDIR"' EXIT
 # Fresh cache dir + lowered fast-probe floor so plain `auto` runs a
@@ -161,10 +164,10 @@ print("autotune round-trip OK: backend", s1["backend"],
       "| probe", round(s1["autotune_probe_ms"], 1), "ms -> hit 0 ms")
 EOF
 
-echo "== smoke 5/10: serving chaos harness (kill -9 + adoption + fencing) =="
+echo "== smoke 5/11: serving chaos harness (kill -9 + adoption + fencing) =="
 bash scripts/chaos.sh 1 2
 
-echo "== smoke 6/10: job classes through the CLI daemon (fit + sweep) =="
+echo "== smoke 6/11: job classes through the CLI daemon (fit + sweep) =="
 # One fit + one sweep submitted through the REAL daemon from stage 2
 # (still serving), asserting completion + served-vs-solo parity
 # (docs/serving.md "Job classes").
@@ -274,7 +277,7 @@ z = np.load(sys.argv[1])
 assert 'min_sep' in z.files and len(z['min_sep']) == 4, z.files
 " "$SPOOL/sweep_verdicts.npz"
 
-echo "== smoke 7/10: unified telemetry (Prometheus scrape + Perfetto trace export) =="
+echo "== smoke 7/11: unified telemetry (Prometheus scrape + Perfetto trace export) =="
 # Against the STILL-LIVE stage-2 daemon: (a) a text/plain /metrics
 # scrape must be valid Prometheus exposition (validated by the strict
 # parser the tests use) including per-class latency histograms and
@@ -319,7 +322,7 @@ assert summary["coverage"] is not None and summary["coverage"] >= 0.9, \
 print("perfetto export OK:", summary)
 PYEOF
 
-echo "== smoke 8/10: nlist cell-list near field (p3m parity + standalone truncated parity) =="
+echo "== smoke 8/11: nlist cell-list near field (p3m parity + standalone truncated parity) =="
 # (a) The P3M near pass through the cell-list tile engine must match
 # the chunked gather near pass <= 1e-5 scaled on CPU (the ISSUE-9
 # acceptance bound); (b) the standalone nlist backend must match the
@@ -361,7 +364,7 @@ print("nlist near-field OK: p3m dev", float(dev),
       "| standalone dev", float(dev2))
 PYEOF
 
-echo "== smoke 9/10: numerics observatory (drift gauges + error histogram scrape, injected accuracy breach) =="
+echo "== smoke 9/11: numerics observatory (drift gauges + error histogram scrape, injected accuracy breach) =="
 # (a) Strict-parse the LIVE stage-2 daemon's Prometheus text and
 # assert the numerics families are present with real series: the
 # per-backend force-error histogram (sentinel probes ran — default
@@ -478,7 +481,7 @@ urllib.request.urlopen(req, timeout=5).read()
 EOF
 kill "$NUM_PID" 2>/dev/null || true
 
-echo "== smoke 10/10: sharded adoption-resume chaos (SIGKILL mid-sharded-job -> resume from snapshot) =="
+echo "== smoke 10/11: sharded adoption-resume chaos (SIGKILL mid-sharded-job -> resume from snapshot) =="
 # Chaos scenario 3 through the real CLI daemon on a 2-device CPU mesh:
 # a worker running a sharded-integrate job is SIGKILLed mid-run; the
 # survivor adopts, RESUMES from the last fenced progress snapshot
@@ -487,5 +490,72 @@ echo "== smoke 10/10: sharded adoption-resume chaos (SIGKILL mid-sharded-job -> 
 # from-zero respool (docs/robustness.md "Sharded & long-job failure
 # modes").
 bash scripts/chaos.sh 3
+
+echo "== smoke 11/11: static analysis (gravity_tpu lint: planted violations -> exit 1, real tree -> exit 0) =="
+# The AST invariant analyzer (docs/static-analysis.md). First a
+# fixture tree with one planted violation per acceptance class
+# (use-after-donation, time.time in a scanned body, unfenced spool
+# write) must exit 1 and report each with the right file:line; then
+# the real tree against the committed baseline must exit 0.
+LINTDIR="$(mktemp -d /tmp/gravity_lint.XXXXXX)"
+cat > "$LINTDIR/planted.py" <<'PYEOF'
+import json
+import os
+import time
+
+import jax
+
+step_fn = jax.jit(lambda s: s * 2.0, donate_argnums=(0,))
+
+
+def run(state):
+    out = step_fn(state)        # donates `state`
+    return out, state.shape     # line 12: use-after-donation
+
+
+def body(carry, x):
+    return carry + x + time.time(), None   # line 16: host call in scan
+
+
+def scanit(xs):
+    return jax.lax.scan(body, 0.0, xs)
+
+
+def publish(spool_dir, rec):
+    path = os.path.join(spool_dir, "jobs", "j1.json")
+    with open(path, "w") as f:  # line 25: unfenced spool write
+        json.dump(rec, f)
+PYEOF
+LINT_OUT="$LINTDIR/findings.txt"
+if python -m gravity_tpu lint --root "$LINTDIR" "$LINTDIR" > "$LINT_OUT"; then
+    echo "FAIL: lint exited 0 on the planted-violation tree"
+    cat "$LINT_OUT"
+    exit 1
+fi
+for needle in \
+    "planted.py:12:.*donation-safety" \
+    "planted.py:16:.*trace-purity" \
+    "planted.py:25:.*fenced-write"; do
+    grep -Eq "$needle" "$LINT_OUT" || {
+        echo "FAIL: lint output missing '$needle'"
+        cat "$LINT_OUT"
+        exit 1
+    }
+done
+# --format json must carry the same findings for fleet tooling.
+python -m gravity_tpu lint --root "$LINTDIR" --format json "$LINTDIR" \
+    > "$LINTDIR/findings.json" || true
+python - "$LINTDIR/findings.json" <<'PYEOF'
+import json, sys
+doc = json.load(open(sys.argv[1]))
+ids = {f["checker"] for f in doc["findings"]}
+assert {"donation-safety", "trace-purity", "fenced-write"} <= ids, ids
+assert all({"path", "line", "checker", "message"} <= set(f)
+           for f in doc["findings"])
+print("lint JSON format OK:", sorted(ids))
+PYEOF
+rm -rf "$LINTDIR"
+# The real tree: zero non-baselined findings.
+python -m gravity_tpu lint
 
 echo "== smoke: all green =="

@@ -31,6 +31,23 @@ import pytest  # noqa: E402
 REPO_ROOT = str(pathlib.Path(__file__).resolve().parents[1])
 
 
+import functools
+
+
+@functools.lru_cache(maxsize=1)
+def repo_lint_report():
+    """ONE full-tree static-analysis pass shared by every lint gate
+    (tests/test_lint.py and the three docs-lint wrappers): findings
+    from all six checkers, NO baseline applied — consumers filter by
+    checker id / key and apply the baseline themselves. Cached per
+    session so the tier-1 lane pays the 85-file parse exactly once."""
+    from gravity_tpu.analysis import run_analysis
+
+    return run_analysis(
+        [os.path.join(REPO_ROOT, "gravity_tpu")], REPO_ROOT,
+    )
+
+
 def subprocess_env():
     """Env for running repo entry points in a subprocess on CPU."""
     env = {"JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO_ROOT,

@@ -1,0 +1,33 @@
+"""donation-safety negative fixture: the sanctioned idioms — carry
+re-binding, pre-donation reads, the undonated variant for emergency
+paths — must produce ZERO findings."""
+
+import jax
+import jax.numpy as jnp
+
+step = jax.jit(lambda s, a: (s + a, a), donate_argnums=(0, 1))
+step_undonated = jax.jit(lambda s, a: (s + a, a))
+
+
+def carry_rebind_loop(state, acc, blocks):
+    for _ in range(blocks):
+        state, acc = step(state, acc)
+    return state, acc
+
+
+def read_before_donation(state, acc):
+    checksum = jnp.sum(state)
+    state, acc = step(state, acc)
+    return state, acc, checksum
+
+
+def rebind_then_read(state, acc):
+    state, acc = step(state, acc)
+    return jnp.sum(state) + jnp.sum(acc)
+
+
+def emergency_path(state, acc):
+    # The docs/scaling.md contract: never donate the caller-visible
+    # buffers an emergency save might still need.
+    out, acc2 = step_undonated(state, acc)
+    return out, jnp.sum(state)

@@ -1,0 +1,47 @@
+"""donation-safety positive fixture: every `# LINT-EXPECT` line must
+be flagged (tests/test_lint.py asserts the exact line set). Parsed by
+the analyzer, never imported."""
+
+import jax
+import jax.numpy as jnp
+
+step = jax.jit(lambda s, a: (s + a, a), donate_argnums=(0, 1))
+
+
+def straight_line_read(state, acc):
+    out, acc2 = step(state, acc)
+    return out + jnp.sum(state)  # LINT-EXPECT: donation-safety
+
+
+def read_in_branch(state, acc, flag):
+    out, acc2 = step(state, acc)
+    if flag:
+        return acc  # LINT-EXPECT: donation-safety
+    return out
+
+
+class Engine:
+    def __init__(self):
+        self._round = jax.jit(lambda b, c: b * c, donate_argnums=(0,))
+
+    def run(self, batch, coef):
+        fn = self._round
+        new_batch = fn(batch, coef)
+        stale = batch.sum()  # LINT-EXPECT: donation-safety
+        return new_batch, stale
+
+
+@jax.jit
+def plain_jit(x):
+    return x * 2.0
+
+
+def decorated_donor_read(x):
+    import functools
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def dec(v):
+        return v + 1.0
+
+    y = dec(x)
+    return y, x.shape  # LINT-EXPECT: donation-safety

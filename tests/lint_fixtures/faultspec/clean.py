@@ -1,0 +1,15 @@
+"""fault-coverage negative fixture tree: every declared kind has a
+consumption site (possibly in a sibling file)."""
+
+SERVING_KINDS = (
+    "crashy",
+    "stally",
+)
+
+
+def crash_due(plan):
+    return plan._take("crashy", lambda f: True)
+
+
+def stall_due(plan):
+    return plan._take("stally", lambda f: True)

@@ -1,0 +1,11 @@
+"""fault-coverage positive fixture: a declared kind with no
+consumption site anywhere in the tree."""
+
+SERVING_KINDS = (  # LINT-EXPECT: fault-coverage
+    "used_fault",
+    "ghost_fault",
+)
+
+
+def consume(plan):
+    return plan._take("used_fault", lambda f: True)

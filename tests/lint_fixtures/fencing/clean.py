@@ -1,0 +1,33 @@
+"""fenced-write negative fixture: the sanctioned idioms — the atomic
+helper for spool records, raw writes to NON-spool artifacts, and
+read-mode spool access."""
+
+import json
+import os
+
+
+def atomic_write_json(path, obj, *, fault_injection=True):
+    raise NotImplementedError  # stand-in for utils/hostio
+
+
+def spool_record_via_helper(spool_dir, rec):
+    atomic_write_json(os.path.join(spool_dir, "jobs", "j1.json"), rec)
+
+
+def metrics_via_helper(workers_dir, snap):
+    atomic_write_json(
+        os.path.join(workers_dir, "w1.metrics.json"), snap,
+        fault_injection=False,
+    )
+
+
+def export_artifact(out_path, doc):
+    # A trace EXPORT / report is not a spool record: raw writes to
+    # unrelated artifacts stay legal.
+    with open(out_path, "w") as f:
+        json.dump(doc, f)
+
+
+def spool_reader(spool_dir):
+    with open(os.path.join(spool_dir, "jobs", "j1.json")) as f:
+        return json.load(f)

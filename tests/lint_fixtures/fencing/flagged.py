@@ -1,0 +1,33 @@
+"""fenced-write positive fixture: raw durable writes targeting
+spool-family paths outside the sanctioned helpers."""
+
+import json
+import os
+
+
+def raw_job_write(spool_dir, rec):
+    path = os.path.join(spool_dir, "jobs", "j1.json")
+    with open(path, "w") as f:  # LINT-EXPECT: fenced-write
+        f.write(json.dumps(rec))
+
+
+def raw_replace(lease_path, rec):
+    tmp = f"{lease_path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:  # LINT-EXPECT: fenced-write
+        f.write(json.dumps(rec))
+    os.replace(tmp, lease_path)  # LINT-EXPECT: fenced-write
+
+
+class Publisher:
+    def publish(self, root, snap):
+        workers_dir = os.path.join(root, "workers")
+        target = os.path.join(workers_dir, "w1.metrics.json")
+        dst = open(target, mode="w")  # LINT-EXPECT: fenced-write
+        json.dump(snap, dst)  # LINT-EXPECT: fenced-write
+        dst.close()
+
+
+def progress_meta(progress_dir, meta):
+    out = os.path.join(progress_dir, "job.json")
+    with open(out, "x") as f:  # LINT-EXPECT: fenced-write
+        f.write(json.dumps(meta))

@@ -1,0 +1,20 @@
+"""flock-weight negative fixture: the Spool.write_result pattern —
+serialize/hash OUTSIDE the lock, only validate + rename + small meta
+writes inside."""
+
+import hashlib
+import os
+
+import numpy as np
+
+
+def write_result_pattern(leases, spool, job_id, arrays, fence):
+    tmp = f"{spool.result_path(job_id)}.tmp.{os.getpid()}"
+    np.savez(tmp, **arrays)                 # heavy half: outside
+    digest = hashlib.sha256(b"x").hexdigest()
+    with leases.locked():                   # light half: inside
+        if not leases.fence_ok(job_id, fence):
+            os.remove(tmp)
+            return None
+        os.replace(tmp, spool.result_path(job_id))  # lint: ok=fenced-write fixture models the fenced helper itself
+    return digest

@@ -1,0 +1,23 @@
+"""flock-weight positive fixture: heavy work lexically inside the
+lease-lock critical section."""
+
+import hashlib
+import time
+
+import numpy as np
+
+
+def heavy_under_lock(leases, tmp, arrays):
+    with leases.locked():
+        np.savez(tmp, **arrays)  # LINT-EXPECT: flock-weight
+        digest = hashlib.sha256(b"payload")  # LINT-EXPECT: flock-weight
+        time.sleep(0.1)  # LINT-EXPECT: flock-weight
+    return digest
+
+
+def d2h_under_lock(leases, batch):
+    import jax
+
+    with leases.locked():
+        host = jax.device_get(batch)  # LINT-EXPECT: flock-weight
+    return host

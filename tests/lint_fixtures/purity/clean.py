@@ -1,0 +1,41 @@
+"""trace-purity negative fixture: jnp-only traced bodies, static
+closure captures via default args, sanctioned debug callbacks, and
+host work OUTSIDE the traced graph."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+CFG_DT = 0.25
+
+
+def scan_body(carry, x, dt=CFG_DT, use_quad=True):
+    # Defaulted params are static closure captures, not tracers —
+    # branching on them is trace-time routing, not a leak.
+    if use_quad:
+        carry = carry + dt * x
+    jax.debug.print("carry={c}", c=carry)
+    return carry, None
+
+
+def outer(xs):
+    return jax.lax.scan(scan_body, 0.0, xs)
+
+
+@jax.jit
+def jitted(v):
+    return jnp.where(v > 0, v, -v)
+
+
+def host_side_driver(xs):
+    # Host timing AROUND the traced call is the sanctioned pattern.
+    t0 = time.time()
+    out = outer(xs)
+    return out, time.time() - t0
+
+
+def untraced_helper(path):
+    # Reachable from nothing jitted: host I/O is fine here.
+    with open(path) as f:
+        return f.read()

@@ -1,0 +1,49 @@
+"""trace-purity positive fixture: host effects and tracer coercions
+inside jit/scan-reachable bodies."""
+
+import os
+import time
+
+import jax
+import numpy as np
+
+
+def scan_body(carry, x):
+    t = time.time()  # LINT-EXPECT: trace-purity
+    noise = np.random.normal()  # LINT-EXPECT: trace-purity
+    return carry + x + t + noise, None
+
+
+def outer(xs):
+    return jax.lax.scan(scan_body, 0.0, xs)
+
+
+def helper_called_from_jit(v):
+    os.getenv("SOME_KNOB")  # LINT-EXPECT: trace-purity
+    with open("config.json") as f:  # LINT-EXPECT: trace-purity
+        f.read()
+    return v
+
+
+@jax.jit
+def jitted(v):
+    return helper_called_from_jit(v) * 2.0
+
+
+def loop_body(i, carry):
+    if i:  # LINT-EXPECT: trace-purity
+        return carry
+    return float(carry) + carry  # LINT-EXPECT: trace-purity
+
+
+def run_loop(c0):
+    return jax.lax.fori_loop(0, 8, loop_body, c0)
+
+
+def cond_branch(operand):
+    operand.item()  # LINT-EXPECT: trace-purity
+    return operand
+
+
+def pick(pred, operand):
+    return jax.lax.cond(pred, cond_branch, lambda o: o, operand)

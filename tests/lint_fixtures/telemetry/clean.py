@@ -1,0 +1,21 @@
+"""telemetry-drift negative fixture: every emission matches a
+declaration (declarations may live in another file of the tree —
+here, flagged.py's registries are shared)."""
+
+
+class RoundLogger:
+    KINDS = ("round_start", "round_end")
+
+    def event(self, kind, /, **fields):
+        pass
+
+
+def emit(log, reg, tracer, recorder, kind):
+    log.event("round_start", n=4)
+    log.event("round_end", n=4)
+    log.event(kind, n=4)   # non-literal kinds are the wrapper idiom
+    reg.gauge("gravity_rounds_total").set(1.0)
+    # Non-"gravity_"-namespaced instruments belong to other systems.
+    reg.counter("python_gc_collections").inc()
+    tracer.emit("round", "tr-2", 0.0, 0.5)
+    recorder.dump("divergence")

@@ -1,0 +1,29 @@
+"""telemetry-drift positive fixture: kinds/metrics/spans/reasons
+emitted without a matching registry declaration."""
+
+SPAN_NAMES = ("round",)
+DUMP_REASONS = ("divergence",)
+
+WORKER_METRICS = (
+    ("gravity_rounds_total", "counter", "rounds"),
+)
+
+
+class EventLogger:
+    KINDS = ("submitted", "completed")
+
+    def event(self, kind, /, **fields):
+        pass
+
+
+def emit_all(log, reg, tracer, recorder):
+    log.event("submitted", job="j1")
+    log.event("vanished", job="j1")  # LINT-EXPECT: telemetry-drift
+    reg.counter("gravity_rounds_total").inc()
+    reg.counter("gravity_ghost_total").inc()  # LINT-EXPECT: telemetry-drift
+    tracer.emit("round", "tr-1", 0.0, 1.0)
+    tracer.emit("phantom_span", "tr-1", 0.0, 1.0)  # LINT-EXPECT: telemetry-drift
+    with tracer.span("warp", "tr-1"):  # LINT-EXPECT: telemetry-drift
+        pass
+    recorder.dump("divergence")
+    recorder.dump("gremlins")  # LINT-EXPECT: telemetry-drift

@@ -268,20 +268,15 @@ def test_new_fault_spec_grammar():
 
 @pytest.mark.fast
 def test_docs_pin_every_fault_spec_kind():
-    """Satellite docs-lint: every injectable fault kind — solo and
-    serving — appears in docs/robustness.md's fault tables."""
-    import os
+    """Satellite docs-lint (PR 12: now a thin wrapper over the
+    fault-coverage checker, so the kind list lives in exactly one
+    place — the SERVING_KINDS tuple the analyzer reads from source):
+    every injectable fault kind — solo and serving — is consumed by an
+    injection site and appears in docs/robustness.md's fault tables."""
+    from conftest import repo_lint_report
 
-    from gravity_tpu.utils.faults import SERVING_KINDS
-
-    doc = open(os.path.join(
-        os.path.dirname(__file__), "..", "docs", "robustness.md"
-    )).read()
-    missing = [
-        kind for kind in
-        ("diverge", "transient", "preempt", "backend") + SERVING_KINDS
-        if f"`{kind}" not in doc
-    ]
-    assert not missing, (
-        "docs/robustness.md fault tables missing: " + ", ".join(missing)
+    findings = [f for f in repo_lint_report().findings
+                if f.checker == "fault-coverage"]
+    assert not findings, "\n" + "\n".join(
+        f.format() for f in findings
     )

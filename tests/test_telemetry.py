@@ -28,7 +28,7 @@ from gravity_tpu.telemetry import (
     snapshot_quantile,
     span_coverage,
 )
-from gravity_tpu.telemetry.metrics import WORKER_METRICS, Histogram
+from gravity_tpu.telemetry.metrics import Histogram
 
 
 def _cfg(n, steps=30, **kw):
@@ -427,40 +427,17 @@ def test_solo_run_trace_spans(tmp_path):
 
 @pytest.mark.fast
 def test_docs_cover_every_event_and_metric_name():
-    """Satellite: every emitted event kind, metric name, span name,
-    and flight-recorder dump reason appears in docs/observability.md
-    — new telemetry cannot ship undocumented."""
-    from gravity_tpu.serve.jobs import sweep  # noqa: F401 — ensure
-    from gravity_tpu.telemetry.flightrec import DUMP_REASONS
-    from gravity_tpu.telemetry.tracing import SPAN_NAMES
-    from gravity_tpu.utils.logging import (
-        RecoveryEventLogger,
-        RunEventLogger,
-        ServingEventLogger,
-    )
-    from gravity_tpu.utils.profiling import MetricsLogger
+    """Satellite (PR 12: now a thin wrapper over the telemetry-drift
+    checker, so the kind lists live in exactly one place — the
+    registry constants the analyzer reads from source): every emitted
+    event kind, metric name, span name, and flight-recorder dump
+    reason is declared in its registry AND appears in
+    docs/observability.md — new telemetry cannot ship undeclared or
+    undocumented."""
+    from conftest import repo_lint_report
 
-    doc_path = os.path.join(
-        os.path.dirname(__file__), "..", "docs", "observability.md"
-    )
-    doc = open(doc_path).read()
-    missing = []
-    for kinds in (ServingEventLogger.KINDS, RecoveryEventLogger.KINDS,
-                  RunEventLogger.KINDS, MetricsLogger.KINDS):
-        for kind in kinds:
-            if f"`{kind}`" not in doc:
-                missing.append(f"event kind {kind}")
-    for name, _typ, _help in WORKER_METRICS:
-        # Docs table metrics as `name{label,...}` — match the bare
-        # name anywhere.
-        if name not in doc:
-            missing.append(f"metric {name}")
-    for name in SPAN_NAMES:
-        if f"`{name}`" not in doc:
-            missing.append(f"span {name}")
-    for reason in DUMP_REASONS:
-        if f"`{reason}`" not in doc:
-            missing.append(f"dump reason {reason}")
-    assert not missing, (
-        "docs/observability.md is missing: " + ", ".join(missing)
+    findings = [f for f in repo_lint_report().findings
+                if f.checker == "telemetry-drift"]
+    assert not findings, "\n" + "\n".join(
+        f.format() for f in findings
     )
