@@ -3,7 +3,7 @@
 
 PYTEST := env JAX_PLATFORMS=cpu python -m pytest
 
-.PHONY: smoke chaos fast test nightly lint
+.PHONY: smoke chaos fast test nightly lint perf-gate
 
 # The documented pre-push check: the -m fast contract lane plus the
 # serving e2es through the real CLI daemon — 2-job ensemble, chaos
@@ -27,6 +27,14 @@ chaos:
 # tier-1 test (tests/test_lint.py) and smoke stage 11/11.
 lint:
 	env JAX_PLATFORMS=cpu python -m gravity_tpu lint
+
+# Noise-robust perf regression gate against the committed
+# PERF_BASELINE.json contracts (docs/observability.md "Performance"):
+# interleaved paired A/B, median-of-ratios + bootstrap CI — the ~1.8x
+# window swing structurally cannot flake it. Exit 1 names the file +
+# every violated contract. Also smoke stage 12/12.
+perf-gate:
+	env JAX_PLATFORMS=cpu python -m gravity_tpu bench --gate
 
 fast:
 	$(PYTEST) tests/ -q -m 'fast and not slow and not heavy'

@@ -38,6 +38,27 @@ import time
 NORTH_STAR = 1.0e11  # pair-interactions/sec/chip (BASELINE.json)
 CACHE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_LAST_TPU.json")
 
+# A replayed TPU headline older than STALE_REPLAY_DAYS is STALE: still
+# the last verified chip measurement (and still the honest headline vs
+# a CPU line), but the printed line flags it and a warning lands on
+# stderr — every BENCH row since r5 has been a replay of the same
+# 2026-08-01 window, and that fact should be impossible to miss in the
+# artifact. Policy lives in ONE place (gravity_tpu.bench, shared with
+# the `bench --report` trend table); imported lazily so this script's
+# module import stays as light as before.
+
+
+def _replay_age_days(measured_at: str) -> float | None:
+    from gravity_tpu.bench import replay_age_days
+
+    return replay_age_days(measured_at)
+
+
+def _stale_replay_days() -> float:
+    from gravity_tpu.bench import STALE_REPLAY_DAYS
+
+    return STALE_REPLAY_DAYS
+
 # A cached line replayed as the round's headline must be auditable back to
 # the real on-chip run that produced it. Entries missing any of these were
 # not written by _save_tpu_line (e.g. hand-seeded) and are refused.
@@ -306,6 +327,27 @@ def main() -> int:
             result = dict(cached)
             del result["emitted_json"]  # audit blob, not part of the printed line
             result["platform"] = "tpu-cached"
+            # Replay provenance made loud (docs/observability.md
+            # "Bench trend report"): the line says how old the
+            # replayed chip measurement is, and a stale one warns.
+            age = _replay_age_days(cached.get("measured_at"))
+            stale_days = _stale_replay_days()
+            result["replay_age_days"] = (
+                round(age, 1) if age is not None else None
+            )
+            result["replay_stale"] = bool(
+                age is not None and age > stale_days
+            )
+            if result["replay_stale"]:
+                print(
+                    f"WARNING: replayed TPU headline is {age:.1f} days "
+                    f"old (> {stale_days:g}; measured_at "
+                    f"{cached.get('measured_at')}) — the printed value "
+                    "is the last VERIFIED chip line, not a fresh "
+                    "measurement; re-run on a live tunnel window to "
+                    "refresh BENCH_LAST_TPU.json",
+                    file=sys.stderr,
+                )
             result["fallback_cpu"] = {
                 k: fallback[k]
                 for k in (

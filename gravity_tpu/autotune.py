@@ -391,14 +391,19 @@ def _time_backend(
     speed-WITHIN-budget instead of raw speed."""
     from .ops.integrators import init_carry
     from .simulation import Simulator
+    from .telemetry import perf as _perf
     from .utils.profiling import debug_check_forces
     from .utils.timing import sync, warm_sync
 
     cfg = dataclasses.replace(config, force_backend=backend)
-    sim = Simulator(cfg, state=state)
-    st = sim.state
-    acc = init_carry(sim.accel_fn, st)
-    st, acc, _ = sim._run_block(st, acc, n_steps=1, record=False)
+    # Probe compiles are real Simulator block compiles: the perf-site
+    # bind labels their ledger rows "autotune_probe" so a reader can
+    # tell routing probes from the run's own programs.
+    with _perf.site("autotune_probe"):
+        sim = Simulator(cfg, state=state)
+        st = sim.state
+        acc = init_carry(sim.accel_fn, st)
+        st, acc, _ = sim._run_block(st, acc, n_steps=1, record=False)
     warm_sync(st.positions)
     t0 = time.perf_counter()
     for _ in range(probe_steps):
@@ -539,6 +544,12 @@ def resolve_backend_measured(
             # proceeds on whatever did probe.
             skipped[backend] = f"{type(e).__name__}: {e}"
     probe_ms = (time.perf_counter() - t0) * 1e3
+    # Probe cost promoted from run-stats-only to a scrapeable
+    # histogram when a worker's telemetry is attached
+    # (docs/observability.md "Performance").
+    from .telemetry import perf as _perf
+
+    _perf.ledger().observe_probe(probe_ms)
     if not timings:
         return AutotuneDecision(
             _static(), "static", probe_ms, {}, skipped, h

@@ -183,6 +183,27 @@ def run_cadence_benchmark(config: SimulationConfig) -> dict:
 
 # --- perf-trend reporting over the accumulated round artifacts ---
 
+# Replay-staleness policy (ONE definition — the root bench.py headline
+# warning and the trend report both import it): a replayed TPU line
+# older than this is flagged stale. Still the last verified chip
+# measurement, but the artifacts must say how old it is.
+STALE_REPLAY_DAYS = 7.0
+
+
+def replay_age_days(measured_at) -> "float | None":
+    """Age in days of a ``measured_at`` UTC stamp
+    (``%Y-%m-%dT%H:%M:%SZ``); None if unparseable."""
+    import calendar
+    import time as _time
+
+    try:
+        t = calendar.timegm(
+            _time.strptime(measured_at, "%Y-%m-%dT%H:%M:%SZ")
+        )
+    except (TypeError, ValueError):
+        return None
+    return max(0.0, (_time.time() - t) / 86400.0)
+
 
 def _round_num(path: str) -> int:
     import re
@@ -242,6 +263,10 @@ def collect_bench_rounds(root: str = ".") -> dict:
             "n": parsed.get("n"),
             "backend": parsed.get("backend"),
             "platform": parsed.get("platform"),
+            # A tpu-cached row is a REPLAY of the last verified chip
+            # line, not a live measurement of this round — the report
+            # must say so (docs/observability.md "Bench trend report").
+            "replay": parsed.get("platform") == "tpu-cached",
             "steps_per_s": (1.0 / avg) if avg else None,
             "pairs_per_s": parsed.get("value"),
             "mfu": parsed.get("mfu"),
@@ -338,12 +363,55 @@ def collect_bench_rounds(root: str = ".") -> dict:
             "candidates": key.get("candidates"),
         })
     verdicts.sort(key=lambda r: (r["n"] or 0, r["winner"] or ""))
+    # Replay staleness: the newest replayed headline's age — every
+    # BENCH row since r5 replays the same chip window, and the trend
+    # table should say so instead of looking freshly measured.
+    stale = None
+    replays = [
+        r for r in bench_rows if r["replay"] and r.get("measured_at")
+    ]
+    if replays:
+        age = replay_age_days(replays[-1]["measured_at"])
+        if age is not None:
+            stale = {
+                "age_days": round(age, 1),
+                "stale": age > STALE_REPLAY_DAYS,
+                "measured_at": replays[-1]["measured_at"],
+            }
+    # Perf observatory artifacts (docs/observability.md
+    # "Performance"): ledger rows, committed gate contracts, and the
+    # last gate outcome.
+    from .telemetry.perf import LEDGER_FILE, read_ledger, summarize_rows
+
+    perf_rows = summarize_rows(
+        read_ledger(os.path.join(root, LEDGER_FILE))
+    )
+    baseline = None
+    try:
+        with open(os.path.join(root, "PERF_BASELINE.json")) as f:
+            doc = json.load(f)
+        baseline = [
+            {"name": c.get("name"), "kind": c.get("kind")}
+            for c in doc.get("contracts", [])
+        ]
+    except (OSError, ValueError):
+        pass
+    gate = None
+    try:
+        with open(os.path.join(root, "PERF_GATE_LAST.json")) as f:
+            gate = json.load(f)
+    except (OSError, ValueError):
+        pass
     return {
         "bench": bench_rows,
+        "replay_staleness": stale,
         "multichip": multichip_rows,
         "nlist_sweep": nlist_sweep,
         "nlist_tune": nlist_tune,
         "tuning_verdicts": verdicts,
+        "perf_ledger": perf_rows,
+        "perf_baseline": baseline,
+        "perf_gate": gate,
     }
 
 
@@ -364,7 +432,7 @@ def format_bench_report(data: dict) -> str:
     lines = ["== bench rounds =="]
     header = (
         f"{'rnd':>3} {'n':>9} {'backend':>10} {'platform':>10} "
-        f"{'steps/s':>9} {'pairs/s':>10} {'mfu':>6} "
+        f"{'live':>6} {'steps/s':>9} {'pairs/s':>10} {'mfu':>6} "
         f"{'host_gap':>8} {'delta':>7}"
     )
     lines.append(header)
@@ -382,6 +450,7 @@ def format_bench_report(data: dict) -> str:
             f"{_fmt(row['n'], 'd'):>9} "
             f"{_fmt(row['backend']):>10} "
             f"{_fmt(row['platform']):>10} "
+            f"{'replay' if row.get('replay') else 'live':>6} "
             f"{_fmt(row['steps_per_s'], '.2f'):>9} "
             f"{_fmt(row['pairs_per_s'], '.2e'):>10} "
             f"{_fmt(row['mfu'], '.3f'):>6} "
@@ -390,6 +459,15 @@ def format_bench_report(data: dict) -> str:
         )
     if not data.get("bench"):
         lines.append("  (no BENCH_r*.json rounds found)")
+    stale = data.get("replay_staleness")
+    if stale and stale.get("stale"):
+        lines.append(
+            f"  WARNING: the replayed TPU headline is "
+            f"{stale['age_days']:g} days old (measured_at "
+            f"{stale['measured_at']}) — every 'replay' row above "
+            "re-prints that one verified chip line; the next live "
+            "tunnel window should refresh it"
+        )
     lines.append("")
     lines.append("== multichip rounds ==")
     lines.append(f"{'rnd':>3} {'devices':>8} {'ok':>5} {'skipped':>8}")
@@ -454,4 +532,54 @@ def format_bench_report(data: dict) -> str:
                 f"{ru:>18} "
                 f"{_fmt(row['winner_p90_err'], '.1e'):>8}"
             )
+    if data.get("perf_ledger"):
+        lines.append("")
+        lines.append("== perf ledger (perf_ledger.jsonl, latest per key) ==")
+        lines.append(
+            f"{'site':>14} {'backend':>10} {'n':>9} {'flops':>10} "
+            f"{'peak MB':>8} {'compile s':>9} {'model':>6}"
+        )
+        for row in data["perf_ledger"]:
+            peak = row.get("peak_bytes")
+            lines.append(
+                f"{_fmt(row.get('site')):>14} "
+                f"{_fmt(row.get('backend')):>10} "
+                f"{_fmt(row.get('n'), 'd'):>9} "
+                f"{_fmt(row.get('flops'), '.2e'):>10} "
+                f"{_fmt(peak / 1e6 if peak else None, '.1f'):>8} "
+                f"{_fmt(row.get('compile_s'), '.2f'):>9} "
+                f"{_fmt(row.get('model_ratio'), '.2f'):>6}"
+            )
+    gate = data.get("perf_gate")
+    if gate:
+        lines.append("")
+        lines.append(
+            f"== perf gate (PERF_GATE_LAST.json, ran {gate.get('ran_at')}) "
+            f"{'PASS' if gate.get('ok') else 'FAIL'} =="
+        )
+        if gate.get("handicap"):
+            # Defense in depth: the gate refuses to persist handicapped
+            # runs, but an artifact that somehow carries one must not
+            # read as an honest outcome.
+            lines.append(
+                f"  WARNING: artifact recorded under an injected "
+                f"handicap {gate['handicap']} — not a clean gate run"
+            )
+        for r in gate.get("results", []):
+            ci = r.get("ci")
+            lines.append(
+                f"  {'ok ' if r.get('ok') else 'VIOLATED'} "
+                f"{r.get('name')}: measured "
+                f"{_fmt(r.get('measured'), '.3g')}"
+                + (f" CI [{ci[0]:.3g}, {ci[1]:.3g}]" if ci else "")
+                + f" vs bound {_fmt(r.get('bound'), '.3g')}"
+                f" [{r.get('kind')}]"
+            )
+    elif data.get("perf_baseline"):
+        lines.append("")
+        lines.append(
+            "== perf gate: PERF_BASELINE.json has "
+            f"{len(data['perf_baseline'])} contract(s); no "
+            "PERF_GATE_LAST.json yet (run `gravity_tpu bench --gate`) =="
+        )
     return "\n".join(lines)

@@ -2113,6 +2113,21 @@ def cmd_tune(args: argparse.Namespace) -> int:
 
 
 def cmd_bench(args: argparse.Namespace) -> int:
+    if args.gate:
+        # Noise-robust perf regression gate against the committed
+        # PERF_BASELINE.json contracts (docs/observability.md
+        # "Performance"): exit 1 names the file + every violated
+        # contract.
+        from .perfgate import run_gate
+
+        code, _ = run_gate(
+            args.gate_baseline,
+            contracts=(
+                [c for c in args.gate_contracts.split(",") if c]
+                if args.gate_contracts else None
+            ),
+        )
+        return code
     if args.report:
         # Trend report over the accumulated BENCH_r*/MULTICHIP_r*
         # round artifacts — no run, no device (scripts/bench_report.py
@@ -2498,6 +2513,19 @@ def main(argv=None) -> int:
                               "(docs/observability.md)")
     p_bench.add_argument("--report-dir", dest="report_dir", default=".",
                          help="directory holding the round JSON files")
+    p_bench.add_argument("--gate", action="store_true",
+                         help="run the noise-robust perf regression "
+                              "gate against the committed "
+                              "PERF_BASELINE.json (exit 1 on any "
+                              "violated contract; docs/observability"
+                              ".md 'Performance')")
+    p_bench.add_argument("--gate-baseline", dest="gate_baseline",
+                         default="PERF_BASELINE.json",
+                         help="baseline contract file for --gate")
+    p_bench.add_argument("--gate-contracts", dest="gate_contracts",
+                         default=None,
+                         help="comma-separated contract names for "
+                              "--gate (default: all)")
     p_bench.set_defaults(fn=cmd_bench)
 
     p_texp = sub.add_parser(

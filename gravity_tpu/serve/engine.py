@@ -431,9 +431,33 @@ class EnsembleEngine:
     def round_fn(self, key: BatchKey):
         if key not in self._round_fns:
             cls = self._job_class(key)
-            self._round_fns[key] = (
+            built = (
                 self._build_round_fn(key) if cls is None
                 else cls.build_round_fn(self, key)
+            )
+            # Performance observatory (docs/observability.md
+            # "Performance"): every BatchKey's round program compiles
+            # through the instrumented AOT path, so the perf ledger
+            # records its measured flops / bytes / peak HBM, compile
+            # seconds, and pair-model ratio — and the measured peak
+            # feeds the memory-aware admission for every later job
+            # that resolves to this key. ``_mark_compile`` still fires
+            # at trace time inside the wrapped body, so
+            # ``compile_counts`` semantics are unchanged.
+            from ..telemetry import perf as _perf
+
+            self._round_fns[key] = _perf.instrument_jit(
+                built,
+                site="serve_round",
+                key=_perf.engine_key_str(key),
+                backend=key.backend,
+                n=key.bucket_n,
+                analytic=(
+                    (_perf.analytic_flops(key.backend, key.bucket_n)
+                     or 0.0) * key.slots or None
+                ),
+                meta={"job_type": key.job_type, "slots": key.slots,
+                      "bucket": key.bucket_n},
             )
         return self._round_fns[key]
 

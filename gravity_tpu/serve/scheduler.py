@@ -550,6 +550,22 @@ class EnsembleScheduler:
         declare_worker_metrics(self.telemetry.registry)
         # Compile marks from the engine land in the same ring.
         self.engine.recorder = self.telemetry.recorder
+        # Performance observatory (docs/observability.md
+        # "Performance"): point the process perf ledger at this
+        # worker's telemetry — compiled-program rows append to
+        # perf_ledger.jsonl under the spool, feed the compile/flops/
+        # peak-bytes metrics, and recompile storms raise the
+        # recompile_storm event + flight-recorder dump through this
+        # worker's own emitters. close() detaches.
+        from ..telemetry import perf as _perf
+
+        _perf.ledger().attach(
+            out_dir=spool.root if spool is not None else None,
+            registry=self.telemetry.registry,
+            recorder=self.telemetry.recorder,
+            event_hook=self._event,
+            owner=self,
+        )
         # SLO burn flags (--slo-p99-ms / --slo-occupancy): breaches are
         # edge-triggered slo_breach events + counters, state readable
         # in /metrics (docs/observability.md "SLO flags").
@@ -768,6 +784,7 @@ class EnsembleScheduler:
                         retry_after_s=retry_after)
             raise QueueFull(retry_after, self.queue_depth)
         key = None
+        member_key = None
         # The bind hands the autotune probe (resolve_engine_backend on
         # a cache miss) this trace: probe spans + verdict provenance
         # land in the job's own timeline.
@@ -786,7 +803,7 @@ class EnsembleScheduler:
                 # failures.
                 from .jobs import get_class as _gc
 
-                _gc("sweep-member").batch_key(
+                member_key = _gc("sweep-member").batch_key(
                     config, {"member": 0, **{
                         k: v for k, v in params.items()
                         if k in ("spread", "drift_tol", "escape_radius",
@@ -795,6 +812,28 @@ class EnsembleScheduler:
                     slots=self.slots, min_bucket=self.min_bucket,
                     reroute=self.breakers.reroute,
                 )
+        # Memory-aware admission (docs/observability.md
+        # "Performance"): the resolved key's program must fit device
+        # memory — from the perf ledger's MEASURED peak HBM when the
+        # key has compiled before, the sizing-model estimate on a cold
+        # key. An over-budget job is a typed submit-time rejection
+        # (HTTP 400), never an OOM that takes down a live round and
+        # its batchmates — the first concrete piece of the ROADMAP-1
+        # router's placement logic. No-op where the platform exposes
+        # no budget (CPU without the GRAVITY_TPU_HBM_BYTES override).
+        from ..telemetry import perf as _perf
+
+        try:
+            _perf.check_admission_memory(key or member_key)
+        except _perf.InsufficientDeviceMemory as e:
+            self._event(
+                "memory_rejected", n=config.n, job_type=job_type,
+                backend=(key or member_key).backend,
+                bucket=(key or member_key).bucket_n,
+                required_bytes=e.required_bytes,
+                budget_bytes=e.budget_bytes, source=e.source,
+            )
+            raise
         if deadline_s is not None:
             # Coerce at the boundary: the HTTP API is open, and a
             # string deadline would TypeError inside _expire_deadlines
@@ -1562,6 +1601,12 @@ class EnsembleScheduler:
         if self.leases is not None:
             self.leases.stop_heartbeat()
             self.leases.release_all()
+        # The process perf ledger must not keep writing into a closed
+        # scheduler's spool/registry (detach only if we still own it —
+        # a newer scheduler's attach wins).
+        from ..telemetry import perf as _perf
+
+        _perf.ledger().detach(owner=self)
 
     def __enter__(self) -> "EnsembleScheduler":
         return self
@@ -2099,6 +2144,7 @@ class EnsembleScheduler:
         t0 = time.perf_counter()
         try:
             batch, res = self.engine.run_slice(batch, self.slice_steps)
+            slice_s = time.perf_counter() - t0
         except Exception as exc:
             # run_slice DONATES the batch carry: after a throw mid-slice
             # (e.g. a transient device error at the finite fetch) the
@@ -2212,6 +2258,20 @@ class EnsembleScheduler:
         reg.histogram("gravity_round_seconds").observe(round_s)
         if compiled:
             reg.counter("gravity_compiles_total").inc()
+        # Performance observatory (docs/observability.md
+        # "Performance"): the run-stats-only throughput facts promoted
+        # to scrapeable gauges — slot-units/s over this round, and the
+        # round's host tax (time outside run_slice: numerics probes,
+        # accounting, span emission) as the serve analog of the solo
+        # host_gap_frac.
+        reg.gauge("gravity_steps_per_sec").set(
+            float(np.sum(res.advanced)) / round_s if round_s > 0
+            else 0.0
+        )
+        reg.gauge("gravity_host_gap_frac").set(
+            max(0.0, round_s - slice_s) / round_s if round_s > 0
+            else 0.0
+        )
 
         # Class hook BEFORE accounting: event emission / follow-up
         # submission sees round-start unit counts, and a job completing
@@ -2250,10 +2310,23 @@ class EnsembleScheduler:
                     backend=key.backend, compiled=compiled,
                 )
                 if compiled:
+                    # Enriched with the perf ledger's figures for this
+                    # key (docs/observability.md "Performance"): the
+                    # compile span now SAYS what the program costs,
+                    # not just that a compile happened.
+                    from ..telemetry import perf as _perf
+
+                    led_row = _perf.ledger().row_for(
+                        _perf.engine_key_str(key)
+                    ) or {}
                     self.telemetry.tracer.emit(
                         "compile", job.trace_id, t0_wall, round_s,
                         parent=rid, bucket=key.bucket_n,
                         backend=key.backend,
+                        compile_s=led_row.get("compile_s"),
+                        flops=led_row.get("flops"),
+                        peak_bytes=led_row.get("peak_bytes"),
+                        model_ratio=led_row.get("model_ratio"),
                     )
                 if probe is not None and probe["job"] == job.id:
                     # The sentinel's cost + verdict as a CHILD of the

@@ -629,7 +629,21 @@ class GravityDaemon:
                     # TypeError too: dataclasses don't type-check, so a
                     # wrong-typed field (n="10") surfaces inside
                     # batch_key_for — still client input, still 400.
-                    return 400, {"error": str(e)}
+                    payload = {"error": str(e)}
+                    from ..telemetry import InsufficientDeviceMemory
+
+                    if isinstance(e, InsufficientDeviceMemory):
+                        # Memory-aware admission (docs/observability
+                        # .md "Performance"): typed fields so a router
+                        # can place the job elsewhere instead of
+                        # string-matching the message.
+                        payload.update(
+                            kind="insufficient_device_memory",
+                            required_bytes=e.required_bytes,
+                            budget_bytes=e.budget_bytes,
+                            source=e.source,
+                        )
+                    return 400, payload
             return 200, {"job": job_id}
         if path == "/cancel":
             with self.lock:

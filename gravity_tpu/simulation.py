@@ -1006,9 +1006,45 @@ class Simulator:
         # Convenience one-arg wrapper (carry seeding, run_adaptive, the
         # bench harness): reads the CURRENT self.state's masses.
         self.accel_fn = lambda pos: self._accel2(pos, self.state.masses)
-        self._run_block = jax.jit(
-            self._block_fn,
-            static_argnames=("n_steps", "record", "record_every"),
+        # Performance observatory (docs/observability.md
+        # "Performance"): the block fn is the solo stack's compile
+        # site — every distinct (n_steps, record) signature is AOT
+        # lowered+compiled once through the instrumented wrapper, its
+        # XLA cost/memory analysis and compile seconds captured into
+        # the perf ledger with the pair-model flop expectation, and
+        # executed through the captured executable.
+        from .telemetry import perf as _perf
+
+        _tiles = (
+            self.nlist_sizing[2]
+            if self.backend == "nlist" and self.nlist_sizing is not None
+            else None
+        )
+        _perf_kw = dict(
+            site="solo_block",
+            key=_perf.logical_key(
+                "solo", backend=self.backend, n=self.state.n,
+                dtype=config.dtype, integrator=config.integrator,
+                sharding=(
+                    config.sharding if self.mesh is not None else None
+                ),
+            ),
+            backend=self.backend,
+            n=self.state.n,
+            analytic=_perf.analytic_flops(
+                self.backend, self.state.n,
+                force_evals=FORCE_EVALS_PER_STEP.get(
+                    config.integrator, 1
+                ),
+                evaluated_pairs=_tiles,
+            ),
+        )
+        self._run_block = _perf.instrument_jit(
+            jax.jit(
+                self._block_fn,
+                static_argnames=("n_steps", "record", "record_every"),
+            ),
+            **_perf_kw,
         )
         # Donated twin for the pipelined driver (docs/scaling.md "Host
         # pipeline & donation"): the (state, acc) carry is donated so
@@ -1016,10 +1052,13 @@ class Simulator:
         # pipelined loop, which consumes the previous block through the
         # non-aliased snapshot below — the serial loop reads its block
         # inputs after the call (emergency saves) and must not donate.
-        self._run_block_donated = jax.jit(
-            self._block_fn,
-            static_argnames=("n_steps", "record", "record_every"),
-            donate_argnums=(0, 1),
+        self._run_block_donated = _perf.instrument_jit(
+            jax.jit(
+                self._block_fn,
+                static_argnames=("n_steps", "record", "record_every"),
+                donate_argnums=(0, 1),
+            ),
+            **dict(_perf_kw, meta={"donated": True}),
         )
         # Pipeline companions, dispatched on a block's outputs BEFORE
         # the next block donates them: the watchdog's finiteness verdict
@@ -2206,6 +2245,34 @@ class Simulator:
         stats["autotune_probe_ms"] = self.autotune["probe_ms"]
         stats["host_gap_frac"] = gap.host_gap_frac
         self.last_host_gap_frac = gap.host_gap_frac
+        # Performance observatory (docs/observability.md
+        # "Performance"): the perf facts promoted into the run's
+        # metrics registry when a telemetry bundle is attached — the
+        # same gauge names the serving worker publishes, so solo and
+        # served runs merge in one fleet view. The run's own
+        # compiled-program rows ride along in stats["perf"].
+        if telemetry is not None:
+            from .telemetry import declare_worker_metrics
+
+            reg = declare_worker_metrics(telemetry.registry)
+            if gap.host_gap_frac is not None:
+                reg.gauge("gravity_host_gap_frac").set(
+                    gap.host_gap_frac
+                )
+            if total_time > 0:
+                reg.gauge("gravity_steps_per_sec").set(
+                    (total_steps - start_step) / total_time
+                )
+            if self.autotune["probe_ms"]:
+                reg.histogram("gravity_autotune_probe_ms").observe(
+                    self.autotune["probe_ms"]
+                )
+        from .telemetry import perf as _perf
+
+        stats["perf"] = _perf.summarize_rows([
+            r for r in _perf.ledger().rows_list()
+            if r.get("key") == self._run_block.key
+        ])
         if ledger_on:
             # The drift series' run-level summary (docs/observability
             # .md "Numerics") — consumed by the BENCH JSON line and the

@@ -23,6 +23,13 @@ import os
 from typing import Optional
 
 from .flightrec import FlightRecorder
+from .perf import (
+    InstrumentedFn,
+    InsufficientDeviceMemory,
+    PerfLedger,
+    instrument_jit,
+)
+from .perf import ledger as perf_ledger
 from .metrics import (
     MetricsRegistry,
     declare_worker_metrics,
@@ -73,10 +80,11 @@ class Telemetry:
 
 
 __all__ = [
-    "FlightRecorder", "MetricsRegistry", "SPAN_NAMES", "TRACES_FILE",
+    "FlightRecorder", "InstrumentedFn", "InsufficientDeviceMemory",
+    "MetricsRegistry", "PerfLedger", "SPAN_NAMES", "TRACES_FILE",
     "Telemetry", "Tracer", "bind", "chrome_trace",
-    "declare_worker_metrics", "emit_bound", "load_spans",
-    "merge_snapshots", "new_trace_id", "parse_prometheus_text",
-    "prometheus_text", "snapshot_quantile", "span_coverage",
-    "trace_ids",
+    "declare_worker_metrics", "emit_bound", "instrument_jit",
+    "load_spans", "merge_snapshots", "new_trace_id",
+    "parse_prometheus_text", "perf_ledger", "prometheus_text",
+    "snapshot_quantile", "span_coverage", "trace_ids",
 ]

@@ -35,7 +35,7 @@ DEFAULT_CAPACITY = 512
 # Dump-trigger reasons (docs lint tables them).
 DUMP_REASONS = (
     "divergence", "breaker_open", "sigterm", "round_error",
-    "adoption", "request", "accuracy_breach",
+    "adoption", "request", "accuracy_breach", "recompile_storm",
 )
 
 

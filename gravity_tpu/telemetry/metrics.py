@@ -90,13 +90,40 @@ WORKER_METRICS = (
     ("gravity_job_resume_step", "gauge",
      "Units restored from the last verified progress snapshot when a "
      "requeued/adopted job resumed mid-run, by job"),
+    # Performance observatory (docs/observability.md "Performance").
+    ("gravity_compile_seconds", "histogram",
+     "Wall-clock seconds per XLA program compile, by site"),
+    ("gravity_program_flops", "gauge",
+     "Measured per-iteration flops of the latest compiled program, "
+     "by ledger key (XLA cost analysis)"),
+    ("gravity_program_peak_bytes", "gauge",
+     "Measured device-memory footprint (arg+output+temp) of the "
+     "latest compiled program, by ledger key"),
+    ("gravity_host_gap_frac", "gauge",
+     "Fraction of recent wall-clock with no device work in flight "
+     "(solo: the block pipeline's host gap; serve: round time "
+     "outside run_slice)"),
+    ("gravity_steps_per_sec", "gauge",
+     "Integration throughput over the last block/round (serve: "
+     "slot-units advanced per second summed over residents)"),
+    ("gravity_autotune_probe_ms", "histogram",
+     "Wall-clock milliseconds per autotune measurement probe"),
+)
+
+# Millisecond-scale buckets for the autotune probe cost (a probe is
+# 10ms-minutes; the seconds-scale latency buckets would collapse the
+# interesting range into two bins).
+MS_BUCKETS = (
+    1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0,
+    1000.0, 2500.0, 5000.0, 10000.0, 30000.0, 60000.0, 300000.0,
 )
 
 # Per-family bucket overrides for declare_worker_metrics: histograms
 # default to the latency buckets, which are meaningless for relative
-# errors.
+# errors or millisecond probe costs.
 WORKER_METRIC_BUCKETS = {
     "gravity_force_error_rel": ERROR_BUCKETS,
+    "gravity_autotune_probe_ms": MS_BUCKETS,
 }
 
 _NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
@@ -298,6 +325,14 @@ GAUGE_MERGE = {
     # observed drift instead of a nonsense sum.
     "gravity_job_energy_drift": "max",
     "gravity_job_momentum_drift": "max",
+    # Performance observatory: a ratio averages; per-program facts
+    # are identical across workers that compiled the same key — max
+    # reports one honest figure instead of a worker-count multiple.
+    # steps_per_sec stays the sum default: fleet throughput is a
+    # genuine total.
+    "gravity_host_gap_frac": "mean",
+    "gravity_program_flops": "max",
+    "gravity_program_peak_bytes": "max",
 }
 
 

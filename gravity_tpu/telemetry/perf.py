@@ -1,0 +1,686 @@
+"""Performance observatory: the XLA cost/memory ledger behind every
+compiled program (docs/observability.md "Performance").
+
+Until now performance was folklore: nothing recorded what a compiled
+program actually COSTS — its measured flops, bytes, and peak HBM —
+recompiles were only visible as anonymous ``compile`` spans, and the
+per-kernel breakdown discipline the GPU N-body literature treats as
+table stakes (arxiv 0706.3060, 1710.07350) had no home. This module
+gives every compile site one:
+
+- :class:`InstrumentedFn` wraps a jitted function so each distinct
+  (static args, input avals) signature is AOT ``lower().compile()``-d
+  exactly once, its ``cost_analysis()`` / ``memory_analysis()`` and
+  compile seconds captured into the ledger, and every call executed
+  through the captured executable. (The jit call cache and the AOT
+  cache do NOT share entries on this jax — compiling both ways would
+  double every compile — so the executable IS the call path; any AOT
+  anomaly falls back to the plain jitted call for that signature.)
+- :class:`PerfLedger` is the per-process record store: one row per
+  compiled program carrying measured flops/bytes/peak-HBM, compile
+  seconds, the analytic flop expectation from the
+  :data:`~gravity_tpu.utils.timing.FLOPS_PER_PAIR` cost model, and
+  ``model_ratio`` = measured / analytic — the "is this kernel still
+  the kernel we think it is?" number. Rows append to
+  ``perf_ledger.jsonl`` when a sink is attached, feed the
+  ``gravity_compile_seconds`` / ``gravity_program_flops`` /
+  ``gravity_program_peak_bytes`` worker metrics, and enrich the
+  serving ``compile`` span.
+- Recompile-storm detection: the same logical key compiled more than
+  :data:`STORM_THRESHOLD` times means the program cache is thrashing
+  (a shape leak, an aval drift) — a ``recompile_storm`` event plus a
+  flight-recorder dump, not a silent compile tax.
+- Memory-aware admission: :func:`required_bytes_for_key` answers "will
+  this BatchKey's program fit device memory?" from the ledger's
+  measured peak when the key has compiled before, and from the sizing
+  model :func:`estimate_peak_bytes` on a cold key — the serving
+  scheduler rejects over-budget submits with the typed
+  :class:`InsufficientDeviceMemory` instead of OOM-ing a live round.
+
+Flop-accounting convention: XLA's HLO cost analysis counts a
+``while``/``scan`` body ONCE regardless of trip count, so a ledger
+row's ``flops`` is the per-iteration cost of the program's loop — and
+``analytic_flops`` is correspondingly the ONE-step pair-model
+expectation (pairs x flops/pair x force evals/step). For direct-sum
+backends ``model_ratio`` sits near 1 (measured ~1.2 on the dense jnp
+block: integrator + watchdog overhead); for the sub-quadratic solvers
+the analytic term is the DENSE-EQUIVALENT expectation, so the ratio is
+the measured work fraction — well below 1, shrinking with n.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import json
+import math
+import os
+import threading
+import time
+from collections import deque
+from typing import Callable, Optional
+
+from ..utils.logging import JsonlEventLogger
+
+LEDGER_FILE = "perf_ledger.jsonl"
+
+# One program owner (an InstrumentedFn: one engine BatchKey, one
+# Simulator's block fn) compiling more than this many distinct
+# signatures = a recompile storm: serving keys compile exactly once by
+# design, and a solo run legitimately sees only the handful of
+# (n_steps, record) tail shapes. Past the threshold the program cache
+# is thrashing (a shape or weak-type leak). Tests lower
+# ``ledger().storm_threshold``.
+STORM_THRESHOLD = 5
+
+# Fraction of the device memory budget a program's peak may claim at
+# admission — headroom for the runtime's own allocations and the
+# resident batches of OTHER keys.
+ADMIT_HEADROOM = 0.9
+
+# Bounded in-memory row history (the JSONL sink is the durable record).
+MAX_ROWS = 4096
+
+
+class InsufficientDeviceMemory(ValueError):
+    """A job's resolved program cannot fit device memory: raised at
+    ADMISSION (a clean typed rejection the HTTP layer maps to 400)
+    instead of letting the slot load OOM a live scheduling round."""
+
+    def __init__(self, message: str, *, required_bytes: int,
+                 budget_bytes: int, source: str):
+        super().__init__(message)
+        self.required_bytes = int(required_bytes)
+        self.budget_bytes = int(budget_bytes)
+        # "measured" (a ledger row for this key) or "estimated" (the
+        # cold-key sizing model) — the rejection names its evidence.
+        self.source = source
+
+
+class PerfEventLogger(JsonlEventLogger):
+    """``perf_ledger.jsonl`` — one ``perf_compile`` record per compiled
+    program, on the shared JSONL spine."""
+
+    KINDS = ("perf_compile",)
+
+
+# Ambient site override: the autotune probe drives real Simulator
+# block compiles; binding a site here labels those ledger rows as
+# probe compiles without threading a parameter through the Simulator.
+_SITE: contextvars.ContextVar = contextvars.ContextVar(
+    "gravity_tpu_perf_site", default=None
+)
+
+
+@contextlib.contextmanager
+def site(name: str):
+    token = _SITE.set(name)
+    try:
+        yield
+    finally:
+        _SITE.reset(token)
+
+
+def _cost_dict(compiled) -> dict:
+    """Flatten ``compiled.cost_analysis()`` (dict, or list-of-dict per
+    partition — summed) into {flops, bytes_accessed, transcendentals};
+    empty on backends without the analysis."""
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:  # noqa: BLE001 — optional on some backends
+        return {}
+    if isinstance(ca, dict):
+        parts = [ca]
+    elif isinstance(ca, (list, tuple)):
+        parts = [p for p in ca if isinstance(p, dict)]
+    else:
+        parts = []
+    out: dict = {}
+    for key, name in (
+        ("flops", "flops"),
+        ("bytes accessed", "bytes_accessed"),
+        ("transcendentals", "transcendentals"),
+    ):
+        vals = [p.get(key) for p in parts if p.get(key) is not None]
+        if vals:
+            out[name] = float(sum(vals))
+    return out
+
+
+def _memory_dict(compiled) -> dict:
+    """``compiled.memory_analysis()`` as plain fields, with
+    ``peak_bytes`` = argument + output + temp (the program's
+    steady-state device footprint; XLA exposes no finer peak through
+    this API). Empty when the backend offers no analysis."""
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:  # noqa: BLE001
+        return {}
+    if ma is None:
+        return {}
+    try:
+        arg = int(ma.argument_size_in_bytes)
+        out_b = int(ma.output_size_in_bytes)
+        temp = int(ma.temp_size_in_bytes)
+        alias = int(getattr(ma, "alias_size_in_bytes", 0))
+        code = int(getattr(ma, "generated_code_size_in_bytes", 0))
+    except Exception:  # noqa: BLE001
+        return {}
+    return {
+        "arg_bytes": arg,
+        "output_bytes": out_b,
+        "temp_bytes": temp,
+        "generated_code_bytes": code,
+        # Aliased (donated) pages are counted once: they are the same
+        # physical HBM on input and output.
+        "peak_bytes": arg + max(out_b - alias, 0) + temp,
+    }
+
+
+def analytic_flops(
+    backend: str, n: int, *, force_evals: int = 1,
+    evaluated_pairs: Optional[float] = None,
+) -> Optional[float]:
+    """The cost model's ONE-step flop expectation for a backend at n
+    bodies (the denominator of ``model_ratio``; see the module
+    docstring for the loop-counted-once convention).
+
+    Direct-sum backends price the full N*(N-1) directed pair set at
+    their formulation's flops/pair. The nlist cell-list kernel prices
+    the pair TILES it actually evaluates when the caller knows them
+    (``evaluated_pairs``). Every other family (tree/fmm/sfmm/pm/p3m,
+    and nlist without sizing) is priced at the DENSE-EQUIVALENT
+    expectation — their ratio then reads as the measured work
+    fraction, the honest "how sub-quadratic is it really"."""
+    from ..utils.timing import (
+        FLOPS_PER_PAIR,
+        backend_formulation,
+        pairs_per_step,
+    )
+
+    if n is None or n < 2:
+        return None
+    fpp = FLOPS_PER_PAIR.get(
+        backend_formulation(backend), FLOPS_PER_PAIR["jnp"]
+    )
+    if backend == "nlist" and evaluated_pairs:
+        return float(evaluated_pairs) * fpp * max(force_evals, 1)
+    return float(pairs_per_step(n)) * fpp * max(force_evals, 1)
+
+
+def device_memory_budget() -> Optional[int]:
+    """Per-device memory budget in bytes, or None when the platform
+    exposes none (CPU hosts: admission checking is off unless
+    ``GRAVITY_TPU_HBM_BYTES`` forces a budget — tests and the smoke
+    stage use the override to exercise the rejection path on CPU)."""
+    env = os.environ.get("GRAVITY_TPU_HBM_BYTES")
+    if env:
+        try:
+            return int(float(env))
+        except ValueError:
+            pass
+    try:
+        import jax
+
+        stats = jax.local_devices()[0].memory_stats()
+    except Exception:  # noqa: BLE001 — no device, no budget
+        return None
+    if not stats:
+        return None
+    limit = stats.get("bytes_limit")
+    return int(limit) if limit else None
+
+
+def estimate_peak_bytes(key) -> int:
+    """Cold-key sizing model for a serve BatchKey's program footprint:
+    the state carry (two generations of the (slots, n, 3) triple —
+    donation halves it but admission must not assume it) plus the
+    backend's dominant pair intermediate. Deliberately simple and
+    slightly conservative; the first real compile replaces it with the
+    measured ``peak_bytes`` for every later admission of the key."""
+    item = 8 if str(key.dtype) in ("float64", "f64") else 4
+    slots, n = int(key.slots), int(key.bucket_n)
+    state = 2 * slots * (3 * n * 3 + n) * item
+    backend = key.backend
+    if backend.startswith("sharded"):
+        # The sharded class keys slots=1 and shards the pair work; its
+        # per-device intermediate is chunk-bounded, state-dominated.
+        return state
+    if backend == "dense":
+        pair = slots * n * n * 3 * item  # the (n, n, 3) diff tensor
+    elif backend == "chunked":
+        chunk = min(512, n)
+        pair = slots * n * chunk * 3 * item
+    elif backend == "nlist":
+        extra = dict(key.extra) if key.extra else {}
+        side = int(extra.get("nlist_side", 8) or 8)
+        cap = int(extra.get("nlist_cap", 64) or 64)
+        pair = slots * (side ** 3) * 27 * cap * 4 * item
+    else:
+        # Pallas tiles are VMEM-blocked: HBM stays state-dominated.
+        pair = slots * n * 8 * item
+    return state + pair
+
+
+class PerfLedger:
+    """Process-wide compile-cost record store with optional sinks.
+
+    Always records in memory (bounded ring). ``attach`` points it at a
+    worker's telemetry: rows then also append to
+    ``<out_dir>/perf_ledger.jsonl``, feed the metrics registry, mirror
+    into the flight recorder, and recompile storms raise the
+    ``recompile_storm`` event + dump through the worker's own
+    emitters. One attachment at a time (last wins — the daemon owns
+    its process); ``detach`` restores the unattached state."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self.rows: deque = deque(maxlen=MAX_ROWS)
+        self._by_key: dict = {}        # logical key -> latest row
+        self._compile_counts: dict = {}
+        self._stormed: set = set()
+        self.storm_threshold = STORM_THRESHOLD
+        self._log: Optional[PerfEventLogger] = None
+        self.registry = None
+        self.recorder = None
+        # event_hook(kind, **fields): the scheduler's serving-event
+        # emitter, so storms land in serving_events.jsonl.
+        self.event_hook: Optional[Callable] = None
+        self._owner = None
+
+    # --- sinks ---
+
+    def attach(self, *, out_dir=None, registry=None, recorder=None,
+               event_hook=None, owner=None) -> None:
+        with self._lock:
+            self._log = (
+                PerfEventLogger(os.path.join(out_dir, LEDGER_FILE))
+                if out_dir else None
+            )
+            self.registry = registry
+            self.recorder = recorder
+            self.event_hook = event_hook
+            self._owner = owner
+
+    def detach(self, owner=None) -> None:
+        """Drop the sinks (if ``owner`` still holds them): a closed
+        daemon must not leave the process ledger writing into its dead
+        spool dir."""
+        with self._lock:
+            if owner is not None and self._owner is not owner:
+                return
+            self._log = None
+            self.registry = None
+            self.recorder = None
+            self.event_hook = None
+            self._owner = None
+
+    def reset(self) -> None:
+        with self._lock:
+            self.rows.clear()
+            self._by_key.clear()
+            self._compile_counts.clear()
+            self._stormed.clear()
+
+    # --- recording ---
+
+    def record_compile(
+        self, *, site: str, key: str, compiled=None,
+        compile_s: float = 0.0, backend: Optional[str] = None,
+        n: Optional[int] = None, analytic: Optional[float] = None,
+        storm_count: Optional[int] = None,
+        **extra,
+    ) -> dict:
+        """Append one compiled-program row; returns it. ``key`` is the
+        logical program identity (per-key lookup); ``analytic`` the
+        cost model's one-step flop expectation; ``storm_count`` the
+        OWNER's compile ordinal (storm detection counts one program
+        owner's signature churn, not the benign cross-run repeats of
+        short-lived Simulators sharing a key)."""
+        eff_site = _SITE.get() or site
+        row = {
+            "site": eff_site,
+            "key": key,
+            "backend": backend,
+            "n": n,
+            "compile_s": round(float(compile_s), 6),
+        }
+        if compiled is not None:
+            row.update(_cost_dict(compiled))
+            row.update(_memory_dict(compiled))
+        measured = row.get("flops")
+        if measured is None and analytic:
+            # Backends without XLA cost analysis still get a finite,
+            # honest-by-construction ratio — flagged so a reader knows
+            # the measurement half is the model, not XLA.
+            measured = float(analytic)
+            row["flops"] = measured
+            row["flops_source"] = "analytic_fallback"
+        if analytic and measured is not None and analytic > 0:
+            row["analytic_flops"] = float(analytic)
+            row["model_ratio"] = round(measured / analytic, 6)
+        row.update(extra)
+        with self._lock:
+            self.rows.append(row)
+            self._by_key[key] = row
+            count = self._compile_counts.get(key, 0) + 1
+            self._compile_counts[key] = count
+            row["compile_count"] = count
+            log, registry, recorder = (
+                self._log, self.registry, self.recorder
+            )
+        try:
+            if log is not None:
+                log.event("perf_compile", **row)
+        except Exception:  # noqa: BLE001 — the ledger must never
+            pass  # take down the program it observes
+        if registry is not None:
+            try:
+                registry.histogram(
+                    "gravity_compile_seconds", site=eff_site
+                ).observe(row["compile_s"])
+                if row.get("flops") is not None:
+                    registry.gauge(
+                        "gravity_program_flops", key=key
+                    ).set(row["flops"])
+                if row.get("peak_bytes") is not None:
+                    registry.gauge(
+                        "gravity_program_peak_bytes", key=key
+                    ).set(row["peak_bytes"])
+            except Exception:  # noqa: BLE001
+                pass
+        if recorder is not None:
+            try:
+                recorder.record(
+                    "perf_compile", site=eff_site, key=key,
+                    compile_s=row["compile_s"],
+                    flops=row.get("flops"),
+                    peak_bytes=row.get("peak_bytes"),
+                    count=count,
+                )
+            except Exception:  # noqa: BLE001
+                pass
+        if storm_count is not None and storm_count > self.storm_threshold:
+            self._storm(key, storm_count)
+        return row
+
+    def _storm(self, key: str, count: int) -> None:
+        """Same logical key compiled past the threshold: emit the
+        ``recompile_storm`` event ONCE per key (edge-triggered — a
+        thrashing cache would otherwise spam every further retrace)
+        and dump the flight recorder for the postmortem."""
+        with self._lock:
+            if key in self._stormed:
+                return
+            self._stormed.add(key)
+            recorder, hook = self.recorder, self.event_hook
+        if hook is not None:
+            try:
+                hook("recompile_storm", key=key, compiles=count,
+                     threshold=self.storm_threshold)
+            except Exception:  # noqa: BLE001
+                pass
+        if recorder is not None:
+            try:
+                recorder.record(
+                    "event", event="recompile_storm", key=key,
+                    compiles=count,
+                )
+                recorder.dump("recompile_storm")
+            except Exception:  # noqa: BLE001
+                pass
+
+    def observe_probe(self, probe_ms: float) -> None:
+        """Autotune probe cost into the attached registry (the
+        run-stats-only ``autotune_probe_ms`` promoted to a scrapeable
+        histogram)."""
+        with self._lock:
+            registry = self.registry
+        if registry is None:
+            return
+        try:
+            registry.histogram("gravity_autotune_probe_ms").observe(
+                float(probe_ms)
+            )
+        except Exception:  # noqa: BLE001
+            pass
+
+    # --- queries ---
+
+    def row_for(self, key: str) -> Optional[dict]:
+        with self._lock:
+            row = self._by_key.get(key)
+            return dict(row) if row is not None else None
+
+    def rows_list(self) -> list:
+        with self._lock:
+            return [dict(r) for r in self.rows]
+
+    def compile_count(self, key: str) -> int:
+        with self._lock:
+            return self._compile_counts.get(key, 0)
+
+
+_LEDGER = PerfLedger()
+
+
+def ledger() -> PerfLedger:
+    return _LEDGER
+
+
+def logical_key(site: str, **parts) -> str:
+    """Canonical ledger key string: ``site:part=value/...`` with parts
+    sorted — short enough for a metric label, stable across runs."""
+    body = "/".join(
+        f"{k}={parts[k]}" for k in sorted(parts) if parts[k] is not None
+    )
+    return f"{site}:{body}" if body else site
+
+
+def engine_key_str(key) -> str:
+    """The serving BatchKey's ledger identity (one compiled program
+    per BatchKey — same granularity as engine.compile_counts)."""
+    return logical_key(
+        "serve", job=key.job_type, bucket=key.bucket_n,
+        slots=key.slots, backend=key.backend, dtype=key.dtype,
+        integrator=key.integrator,
+    )
+
+
+def required_bytes_for_key(key) -> tuple[int, str]:
+    """(bytes, source) a BatchKey's program needs on device: the
+    ledger's MEASURED peak when this key has compiled before (any
+    worker restart resets to the estimate — the ledger is per
+    process), else the sizing-model estimate."""
+    row = _LEDGER.row_for(engine_key_str(key))
+    if row is not None and row.get("peak_bytes"):
+        return int(row["peak_bytes"]), "measured"
+    return estimate_peak_bytes(key), "estimated"
+
+
+def check_admission_memory(key) -> None:
+    """Raise :class:`InsufficientDeviceMemory` when ``key``'s program
+    cannot fit the device memory budget (no-op when the platform
+    exposes no budget). The serving scheduler calls this at SUBMIT
+    time — the first concrete piece of the pod-router's
+    memory-aware placement (ROADMAP item 1)."""
+    budget = device_memory_budget()
+    if not budget:
+        return
+    required, source = required_bytes_for_key(key)
+    if required > budget * ADMIT_HEADROOM:
+        raise InsufficientDeviceMemory(
+            f"job does not fit device memory: backend "
+            f"{key.backend!r} at bucket {key.bucket_n} x "
+            f"{key.slots} slots needs ~{required / 1e9:.2f} GB "
+            f"({source}) vs a {budget / 1e9:.2f} GB device budget "
+            f"(x{ADMIT_HEADROOM} admission headroom); run it solo or "
+            f"shrink n",
+            required_bytes=required,
+            budget_bytes=budget,
+            source=source,
+        )
+
+
+class InstrumentedFn:
+    """A jitted function whose every distinct signature compiles ONCE
+    through the AOT path, with cost/memory captured into the process
+    ledger, and executes through the captured executable.
+
+    Call convention (every instrumented site in the repo already
+    follows it): dynamic arguments POSITIONAL, static arguments
+    KEYWORD. The signature key is (static kwargs, pytree structure,
+    leaf (shape, dtype, sharding)) — exactly the facts that would make
+    plain jit retrace. Any anomaly on the AOT path (an unsupported
+    backend, a layout mismatch on a later call) permanently falls the
+    signature back to the plain jitted call, so instrumentation can
+    never break a run it observes.
+
+    ``on_compile(signature_index)`` fires at trace time of each new
+    signature — the engine's compile_counts hook rides it.
+    """
+
+    def __init__(
+        self, jitted, *, site: str, key: str,
+        backend: Optional[str] = None, n: Optional[int] = None,
+        analytic: Optional[float] = None,
+        on_compile: Optional[Callable] = None,
+        meta: Optional[dict] = None,
+    ):
+        self._jitted = jitted
+        self.site = site
+        self.key = key
+        self.backend = backend
+        self.n = n
+        self.analytic = analytic
+        self.on_compile = on_compile
+        self.meta = dict(meta or {})
+        self._cache: dict = {}  # sig -> compiled executable | None
+        self._lock = threading.Lock()
+
+    def lower(self, *args, **kwargs):
+        """AOT passthrough: callers inspecting the program (the HLO
+        compile-contract tests) see exactly what the wrapper runs."""
+        return self._jitted.lower(*args, **kwargs)
+
+    @staticmethod
+    def _sig(args, kwargs):
+        import jax
+
+        leaves, treedef = jax.tree_util.tree_flatten(args)
+        avals = []
+        for leaf in leaves:
+            shape = getattr(leaf, "shape", None)
+            dtype = getattr(leaf, "dtype", None)
+            if shape is None or dtype is None:
+                # Non-array leaf (python scalar): its VALUE can change
+                # per call without retracing under weak typing — key
+                # on type only, like jit does for abstracted scalars.
+                avals.append((type(leaf).__name__,))
+                continue
+            sharding = getattr(leaf, "sharding", None)
+            avals.append((tuple(shape), str(dtype), sharding))
+        return (tuple(sorted(kwargs.items())), treedef, tuple(avals))
+
+    def _compile(self, sig, args, kwargs, ordinal: int):
+        t0 = time.perf_counter()
+        lowered = self._jitted.lower(*args, **kwargs)
+        compiled = lowered.compile()
+        compile_s = time.perf_counter() - t0
+        _LEDGER.record_compile(
+            site=self.site, key=self.key, compiled=compiled,
+            compile_s=compile_s, backend=self.backend, n=self.n,
+            analytic=self.analytic, storm_count=ordinal, **self.meta,
+        )
+        return compiled
+
+    def __call__(self, *args, **kwargs):
+        try:
+            sig = self._sig(args, kwargs)
+        except Exception:  # noqa: BLE001 — unhashable static etc.
+            return self._jitted(*args, **kwargs)
+        with self._lock:
+            known = sig in self._cache
+            compiled = self._cache.get(sig)
+            ordinal = len(self._cache) + 1
+        if not known:
+            if self.on_compile is not None:
+                try:
+                    self.on_compile(ordinal)
+                except Exception:  # noqa: BLE001
+                    pass
+            try:
+                compiled = self._compile(sig, args, kwargs, ordinal)
+            except Exception:  # noqa: BLE001 — AOT unsupported here:
+                # fall back to plain jit for this signature, once.
+                compiled = None
+                _LEDGER.record_compile(
+                    site=self.site, key=self.key, compiled=None,
+                    compile_s=0.0, backend=self.backend, n=self.n,
+                    analytic=self.analytic, storm_count=ordinal,
+                    aot="unavailable", **self.meta,
+                )
+            with self._lock:
+                self._cache[sig] = compiled
+        if compiled is None:
+            return self._jitted(*args, **kwargs)
+        try:
+            return compiled(*args)
+        except TypeError:
+            # TypeError is how the AOT executable rejects inputs
+            # BEFORE execution (aval/pytree/layout drift within one
+            # signature key — something plain jit would absorb by
+            # retracing): safe to stop routing this signature through
+            # AOT and retry on jit, since nothing ran and no donated
+            # buffer was consumed. Every other exception is a genuine
+            # EXECUTION error and must re-raise as-is — retrying it
+            # through jit would consume-already-donated inputs
+            # ("Array has been deleted" masking the root cause) and
+            # double-count the key's trace in compile_counts.
+            with self._lock:
+                self._cache[sig] = None
+            return self._jitted(*args, **kwargs)
+
+
+def instrument_jit(jitted, **kw) -> InstrumentedFn:
+    """Sugar: ``instrument_jit(jax.jit(fn, ...), site=..., key=...)``."""
+    return InstrumentedFn(jitted, **kw)
+
+
+def summarize_rows(rows: list) -> list:
+    """Latest row per ledger key, compile-order stable — the compact
+    view ``bench --report`` renders."""
+    latest: dict = {}
+    order: list = []
+    for row in rows:
+        key = row.get("key")
+        if key not in latest:
+            order.append(key)
+        latest[key] = row
+    return [latest[k] for k in order]
+
+
+def read_ledger(path: str) -> list:
+    """Rows of a ``perf_ledger.jsonl`` (torn lines tolerated)."""
+    if not os.path.exists(path):
+        return []
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if rec.get("event") == "perf_compile":
+                out.append(rec)
+    return out
+
+
+def finite(x) -> bool:
+    try:
+        return x is not None and math.isfinite(float(x))
+    except (TypeError, ValueError):
+        return False

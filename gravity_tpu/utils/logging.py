@@ -241,6 +241,13 @@ class ServingEventLogger(JsonlEventLogger):
     were NOT re-executed. ``worker_reaped`` records housekeeping
     deleting a dead same-host worker's registry entry, so failover
     and fleet scans stop pid-probing a SIGKILL'd worker forever.
+
+    ``recompile_storm`` and ``memory_rejected`` are the performance
+    observatory's kinds (docs/observability.md "Performance"):
+    edge-triggered when one logical program key compiles past the
+    storm threshold (the compile cache is thrashing), and the
+    memory-aware admission rejecting a submit whose resolved program
+    cannot fit device memory.
     """
 
     KINDS = (
@@ -251,4 +258,5 @@ class ServingEventLogger(JsonlEventLogger):
         "shed", "poisoned", "worker_reaped",
         "encounter", "merger", "followup_submitted",
         "slo_breach", "accuracy_breach",
+        "recompile_storm", "memory_rejected",
     )

@@ -1,0 +1,30 @@
+#!/usr/bin/env python
+"""Standalone entry for the noise-robust perf regression gate —
+the same code as `gravity_tpu bench --gate` (make perf-gate runs it
+through the CLI; this script exists for tooling that wants the gate
+without the CLI's device-probe plumbing).
+
+Usage: python scripts/perf_gate.py [--baseline PERF_BASELINE.json]
+       [--contracts name,name] [--out PERF_GATE_LAST.json]
+
+Exit 0: every contract holds (report written to --out).
+Exit 1: at least one contract violated; stdout names the baseline
+        file and each violated contract with the measured value,
+        bootstrap CI, and bound.
+
+See docs/observability.md "Performance" for the contract kinds and
+why the gate measures interleaved paired ratios instead of absolute
+wall-clock (this box's ~1.8x window swing).
+"""
+
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+from gravity_tpu.perfgate import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

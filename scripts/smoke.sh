@@ -22,6 +22,11 @@
 # and the static-analysis stage (`gravity_tpu lint` over a planted-
 # violation fixture tree asserting exit 1 + finding format, then the
 # real tree asserting exit 0 — docs/static-analysis.md),
+# and the perf-gate stage (`bench --gate` over PERF_BASELINE.json: a
+# planted one-arm handicap exits 1 naming the contract; the full
+# baseline under a 2x both-arm handicap exits 0 — the paired-ratio
+# gating absorbing the documented window swing;
+# docs/observability.md "Performance"),
 # all on CPU. Exits nonzero on any failure. ~10 min on a laptop-class
 # CPU.
 set -euo pipefail
@@ -29,7 +34,7 @@ cd "$(dirname "$0")/.."
 
 export JAX_PLATFORMS=cpu
 
-echo "== smoke 1/11: pytest -m 'fast and not slow and not heavy' (contract + oracle-parity lane) =="
+echo "== smoke 1/12: pytest -m 'fast and not slow and not heavy' (contract + oracle-parity lane) =="
 # "fast and not slow and not heavy": module-level fast marks would
 # otherwise pull a file's slow-marked wall-clock tests into the lane
 # (pytest -m fast selects anything CARRYING the mark; it does not
@@ -38,7 +43,7 @@ echo "== smoke 1/11: pytest -m 'fast and not slow and not heavy' (contract + ora
 # item 5).
 python -m pytest tests/ -q -m "fast and not slow and not heavy" -p no:cacheprovider
 
-echo "== smoke 2/11: 2-job ensemble serving e2e (CLI daemon) =="
+echo "== smoke 2/12: 2-job ensemble serving e2e (CLI daemon) =="
 SPOOL="$(mktemp -d /tmp/gravity_smoke.XXXXXX)"
 cleanup() {
     # Best-effort daemon shutdown + spool removal.
@@ -91,7 +96,7 @@ print("ensemble e2e OK:", {j: s["status"] for j, s in statuses.items()},
       "| compiles:", metrics["compile_counts"])
 EOF
 
-echo "== smoke 3/11: async host pipeline e2e (cadence run + SIGTERM + resume) =="
+echo "== smoke 3/12: async host pipeline e2e (cadence run + SIGTERM + resume) =="
 IODIR="$(mktemp -d /tmp/gravity_smoke_io.XXXXXX)"
 trap 'cleanup; rm -rf "$IODIR"' EXIT
 # Cadence-on pipelined run; preempt@500 delivers a real SIGTERM to the
@@ -127,7 +132,7 @@ print("io-pipeline e2e OK: resumed", stats["steps"], "steps,",
       "host_gap_frac", round(stats["host_gap_frac"], 3))
 EOF
 
-echo "== smoke 4/11: autotune cache round-trip (probe-on-miss, instant-on-hit) =="
+echo "== smoke 4/12: autotune cache round-trip (probe-on-miss, instant-on-hit) =="
 TUNEDIR="$(mktemp -d /tmp/gravity_smoke_tune.XXXXXX)"
 trap 'cleanup; rm -rf "$IODIR" "$TUNEDIR"' EXIT
 # Fresh cache dir + lowered fast-probe floor so plain `auto` runs a
@@ -164,10 +169,10 @@ print("autotune round-trip OK: backend", s1["backend"],
       "| probe", round(s1["autotune_probe_ms"], 1), "ms -> hit 0 ms")
 EOF
 
-echo "== smoke 5/11: serving chaos harness (kill -9 + adoption + fencing) =="
+echo "== smoke 5/12: serving chaos harness (kill -9 + adoption + fencing) =="
 bash scripts/chaos.sh 1 2
 
-echo "== smoke 6/11: job classes through the CLI daemon (fit + sweep) =="
+echo "== smoke 6/12: job classes through the CLI daemon (fit + sweep) =="
 # One fit + one sweep submitted through the REAL daemon from stage 2
 # (still serving), asserting completion + served-vs-solo parity
 # (docs/serving.md "Job classes").
@@ -277,7 +282,7 @@ z = np.load(sys.argv[1])
 assert 'min_sep' in z.files and len(z['min_sep']) == 4, z.files
 " "$SPOOL/sweep_verdicts.npz"
 
-echo "== smoke 7/11: unified telemetry (Prometheus scrape + Perfetto trace export) =="
+echo "== smoke 7/12: unified telemetry (Prometheus scrape + Perfetto trace export) =="
 # Against the STILL-LIVE stage-2 daemon: (a) a text/plain /metrics
 # scrape must be valid Prometheus exposition (validated by the strict
 # parser the tests use) including per-class latency histograms and
@@ -322,7 +327,7 @@ assert summary["coverage"] is not None and summary["coverage"] >= 0.9, \
 print("perfetto export OK:", summary)
 PYEOF
 
-echo "== smoke 8/11: nlist cell-list near field (p3m parity + standalone truncated parity) =="
+echo "== smoke 8/12: nlist cell-list near field (p3m parity + standalone truncated parity) =="
 # (a) The P3M near pass through the cell-list tile engine must match
 # the chunked gather near pass <= 1e-5 scaled on CPU (the ISSUE-9
 # acceptance bound); (b) the standalone nlist backend must match the
@@ -364,7 +369,7 @@ print("nlist near-field OK: p3m dev", float(dev),
       "| standalone dev", float(dev2))
 PYEOF
 
-echo "== smoke 9/11: numerics observatory (drift gauges + error histogram scrape, injected accuracy breach) =="
+echo "== smoke 9/12: numerics observatory (drift gauges + error histogram scrape, injected accuracy breach) =="
 # (a) Strict-parse the LIVE stage-2 daemon's Prometheus text and
 # assert the numerics families are present with real series: the
 # per-backend force-error histogram (sentinel probes ran — default
@@ -481,7 +486,7 @@ urllib.request.urlopen(req, timeout=5).read()
 EOF
 kill "$NUM_PID" 2>/dev/null || true
 
-echo "== smoke 10/11: sharded adoption-resume chaos (SIGKILL mid-sharded-job -> resume from snapshot) =="
+echo "== smoke 10/12: sharded adoption-resume chaos (SIGKILL mid-sharded-job -> resume from snapshot) =="
 # Chaos scenario 3 through the real CLI daemon on a 2-device CPU mesh:
 # a worker running a sharded-integrate job is SIGKILLed mid-run; the
 # survivor adopts, RESUMES from the last fenced progress snapshot
@@ -491,7 +496,7 @@ echo "== smoke 10/11: sharded adoption-resume chaos (SIGKILL mid-sharded-job -> 
 # modes").
 bash scripts/chaos.sh 3
 
-echo "== smoke 11/11: static analysis (gravity_tpu lint: planted violations -> exit 1, real tree -> exit 0) =="
+echo "== smoke 11/12: static analysis (gravity_tpu lint: planted violations -> exit 1, real tree -> exit 0) =="
 # The AST invariant analyzer (docs/static-analysis.md). First a
 # fixture tree with one planted violation per acceptance class
 # (use-after-donation, time.time in a scanned body, unfenced spool
@@ -557,5 +562,41 @@ PYEOF
 rm -rf "$LINTDIR"
 # The real tree: zero non-baselined findings.
 python -m gravity_tpu lint
+
+echo "== smoke 12/12: perf regression gate (planted violation -> exit 1, clean tree -> exit 0) =="
+# The noise-robust perf gate (docs/observability.md "Performance")
+# through the real CLI. (a) A planted regression — an 8x handicap on
+# the nlist arm of the speedup contract — must exit 1 and NAME the
+# baseline file + contract; the run is scoped to that one contract so
+# the planted half stays cheap. (b) The full committed baseline on the
+# clean tree must exit 0 — under a 2x BOTH-ARM handicap, proving the
+# paired-ratio gating absorbs exactly the kind of global window
+# slowdown this box is documented to have (~1.8x, CHANGES.md PR 6).
+GATEDIR="$(mktemp -d /tmp/gravity_gate.XXXXXX)"
+trap 'cleanup; rm -rf "$IODIR" "$TUNEDIR" "$NUMDIR" "$GATEDIR"' EXIT
+RC=0
+GRAVITY_TPU_PERF_HANDICAP='{"contract":"nlist_vs_chunked_speedup","arm":"b","factor":8.0}' \
+python -m gravity_tpu bench --gate \
+    --gate-contracts nlist_vs_chunked_speedup \
+    >"$GATEDIR/planted.out" 2>&1 || RC=$?
+[ "$RC" -eq 1 ] || {
+    echo "FAIL: planted perf regression exited $RC (expected 1)"
+    cat "$GATEDIR/planted.out"; exit 1;
+}
+grep -q "PERF_BASELINE.json: contract 'nlist_vs_chunked_speedup' VIOLATED" \
+    "$GATEDIR/planted.out" || {
+    echo "FAIL: gate did not name the violated contract + file"
+    cat "$GATEDIR/planted.out"; exit 1;
+}
+GRAVITY_TPU_PERF_HANDICAP='{"contract":"*","arm":"both","factor":2.0}' \
+python -m gravity_tpu bench --gate >"$GATEDIR/clean.out" 2>&1 || {
+    echo "FAIL: clean-tree gate (2x both-arm handicap) exited nonzero"
+    cat "$GATEDIR/clean.out"; exit 1;
+}
+grep -q "perf gate: all contracts hold" "$GATEDIR/clean.out" || {
+    echo "FAIL: clean gate output missing the all-hold line"
+    cat "$GATEDIR/clean.out"; exit 1;
+}
+echo "perf gate OK: planted violation exit 1 (contract named), clean tree exit 0 under a 2x both-arm window handicap"
 
 echo "== smoke: all green =="

@@ -1,0 +1,706 @@
+"""Performance observatory (docs/observability.md "Performance"):
+the XLA cost/memory ledger behind every compile site, recompile-storm
+detection, memory-aware serve admission, the promoted perf metrics,
+and the noise-robust regression gate — planted regression fails,
+both-arm slowdown passes.
+"""
+
+import json
+import math
+import os
+
+import numpy as np
+import pytest
+
+from gravity_tpu.config import SimulationConfig
+from gravity_tpu.serve.scheduler import EnsembleScheduler
+from gravity_tpu.simulation import Simulator, make_initial_state
+from gravity_tpu.telemetry import (
+    Telemetry,
+    declare_worker_metrics,
+    parse_prometheus_text,
+)
+from gravity_tpu.telemetry import perf
+from gravity_tpu import perfgate
+
+
+@pytest.fixture(autouse=True)
+def _clean_ledger():
+    """Each test reads only its own rows/sinks; the ledger is a
+    process singleton."""
+    perf.ledger().reset()
+    perf.ledger().detach()
+    yield
+    perf.ledger().reset()
+    perf.ledger().detach()
+
+
+def _cfg(n, backend="dense", **kw):
+    kw.setdefault("model", "random")
+    kw.setdefault("dt", 3600.0)
+    kw.setdefault("steps", 10)
+    kw.setdefault("integrator", "leapfrog")
+    return SimulationConfig(n=n, force_backend=backend, **kw)
+
+
+def _solo_row(backend, n=256, **kw):
+    sim = Simulator(_cfg(n, backend, **kw))
+    from gravity_tpu.ops.integrators import init_carry
+
+    st = sim.state
+    acc = init_carry(sim.accel_fn, st)
+    sim._run_block(st, acc, n_steps=1, record=False)
+    return perf.ledger().row_for(sim._run_block.key)
+
+
+def _assert_row_schema(row, backend):
+    assert row is not None, f"no ledger row for {backend}"
+    assert row["site"] in ("solo_block", "serve_round")
+    assert row["backend"] == backend
+    assert row["compile_s"] > 0.0
+    for field in ("flops", "bytes_accessed", "peak_bytes",
+                  "arg_bytes", "temp_bytes"):
+        assert row.get(field) is not None, (backend, field, row)
+    assert perf.finite(row["model_ratio"]), (backend, row)
+    assert row["analytic_flops"] > 0.0
+
+
+# --- cost/memory ledger schema per backend family ---
+
+
+@pytest.mark.fast
+def test_ledger_row_dense_schema_and_model_ratio():
+    row = _solo_row("dense")
+    _assert_row_schema(row, "dense")
+    # The dense block's measured flops sit near the pair model (the
+    # calibrated ~1.2: integrator + watchdog overhead on top of the
+    # 20-flop pair pipeline). A big drift means the cost model or the
+    # kernel changed.
+    assert 0.8 <= row["model_ratio"] <= 3.0, row
+
+
+@pytest.mark.fast
+def test_ledger_row_chunked_and_fast_solvers():
+    for backend in ("chunked", "tree"):
+        row = _solo_row(backend, n=256)
+        _assert_row_schema(row, backend)
+    # Fast solvers are priced at the dense-equivalent expectation, so
+    # their ratio is the measured work fraction — finite by contract.
+
+
+def test_ledger_row_pallas_sfmm_nlist():
+    p = np.asarray(make_initial_state(_cfg(256)).positions)
+    rcut = float((p.max(0) - p.min(0)).max()) * 0.2
+    for backend, kw in (
+        ("pallas", {}),
+        ("sfmm", {}),
+        ("nlist", {"nlist_rcut": rcut}),
+    ):
+        row = _solo_row(backend, n=256, **kw)
+        _assert_row_schema(row, backend)
+
+
+@pytest.mark.fast
+def test_ledger_row_serve_vmap_key():
+    from gravity_tpu.serve.engine import EnsembleEngine, batch_key_for
+
+    cfg = _cfg(24, steps=4)
+    engine = EnsembleEngine()
+    key = batch_key_for(cfg, slots=2)
+    batch = engine.new_batch(key)
+    batch = engine.load_slot(
+        batch, 0, make_initial_state(cfg), dt=cfg.dt, steps=4
+    )
+    engine.run_slice(batch, 4)
+    row = perf.ledger().row_for(perf.engine_key_str(key))
+    _assert_row_schema(row, key.backend)
+    assert row["site"] == "serve_round"
+    assert row["job_type"] == "integrate"
+    # The engine's own compile counter agrees: one trace.
+    assert engine.compile_counts[key] == 1
+
+
+@pytest.mark.fast
+def test_xla_loop_body_counted_once():
+    """The documented flop convention: a bigger n_steps does not grow
+    the measured per-iteration flops (XLA counts the scan body once),
+    so model_ratio is block-size independent."""
+    sim = Simulator(_cfg(128))
+    from gravity_tpu.ops.integrators import init_carry
+
+    st, acc = sim.state, init_carry(sim.accel_fn, sim.state)
+    sim._run_block(st, acc, n_steps=1, record=False)
+    r1 = perf.ledger().row_for(sim._run_block.key)
+    sim._run_block(st, acc, n_steps=7, record=False)
+    r7 = perf.ledger().row_for(sim._run_block.key)
+    assert r1["flops"] == pytest.approx(r7["flops"], rel=0.05)
+    assert r1["model_ratio"] == pytest.approx(
+        r7["model_ratio"], rel=0.05
+    )
+
+
+@pytest.mark.fast
+def test_instrumented_fn_executes_identically(tmp_path):
+    """The AOT call path returns exactly what the plain jit returns
+    (same program, same math), and a run's artifacts are what they
+    were: one full solo run through the instrumented block fn."""
+    import jax
+
+    sim = Simulator(_cfg(64, steps=20, progress_every=7))
+    stats = sim.run()
+    assert stats["steps"] == 20
+    assert np.all(np.isfinite(np.asarray(
+        stats["final_state"].positions
+    )))
+    # The same config through a fresh plain-jit block fn agrees
+    # bitwise (the wrapper is a cache in front of the same program).
+    sim2 = Simulator(_cfg(64, steps=20, progress_every=7))
+    raw = jax.jit(
+        sim2._block_fn,
+        static_argnames=("n_steps", "record", "record_every"),
+    )
+    from gravity_tpu.ops.integrators import init_carry
+
+    st, acc = sim2.state, init_carry(sim2.accel_fn, sim2.state)
+    for n_steps in (7, 7, 6):
+        st, acc, _ = raw(st, acc, n_steps=n_steps, record=False)
+    np.testing.assert_array_equal(
+        np.asarray(stats["final_state"].positions),
+        np.asarray(st.positions),
+    )
+    # And the run's stats carry its ledger rows.
+    assert stats["perf"], stats.get("perf")
+    assert all(r["site"] == "solo_block" for r in stats["perf"])
+
+
+@pytest.mark.fast
+def test_perf_ledger_jsonl_persistence(tmp_path):
+    perf.ledger().attach(out_dir=str(tmp_path))
+    _solo_row("dense", n=64)
+    rows = perf.read_ledger(str(tmp_path / perf.LEDGER_FILE))
+    assert rows and rows[0]["event"] == "perf_compile"
+    assert rows[0]["backend"] == "dense"
+    assert perf.finite(rows[0]["model_ratio"])
+
+
+@pytest.mark.fast
+def test_autotune_probe_site_label(tmp_path):
+    """Probe compiles are labeled autotune_probe via the site bind —
+    distinguishable from the run's own programs."""
+    from gravity_tpu.autotune import resolve_backend_measured
+
+    cfg = _cfg(64, backend="auto")
+    state = make_initial_state(cfg)
+    resolve_backend_measured(
+        cfg, state, candidates=("dense", "chunked"), refresh=True
+    )
+    sites = {r["site"] for r in perf.ledger().rows_list()}
+    assert "autotune_probe" in sites, sites
+
+
+# --- recompile storms ---
+
+
+@pytest.mark.fast
+def test_recompile_storm_event_and_dump(tmp_path):
+    events = []
+    tele = Telemetry(out_dir=str(tmp_path), worker="w-test")
+    perf.ledger().attach(
+        out_dir=str(tmp_path), recorder=tele.recorder,
+        event_hook=lambda kind, **f: events.append((kind, f)),
+    )
+    led = perf.ledger()
+    old = led.storm_threshold
+    led.storm_threshold = 2
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        fn = perf.instrument_jit(
+            jax.jit(lambda x: x * 2.0), site="solo_block",
+            key="solo:test-storm",
+        )
+        # Distinct shapes per call: exactly the signature churn a
+        # shape leak produces.
+        for k in range(4):
+            fn(jnp.ones((4 + k,)))
+    finally:
+        led.storm_threshold = old
+    storm = [e for e in events if e[0] == "recompile_storm"]
+    assert len(storm) == 1, events  # edge-triggered: once per key
+    assert storm[0][1]["key"] == "solo:test-storm"
+    assert storm[0][1]["compiles"] == 3
+    dumps = [f for f in os.listdir(tmp_path)
+             if f.startswith("flightrec_")]
+    assert dumps, "storm did not dump the flight recorder"
+    doc = json.load(open(tmp_path / dumps[0]))
+    assert doc["reason"] == "recompile_storm"
+
+
+# --- memory-aware admission ---
+
+
+@pytest.mark.fast
+def test_memory_admission_rejects_oversized_submit(
+    tmp_path, monkeypatch
+):
+    """A synthetic over-HBM submit is a typed rejection at admission,
+    with the memory_rejected event emitted — not a round failure."""
+    monkeypatch.setenv("GRAVITY_TPU_HBM_BYTES", str(2 * 1024 * 1024))
+    from gravity_tpu.utils.logging import ServingEventLogger
+
+    events = ServingEventLogger(str(tmp_path / "serving.jsonl"))
+    with EnsembleScheduler(slots=2, slice_steps=10,
+                           events=events) as sched:
+        with pytest.raises(perf.InsufficientDeviceMemory) as ei:
+            sched.submit(_cfg(4096, steps=10))
+        assert ei.value.budget_bytes == 2 * 1024 * 1024
+        assert ei.value.required_bytes > ei.value.budget_bytes
+        assert ei.value.source == "estimated"
+        assert isinstance(ei.value, ValueError)  # the HTTP 400 class
+        # Small jobs still admit under the same budget.
+        jid = sched.submit(_cfg(8, steps=10))
+        sched.run_until_idle()
+        assert sched.jobs[jid].status == "completed"
+    recs = [json.loads(line) for line in
+            open(tmp_path / "serving.jsonl") if line.strip()]
+    rej = [r for r in recs if r["event"] == "memory_rejected"]
+    assert len(rej) == 1 and rej[0]["n"] == 4096
+    assert rej[0]["source"] == "estimated"
+
+
+@pytest.mark.fast
+def test_memory_admission_uses_measured_peak_after_compile(
+    monkeypatch,
+):
+    """Once a key has compiled, admission consults the MEASURED peak
+    instead of the estimate."""
+    with EnsembleScheduler(slots=2, slice_steps=10) as sched:
+        jid = sched.submit(_cfg(24, steps=10))
+        sched.run_until_idle()
+        assert sched.jobs[jid].status == "completed"
+        key = sched._job_key(sched.jobs[jid])
+        required, source = perf.required_bytes_for_key(key)
+        assert source == "measured"
+        # A budget squeezed under the measured peak now rejects.
+        monkeypatch.setenv("GRAVITY_TPU_HBM_BYTES",
+                           str(max(1, required // 2)))
+        with pytest.raises(perf.InsufficientDeviceMemory) as ei:
+            sched.submit(_cfg(24, steps=10))
+        assert ei.value.source == "measured"
+
+
+def test_memory_admission_http_400_typed(tmp_path, monkeypatch):
+    """Daemon surface: the over-HBM submit is an HTTP 400 whose
+    payload carries the typed fields, and the daemon keeps serving
+    (no round failure)."""
+    from gravity_tpu.serve import GravityDaemon, request, wait_for
+
+    monkeypatch.setenv("GRAVITY_TPU_HBM_BYTES", str(2 * 1024 * 1024))
+    d = GravityDaemon(str(tmp_path / "spool"), slots=2,
+                      slice_steps=10, idle_sleep_s=0.01)
+    d.start()
+    try:
+        spool = d.spool_dir
+        # `request` returns a 400's error body instead of raising.
+        body = request(spool, "POST", "/submit", {
+            "config": json.loads(_cfg(4096, steps=10).to_json()),
+        })
+        assert "job" not in body, body
+        assert body["kind"] == "insufficient_device_memory"
+        assert body["required_bytes"] > body["budget_bytes"]
+        assert body["source"] == "estimated"
+        # The daemon survived: a small job completes normally.
+        resp = request(spool, "POST", "/submit", {
+            "config": json.loads(_cfg(8, steps=10).to_json()),
+        })
+        statuses = wait_for(spool, [resp["job"]], timeout=120)
+        assert statuses[resp["job"]]["status"] == "completed"
+    finally:
+        d.stop()
+
+
+@pytest.mark.fast
+def test_memory_admission_noop_without_budget(monkeypatch):
+    monkeypatch.delenv("GRAVITY_TPU_HBM_BYTES", raising=False)
+    # CPU exposes no bytes_limit: the check must be a no-op, never a
+    # rejection.
+    if perf.device_memory_budget() is not None:
+        pytest.skip("platform exposes a real memory budget")
+    from gravity_tpu.serve.engine import batch_key_for
+
+    perf.check_admission_memory(
+        batch_key_for(_cfg(4096), slots=4)
+    )  # does not raise
+
+
+@pytest.mark.fast
+def test_estimate_peak_bytes_scales():
+    from gravity_tpu.serve.engine import batch_key_for
+
+    small = perf.estimate_peak_bytes(batch_key_for(_cfg(64), slots=2))
+    big = perf.estimate_peak_bytes(batch_key_for(_cfg(4096), slots=2))
+    assert big > small * 100  # the (n, n) pair term dominates
+
+
+# --- promoted metrics ---
+
+
+def test_promoted_metrics_scrapeable():
+    """host_gap_frac / steps_per_sec / autotune probe / compile
+    metrics land in the worker registry and render as valid
+    Prometheus exposition."""
+    with EnsembleScheduler(slots=2, slice_steps=10) as sched:
+        jid = sched.submit(_cfg(12, steps=30))
+        sched.run_until_idle()
+        assert sched.jobs[jid].status == "completed"
+        text = sched.telemetry.registry.prometheus_text()
+    parsed = parse_prometheus_text(text)
+    for name in ("gravity_compile_seconds", "gravity_program_flops",
+                 "gravity_program_peak_bytes", "gravity_steps_per_sec",
+                 "gravity_host_gap_frac"):
+        assert name in parsed, name
+    samples = parsed["gravity_program_flops"]["samples"]
+    assert samples and all(v > 0 for v in samples.values())
+    gap = parsed["gravity_host_gap_frac"]["samples"]
+    assert all(0.0 <= v <= 1.0 for v in gap.values())
+    # compile_seconds histogram counted the round program's compile.
+    count = sum(
+        v for (name, _l), v in
+        parsed["gravity_compile_seconds"]["samples"].items()
+        if name == "gravity_compile_seconds_count"
+    )
+    assert count >= 1
+
+
+def test_compile_span_enriched_with_ledger(tmp_path):
+    """The serving compile span carries the ledger's figures."""
+    from gravity_tpu.telemetry import load_spans
+
+    tele = Telemetry(out_dir=str(tmp_path), worker="w-span")
+    declare_worker_metrics(tele.registry)
+    with EnsembleScheduler(slots=2, slice_steps=10,
+                           telemetry=tele) as sched:
+        jid = sched.submit(_cfg(12, steps=20))
+        sched.run_until_idle()
+        assert sched.jobs[jid].status == "completed"
+    spans = load_spans(str(tmp_path / "traces.jsonl"))
+    compiles = [s for s in spans if s["name"] == "compile"]
+    assert compiles, [s["name"] for s in spans]
+    c = compiles[0]
+    assert c["flops"] and c["flops"] > 0
+    assert c["peak_bytes"] and c["peak_bytes"] > 0
+    assert c["compile_s"] and c["compile_s"] > 0
+    assert perf.finite(c["model_ratio"])
+
+
+@pytest.mark.fast
+def test_solo_run_promotes_gauges(tmp_path):
+    tele = Telemetry(out_dir=str(tmp_path), worker="w-solo")
+    sim = Simulator(_cfg(64, steps=20, progress_every=10))
+    sim.run(telemetry=tele)
+    snap = tele.registry.snapshot()
+    gap = snap["gravity_host_gap_frac"]["series"]
+    sps = snap["gravity_steps_per_sec"]["series"]
+    assert gap and 0.0 <= gap[0]["value"] <= 1.0
+    assert sps and sps[0]["value"] > 0
+
+
+# --- the perf gate ---
+
+
+def _toy_baseline(tmp_path, contracts):
+    path = tmp_path / "PERF_BASELINE.json"
+    path.write_text(json.dumps({"v": 1, "contracts": contracts}))
+    return str(path)
+
+
+def _fake_arms(monkeypatch, times):
+    """Replace the measurement arms with synthetic per-(backend, n)
+    timers so the gate math is tested deterministically and fast."""
+    def fake_pair_arm(backend, n, spacings, eps):
+        return lambda: float(times[(backend, n)])
+
+    monkeypatch.setattr(perfgate, "_pair_arm", fake_pair_arm)
+
+
+@pytest.mark.fast
+def test_gate_clean_passes_and_writes_report(tmp_path, monkeypatch):
+    _fake_arms(monkeypatch, {("chunked", 512): 0.10,
+                             ("nlist", 512): 0.02,
+                             ("nlist", 2048): 0.05})
+    baseline = _toy_baseline(tmp_path, [
+        {"name": "speedup", "kind": "paired_ratio_min",
+         "min_ratio": 2.0,
+         "params": {"n": 512, "reps": 5}},
+        {"name": "scaling", "kind": "scaling_exponent_max",
+         "max_exponent": 1.7,
+         "params": {"n_small": 512, "n_large": 2048, "reps": 5}},
+    ])
+    out = str(tmp_path / "report.json")
+    logs = []
+    code, report = perfgate.run_gate(
+        baseline, report_path=out, log=logs.append
+    )
+    assert code == 0 and report["ok"]
+    doc = json.load(open(out))
+    assert doc["ok"] and len(doc["results"]) == 2
+    by_name = {r["name"]: r for r in doc["results"]}
+    assert by_name["speedup"]["measured"] == pytest.approx(5.0)
+    # exponent log(0.05/0.02)/log(4) ~ 0.66
+    assert by_name["scaling"]["measured"] == pytest.approx(
+        math.log(2.5) / math.log(4.0), rel=1e-6
+    )
+    assert any("all contracts hold" in line for line in logs)
+
+
+@pytest.mark.fast
+def test_gate_planted_regression_fails_with_structured_report(
+    tmp_path, monkeypatch
+):
+    """One-arm handicap = a real regression: exit 1 and the report
+    names the baseline file + contract."""
+    _fake_arms(monkeypatch, {("chunked", 512): 0.10,
+                             ("nlist", 512): 0.02})
+    monkeypatch.setenv(
+        "GRAVITY_TPU_PERF_HANDICAP",
+        json.dumps({"contract": "speedup", "arm": "b", "factor": 8.0}),
+    )
+    baseline = _toy_baseline(tmp_path, [
+        {"name": "speedup", "kind": "paired_ratio_min",
+         "min_ratio": 2.0, "params": {"n": 512, "reps": 5}},
+    ])
+    logs = []
+    code, report = perfgate.run_gate(
+        baseline, report_path=None, log=logs.append
+    )
+    assert code == 1 and not report["ok"]
+    r = report["results"][0]
+    assert not r["ok"]
+    assert r["measured"] == pytest.approx(0.625)  # 5x / 8
+    assert r["ci"] is not None and r["bound"] == 2.0
+    violated = [line for line in logs if "VIOLATED" in line]
+    assert violated and "speedup" in violated[0]
+    assert baseline in violated[0]  # the FILE is named
+
+
+@pytest.mark.fast
+def test_gate_both_arm_slowdown_cannot_flake_ratios(
+    tmp_path, monkeypatch
+):
+    """A 2x handicap on BOTH arms — the documented window swing — is
+    absorbed by ratio gating: identical verdict, identical measured
+    ratio."""
+    _fake_arms(monkeypatch, {("chunked", 512): 0.10,
+                             ("nlist", 512): 0.02,
+                             ("nlist", 2048): 0.05})
+    baseline = _toy_baseline(tmp_path, [
+        {"name": "speedup", "kind": "paired_ratio_min",
+         "min_ratio": 2.0, "params": {"n": 512, "reps": 5}},
+        {"name": "scaling", "kind": "scaling_exponent_max",
+         "max_exponent": 1.7,
+         "params": {"n_small": 512, "n_large": 2048, "reps": 5}},
+    ])
+    code_clean, rep_clean = perfgate.run_gate(
+        baseline, report_path=None, log=lambda *_: None
+    )
+    monkeypatch.setenv(
+        "GRAVITY_TPU_PERF_HANDICAP",
+        json.dumps({"contract": "*", "arm": "both", "factor": 2.0}),
+    )
+    code_slow, rep_slow = perfgate.run_gate(
+        baseline, report_path=None, log=lambda *_: None
+    )
+    assert code_clean == code_slow == 0
+    for a, b in zip(rep_clean["results"], rep_slow["results"]):
+        assert a["measured"] == pytest.approx(b["measured"])
+
+
+@pytest.mark.fast
+def test_gate_count_and_coverage_contracts_ignore_window_handicap(
+    tmp_path, monkeypatch
+):
+    """count/coverage contracts measure integers and instrumentation
+    facts — a both-arm 'window slowdown' handicap must not touch
+    them (smoke runs the full baseline under exactly that)."""
+    monkeypatch.setenv(
+        "GRAVITY_TPU_PERF_HANDICAP",
+        json.dumps({"contract": "*", "arm": "both", "factor": 2.0}),
+    )
+    baseline = _toy_baseline(tmp_path, [
+        {"name": "compile_once", "kind": "count_max", "max_count": 1,
+         "params": {"n": 12, "steps": 20, "slice_steps": 10}},
+    ])
+    code, report = perfgate.run_gate(
+        baseline, report_path=None, log=lambda *_: None
+    )
+    assert code == 0, report
+    assert report["results"][0]["measured"] == 1.0
+
+
+@pytest.mark.fast
+def test_gate_unknown_contract_and_bad_baseline(tmp_path):
+    baseline = _toy_baseline(tmp_path, [
+        {"name": "x", "kind": "paired_ratio_min", "min_ratio": 1.0,
+         "params": {}},
+    ])
+    with pytest.raises(ValueError, match="unknown contract"):
+        perfgate.run_gate(baseline, contracts=["nope"],
+                          report_path=None, log=lambda *_: None)
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({"v": 1, "contracts": [
+        {"name": "y", "kind": "martingale"}
+    ]}))
+    with pytest.raises(ValueError, match="unknown kind"):
+        perfgate.load_baseline(str(bad))
+
+
+def test_gate_ledger_coverage_contract_small():
+    """The coverage contract on a cheap family subset, through the
+    real runner (the full 7-family run is the committed baseline's
+    job, exercised by smoke stage 12)."""
+    res = perfgate.run_ledger_coverage(
+        {"name": "cov", "kind": "ledger_coverage",
+         "params": {"n": 128, "families": ["dense", "serve"]}},
+        lambda *_: None,
+    )
+    assert res.ok, res.detail
+
+
+@pytest.mark.fast
+def test_committed_baseline_loads_and_is_complete():
+    """The committed PERF_BASELINE.json parses, every contract kind is
+    known, and the acceptance families are all covered."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    doc = perfgate.load_baseline(
+        os.path.join(root, "PERF_BASELINE.json")
+    )
+    names = {c["name"] for c in doc["contracts"]}
+    assert {"ledger_coverage", "nlist_vs_chunked_speedup",
+            "nlist_scaling_subquadratic", "host_gap_pipelined",
+            "serve_compile_once"} <= names
+    cov = next(c for c in doc["contracts"]
+               if c["kind"] == "ledger_coverage")
+    assert set(cov["params"]["families"]) >= {
+        "dense", "chunked", "pallas", "nlist", "tree", "sfmm", "serve"
+    }
+
+
+# --- bench --report folds + replay staleness ---
+
+
+@pytest.mark.fast
+def test_bench_report_folds_perf_artifacts(tmp_path):
+    from gravity_tpu.bench import (
+        collect_bench_rounds,
+        format_bench_report,
+    )
+
+    perf.ledger().attach(out_dir=str(tmp_path))
+    _solo_row("dense", n=64)
+    (tmp_path / "PERF_GATE_LAST.json").write_text(json.dumps({
+        "v": 1, "ok": True, "ran_at": "2026-08-04T00:00:00Z",
+        "results": [{"name": "speedup", "kind": "paired_ratio_min",
+                     "ok": True, "measured": 5.0, "bound": 1.5,
+                     "ci": [4.0, 6.0], "detail": {}}],
+    }))
+    import shutil
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    shutil.copy(os.path.join(root, "PERF_BASELINE.json"),
+                tmp_path / "PERF_BASELINE.json")
+    data = collect_bench_rounds(str(tmp_path))
+    assert data["perf_ledger"] and \
+        data["perf_ledger"][0]["backend"] == "dense"
+    assert data["perf_gate"]["ok"] is True
+    assert any(c["name"] == "ledger_coverage"
+               for c in data["perf_baseline"])
+    report = format_bench_report(data)
+    assert "perf ledger" in report
+    assert "PASS" in report and "speedup" in report
+
+
+@pytest.mark.fast
+def test_bench_report_marks_replay_rows_and_staleness(tmp_path):
+    from gravity_tpu.bench import (
+        collect_bench_rounds,
+        format_bench_report,
+    )
+
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps({
+        "parsed": {"n": 262144, "backend": "pallas",
+                   "platform": "tpu-cached", "value": 1.8e11,
+                   "avg_step_s": 0.001,
+                   "measured_at": "2026-07-01T00:00:00Z"},
+    }))
+    data = collect_bench_rounds(str(tmp_path))
+    assert data["bench"][0]["replay"] is True
+    stale = data["replay_staleness"]
+    assert stale is not None and stale["stale"] is True
+    report = format_bench_report(data)
+    assert "replay" in report
+    assert "WARNING" in report and "days old" in report
+
+
+@pytest.mark.fast
+def test_bench_py_replay_age_and_stale_flag():
+    """ONE staleness policy: the root script's helpers delegate to
+    gravity_tpu.bench (which the trend report uses too)."""
+    import importlib.util
+    import time as _time
+
+    from gravity_tpu.bench import STALE_REPLAY_DAYS, replay_age_days
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "bench_root", os.path.join(root, "bench.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    fresh = _time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                           _time.gmtime(_time.time() - 3600))
+    old = _time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                         _time.gmtime(_time.time() - 30 * 86400))
+    assert mod._replay_age_days(fresh) < 1.0
+    assert mod._replay_age_days(old) > STALE_REPLAY_DAYS
+    assert mod._replay_age_days("garbage") is None
+    assert mod._stale_replay_days() == STALE_REPLAY_DAYS
+    assert replay_age_days(old) > STALE_REPLAY_DAYS
+
+
+@pytest.mark.fast
+def test_gate_handicapped_run_never_persists(tmp_path, monkeypatch):
+    """A handicapped gate run is a test injection — it must not
+    overwrite the honest PERF_GATE_LAST.json artifact (the smoke
+    stage runs the full baseline handicapped)."""
+    _fake_arms(monkeypatch, {("chunked", 512): 0.10,
+                             ("nlist", 512): 0.02})
+    baseline = _toy_baseline(tmp_path, [
+        {"name": "speedup", "kind": "paired_ratio_min",
+         "min_ratio": 2.0, "params": {"n": 512, "reps": 5}},
+    ])
+    out = str(tmp_path / "report.json")
+    monkeypatch.setenv(
+        "GRAVITY_TPU_PERF_HANDICAP",
+        json.dumps({"contract": "*", "arm": "both", "factor": 2.0}),
+    )
+    code, report = perfgate.run_gate(
+        baseline, report_path=out, log=lambda *_: None
+    )
+    assert code == 0 and not os.path.exists(out)
+    monkeypatch.delenv("GRAVITY_TPU_PERF_HANDICAP")
+    code, report = perfgate.run_gate(
+        baseline, report_path=out, log=lambda *_: None
+    )
+    assert code == 0 and os.path.exists(out)
+    assert json.load(open(out))["handicap"] is None
+    # And the report renderer flags any artifact that somehow carries
+    # a handicap.
+    from gravity_tpu.bench import format_bench_report
+
+    text = format_bench_report({
+        "bench": [], "multichip": [],
+        "perf_gate": {"ok": True, "ran_at": "x",
+                      "handicap": {"factor": 2.0}, "results": []},
+    })
+    assert "not a clean gate run" in text
