@@ -13,9 +13,11 @@ smoke:
 	bash scripts/smoke.sh
 
 # Serving-layer chaos harness: workers on one spool under injected
-# kill -9 / stale-lease faults — adoption, fencing, solo parity, and
-# the sharded adoption-resume scenario (docs/robustness.md "Fleet
-# failure modes" + "Sharded & long-job failure modes"). Scenarios run
+# kill -9 / stale-lease faults — adoption, fencing, solo parity, the
+# sharded adoption-resume scenario, and the pod-router scenario
+# (worker kill -9 under the router + router kill -9 with direct
+# client failover; docs/robustness.md "Fleet failure modes" +
+# "Sharded & long-job failure modes"). Scenarios run
 # in per-scenario subshells; ANY failure exits nonzero. Also smoke
 # stages 5 (scenarios 1-2) and 10 (scenario 3).
 chaos:

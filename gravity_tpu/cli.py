@@ -2016,20 +2016,129 @@ def cmd_lint(args: argparse.Namespace) -> int:
 def cmd_fleet_status(args: argparse.Namespace) -> int:
     """Fleet-wide serving health: every live worker's snapshot from
     the shared spool, aggregated (per-class p50/p95/p99, occupancy,
-    breakers, SLO burn) — `/metrics?fleet=1` as a CLI verb."""
+    breakers, SLO burn) — `/metrics?fleet=1` as a CLI verb — plus the
+    worker registry's capability/drain view and, when a pod router is
+    running, its placement table (per-worker routed counts, decision
+    rationale ring; docs/serving.md 'Pod topology & router')."""
+    import os
+
     from .serve import DaemonUnreachable, request
+    from .serve.leases import entry_alive, read_json_retry
+    from .serve.service import ROUTER_FILE, WORKERS_DIR
 
     try:
         resp = request(args.spool_dir, "GET", "/metrics?fleet=1")
     except DaemonUnreachable as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
+    # Capability/capacity + drain state straight from the registry
+    # files — authoritative with or without a router in front.
+    registry_view = {}
+    workers_dir = os.path.join(args.spool_dir, WORKERS_DIR)
+    try:
+        names = sorted(
+            n for n in os.listdir(workers_dir)
+            if n.endswith(".json") and not n.endswith(".metrics.json")
+        )
+    except OSError:
+        names = []
+    for name in names:
+        entry = read_json_retry(os.path.join(workers_dir, name))
+        if not isinstance(entry, dict):
+            continue
+        wid = entry.get("worker_id") or name[:-len(".json")]
+        registry_view[wid] = {
+            "alive": entry_alive(entry),
+            "draining": bool(entry.get("draining")),
+            "capabilities": entry.get("capabilities") or {},
+        }
+    resp["worker_registry"] = registry_view
+    if "router" not in resp:
+        # Fleet view answered by a worker directly (no router in the
+        # request path) — still render a live router's placement table
+        # by asking it ourselves.
+        rinfo = read_json_retry(
+            os.path.join(args.spool_dir, ROUTER_FILE)
+        )
+        if isinstance(rinfo, dict) and entry_alive(rinfo):
+            try:
+                import urllib.request as _urlreq
+
+                with _urlreq.urlopen(
+                    f"http://{rinfo['host']}:{rinfo['port']}/metrics",
+                    timeout=10.0,
+                ) as r:
+                    resp["router"] = json.loads(r.read())
+            except Exception:  # noqa: BLE001 — router view best-effort
+                pass
     if not args.full:
-        # The registry dump is for machines; the default view is the
+        # The registry dumps are for machines; the default view is the
         # operator summary.
         resp.pop("registry", None)
+        if isinstance(resp.get("router"), dict):
+            resp["router"].pop("registry", None)
     print(json.dumps(resp, indent=2))
     return 0
+
+
+def cmd_route(args: argparse.Namespace) -> int:
+    """Start the pod router: a stateless placement tier speaking the
+    worker HTTP/JSON API, steering each submit onto a live worker by
+    measured evidence (docs/serving.md 'Pod topology & router').
+    Clients discover it through the same spool (router.json preferred
+    by find_daemon while the router pid is alive)."""
+    import os
+
+    from .serve.router import RouterDaemon
+
+    router = RouterDaemon(
+        args.spool_dir, host=args.host, port=args.port,
+        router_id=args.router_id,
+        proxy_timeout_s=args.proxy_timeout,
+    )
+    host, port = router.start()
+    print(json.dumps({
+        "routing": True, "host": host, "port": port,
+        "spool_dir": args.spool_dir, "pid": os.getpid(),
+        "router_id": router.router_id,
+    }), flush=True)
+    router.serve_blocking()
+    return 0
+
+
+def cmd_drain(args: argparse.Namespace) -> int:
+    """Flip a worker's drain state: a draining worker keeps running
+    its residents and answering every client verb, but the pod router
+    stops placing new jobs onto it (the drain workflow in
+    docs/serving.md 'Pod topology & router')."""
+    import urllib.error
+    import urllib.request as _urlreq
+
+    from .serve.service import _live_workers
+
+    drain = not args.undrain
+    for info in _live_workers(args.spool_dir):
+        if info.get("worker_id") != args.worker:
+            continue
+        body = json.dumps({"drain": drain}).encode()
+        req = _urlreq.Request(
+            f"http://{info['host']}:{info['port']}/drain",
+            data=body, headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        try:
+            with _urlreq.urlopen(req, timeout=30.0) as resp:
+                print(json.dumps(json.loads(resp.read())))
+                return 0
+        except (urllib.error.URLError, OSError) as e:
+            print(f"error: worker {args.worker!r} unreachable: {e}",
+                  file=sys.stderr)
+            return 2
+    print(
+        f"error: no live worker {args.worker!r} in the registry under "
+        f"{args.spool_dir!r}", file=sys.stderr,
+    )
+    return 2
 
 
 def cmd_tune(args: argparse.Namespace) -> int:
@@ -2550,12 +2659,48 @@ def main(argv=None) -> int:
     p_fleet = sub.add_parser(
         "fleet-status",
         help="aggregated fleet health across every live worker on the "
-             "spool (/metrics?fleet=1; docs/observability.md)",
+             "spool (/metrics?fleet=1; docs/observability.md) + the "
+             "worker registry's capability/drain view and the pod "
+             "router's placement table when one is running",
     )
     _add_spool_arg(p_fleet)
     p_fleet.add_argument("--full", action="store_true",
                          help="include the merged metric registry dump")
     p_fleet.set_defaults(fn=cmd_fleet_status)
+
+    p_route = sub.add_parser(
+        "route",
+        help="start the pod router: policy-placed submits over every "
+             "worker sharing the spool, same HTTP/JSON API as a "
+             "worker (docs/serving.md 'Pod topology & router')",
+    )
+    _add_spool_arg(p_route)
+    p_route.add_argument("--host", default="127.0.0.1")
+    p_route.add_argument("--port", type=int, default=0,
+                         help="0 = any free port (clients discover it "
+                              "via the spool's router.json)")
+    p_route.add_argument("--router-id", dest="router_id", default=None,
+                         help="stable router identity in the shared "
+                              "event/trace streams (default: "
+                              "router-host-pid-random)")
+    p_route.add_argument("--proxy-timeout", dest="proxy_timeout",
+                         type=float, default=300.0,
+                         help="per-proxy worker call budget in seconds "
+                              "(must outwait an admission-time "
+                              "autotune probe, not a socket RTT)")
+    p_route.set_defaults(fn=cmd_route)
+
+    p_drain = sub.add_parser(
+        "drain",
+        help="take a worker out of the router's placement rotation "
+             "(its residents keep running; --undrain puts it back)",
+    )
+    _add_spool_arg(p_drain)
+    p_drain.add_argument("worker", help="worker id from the registry "
+                                        "(see fleet-status)")
+    p_drain.add_argument("--undrain", action="store_true",
+                         help="re-enter the placement rotation")
+    p_drain.set_defaults(fn=cmd_drain)
 
     p_lint = sub.add_parser(
         "lint",
@@ -2575,7 +2720,7 @@ def main(argv=None) -> int:
     # talk JSON to files / the daemon) — skip the backend probe there.
     if args.command not in (
         "traj", "submit", "status", "result", "cancel",
-        "trace-export", "fleet-status", "lint",
+        "trace-export", "fleet-status", "lint", "route", "drain",
     ) and not (
         # bench --report only globs local round JSONs — device-free.
         args.command == "bench" and getattr(args, "report", False)

@@ -18,6 +18,11 @@ Fleet resilience (docs/robustness.md "Fleet failure modes"):
   so N workers share one spool and adopt a dead peer's jobs.
 - :mod:`.breaker` — per-backend circuit breakers over the supervisor's
   exact-physics degrade ladder, applied at admission keying.
+- :mod:`.router` — the pod router (`gravity_tpu route`): a stateless
+  placement tier that speaks the worker API in front and places each
+  submit onto a worker by measured evidence (compile-cache affinity,
+  sharded capability, HBM fit, per-class latency, load), docs/serving
+  .md "Pod topology & router".
 
 Traffic classes (docs/serving.md "Job classes"):
 
@@ -55,6 +60,12 @@ from .scheduler import (  # noqa: F401
     QueueFull,
     Spool,
     default_worker_id,
+)
+from .router import (  # noqa: F401
+    PlacementError,
+    RouterDaemon,
+    WorkerView,
+    place,
 )
 from .service import (  # noqa: F401
     DaemonUnreachable,

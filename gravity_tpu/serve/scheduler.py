@@ -253,6 +253,18 @@ class Spool:
         rec = read_json_retry(self.job_path(job_id))
         return rec if isinstance(rec, dict) else None
 
+    def job_ids(self) -> list:
+        """Every job id with a record on disk (the router's /status
+        listing and spool-wide scans; tolerant of a vanishing dir)."""
+        try:
+            return sorted(
+                n[:-len(".json")]
+                for n in os.listdir(self.jobs_dir)
+                if n.endswith(".json")
+            )
+        except OSError:
+            return []
+
     def record_fence(self, job_id: str) -> int:
         rec = self.read_job(job_id)
         try:

@@ -37,6 +37,7 @@ Device work happens exclusively on the worker thread.
 
 from __future__ import annotations
 
+import http.client
 import json
 import os
 import random
@@ -66,6 +67,45 @@ DAEMON_FILE = "daemon.json"
 # over to a surviving replica when the daemon.json worker dies
 # (docs/serving.md "Multi-worker shared spool").
 WORKERS_DIR = "workers"
+# The pod router's endpoint advertisement (serve/router/): clients
+# prefer a LIVE router over direct worker discovery, so starting
+# `gravity_tpu route` upgrades every existing client verb to
+# policy-placed submits with zero client changes — and a dead router
+# fails them over straight back to the workers (docs/serving.md
+# "Pod topology & router").
+ROUTER_FILE = "router.json"
+
+
+def worker_capabilities(*, slots: int) -> dict:
+    """Capability/capacity metadata a worker advertises in its
+    registry entry at serve start — the router's static placement
+    input (devices, sharded capability, admissible backends, HBM
+    budget, bucket cap, batch slots), also rendered by `gravity_tpu
+    fleet-status`."""
+    from ..telemetry.perf import device_memory_budget
+    from .engine import ENGINE_BACKENDS, MAX_BUCKET
+
+    try:
+        import jax
+
+        devices = jax.local_device_count()
+    except Exception:  # noqa: BLE001 — no runtime yet: minimal caps
+        devices = 1
+    sharded_env = os.environ.get("GRAVITY_TPU_SHARDED_CAPABLE")
+    return {
+        "devices": int(devices),
+        # Every worker can host the sharded class on its local mesh;
+        # the env knob lets tests/operators mark a replica out of the
+        # sharded rotation (e.g. a host whose devices are reserved).
+        "sharded_capable": (
+            sharded_env not in ("0", "false", "no")
+            if sharded_env is not None else devices >= 1
+        ),
+        "backends": list(ENGINE_BACKENDS),
+        "hbm_budget_bytes": device_memory_budget(),
+        "max_bucket": MAX_BUCKET,
+        "slots": int(slots),
+    }
 
 
 class GravityDaemon:
@@ -126,6 +166,12 @@ class GravityDaemon:
         # docs/observability.md "Chip windows"): zero cost while 0.
         self._profile_rounds = 0
         self._profile_dir = os.path.join(spool_dir, "profile")
+        # Drain state (POST /drain): a draining worker keeps serving
+        # its residents and every client verb, but advertises itself
+        # out of the pod router's placement rotation via its registry
+        # entry (docs/serving.md "Pod topology & router").
+        self.draining = False
+        self._endpoint: Optional[dict] = None
 
     # --- lifecycle ---
 
@@ -222,7 +268,14 @@ class GravityDaemon:
             # clients on other hosts know the pid probe does not apply.
             "host_name": _local_host(),
             "worker_id": self.worker_id,
+            # The router's static placement input + drain state
+            # (docs/serving.md "Pod topology & router").
+            "capabilities": worker_capabilities(
+                slots=self.scheduler.slots
+            ),
+            "draining": self.draining,
         }
+        self._endpoint = endpoint
         # daemon.json stays the primary discovery file (last worker to
         # start wins); the per-worker registry is the failover list
         # clients walk when its pid is dead (find_daemon).
@@ -498,6 +551,7 @@ class GravityDaemon:
                 "queue_depth": self.scheduler.queue_depth,
                 "active": self.scheduler.active_count,
                 "rounds": self.scheduler.rounds_run,
+                "draining": self.draining,
             }
         if path == "/metrics":
             # Served from a snapshot taken OUTSIDE the round lock: a
@@ -667,6 +721,35 @@ class GravityDaemon:
             return 200, {
                 "profiling_rounds": rounds, "dir": self._profile_dir,
             }
+        if path == "/drain":
+            # Take this worker out of (or back into) the router's
+            # placement rotation WITHOUT touching its residents: flip
+            # the drain flag in the registry entry the router reads.
+            # Direct clients are unaffected — drain is a placement
+            # signal, not an admission gate (the operator may be
+            # draining exactly to finish the queue before a restart).
+            drain = bool(body.get("drain", True))
+            changed = drain != self.draining
+            self.draining = drain
+            endpoint = dict(self._endpoint or {})
+            if endpoint:
+                endpoint["draining"] = drain
+                self._endpoint = endpoint
+                try:
+                    atomic_write_json(
+                        os.path.join(
+                            self.spool_dir, WORKERS_DIR,
+                            f"{self.worker_id}.json",
+                        ),
+                        endpoint,
+                    )
+                except OSError as e:
+                    return 500, {"error": f"registry write failed: {e}"}
+            if changed:
+                self.events.event("drained", drain=drain)
+            return 200, {
+                "worker_id": self.worker_id, "draining": drain,
+            }
         if path == "/shutdown":
             self._stop.set()
             return 200, {"stopping": True}
@@ -716,11 +799,27 @@ def _live_workers(spool_dir: str) -> list[dict]:
 
 
 def find_daemon(spool_dir: str) -> tuple[str, int]:
-    """The endpoint to talk to: ``daemon.json`` while its pid is alive,
-    else any live worker from the registry (failover to a surviving
-    replica). A daemon.json whose pid is DEAD is deleted on sight — a
-    stale endpoint file must produce a clear 'daemon not running'
-    error (CLI exit 2), never a hang against a port nobody owns."""
+    """The endpoint to talk to: a LIVE pod router first (``router.json``
+    — the placement front door speaks the same API, so clients route
+    through it transparently), then ``daemon.json`` while its pid is
+    alive, else any live worker from the registry (failover to a
+    surviving replica). A dead router/daemon endpoint file is deleted
+    on sight — kill -9 the router and the NEXT client call lands
+    direct on a worker; a stale endpoint file must produce a clear
+    'daemon not running' error (CLI exit 2), never a hang against a
+    port nobody owns."""
+    router_path = os.path.join(spool_dir, ROUTER_FILE)
+    info = read_json_retry(router_path)
+    if isinstance(info, dict) and "host" in info and "port" in info:
+        if _entry_alive(info):
+            return info["host"], int(info["port"])
+        try:
+            # Same TOCTOU care as daemon.json below: only reap the
+            # exact record we probed dead.
+            if read_json_retry(router_path) == info:
+                os.remove(router_path)
+        except OSError:
+            pass
     path = os.path.join(spool_dir, DAEMON_FILE)
     info = read_json_retry(path)
     if isinstance(info, dict) and "host" in info and "port" in info:
@@ -831,7 +930,12 @@ def _request_once(
         if e.code == 503:
             raise _Shed(body) from e
         return body
-    except (urllib.error.URLError, OSError) as e:
+    # HTTPException covers a daemon SIGKILLed MID-RESPONSE
+    # (IncompleteRead / BadStatusLine): the body will never arrive, so
+    # it is the same failover case as a refused connection.
+    except (
+        urllib.error.URLError, OSError, http.client.HTTPException,
+    ) as e:
         raise DaemonUnreachable(
             f"daemon at {url} not responding: {e}"
         ) from e

@@ -32,6 +32,7 @@ from .perf import (
 from .perf import ledger as perf_ledger
 from .metrics import (
     MetricsRegistry,
+    declare_router_metrics,
     declare_worker_metrics,
     merge_snapshots,
     parse_prometheus_text,
@@ -45,6 +46,7 @@ from .tracing import (
     chrome_trace,
     emit_bound,
     load_spans,
+    new_span_id,
     new_trace_id,
     span_coverage,
     trace_ids,
@@ -83,8 +85,9 @@ __all__ = [
     "FlightRecorder", "InstrumentedFn", "InsufficientDeviceMemory",
     "MetricsRegistry", "PerfLedger", "SPAN_NAMES", "TRACES_FILE",
     "Telemetry", "Tracer", "bind", "chrome_trace",
-    "declare_worker_metrics", "emit_bound", "instrument_jit",
-    "load_spans", "merge_snapshots", "new_trace_id",
+    "declare_router_metrics", "declare_worker_metrics", "emit_bound",
+    "instrument_jit",
+    "load_spans", "merge_snapshots", "new_span_id", "new_trace_id",
     "parse_prometheus_text", "perf_ledger", "prometheus_text",
     "snapshot_quantile", "span_coverage", "trace_ids",
 ]

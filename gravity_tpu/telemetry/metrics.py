@@ -108,7 +108,25 @@ WORKER_METRICS = (
      "slot-units advanced per second summed over residents)"),
     ("gravity_autotune_probe_ms", "histogram",
      "Wall-clock milliseconds per autotune measurement probe"),
+    # Pod router (docs/serving.md "Pod topology & router"). These
+    # families live in the ROUTER's registry (declare_router_metrics),
+    # not a worker's — tabled with the rest so docs and the drift lint
+    # cover every gravity_* name one way.
+    ("gravity_router_placements_total", "counter",
+     "Router placement decisions that reached a worker, by policy rule"),
+    ("gravity_router_rejected_total", "counter",
+     "Router-level submit rejections, by typed reason"),
+    ("gravity_router_worker_routed", "gauge",
+     "Jobs this router has placed onto each worker since it started, "
+     "by worker"),
+    ("gravity_router_latency_seconds", "histogram",
+     "Wall-clock seconds from router /submit receipt to worker "
+     "acceptance (placement + proxy)"),
 )
+
+# The router's own instrument families (a strict subset of
+# WORKER_METRICS so every gravity_* name stays in ONE table).
+ROUTER_METRIC_PREFIX = "gravity_router_"
 
 # Millisecond-scale buckets for the autotune probe cost (a probe is
 # 10ms-minutes; the seconds-scale latency buckets would collapse the
@@ -591,4 +609,17 @@ def declare_worker_metrics(registry: MetricsRegistry) -> MetricsRegistry:
         registry.declare(
             name, typ, help_, buckets=WORKER_METRIC_BUCKETS.get(name)
         )
+    return registry
+
+
+def declare_router_metrics(registry: MetricsRegistry) -> MetricsRegistry:
+    """Register the pod router's instrument families (the
+    ``gravity_router_*`` subset of WORKER_METRICS — the router is not
+    a worker, so its registry carries only its own families)."""
+    for name, typ, help_ in WORKER_METRICS:
+        if name.startswith(ROUTER_METRIC_PREFIX):
+            registry.declare(
+                name, typ, help_,
+                buckets=WORKER_METRIC_BUCKETS.get(name),
+            )
     return registry

@@ -39,6 +39,10 @@ SPAN_NAMES = (
     "admission", "autotune_probe", "queue", "slot_load", "compile",
     "round", "d2h", "result_write", "adopted", "progress_snapshot",
     "block", "checkpoint", "sentinel",
+    # The pod router's hop: /submit receipt -> worker acceptance,
+    # stitched into the job's own trace via the spool-persisted trace
+    # id (docs/serving.md "Pod topology & router").
+    "route",
 )
 
 

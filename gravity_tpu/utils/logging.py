@@ -248,6 +248,13 @@ class ServingEventLogger(JsonlEventLogger):
     storm threshold (the compile cache is thrashing), and the
     memory-aware admission rejecting a submit whose resolved program
     cannot fit device memory.
+
+    ``routed``/``router_rejected``/``drained`` are the pod router's
+    kinds (docs/serving.md "Pod topology & router"): a placement
+    decision with its full rationale (rule, evidence, excluded
+    workers), a typed router-level submit rejection (no live workers,
+    no sharded-capable worker, over-HBM), and a worker's drain-state
+    transition taking it out of (or back into) router rotation.
     """
 
     KINDS = (
@@ -259,4 +266,5 @@ class ServingEventLogger(JsonlEventLogger):
         "encounter", "merger", "followup_submitted",
         "slo_breach", "accuracy_breach",
         "recompile_storm", "memory_rejected",
+        "routed", "router_rejected", "drained",
     )

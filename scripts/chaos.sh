@@ -24,6 +24,15 @@
 #   exactly once with <=1e-5 parity to an uninterrupted solo run, and
 #   re-execute strictly fewer steps than a from-zero respool.
 #
+#   Scenario 4 — pod router under fire: two workers behind a
+#   `gravity_tpu route` front door; worker G is SIGKILLed mid-load.
+#   Every job must complete exactly once (adoption), and every
+#   placement AFTER the kill must avoid the corpse. Then the ROUTER
+#   is SIGKILLed: clients must fail over DIRECT to a worker (the dead
+#   router.json is reaped on sight by discovery) and one more job
+#   must complete without any router (docs/serving.md "Pod topology
+#   & router").
+#
 # Usage: chaos.sh [scenario...]   (default: all). Each scenario runs
 # in its own subshell (a fresh `bash $0 --one N`), so one scenario's
 # failure cannot mask another's and the harness exits nonzero when ANY
@@ -320,13 +329,136 @@ EOF
     kill "$F_PID" 2>/dev/null || true
 }
 
+scenario_4() {
+    echo "== chaos 4: worker kill -9 UNDER THE ROUTER, then router kill -9 -> direct failover =="
+    SPOOL4=$(mktemp -d /tmp/gravity_chaos4.XXXXXX)
+    DIRS+=("$SPOOL4")
+    start_worker "$SPOOL4" chaos-h ""
+    H_PID=${PIDS[-1]}
+    wait_for_daemon "$SPOOL4" chaos-h
+    # crash_worker@2: the doomed worker dies an un-catchable death at
+    # its second scheduling round — mid-load, with jobs resident.
+    start_worker "$SPOOL4" chaos-g "crash_worker@2"
+    G_PID=${PIDS[-1]}
+    wait_for_daemon "$SPOOL4" chaos-g
+
+    python -m gravity_tpu route --spool-dir "$SPOOL4" \
+        >"$SPOOL4/router.stdout" 2>&1 &
+    ROUTER_PID=$!
+    PIDS+=("$ROUTER_PID")
+    for _ in $(seq 1 150); do
+        [ -f "$SPOOL4/router.json" ] && break
+        sleep 0.2
+    done
+    [ -f "$SPOOL4/router.json" ] || {
+        echo "router never advertised itself";
+        cat "$SPOOL4/router.stdout"; exit 1;
+    }
+
+    python - "$SPOOL4" <<'EOF'
+import json, sys
+from gravity_tpu.config import SimulationConfig
+from gravity_tpu.serve import request
+
+spool = sys.argv[1]
+# find_daemon prefers the live router.json: these submits go through
+# the pod front door, and the rotation guarantees the doomed worker
+# gets load before its injected crash.
+ids = []
+for i, n in enumerate((6, 8, 10, 12, 16, 20)):
+    cfg = SimulationConfig(n=n, steps=60, seed=40 + i, model="random",
+                           dt=3600.0, integrator="leapfrog",
+                           force_backend="dense")
+    resp = request(spool, "POST", "/submit",
+                   {"config": json.loads(cfg.to_json())}, retries=5)
+    assert "job" in resp, resp
+    assert resp.get("routed_by"), f"submit bypassed the router: {resp}"
+    ids.append(resp["job"])
+json.dump(ids, open(f"{spool}/chaos4_ids.json", "w"))
+print("submitted through router:", len(ids), "jobs")
+EOF
+
+    RC=0; wait "$G_PID" || RC=$?
+    [ "$RC" -eq 137 ] || {
+        echo "worker chaos-g should have died by SIGKILL, exit $RC";
+        cat "$SPOOL4/chaos-g.stdout"; exit 1;
+    }
+    echo "worker chaos-g SIGKILLed as injected (exit $RC)"
+
+    python - "$SPOOL4" <<'EOF'
+import json, sys
+from gravity_tpu.config import SimulationConfig
+from gravity_tpu.serve import request, wait_for
+
+spool = sys.argv[1]
+ids = json.load(open(f"{spool}/chaos4_ids.json"))
+# Placements AFTER the kill must avoid the corpse: the router reads
+# the same pid-probed liveness the reaper uses.
+for i in range(2):
+    cfg = SimulationConfig(n=10, steps=30, seed=60 + i, model="random",
+                           dt=3600.0, integrator="leapfrog",
+                           force_backend="dense")
+    resp = request(spool, "POST", "/submit",
+                   {"config": json.loads(cfg.to_json())}, retries=5)
+    assert resp["worker"] == "chaos-h", resp
+    ids.append(resp["job"])
+statuses = wait_for(spool, ids, timeout=300)
+assert all(s["status"] == "completed" for s in statuses.values()), statuses
+events = [json.loads(l) for l in open(f"{spool}/serving_events.jsonl")]
+routed = {e["job"]: e for e in events if e["event"] == "routed"}
+assert set(ids) <= set(routed), (sorted(ids), sorted(routed))
+assert all(e["rule"] and isinstance(e["rationale"], dict)
+           for e in routed.values()), routed
+adopted = [e for e in events if e["event"] == "adopted"]
+assert adopted and {e["worker"] for e in adopted} == {"chaos-h"}, adopted
+completed = [e for e in events if e["event"] == "completed"]
+per_job = {j: sum(1 for e in completed if e["job"] == j) for j in ids}
+assert all(v == 1 for v in per_job.values()), per_job
+print("chaos 4a OK:", len(ids), "jobs exactly-once |",
+      len(adopted), "adopted by chaos-h | post-kill placements avoided",
+      "the corpse")
+EOF
+
+    # Now kill -9 the ROUTER: zero durable state means the next client
+    # call lands DIRECT on a worker and everything still works.
+    kill -9 "$ROUTER_PID" 2>/dev/null || true
+    wait "$ROUTER_PID" 2>/dev/null || true
+    [ -f "$SPOOL4/router.json" ] || {
+        echo "kill -9 should have left a stale router.json"; exit 1;
+    }
+    python - "$SPOOL4" <<'EOF'
+import json, os, sys
+from gravity_tpu.config import SimulationConfig
+from gravity_tpu.serve import request, wait_for
+from gravity_tpu.serve.service import find_daemon
+
+spool = sys.argv[1]
+# Discovery probes the dead router's pid, reaps the stale file, and
+# fails over to the surviving worker's direct endpoint.
+host, port = find_daemon(spool)
+assert not os.path.exists(f"{spool}/router.json"), \
+    "stale router.json not reaped by discovery"
+cfg = SimulationConfig(n=8, steps=30, seed=70, model="random",
+                       dt=3600.0, integrator="leapfrog",
+                       force_backend="dense")
+resp = request(spool, "POST", "/submit",
+               {"config": json.loads(cfg.to_json())}, retries=5)
+assert "job" in resp and "routed_by" not in resp, resp
+statuses = wait_for(spool, [resp["job"]], timeout=300)
+assert statuses[resp["job"]]["status"] == "completed", statuses
+print("chaos 4b OK: router kill -9 -> direct failover, job completed",
+      "without a router")
+EOF
+    kill "$H_PID" 2>/dev/null || true
+}
+
 if [ "${1:-}" = "--one" ]; then
     "scenario_$2"
     exit 0
 fi
 
 SCENARIOS=("$@")
-[ ${#SCENARIOS[@]} -eq 0 ] && SCENARIOS=(1 2 3)
+[ ${#SCENARIOS[@]} -eq 0 ] && SCENARIOS=(1 2 3 4)
 FAILED=0
 for s in "${SCENARIOS[@]}"; do
     # Each scenario runs in its own shell so its `set -e` semantics

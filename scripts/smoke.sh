@@ -27,6 +27,10 @@
 # baseline under a 2x both-arm handicap exits 0 — the paired-ratio
 # gating absorbing the documented window swing;
 # docs/observability.md "Performance"),
+# and the pod-router stage (>=3 job classes placed over two CLI
+# workers through `gravity_tpu route` with rationale-bearing routed
+# events, fleet-status router view, drain workflow — docs/serving.md
+# "Pod topology & router"),
 # all on CPU. Exits nonzero on any failure. ~10 min on a laptop-class
 # CPU.
 set -euo pipefail
@@ -34,7 +38,7 @@ cd "$(dirname "$0")/.."
 
 export JAX_PLATFORMS=cpu
 
-echo "== smoke 1/12: pytest -m 'fast and not slow and not heavy' (contract + oracle-parity lane) =="
+echo "== smoke 1/13: pytest -m 'fast and not slow and not heavy' (contract + oracle-parity lane) =="
 # "fast and not slow and not heavy": module-level fast marks would
 # otherwise pull a file's slow-marked wall-clock tests into the lane
 # (pytest -m fast selects anything CARRYING the mark; it does not
@@ -43,7 +47,7 @@ echo "== smoke 1/12: pytest -m 'fast and not slow and not heavy' (contract + ora
 # item 5).
 python -m pytest tests/ -q -m "fast and not slow and not heavy" -p no:cacheprovider
 
-echo "== smoke 2/12: 2-job ensemble serving e2e (CLI daemon) =="
+echo "== smoke 2/13: 2-job ensemble serving e2e (CLI daemon) =="
 SPOOL="$(mktemp -d /tmp/gravity_smoke.XXXXXX)"
 cleanup() {
     # Best-effort daemon shutdown + spool removal.
@@ -96,7 +100,7 @@ print("ensemble e2e OK:", {j: s["status"] for j, s in statuses.items()},
       "| compiles:", metrics["compile_counts"])
 EOF
 
-echo "== smoke 3/12: async host pipeline e2e (cadence run + SIGTERM + resume) =="
+echo "== smoke 3/13: async host pipeline e2e (cadence run + SIGTERM + resume) =="
 IODIR="$(mktemp -d /tmp/gravity_smoke_io.XXXXXX)"
 trap 'cleanup; rm -rf "$IODIR"' EXIT
 # Cadence-on pipelined run; preempt@500 delivers a real SIGTERM to the
@@ -132,7 +136,7 @@ print("io-pipeline e2e OK: resumed", stats["steps"], "steps,",
       "host_gap_frac", round(stats["host_gap_frac"], 3))
 EOF
 
-echo "== smoke 4/12: autotune cache round-trip (probe-on-miss, instant-on-hit) =="
+echo "== smoke 4/13: autotune cache round-trip (probe-on-miss, instant-on-hit) =="
 TUNEDIR="$(mktemp -d /tmp/gravity_smoke_tune.XXXXXX)"
 trap 'cleanup; rm -rf "$IODIR" "$TUNEDIR"' EXIT
 # Fresh cache dir + lowered fast-probe floor so plain `auto` runs a
@@ -169,10 +173,10 @@ print("autotune round-trip OK: backend", s1["backend"],
       "| probe", round(s1["autotune_probe_ms"], 1), "ms -> hit 0 ms")
 EOF
 
-echo "== smoke 5/12: serving chaos harness (kill -9 + adoption + fencing) =="
+echo "== smoke 5/13: serving chaos harness (kill -9 + adoption + fencing) =="
 bash scripts/chaos.sh 1 2
 
-echo "== smoke 6/12: job classes through the CLI daemon (fit + sweep) =="
+echo "== smoke 6/13: job classes through the CLI daemon (fit + sweep) =="
 # One fit + one sweep submitted through the REAL daemon from stage 2
 # (still serving), asserting completion + served-vs-solo parity
 # (docs/serving.md "Job classes").
@@ -282,7 +286,7 @@ z = np.load(sys.argv[1])
 assert 'min_sep' in z.files and len(z['min_sep']) == 4, z.files
 " "$SPOOL/sweep_verdicts.npz"
 
-echo "== smoke 7/12: unified telemetry (Prometheus scrape + Perfetto trace export) =="
+echo "== smoke 7/13: unified telemetry (Prometheus scrape + Perfetto trace export) =="
 # Against the STILL-LIVE stage-2 daemon: (a) a text/plain /metrics
 # scrape must be valid Prometheus exposition (validated by the strict
 # parser the tests use) including per-class latency histograms and
@@ -327,7 +331,7 @@ assert summary["coverage"] is not None and summary["coverage"] >= 0.9, \
 print("perfetto export OK:", summary)
 PYEOF
 
-echo "== smoke 8/12: nlist cell-list near field (p3m parity + standalone truncated parity) =="
+echo "== smoke 8/13: nlist cell-list near field (p3m parity + standalone truncated parity) =="
 # (a) The P3M near pass through the cell-list tile engine must match
 # the chunked gather near pass <= 1e-5 scaled on CPU (the ISSUE-9
 # acceptance bound); (b) the standalone nlist backend must match the
@@ -369,7 +373,7 @@ print("nlist near-field OK: p3m dev", float(dev),
       "| standalone dev", float(dev2))
 PYEOF
 
-echo "== smoke 9/12: numerics observatory (drift gauges + error histogram scrape, injected accuracy breach) =="
+echo "== smoke 9/13: numerics observatory (drift gauges + error histogram scrape, injected accuracy breach) =="
 # (a) Strict-parse the LIVE stage-2 daemon's Prometheus text and
 # assert the numerics families are present with real series: the
 # per-backend force-error histogram (sentinel probes ran — default
@@ -486,7 +490,7 @@ urllib.request.urlopen(req, timeout=5).read()
 EOF
 kill "$NUM_PID" 2>/dev/null || true
 
-echo "== smoke 10/12: sharded adoption-resume chaos (SIGKILL mid-sharded-job -> resume from snapshot) =="
+echo "== smoke 10/13: sharded adoption-resume chaos (SIGKILL mid-sharded-job -> resume from snapshot) =="
 # Chaos scenario 3 through the real CLI daemon on a 2-device CPU mesh:
 # a worker running a sharded-integrate job is SIGKILLed mid-run; the
 # survivor adopts, RESUMES from the last fenced progress snapshot
@@ -496,7 +500,7 @@ echo "== smoke 10/12: sharded adoption-resume chaos (SIGKILL mid-sharded-job -> 
 # modes").
 bash scripts/chaos.sh 3
 
-echo "== smoke 11/12: static analysis (gravity_tpu lint: planted violations -> exit 1, real tree -> exit 0) =="
+echo "== smoke 11/13: static analysis (gravity_tpu lint: planted violations -> exit 1, real tree -> exit 0) =="
 # The AST invariant analyzer (docs/static-analysis.md). First a
 # fixture tree with one planted violation per acceptance class
 # (use-after-donation, time.time in a scanned body, unfenced spool
@@ -563,7 +567,7 @@ rm -rf "$LINTDIR"
 # The real tree: zero non-baselined findings.
 python -m gravity_tpu lint
 
-echo "== smoke 12/12: perf regression gate (planted violation -> exit 1, clean tree -> exit 0) =="
+echo "== smoke 12/13: perf regression gate (planted violation -> exit 1, clean tree -> exit 0) =="
 # The noise-robust perf gate (docs/observability.md "Performance")
 # through the real CLI. (a) A planted regression — an 8x handicap on
 # the nlist arm of the speedup contract — must exit 1 and NAME the
@@ -598,5 +602,110 @@ grep -q "perf gate: all contracts hold" "$GATEDIR/clean.out" || {
     cat "$GATEDIR/clean.out"; exit 1;
 }
 echo "perf gate OK: planted violation exit 1 (contract named), clean tree exit 0 under a 2x both-arm window handicap"
+
+echo "== smoke 13/13: pod router (3 job classes placed over two CLI workers, drain, fleet view) =="
+# Two CLI workers + the `gravity_tpu route` front door on one spool:
+# every client verb goes through discovery, which prefers the live
+# router — so the same submit/wait/result code exercises placement.
+# Asserts: >=3 job classes complete through the router with
+# rationale-bearing routed events, fleet-status renders the router
+# section + the capability registry, and `gravity_tpu drain` takes a
+# worker out of rotation (docs/serving.md "Pod topology & router").
+ROUTEDIR="$(mktemp -d /tmp/gravity_smoke_route.XXXXXX)"
+trap 'cleanup; rm -rf "$IODIR" "$TUNEDIR" "$NUMDIR" "$GATEDIR" "$ROUTEDIR"' EXIT
+python -m gravity_tpu serve --spool-dir "$ROUTEDIR" --slots 2 \
+    --slice-steps 10 --worker-id rsmoke-a \
+    >"$ROUTEDIR/rsmoke-a.stdout" 2>&1 &
+RA_PID=$!
+python -m gravity_tpu serve --spool-dir "$ROUTEDIR" --slots 2 \
+    --slice-steps 10 --worker-id rsmoke-b \
+    >"$ROUTEDIR/rsmoke-b.stdout" 2>&1 &
+RB_PID=$!
+for _ in $(seq 1 150); do
+    [ -f "$ROUTEDIR/workers/rsmoke-a.json" ] && \
+        [ -f "$ROUTEDIR/workers/rsmoke-b.json" ] && break
+    sleep 0.2
+done
+python -m gravity_tpu route --spool-dir "$ROUTEDIR" \
+    --router-id rsmoke-router >"$ROUTEDIR/router.stdout" 2>&1 &
+ROUTE_PID=$!
+for _ in $(seq 1 150); do
+    [ -f "$ROUTEDIR/router.json" ] && break
+    sleep 0.2
+done
+[ -f "$ROUTEDIR/router.json" ] || {
+    echo "router never advertised itself"; cat "$ROUTEDIR/router.stdout";
+    exit 1;
+}
+
+python - "$ROUTEDIR" <<'PYEOF'
+import json, sys
+from gravity_tpu.serve import request, wait_for
+
+spool = sys.argv[1]
+cfg = {"model": "random", "n": 12, "steps": 20, "dt": 3600.0,
+       "integrator": "leapfrog", "force_backend": "dense"}
+r1 = request(spool, "POST", "/submit", {"config": cfg}, retries=5)
+assert r1.get("routed_by") == "rsmoke-router", r1
+r2 = request(spool, "POST", "/submit", {
+    "config": {**cfg, "n": 8, "steps": 30},
+    "job_type": "sweep", "params": {"members": 3, "spread": 0.02},
+}, retries=5)
+r3 = request(spool, "POST", "/submit", {
+    "config": {**cfg, "n": 6, "steps": 20},
+    "job_type": "watch", "params": {"radius": 1e12},
+}, retries=5)
+ids = [r1["job"], r2["job"], r3["job"]]
+statuses = wait_for(spool, ids, timeout=300)
+assert all(s["status"] == "completed" for s in statuses.values()), statuses
+events = [json.loads(l) for l in
+          open(f"{spool}/serving_events.jsonl") if l.strip()]
+routed = [e for e in events if e["event"] == "routed"]
+classes = {e["job_type"] for e in routed}
+assert {"integrate", "sweep", "watch"} <= classes, classes
+for e in routed:
+    assert e["rule"] and isinstance(e["rationale"], dict), e
+    assert e["target"] in ("rsmoke-a", "rsmoke-b"), e
+print("router e2e OK:", len(routed), "placements over classes",
+      sorted(classes))
+PYEOF
+
+# fleet-status renders the router section + the capability registry.
+python -m gravity_tpu fleet-status --spool-dir "$ROUTEDIR" \
+    > "$ROUTEDIR/fleet.json"
+python - "$ROUTEDIR/fleet.json" <<'PYEOF'
+import json, sys
+doc = json.load(open(sys.argv[1]))
+router = doc["router"]
+assert router["router_id"] == "rsmoke-router", router
+assert router["placements"] >= 3, router
+reg = doc["worker_registry"]
+assert set(reg) >= {"rsmoke-a", "rsmoke-b"}, reg
+for wid, row in reg.items():
+    caps = row["capabilities"]
+    assert caps["max_bucket"] >= 16 and caps["slots"] == 2, (wid, caps)
+    assert "sharded_capable" in caps and "backends" in caps, (wid, caps)
+print("fleet router view OK: placements", router["placements"],
+      "| registry", sorted(reg))
+PYEOF
+
+# Drain rsmoke-a: the next placement must land on rsmoke-b.
+python -m gravity_tpu drain rsmoke-a --spool-dir "$ROUTEDIR" >/dev/null
+python - "$ROUTEDIR" <<'PYEOF'
+import json, sys
+from gravity_tpu.serve import request, wait_for
+
+spool = sys.argv[1]
+entry = json.load(open(f"{spool}/workers/rsmoke-a.json"))
+assert entry["draining"] is True, entry
+cfg = {"model": "random", "n": 24, "steps": 10, "dt": 3600.0,
+       "integrator": "leapfrog", "force_backend": "dense"}
+r = request(spool, "POST", "/submit", {"config": cfg}, retries=5)
+assert r["worker"] == "rsmoke-b", r
+wait_for(spool, [r["job"]], timeout=180)
+print("drain OK: post-drain placement landed on rsmoke-b")
+PYEOF
+
+kill "$ROUTE_PID" "$RA_PID" "$RB_PID" 2>/dev/null || true
 
 echo "== smoke: all green =="

@@ -281,6 +281,10 @@ def test_simulator_reports_cache_off_for_explicit_and_disabled():
     assert sim2.autotune["cache"] == "off"
 
 
+# Tier-2: the miss→hit round-trip runs in tier-1 at the serve layer
+# and in smoke stage 4 through the real CLI; this 7s solo duplicate
+# rides tier-2 (PR-18 lane re-budget).
+@pytest.mark.slow
 def test_simulator_auto_miss_then_hit_lands_in_run_stats(monkeypatch):
     """The acceptance-contract observability: first 'auto' run probes
     (cache=miss, probe_ms>0), the second run of the same configuration

@@ -219,6 +219,11 @@ def test_fmm_rollout_grad_matches_finite_difference(key, x64):
     np.testing.assert_allclose(float(g2), float(fd2), rtol=5e-3)
 
 
+# Tier-2: every backend's grad-vs-finite-difference row stays pinned;
+# the PM row costs 8s of fp64 FFT compiles and rides tier-2 — the
+# cheaper pm-backend grad coverage (sharded rollout, block schemes)
+# stays in tier-1 (PR-18 lane re-budget).
+@pytest.mark.slow
 def test_pm_rollout_grad_matches_finite_difference(key, x64):
     """jax.grad flows through the PM pipeline — CIC deposit (piecewise-
     linear in positions), the FFT Poisson solve, and CIC gather — and

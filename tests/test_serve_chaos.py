@@ -66,6 +66,11 @@ def _chaos_fit_params(config, iters=4):
 
 
 @pytest.mark.heavy  # subprocess worker: JAX import + compiles
+# Tier-2: the kill-9/adoption contract now runs in tier-1 through the
+# router (test_router.py::test_router_worker_sigkill_exactly_once) and
+# in `make chaos` scenarios 1 + 4; this 24s subprocess duplicate rides
+# tier-2 (PR-18 lane re-budget).
+@pytest.mark.slow
 def test_two_worker_kill9_chaos_e2e(tmp_path, faults):
     from conftest import subprocess_env
 

@@ -48,6 +48,10 @@ def daemon(tmp_path):
     d.stop()
 
 
+# Tier-2: the multi-bucket daemon e2e shape is covered in tier-1 by
+# the 2-job daemon e2e plus the router e2es (test_router.py); this
+# 8-job 18s variant rides tier-2 (PR-18 lane re-budget).
+@pytest.mark.slow
 def test_eight_mixed_jobs_two_buckets_e2e(daemon):
     """The headline acceptance gate (see module docstring)."""
     spool = daemon.spool_dir

@@ -51,12 +51,19 @@ def _make_model(key, n, model):
     return state.positions, state.masses, 0.05, 1.0
 
 
-@pytest.mark.parametrize("far_mode", ["gather", "window"])
+@pytest.mark.parametrize(
+    "far_mode",
+    # Tier-1 keeps "window" (the TPU-default data movement, which the
+    # CPU suite would otherwise never execute); the gather movement
+    # repeats the same parity contract ~2x slower and rides tier-2
+    # (PR-18 lane re-budget: tier-1 must fit its 870s window).
+    [pytest.param("gather", marks=pytest.mark.slow), "window"],
+)
 @pytest.mark.parametrize(
     "model",
-    # Tier-1 keeps the uniform pair (both data movements); the cold
-    # geometry repeats the same parity contract and rides tier-2
-    # (VERDICT r5 weak-4: the lane must fit its window).
+    # Tier-1 keeps the uniform geometry; the cold geometry repeats the
+    # same parity contract and rides tier-2 (VERDICT r5 weak-4: the
+    # lane must fit its window).
     ["uniform", pytest.param("cold", marks=pytest.mark.slow)],
 )
 def test_sfmm_matches_dense_fmm_exactly(key, model, far_mode):

@@ -53,7 +53,13 @@ def test_backends_agree_with_dense(backend):
 
 
 @pytest.mark.heavy  # compile-heavy; tier-1 keeps it, contract lane skips
-@pytest.mark.parametrize("backend", ["tree", "pm"])
+@pytest.mark.parametrize(
+    "backend",
+    # Tier-1 keeps the pm arm; the tree arm's end-to-end accuracy is
+    # already pinned all over test_tree.py, and its 10s of octree
+    # compiles ride tier-2 (PR-18 lane re-budget).
+    [pytest.param("tree", marks=pytest.mark.slow), "pm"],
+)
 def test_fast_backends_run_and_approximate(backend):
     """tree/pm backends run end-to-end and stay near the dense result over
     a short horizon (they are approximations; tolerance is loose)."""

@@ -366,6 +366,11 @@ def test_daemon_prometheus_fleet_and_flightrec(tmp_path):
 
 
 @pytest.mark.heavy
+# Tier-2: smoke has no /profile stage, but the endpoint's contract
+# (arm N rounds, then free) is a leaf feature off the daemon loop
+# already e2e-covered in tier-1; the jax.profiler capture costs 8s
+# and rides tier-2 (PR-18 lane re-budget).
+@pytest.mark.slow
 def test_profile_endpoint_arms_per_round_capture(tmp_path):
     """POST /profile arms a jax.profiler capture for the next N
     rounds (zero cost while the budget is 0); the capture directory
