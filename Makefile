@@ -26,7 +26,7 @@ chaos:
 # The AST invariant analyzer (docs/static-analysis.md): donation
 # safety, trace purity, fenced spool writes, flock weight, telemetry
 # and fault-spec drift. Exit 1 on any non-baselined finding. Also a
-# tier-1 test (tests/test_lint.py) and smoke stage 11/11.
+# tier-1 test (tests/test_lint.py) and smoke stage 11/14.
 lint:
 	env JAX_PLATFORMS=cpu python -m gravity_tpu lint
 
@@ -34,7 +34,7 @@ lint:
 # PERF_BASELINE.json contracts (docs/observability.md "Performance"):
 # interleaved paired A/B, median-of-ratios + bootstrap CI — the ~1.8x
 # window swing structurally cannot flake it. Exit 1 names the file +
-# every violated contract. Also smoke stage 12/12.
+# every violated contract. Also smoke stage 12/14.
 perf-gate:
 	env JAX_PLATFORMS=cpu python -m gravity_tpu bench --gate
 

@@ -1,7 +1,7 @@
 """Cell-list kernel sweep: cap x cutoff x density, with MFU per point,
 plus the N-scaling A/B against the rcut-masked chunked direct sum.
 
-Two modes, one JSON line per point (the crossover.py/p3m_short_ab.py
+Three modes, one JSON line per point (the crossover.py/p3m_short_ab.py
 reporting contract):
 
 - default (``--scaling``-less): the cap x cutoff x density grid at a
@@ -21,11 +21,20 @@ reporting contract):
   nlist dense-equivalent rate must RISE with N (O(N) work under an
   O(N^2)-equivalent metric) while the chunked rate stays ~flat.
 
+- ``--mesh``: a fixed-density PER-DEVICE N ladder over the device mesh
+  timing the domain-decomposed halo exchange against the allgather
+  exchange at identical cell sizing (the HALO_SWEEP_CPU.json evidence;
+  the gated form lives in PERF_BASELINE.json's
+  ``halo_vs_allgather_speedup``). Each rung also reports the analytic
+  ghost/local byte ratio — the O(surface)-comms claim as a number.
+
 Usage:
     python benchmarks/nlist_sweep.py                  # cap x rcut x density
     python benchmarks/nlist_sweep.py --n 16384
     python benchmarks/nlist_sweep.py --scaling        # N ladder A/B
     python benchmarks/nlist_sweep.py --scaling --sizes 4096 8192 16384
+    python benchmarks/nlist_sweep.py --mesh           # halo vs allgather
+    python benchmarks/nlist_sweep.py --mesh --devices 8 --sizes 512 2048
 """
 
 from __future__ import annotations
@@ -182,6 +191,120 @@ def run_scaling(args) -> int:
     return 0
 
 
+def run_mesh(args) -> int:
+    """Fixed-density PER-DEVICE ladder: the domain-decomposed halo
+    exchange vs the allgather exchange, same nlist cell sizing on both
+    arms, interleaved A/B pairs per rung (the HALO_SWEEP_CPU.json
+    evidence). ``halo_fraction`` is the analytic ghost/local byte
+    ratio (parallel.halo.halo_comm_model) — the O(surface)/O(volume)
+    claim in one number per rung."""
+    import statistics
+    from functools import partial
+
+    from jax.sharding import Mesh
+
+    from gravity_tpu.ops.pallas_nlist import make_nlist_local_kernel
+    from gravity_tpu.parallel.halo import (
+        halo_comm_model,
+        make_halo_nlist_accel,
+        resolve_halo_sizing,
+    )
+    from gravity_tpu.parallel.sharded import make_sharded_accel2
+    from gravity_tpu.utils.timing import sync
+
+    devices = args.devices
+    avail = jax.devices()
+    if len(avail) < devices:
+        if (avail[0].platform != "cpu"
+                or os.environ.get("_GT_NLIST_SWEEP_REEXEC")):
+            raise SystemExit(
+                f"--mesh wants {devices} devices, this process sees "
+                f"{len(avail)}; on CPU set XLA_FLAGS="
+                f"--xla_force_host_platform_device_count={devices}"
+            )
+        # CPU: the virtual mesh is a process-level XLA decision, so
+        # re-exec once with the flag set before jax initializes.
+        env = dict(os.environ)
+        env["_GT_NLIST_SWEEP_REEXEC"] = "1"
+        env["XLA_FLAGS"] = (
+            env.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={devices}"
+        ).strip()
+        os.execve(sys.executable, [sys.executable] + sys.argv, env)
+    mesh = Mesh(np.asarray(avail[:devices]), ("shard",))
+    sizes = args.sizes or [512, 1024, 2048, 4096]  # per device
+    rcut = 2.5  # 2.5 mean spacings at unit density, as --scaling
+    rows = []
+    for n_per_device in sizes:
+        n = n_per_device * devices
+        span = float(n) ** (1.0 / 3.0)  # unit density
+        pos, m = _state(n, span)
+        side, cap = resolve_halo_sizing(
+            np.asarray(pos), rcut, devices=devices
+        )
+        # Both factories return raw shard_map closures (the Simulator
+        # jits the integrator step around them); time them jitted.
+        halo = jax.jit(make_halo_nlist_accel(
+            mesh, side=side, cap=cap, rcut=rcut, g=1.0, eps=args.eps
+        ))
+        allgather = jax.jit(make_sharded_accel2(
+            mesh, strategy="allgather",
+            local_kernel=make_nlist_local_kernel(
+                rcut=rcut, side=side, cap=cap, g=1.0, eps=args.eps
+            ),
+            g=1.0, eps=args.eps,
+        ))
+        sync(allgather(pos, m))  # compile both before the first pair
+        sync(halo(pos, m))
+        pairs = []
+        for _ in range(args.reps):
+            t0 = time.perf_counter()
+            sync(allgather(pos, m))
+            t_a = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            sync(halo(pos, m))
+            t_b = time.perf_counter() - t0
+            pairs.append((t_a, t_b))
+        t_ag = statistics.median(p[0] for p in pairs)
+        t_halo = statistics.median(p[1] for p in pairs)
+        comm = halo_comm_model(n, side, cap, devices)
+        row = {
+            "mode": "mesh", "n": n, "n_per_device": n_per_device,
+            "devices": devices, "rcut": rcut, "side": side,
+            "cap": cap, "platform": avail[0].platform,
+            "allgather_s_per_eval": t_ag,
+            "halo_s_per_eval": t_halo,
+            "speedup_halo_vs_allgather": statistics.median(
+                a / max(b, 1e-12) for a, b in pairs
+            ),
+            "dense_equiv_pairs_per_sec": n * (n - 1) / t_halo,
+            "halo_fraction": comm["halo_fraction"],
+            "ghost_bytes": comm["ghost_bytes"],
+            "local_bytes": comm["local_bytes"],
+            "migrate_bytes": comm["migrate_bytes"],
+        }
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+    if len(rows) >= 2:
+        first, last = rows[0], rows[-1]
+        print(json.dumps({
+            "summary": True, "mode": "mesh", "devices": devices,
+            # Fixed density: the dense-equiv rate must RISE with N
+            # (O(N/D) force work under the O(N^2)-equivalent metric)
+            # and the halo must beat the allgather on every rung.
+            "halo_rate_growth": last["dense_equiv_pairs_per_sec"]
+            / first["dense_equiv_pairs_per_sec"],
+            "speedup_min": min(
+                r["speedup_halo_vs_allgather"] for r in rows
+            ),
+            "speedup_max": max(
+                r["speedup_halo_vs_allgather"] for r in rows
+            ),
+            "n_span": [first["n"], last["n"]],
+        }), flush=True)
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--n", type=int, default=16384,
@@ -189,12 +312,24 @@ def main(argv=None) -> int:
     p.add_argument("--eps", type=float, default=0.05)
     p.add_argument("--scaling", action="store_true",
                    help="run the fixed-density N ladder A/B instead")
-    p.add_argument("--sizes", type=int, nargs="+", default=None)
+    p.add_argument("--mesh", action="store_true",
+                   help="run the per-device halo-vs-allgather ladder "
+                        "over the device mesh instead")
+    p.add_argument("--devices", type=int, default=8,
+                   help="mesh size for --mesh (CPU re-execs itself "
+                        "with the virtual-device flag if needed)")
+    p.add_argument("--reps", type=int, default=5,
+                   help="interleaved A/B pairs per --mesh rung")
+    p.add_argument("--sizes", type=int, nargs="+", default=None,
+                   help="N ladder for --scaling; per-device N ladder "
+                        "for --mesh")
     p.add_argument("--chunked-pair-budget", dest="chunked_pair_budget",
                    type=int, default=1 << 33,
                    help="skip the masked chunked reference above this "
                         "directed-pair count")
     args = p.parse_args(argv)
+    if args.mesh:
+        return run_mesh(args)
     return run_scaling(args) if args.scaling else run_grid(args)
 
 

@@ -157,6 +157,40 @@ def occupancy_signature(positions, side: int = 16) -> str:
     return f"occ2^{int(round(math.log2(max(occ, side ** -3.0))))}"
 
 
+def _nlist_mesh_candidates(config) -> list:
+    """The nlist candidate(s) for this configuration. On a single-axis
+    multi-device mesh the MESH STRATEGY is itself a measured contest —
+    the domain-decomposed halo form (O(surface) comms, parallel/
+    halo.py) vs gather-the-world — so the candidate splits into the
+    composite pair ``nlist@halo`` / ``nlist@allgather`` (a pinned
+    ``nlist_mesh`` keeps only its side). Elsewhere the lone ``nlist``
+    stands: there is no strategy to choose."""
+    if config.sharding != "allgather":
+        return ["nlist"]
+    import jax
+
+    shape = tuple(config.mesh_shape or (len(jax.devices()),))
+    if len(shape) != 1 or shape[0] < 2:
+        return ["nlist"]
+    if config.nlist_mesh == "halo":
+        return ["nlist@halo"]
+    if config.nlist_mesh == "allgather":
+        return ["nlist@allgather"]
+    return ["nlist@halo", "nlist@allgather"]
+
+
+def _candidate_config(config, backend: str):
+    """The probe config for one candidate. Composite candidates
+    (``nlist@halo``) carry their mesh strategy after the ``@``; plain
+    names are force_backend verbatim."""
+    if "@" in backend:
+        base, strategy = backend.split("@", 1)
+        return dataclasses.replace(
+            config, force_backend=base, nlist_mesh=strategy
+        )
+    return dataclasses.replace(config, force_backend=backend)
+
+
 def eligible_candidates(config, on_tpu: bool) -> tuple[tuple, dict]:
     """(candidates, skipped): the backends worth timing for this
     configuration, plus the reasons anything obvious was excluded.
@@ -208,7 +242,7 @@ def eligible_candidates(config, on_tpu: bool) -> tuple[tuple, dict]:
                 "global cell list"
             )
         elif config.n >= fast_probe_min():
-            cands.append("nlist")
+            cands += _nlist_mesh_candidates(config)
         else:
             skipped["nlist"] = (
                 f"n={config.n} below the fast-probe floor "
@@ -277,6 +311,18 @@ def make_key(
             "nlist_rcut": config.nlist_rcut,
             "nlist_side": config.nlist_side,
             "nlist_cap": config.nlist_cap,
+            # Halo-form knobs, included only off their defaults so
+            # every pre-halo cache record keeps its hash (the
+            # composite candidate list already re-keys mesh contests).
+            **(
+                {"nlist_mesh": config.nlist_mesh}
+                if getattr(config, "nlist_mesh", "auto") != "auto"
+                else {}
+            ),
+            **(
+                {"nlist_mig_cap": config.nlist_mig_cap}
+                if getattr(config, "nlist_mig_cap", 0) else {}
+            ),
         },
     }
 
@@ -395,7 +441,7 @@ def _time_backend(
     from .utils.profiling import debug_check_forces
     from .utils.timing import sync, warm_sync
 
-    cfg = dataclasses.replace(config, force_backend=backend)
+    cfg = _candidate_config(config, backend)
     # Probe compiles are real Simulator block compiles: the perf-site
     # bind labels their ledger rows "autotune_probe" so a reader can
     # tell routing probes from the run's own programs.

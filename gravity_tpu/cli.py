@@ -106,6 +106,17 @@ def _add_config_args(p: argparse.ArgumentParser) -> None:
                    default=None,
                    help="static nlist per-cell slot cap (0 = fit to "
                         "the p95 occupied-cell load)")
+    p.add_argument("--nlist-mesh", dest="nlist_mesh",
+                   choices=["auto", "halo", "allgather"], default=None,
+                   help="mesh strategy for the nlist backend (halo = "
+                        "domain-decomposed slabs with one-cell-deep "
+                        "ghost exchange, parallel/halo.py; auto picks "
+                        "halo on single-axis meshes)")
+    p.add_argument("--nlist-mig-cap", dest="nlist_mig_cap", type=int,
+                   default=None,
+                   help="static halo migration bucket capacity per "
+                        "(device, destination slab); 0 = fit from the "
+                        "initial state")
     p.add_argument("--tree-near", dest="tree_near",
                    choices=["gather", "nlist"], default=None,
                    help="octree near-field data movement (nlist = "
@@ -2047,10 +2058,15 @@ def cmd_fleet_status(args: argparse.Namespace) -> int:
         if not isinstance(entry, dict):
             continue
         wid = entry.get("worker_id") or name[:-len(".json")]
+        caps = entry.get("capabilities") or {}
         registry_view[wid] = {
             "alive": entry_alive(entry),
             "draining": bool(entry.get("draining")),
-            "capabilities": entry.get("capabilities") or {},
+            # Placement-gating capabilities as first-class columns
+            # (what the router's sharded/nlist admission rules read).
+            "sharded_capable": bool(caps.get("sharded_capable")),
+            "nlist_capable": bool(caps.get("nlist_capable")),
+            "capabilities": caps,
         }
     resp["worker_registry"] = registry_view
     if "router" not in resp:

@@ -97,6 +97,17 @@ class SimulationConfig:
     nlist_rcut: float = 0.0
     nlist_side: int = 0
     nlist_cap: int = 0
+    # Mesh strategy for the nlist backend: "auto" = domain-decomposed
+    # slab halo exchange (parallel/halo.py — O(surface) comms, O(N/D)
+    # memory) on a single-axis mesh, falling back to allgather where
+    # slabs don't apply; "halo" forces it (error when inapplicable);
+    # "allgather" keeps the gather-the-world sharded path.
+    nlist_mesh: str = "auto"
+    # Static per-(device, destination-slab) migration bucket capacity
+    # for the halo all_to_all re-shard; 0 = fit from the initial state
+    # (parallel/halo.resolve_mig_cap) or the safe n/D maximum when no
+    # concrete state exists (serve).
+    nlist_mig_cap: int = 0
     # Octree near-field data movement: "gather" (per-target chunk
     # gathers, the classic path) | "nlist" (cell-list tile engine over
     # the leaf blocks; ws=1 only).

@@ -606,6 +606,209 @@ def _overflow_targets(
 
 
 # ---------------------------------------------------------------------------
+# Slab (domain-decomposed) tile engine
+#
+# Rectangular (sx, side, side) target slabs evaluated against an
+# x-extended (sx + 2, side, side) source grid whose first/last x planes
+# are the one-cell-deep halo received from the slab neighbors
+# (parallel/halo.py). x neighbor reads are plain plane indexing — the
+# halo planes close the slab, and the receiver pre-applies the periodic
+# x image shift — while y/z reads are byte-identical to the cubic
+# engine (padded slices isolated, rolls + image shifts periodic). All
+# pair/monopole math is the shared _pair_w/_monopole_w/_near_offsets,
+# so the slab form cannot drift from the solo kernel.
+# ---------------------------------------------------------------------------
+
+
+def _jnp_pair_cells_slab(
+    tcells_pos, ext_pos, ext_gm, sx, side, params, *,
+    kind, cutoff, eps, use_rcut, box=0.0,
+):
+    """:func:`_jnp_pair_cells` over a slab: targets (sx*side^2, t_cap,
+    3); sources ((sx+2)*side^2, cap, 3) with halo planes at x = 0 and
+    x = sx + 1. Returns (sx*side^2, t_cap, 3) in (cell, slot) layout."""
+    s = side
+    t_cap = tcells_pos.shape[1]
+    cap = ext_pos.shape[1]
+    dtype = tcells_pos.dtype
+    pos_g = ext_pos.reshape(sx + 2, s, s, cap, 3)
+    gm_g = ext_gm.reshape(sx + 2, s, s, cap)
+    tpos_g = tcells_pos.reshape(sx, s, s, t_cap, 3)
+    near = jnp.asarray(_near_offsets(1), jnp.int32)
+    pair_w = _pair_w(
+        kind, cutoff=cutoff, eps=eps, use_rcut=use_rcut, dtype=dtype
+    )
+
+    if box <= 0.0:
+        pos_p = jnp.pad(pos_g, ((0, 0),) + ((1, 1),) * 2 + ((0, 0),) * 2)
+        gm_p = jnp.pad(gm_g, ((0, 0),) + ((1, 1),) * 2 + ((0, 0),))
+
+    def one_plane(x0):
+        tpos = jax.lax.dynamic_slice(
+            tpos_g, (x0, _I0, _I0, _I0, _I0), (1, s, s, t_cap, 3)
+        ).reshape(-1, t_cap, 3)
+        c = tpos.shape[0]
+
+        def body(acc, off):
+            xs = x0 + 1 + off[0]  # ext-plane index: halo covers [0, sx+1]
+            if box <= 0.0:
+                start = (xs, 1 + off[1], 1 + off[2])
+                spos = jax.lax.dynamic_slice(
+                    pos_p, start + (_I0, _I0), (1, s, s, cap, 3)
+                ).reshape(c, cap, 3)
+                sgm = jax.lax.dynamic_slice(
+                    gm_p, start + (_I0,), (1, s, s, cap)
+                ).reshape(c, cap)
+            else:
+                # Periodic y/z: same roll + image shift as the cubic
+                # engine. No x term — the halo planes arrive already
+                # image-shifted (parallel/halo.py applies +-box on the
+                # ring-wrap receive).
+                spos_pl = jax.lax.dynamic_slice(
+                    pos_g, (xs, _I0, _I0, _I0, _I0), (1, s, s, cap, 3)
+                )[0]
+                sgm_pl = jax.lax.dynamic_slice(
+                    gm_g, (xs, _I0, _I0, _I0), (1, s, s, cap)
+                )[0]
+                spos_pl = jnp.roll(
+                    spos_pl, (-off[1], -off[2]), axis=(0, 1)
+                )
+                sgm_pl = jnp.roll(sgm_pl, (-off[1], -off[2]), axis=(0, 1))
+                bx = jnp.asarray(box, dtype)
+                iy = jnp.arange(s, dtype=jnp.int32)
+                shift_y = bx * ((iy + off[1]) // s).astype(dtype)
+                shift_z = bx * ((iy + off[2]) // s).astype(dtype)
+                shift = jnp.zeros((s, s, 1, 3), dtype)
+                shift = shift.at[..., 1].add(shift_y[:, None, None])
+                shift = shift.at[..., 2].add(shift_z[None, :, None])
+                spos = (spos_pl + shift).reshape(c, cap, 3)
+                sgm = sgm_pl.reshape(c, cap)
+
+            diff = spos[:, None, :, :] - tpos[:, :, None, :]
+            r2 = jnp.sum(diff * diff, axis=-1)
+            w = pair_w(r2, sgm[:, None, :], params)
+            return acc + jnp.einsum("cts,ctsd->ctd", w, diff), None
+
+        acc0 = jnp.zeros((c, t_cap, 3), dtype)
+        acc, _ = jax.lax.scan(body, acc0, near)
+        return acc
+
+    planes = jax.lax.map(one_plane, jnp.arange(sx, dtype=jnp.int32))
+    return planes.reshape(-1, t_cap, 3)
+
+
+def _remainder_cells_slab(
+    tcells_pos, rem_w, rem_com, over, sx, side, params, *,
+    kind, eps, cell_h, box=0.0,
+):
+    """:func:`_remainder_cells` over a slab: the remainder channels are
+    ((sx+2)*side^2,)-shaped over the halo-extended grid, targets are the
+    local slab. x neighbor reads are static slices of the extended grid
+    (edge devices' missing isolated halos arrive zero-filled — over =
+    False — so they are exact no-ops)."""
+    s = side
+    t_cap = tcells_pos.shape[1]
+    dtype = tcells_pos.dtype
+    tpos_g = tcells_pos.reshape(sx, s, s, t_cap, 3)
+    rem_w_g = rem_w.reshape(sx + 2, s, s)
+    rem_com_g = rem_com.reshape(sx + 2, s, s, 3)
+    over_g = over.reshape(sx + 2, s, s)
+    eps_o2 = jnp.maximum(
+        jnp.asarray(eps * eps, dtype),
+        (0.5 * cell_h) * (0.5 * cell_h),
+    )
+
+    acc = jnp.zeros((sx, s, s, t_cap, 3), dtype)
+    for off in _near_offsets(1):
+        ox, oy, oz = (int(off[0]), int(off[1]), int(off[2]))
+        w_x = rem_w_g[1 + ox: 1 + ox + sx]
+        com_x = rem_com_g[1 + ox: 1 + ox + sx]
+        ov_x = over_g[1 + ox: 1 + ox + sx]
+        if box <= 0.0:
+            def shifted(a, tail_dims, oy=oy, oz=oz):
+                pad = ((0, 0),) + ((1, 1),) * 2 + ((0, 0),) * tail_dims
+                ap = jnp.pad(a, pad)
+                return ap[:, 1 + oy: 1 + oy + s, 1 + oz: 1 + oz + s]
+
+            w_n = shifted(w_x, 0)
+            com_n = shifted(com_x, 1)
+            ov_n = shifted(ov_x, 0)
+        else:
+            w_n = jnp.roll(w_x, (-oy, -oz), axis=(1, 2))
+            com_n = jnp.roll(com_x, (-oy, -oz), axis=(1, 2))
+            ov_n = jnp.roll(ov_x, (-oy, -oz), axis=(1, 2))
+            idx = np.arange(s)
+            bx = float(box)
+            shift = np.zeros((s, s, 3), np.float64)
+            shift[..., 1] += bx * ((idx + oy) // s)[:, None]
+            shift[..., 2] += bx * ((idx + oz) // s)[None, :]
+            com_n = com_n + jnp.asarray(shift, dtype)[None]
+
+        diff = jnp.where(
+            ov_n[..., None, None],
+            com_n[:, :, :, None, :] - tpos_g,
+            jnp.asarray(0.0, dtype),
+        )
+        r2 = jnp.sum(diff * diff, axis=-1)
+        w = _monopole_w(
+            kind, r2, w_n[..., None], params, eps_o2, dtype
+        )
+        acc = acc + w[..., None] * diff
+    return acc.reshape(-1, t_cap, 3)
+
+
+def _overflow_targets_slab(
+    t_pos, t_coords, cell_w, ccom, sx, side, params, *,
+    kind, eps, cell_h, box=0.0,
+):
+    """:func:`_overflow_targets` over a slab: ``t_coords`` are LOCAL
+    slab coords (x in [0, sx)); ``cell_w``/``ccom`` span the
+    halo-extended ((sx+2)*side^2,) grid, so the x neighbor index
+    x + 1 + dx is always in bounds (missing isolated halos are
+    zero-weight — exact no-ops)."""
+    m = t_pos.shape[0]
+    dtype = t_pos.dtype
+    s = side
+    near = jnp.asarray(_near_offsets(1), jnp.int32)
+    eps_o2 = jnp.maximum(
+        jnp.asarray(eps * eps, dtype), (0.5 * cell_h) * (0.5 * cell_h)
+    )
+
+    def body(acc, off):
+        cx = t_coords[:, 0] + 1 + off[0]
+        cy = t_coords[:, 1] + off[1]
+        cz = t_coords[:, 2] + off[2]
+        if box > 0.0:
+            shift = jnp.zeros((m, 3), dtype)
+            shift = shift.at[:, 1].set(
+                jnp.asarray(box, dtype) * (cy // s).astype(dtype)
+            )
+            shift = shift.at[:, 2].set(
+                jnp.asarray(box, dtype) * (cz // s).astype(dtype)
+            )
+            cy, cz = jnp.mod(cy, s), jnp.mod(cz, s)
+            in_b = jnp.ones((m,), bool)
+        else:
+            shift = jnp.zeros((m, 3), dtype)
+            in_b = (cy >= 0) & (cy < s) & (cz >= 0) & (cz < s)
+        ids = (
+            cx * s + jnp.clip(cy, 0, s - 1)
+        ) * s + jnp.clip(cz, 0, s - 1)
+        sw = jnp.where(in_b, cell_w[ids], 0.0)
+        diff = jnp.where(
+            in_b[:, None],
+            ccom[ids] + shift - t_pos,
+            jnp.asarray(0.0, dtype),
+        )
+        r2 = jnp.sum(diff * diff, axis=-1)
+        w = _monopole_w(kind, r2, sw, params, eps_o2, dtype)
+        return acc + w[:, None] * diff, None
+
+    acc, _ = jax.lax.scan(body, jnp.zeros((m, 3), dtype), near)
+    return acc
+
+
+# ---------------------------------------------------------------------------
 # P3M near-field entry (consumer a)
 # ---------------------------------------------------------------------------
 

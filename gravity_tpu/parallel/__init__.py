@@ -11,6 +11,12 @@ from .mesh import (
     replicate_state,
     shard_state,
 )
+from .halo import (
+    halo_comm_model,
+    make_halo_nlist_accel,
+    resolve_halo_sizing,
+    resolve_mig_cap,
+)
 from .multislice import hierarchical_ring_accel
 from .sharded import (
     make_sharded_accel2,
@@ -21,9 +27,13 @@ from .sharded import (
 __all__ = [
     "DCN_AXIS",
     "SHARD_AXIS",
+    "halo_comm_model",
     "hierarchical_ring_accel",
     "initialize_distributed",
+    "make_halo_nlist_accel",
     "make_particle_mesh",
+    "resolve_halo_sizing",
+    "resolve_mig_cap",
     "make_sharded_accel2",
     "make_sharded_accel_fn",
     "make_sharded_rect_accel",

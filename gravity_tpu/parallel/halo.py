@@ -1,0 +1,497 @@
+"""Domain-decomposed cell-list forces: slab halo exchange over the mesh.
+
+The sharded nlist story before this module was "allgather the world,
+then run the solo cell list" — O(N) comms and O(N) memory per device,
+i.e. the source paper's MPI_Allgatherv pattern with a faster local
+kernel. This module is the classic MD/N-body fix (FDPS, arXiv
+1907.02290; MD on GPU clusters, arXiv 1009.4330): partition the
+``side^3`` cell grid into per-device **slabs** along the mesh axis,
+keep all pair-tile work local, and exchange only the one-cell-deep
+boundary halo per evaluation — O(surface) comms, O(N/D) memory and
+compute per device.
+
+Per evaluation, inside ONE ``shard_map``:
+
+1. **Global cube** — ``pmin``/``pmax`` reduce the per-device extents to
+   the exact solo ``bounding_cube`` (periodic runs use the box).
+2. **Migration (spatial re-shard)** — the integrator's state is sharded
+   by particle INDEX, which has no spatial locality, so each device
+   buckets its rows by destination slab (x cell // (side/D)) and one
+   tiled ``lax.all_to_all`` delivers them. Buckets are static
+   ``(D, mig_cap)`` blocks (XLA shapes are static); each bucket also
+   carries a beyond-``mig_cap`` remainder-monopole row with the
+   standard normalized-mass overflow accounting, so emigrant MASS is
+   never dropped even when a bucket overflows (the overflowed rows
+   themselves get zero short-range force that eval — the far-field
+   value of truncated physics — and :func:`resolve_mig_cap` sizes the
+   buckets with 2x headroom so a well-sized run never pays this).
+3. **Local binning** — received rows are sorted into the local
+   ``(side/D, side, side)`` slab grid with the shared ops/cells.py
+   slot machinery (invalid rows park on the trash row).
+4. **Halo exchange** — two ``lax.ppermute`` hops (left + right slab
+   neighbor) carry the boundary plane's cell blocks AND its overflow
+   channels (source remainder, whole-cell monopoles for the
+   target-slot fallback). The periodic x wrap is the ring closing; the
+   receiver applies the +-box image shift, so the slab evaluators need
+   no x wrap logic. Isolated edges simply have no sender — partial
+   permutes deliver zeros, which are exact no-ops (zero mass, over =
+   False).
+5. **Slab evaluation** — the ``_*_slab`` engines in ops/pallas_nlist.py
+   run the 27-neighbor tile math over the x-extended grid, sharing
+   ``_pair_w``/``_monopole_w``/``_near_offsets`` with the solo kernel:
+   identical physics, identical overflow/degradation contracts,
+   identical effective-radius clamp ``min(rcut, span/side)``.
+6. **Inverse re-shard** — the same ``all_to_all`` (it is self-inverse)
+   returns per-particle accelerations to their home shard.
+
+The returned ``accel2(positions, masses)`` has exactly the
+:func:`parallel.sharded.make_sharded_accel2` contract (sharded in,
+sharded out, masses traced), so every consumer — the Simulator's mesh
+branch, serve's sharded-integrate kernel factory, the elastic degrade
+ladder — can swap it in without caring which strategy produced it.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..constants import CUTOFF_RADIUS, G
+from ..ops.cells import _cell_slots, _scatter_cells, grid_coords
+from ..ops.pallas_nlist import (
+    _jnp_pair_cells_slab,
+    _monopole_w,
+    _overflow_targets_slab,
+    _remainder_cells_slab,
+    _source_overflow_channels,
+    resolve_nlist_sizing,
+)
+from ..utils.compat import shard_map
+
+__all__ = [
+    "halo_comm_model",
+    "make_halo_nlist_accel",
+    "resolve_halo_sizing",
+    "resolve_mig_cap",
+]
+
+_EPS_TINY = 1e-37
+
+
+def resolve_halo_sizing(
+    positions,
+    rcut: float,
+    cap: int = 0,
+    *,
+    devices: int,
+    side: int = 0,
+    box: float = 0.0,
+    **kw,
+):
+    """:func:`ops.pallas_nlist.resolve_nlist_sizing` constrained to the
+    slab decomposition: ``side`` must be a multiple of ``devices`` (one
+    or more whole cell planes per device). Rounds DOWN when possible —
+    coarser cells are always correct (coverage only needs cell edge >=
+    rcut) — and only rounds up to the ``devices`` floor when the solo
+    side is too small to split, re-fitting ``cap`` at the final side
+    (the radius-degradation warning fires from the re-fit if the cells
+    shrink below rcut)."""
+    side_r, cap_r = resolve_nlist_sizing(
+        positions, rcut, cap, side=side, box=box, **kw
+    )
+    if devices <= 1 or side_r % devices == 0:
+        return side_r, cap_r
+    side_min = 3 if box > 0.0 else 2
+    down = (side_r // devices) * devices
+    if down >= max(side_min, devices):
+        side_f = down
+    else:
+        side_f = devices * ((max(side_min, devices) + devices - 1)
+                            // devices)
+    side_f, cap_f = resolve_nlist_sizing(
+        positions, rcut, cap, side=side_f, box=box, **kw
+    )
+    return side_f, cap_f
+
+
+def resolve_mig_cap(positions, side: int, devices: int, *, box: float = 0.0):
+    """Host-side static per-(source device, destination slab) migration
+    bucket capacity from concrete positions: the next power of two >=
+    2x the largest observed bucket (contiguous index blocks, the
+    mesh's sharding), clamped to the per-device row count (a bucket can
+    never receive more rows than one device holds)."""
+    pos = np.asarray(positions, np.float64)
+    n = pos.shape[0]
+    n_loc = max(1, -(-n // max(devices, 1)))
+    if devices <= 1:
+        return n_loc
+    if box > 0.0:
+        x = np.mod(pos[:, 0], box)
+        origin, span = 0.0, float(box)
+    else:
+        lo, hi = pos.min(axis=0), pos.max(axis=0)
+        span = float((hi - lo).max()) * 1.02 + 1e-30
+        origin = float((0.5 * (hi + lo) - 0.5 * span)[0])
+        x = pos[:, 0]
+    cell_x = np.clip(
+        ((x - origin) / span * side).astype(np.int64), 0, side - 1
+    )
+    dest = cell_x // (side // devices)
+    worst = 1
+    for block in np.array_split(dest, devices):
+        if block.size:
+            worst = max(worst, int(np.bincount(
+                block, minlength=devices).max()))
+    mig = 16
+    while mig < 2 * worst:
+        mig *= 2
+    return int(min(mig, n_loc))
+
+
+def halo_comm_model(
+    n: int, side: int, cap: int, devices: int, *,
+    mig_cap: int = 0, dtype_bytes: int = 4,
+):
+    """Analytic per-device per-eval byte model — the 'halo fraction'
+    evidence line (ghost bytes / local bytes) the bench and docs
+    report. Cell blocks carry cap x (pos 3 + gm 1) floats plus 9
+    overflow-channel floats per cell."""
+    s2 = side * side
+    per_cell = (cap * 4 + 9) * dtype_bytes
+    ghost = 2 * s2 * per_cell  # one boundary plane each way
+    local = max(1, side // max(devices, 1)) * s2 * per_cell
+    n_loc = max(1, -(-n // max(devices, 1)))
+    mig = mig_cap or n_loc
+    migrate = devices * ((mig + 1) * 5 + mig * 3) * dtype_bytes
+    return {
+        "ghost_bytes": ghost,
+        "local_bytes": local,
+        "halo_fraction": ghost / local,
+        "migrate_bytes": migrate,
+    }
+
+
+def _halo_body(
+    pos_l, m_l, *, axis, devices, side, cap, mig_cap, rcut, g, cutoff,
+    eps, box, kind, ewald_scales,
+):
+    n_loc = pos_l.shape[0]
+    dtype = pos_l.dtype
+    s = side
+    sx = side // devices
+    n_cells_loc = sx * s * s
+    mig = mig_cap if mig_cap > 0 else n_loc
+    d = jax.lax.axis_index(axis)
+
+    # 1. Global bounding cube — bitwise the solo ops/pm.bounding_cube
+    # (pmin/pmax of per-device extents ARE the global extents).
+    if box > 0.0:
+        origin = jnp.zeros((3,), dtype)
+        span = jnp.asarray(box, dtype)
+        pos_w = jnp.mod(pos_l, span)
+    else:
+        lo = jax.lax.pmin(jnp.min(pos_l, axis=0), axis)
+        hi = jax.lax.pmax(jnp.max(pos_l, axis=0), axis)
+        span = jnp.max(hi - lo) * 1.02 + jnp.asarray(1e-30, dtype)
+        origin = 0.5 * (hi + lo) - 0.5 * span
+        pos_w = pos_l
+    cell_h = span / side
+    m_scale = jnp.maximum(
+        jax.lax.pmax(jnp.max(m_l), axis), jnp.asarray(_EPS_TINY, dtype)
+    )
+
+    if kind == "newton":
+        rcut_eff2 = jnp.minimum(jnp.asarray(rcut, dtype), cell_h) ** 2
+        params = jnp.stack([rcut_eff2, jnp.asarray(0.0, dtype)])
+    else:  # ewald: traced scales per unit span (the p3m near field)
+        # alpha ~ 1/length scales INVERSELY with the cube (alpha =
+        # (grid-1)/(sqrt(2) sigma_cells span)); rcut ~ length scales
+        # directly (rcut = rcut_sigmas sigma_cells span/(grid-1)).
+        a_s, r_s = ewald_scales
+        alpha = jnp.asarray(a_s, dtype) / span
+        rc_t = jnp.asarray(r_s, dtype) * span
+        params = jnp.stack([rc_t * rc_t, alpha])
+
+    # 2. Migration: bucket local rows by destination slab, all_to_all.
+    coords = grid_coords(pos_w, origin, span, side)
+    dest = (coords[:, 0] // sx).astype(jnp.int32)
+    order = jnp.argsort(dest)
+    sorted_dest = dest[order]
+    count = jax.ops.segment_sum(
+        jnp.ones((n_loc,), jnp.int32), dest, num_segments=devices
+    )
+    start = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(count)[:-1]]
+    )
+    slot, _ = _cell_slots(sorted_dest, start, devices, mig)
+    feat = jnp.concatenate(
+        [pos_w, m_l[:, None], jnp.ones((n_loc, 1), dtype)], axis=1
+    )
+    buckets = _scatter_cells(feat[order], slot, devices, mig)
+
+    m_hat = m_l / m_scale
+    bmass_hat = jax.ops.segment_sum(m_hat, dest, num_segments=devices)
+    bmw = jax.ops.segment_sum(
+        m_hat[:, None] * pos_w, dest, num_segments=devices
+    )
+    bcom = bmw / jnp.maximum(
+        bmass_hat, jnp.asarray(_EPS_TINY, dtype)
+    )[:, None]
+    mig_w, mig_com, mig_over = _source_overflow_channels(
+        buckets[..., :3], buckets[..., 3], count, bmass_hat, bcom,
+        m_scale, g, mig, dtype,
+    )
+    rem_row = jnp.concatenate(
+        [mig_com, mig_w[:, None], mig_over.astype(dtype)[:, None]],
+        axis=1,
+    )
+    send = jnp.concatenate(
+        [buckets, rem_row[:, None, :]], axis=1
+    ).reshape(devices * (mig + 1), 5)
+    recv = jax.lax.all_to_all(send, axis, 0, 0, tiled=True)
+
+    # 3. Bin received rows into the local slab grid.
+    r = recv.reshape(devices, mig + 1, 5)
+    nr = devices * mig
+    r_feat = r[:, :mig, :].reshape(nr, 5)
+    r_rem = r[:, mig, :]
+    r_pos = r_feat[:, :3]
+    r_mass = r_feat[:, 3]
+    rc = grid_coords(r_pos, origin, span, side)
+    lx = rc[:, 0] - d * sx
+    ok = (r_feat[:, 4] > 0.5) & (lx >= 0) & (lx < sx)
+    lid = jnp.where(
+        ok, (lx * s + rc[:, 1]) * s + rc[:, 2], n_cells_loc
+    ).astype(jnp.int32)
+    sort_order = jnp.argsort(lid)
+    sorted_lid = lid[sort_order]
+    lcount_full = jax.ops.segment_sum(
+        jnp.ones((nr,), jnp.int32), lid, num_segments=n_cells_loc + 1
+    )
+    lstart = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(lcount_full)[:-1]]
+    )
+    slot_l, _ = _cell_slots(sorted_lid, lstart, n_cells_loc, cap)
+    cells_pos = _scatter_cells(r_pos[sort_order], slot_l, n_cells_loc, cap)
+    cells_mass = _scatter_cells(
+        r_mass[sort_order], slot_l, n_cells_loc, cap
+    )
+    cells_gm = jnp.asarray(g, dtype) * cells_mass
+
+    r_mhat = jnp.where(ok, r_mass, jnp.asarray(0.0, dtype)) / m_scale
+    cmass_hat = jax.ops.segment_sum(
+        r_mhat, lid, num_segments=n_cells_loc + 1
+    )[:n_cells_loc]
+    cmw = jax.ops.segment_sum(
+        r_mhat[:, None] * r_pos, lid, num_segments=n_cells_loc + 1
+    )[:n_cells_loc]
+    ccom = cmw / jnp.maximum(
+        cmass_hat, jnp.asarray(_EPS_TINY, dtype)
+    )[:, None]
+    rem_w_c, rem_com_c, over_c = _source_overflow_channels(
+        cells_pos, cells_mass, lcount_full[:n_cells_loc], cmass_hat,
+        ccom, m_scale, g, cap, dtype,
+    )
+    cmass_w = jnp.asarray(g, dtype) * cmass_hat * m_scale
+
+    # 4. Halo exchange: boundary-plane cell blocks + overflow channels
+    # to the two slab neighbors. Channel layout per cell: [rem_w,
+    # rem_com xyz, over, cmass_w, ccom xyz].
+    pmain = jnp.concatenate(
+        [cells_pos, cells_gm[..., None]], axis=-1
+    ).reshape(sx, s * s, cap, 4)
+    pchan = jnp.concatenate(
+        [
+            rem_w_c[:, None], rem_com_c, over_c.astype(dtype)[:, None],
+            cmass_w[:, None], ccom,
+        ],
+        axis=1,
+    ).reshape(sx, s * s, 9)
+    perm_r = [(i, i + 1) for i in range(devices - 1)]
+    perm_l = [(i + 1, i) for i in range(devices - 1)]
+    if box > 0.0:
+        perm_r.append((devices - 1, 0))
+        perm_l.append((0, devices - 1))
+    lh_main = jax.lax.ppermute(pmain[sx - 1], axis, perm_r)
+    lh_chan = jax.lax.ppermute(pchan[sx - 1], axis, perm_r)
+    rh_main = jax.lax.ppermute(pmain[0], axis, perm_l)
+    rh_chan = jax.lax.ppermute(pchan[0], axis, perm_l)
+    if box > 0.0:
+        # Ring-wrap image shifts applied on receive (x components of
+        # positions, rem_com and ccom), so the slab evaluators read
+        # minimum-image x without any wrap logic of their own.
+        bx = jnp.asarray(box, dtype)
+        lsh = jnp.where(d == 0, -bx, jnp.asarray(0.0, dtype))
+        rsh = jnp.where(d == devices - 1, bx, jnp.asarray(0.0, dtype))
+        lh_main = lh_main.at[..., 0].add(lsh)
+        rh_main = rh_main.at[..., 0].add(rsh)
+        lh_chan = lh_chan.at[..., 1].add(lsh).at[..., 6].add(lsh)
+        rh_chan = rh_chan.at[..., 1].add(rsh).at[..., 6].add(rsh)
+    ext_main = jnp.concatenate(
+        [lh_main[None], pmain, rh_main[None]], axis=0
+    ).reshape((sx + 2) * s * s, cap, 4)
+    ext_chan = jnp.concatenate(
+        [lh_chan[None], pchan, rh_chan[None]], axis=0
+    ).reshape((sx + 2) * s * s, 9)
+
+    # 5. Slab evaluation (self form: targets are the source binning).
+    acc_cell = _jnp_pair_cells_slab(
+        cells_pos, ext_main[..., :3], ext_main[..., 3], sx, s, params,
+        kind=kind, cutoff=cutoff, eps=eps, use_rcut=True, box=box,
+    )
+    acc_cell = acc_cell + _remainder_cells_slab(
+        cells_pos, ext_chan[:, 0], ext_chan[:, 1:4],
+        ext_chan[:, 4] > 0.5, sx, s, params,
+        kind=kind, eps=eps, cell_h=cell_h, box=box,
+    )
+
+    # 6. Un-bin; overflow targets take the whole-cell monopole fallback.
+    idx = jnp.arange(nr, dtype=jnp.int32)
+    rank_l = idx - lstart[sorted_lid]
+    ok_sorted = ok[sort_order]
+    over_t = (rank_l >= cap) & ok_sorted
+    safe_id = jnp.minimum(sorted_lid, n_cells_loc - 1)
+    acc_sorted = jnp.where(
+        ok_sorted[:, None],
+        acc_cell[safe_id, jnp.minimum(rank_l, cap - 1)],
+        jnp.asarray(0.0, dtype),
+    )
+    t_pos_sorted = r_pos[sort_order]
+    t_lc = jnp.stack([lx, rc[:, 1], rc[:, 2]], axis=1)[sort_order]
+    acc_sorted = jax.lax.cond(
+        jnp.any(over_t),
+        lambda a: jnp.where(
+            over_t[:, None],
+            _overflow_targets_slab(
+                t_pos_sorted, t_lc, ext_chan[:, 5], ext_chan[:, 6:9],
+                sx, s, params, kind=kind, eps=eps, cell_h=cell_h,
+                box=box,
+            ),
+            a,
+        ),
+        lambda a: a,
+        acc_sorted,
+    )
+
+    # Migration-bucket remainder monopoles: emigrant mass beyond
+    # mig_cap, softened at the slab half-width (COM and targets share
+    # a slab). Cond-gated — well-sized runs never pay it.
+    def _mig_monopoles(a):
+        eps_m2 = jnp.maximum(
+            jnp.asarray(eps * eps, dtype),
+            (0.5 * span / devices) * (0.5 * span / devices),
+        )
+
+        def body(acc, row):
+            wmass = jnp.where(
+                row[4] > 0.5, row[3], jnp.asarray(0.0, dtype)
+            )
+            diff = row[:3][None, :] - t_pos_sorted
+            if box > 0.0:
+                diff = diff - jnp.asarray(box, dtype) * jnp.round(
+                    diff / box
+                )
+            r2 = jnp.sum(diff * diff, axis=-1)
+            w = _monopole_w(kind, r2, wmass, params, eps_m2, dtype)
+            return acc + w[:, None] * diff, None
+
+        extra, _ = jax.lax.scan(
+            body, jnp.zeros((nr, 3), dtype), r_rem
+        )
+        return a + jnp.where(
+            ok_sorted[:, None], extra, jnp.asarray(0.0, dtype)
+        )
+
+    acc_sorted = jax.lax.cond(
+        jnp.any(r_rem[:, 4] > 0.5), _mig_monopoles, lambda a: a,
+        acc_sorted,
+    )
+
+    # 7. Inverse re-shard (all_to_all is self-inverse) + scatter back
+    # to the local index order. Beyond-mig_cap emigrants get zero.
+    inv = jnp.zeros((nr,), jnp.int32).at[sort_order].set(idx)
+    back = jax.lax.all_to_all(
+        acc_sorted[inv], axis, 0, 0, tiled=True
+    )
+    rank0 = jnp.arange(n_loc, dtype=jnp.int32) - start[sorted_dest]
+    rank_orig = jnp.zeros((n_loc,), jnp.int32).at[order].set(rank0)
+    kept = rank_orig < mig
+    rows = jnp.clip(dest * mig + rank_orig, 0, nr - 1)
+    return jnp.where(
+        kept[:, None], back[rows], jnp.asarray(0.0, dtype)
+    )
+
+
+def make_halo_nlist_accel(
+    mesh: Mesh,
+    *,
+    side: int,
+    cap: int,
+    rcut: float = 0.0,
+    g: float = G,
+    cutoff: float = CUTOFF_RADIUS,
+    eps: float = 0.0,
+    box: float = 0.0,
+    mig_cap: int = 0,
+    kind: str = "newton",
+    ewald_scales: tuple[float, float] | None = None,
+):
+    """Build the domain-decomposed ``accel2(positions, masses)`` —
+    the drop-in halo counterpart of
+    :func:`parallel.sharded.make_sharded_accel2` for the nlist local
+    backend (``kind="newton"``, the standalone cutoff dynamics) or the
+    P3M erfc near field (``kind="ewald"``, ``ewald_scales =
+    (alpha_span, rcut_frac)`` with ``alpha = alpha_span / span`` and
+    ``rcut = rcut_frac * span`` — both track the global cube so the
+    split matches the solo mesh's traced spacing).
+
+    ``side`` must be a multiple of the mesh axis size (use
+    :func:`resolve_halo_sizing`); N must be divisible by it too (pad
+    with ``ParticleState.pad_to`` — zero-mass padding is exact).
+    ``mig_cap`` = 0 sizes the migration buckets at the safe n/D
+    maximum; pass :func:`resolve_mig_cap`'s fit to shrink the
+    all_to_all when concrete positions are available.
+    """
+    axes = mesh.axis_names
+    if len(axes) != 1:
+        raise ValueError(
+            "halo slab decomposition runs over a single mesh axis; got "
+            f"axes {axes!r} (multi-axis meshes take the allgather path)"
+        )
+    axis = axes[0]
+    devices = mesh.shape[axis]
+    if side % devices != 0 or side < devices:
+        raise ValueError(
+            f"halo nlist needs side divisible by the mesh axis size "
+            f"(>= 1 cell plane per device); got side={side}, "
+            f"devices={devices} (resolve_halo_sizing rounds for you)"
+        )
+    if box > 0.0 and side < 3:
+        raise ValueError(
+            f"periodic halo nlist needs side >= 3; got side={side}"
+        )
+    if kind == "newton":
+        if rcut <= 0.0:
+            raise ValueError(f"halo nlist rcut must be > 0, got {rcut}")
+    elif kind == "ewald":
+        if ewald_scales is None:
+            raise ValueError("kind='ewald' needs ewald_scales")
+    else:
+        raise ValueError(f"unknown halo kind {kind!r}")
+    body = partial(
+        _halo_body, axis=axis, devices=devices, side=side, cap=cap,
+        mig_cap=mig_cap, rcut=rcut, g=g, cutoff=cutoff, eps=eps,
+        box=box, kind=kind, ewald_scales=ewald_scales,
+    )
+    spec = P(axes)
+    return shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )

@@ -454,12 +454,147 @@ def _serve_ledger_row(n: int):
     return perf.ledger().row_for(perf.engine_key_str(key))
 
 
+def mesh_ab_pairs(params: dict) -> dict:
+    """Measure interleaved (t_allgather, t_halo) second-pairs for the
+    domain-decomposed nlist on the virtual device mesh THIS process
+    was launched with. Runs in the ``--mesh-ab-worker`` subprocess:
+    the device count is a process-level XLA decision
+    (``--xla_force_host_platform_device_count`` must be set before
+    jax initializes), and ``make perf-gate`` runs single-device — so
+    the parent cannot host the mesh itself.
+
+    Both arms share the SAME local cell-list sizing and the SAME
+    sharded layout; they differ only in the exchange (full allgather
+    of every remote position vs the one-plane ghost halo), which is
+    exactly the quantity the contract gates."""
+    import numpy as np
+
+    import jax
+
+    from jax.sharding import Mesh
+
+    from .ops.pallas_nlist import make_nlist_local_kernel
+    from .parallel.halo import make_halo_nlist_accel, resolve_halo_sizing
+    from .parallel.sharded import make_sharded_accel2
+    from .utils.timing import sync, warm_sync
+
+    devices = int(params.get("devices", 8))
+    n_per_device = int(params.get("n_per_device", 2048))
+    reps = int(params.get("reps", 5))
+    spacings = float(params.get("rcut_spacings", 2.5))
+    eps = float(params.get("eps", 0.05))
+    avail = jax.devices()
+    if len(avail) < devices:
+        raise RuntimeError(
+            f"mesh A/B worker wants {devices} devices but this "
+            f"process sees {len(avail)} — launch it with XLA_FLAGS="
+            f"--xla_force_host_platform_device_count={devices}"
+        )
+    n = n_per_device * devices
+    pos, m = _uniform_state(n)
+    rcut = float(spacings)  # unit density: spacing == 1
+    side, cap = resolve_halo_sizing(
+        np.asarray(pos), rcut, devices=devices
+    )
+    mesh = Mesh(np.asarray(avail[:devices]), ("shard",))
+    # The factories return raw shard_map closures (the Simulator jits
+    # the whole integrator step around them); time them jitted, as the
+    # engine actually runs them.
+    halo = jax.jit(make_halo_nlist_accel(
+        mesh, side=side, cap=cap, rcut=rcut, g=1.0, eps=eps
+    ))
+    allgather = jax.jit(make_sharded_accel2(
+        mesh, strategy="allgather",
+        local_kernel=make_nlist_local_kernel(
+            rcut=rcut, side=side, cap=cap, g=1.0, eps=eps
+        ),
+        g=1.0, eps=eps,
+    ))
+    warm_sync(allgather(pos, m))
+    warm_sync(halo(pos, m))
+    pairs = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        sync(allgather(pos, m))
+        t_a = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        sync(halo(pos, m))
+        t_b = time.perf_counter() - t0
+        pairs.append([t_a, t_b])
+    return {
+        "pairs": pairs, "n": n, "devices": devices,
+        "side": side, "cap": cap,
+    }
+
+
+def run_mesh_paired_ratio(contract: dict, log: Callable) -> ContractResult:
+    """min-ratio contract for the halo exchange: arm "a" is the
+    allgather sharded nlist (every remote position shipped each eval),
+    arm "b" the halo form (one ghost plane each way). Pairs are
+    measured interleaved inside ONE ``--mesh-ab-worker`` subprocess —
+    the same window-cancellation structure as ``paired_ratio_min`` —
+    because the virtual mesh needs XLA_FLAGS before jax init and the
+    gate parent is already a live single-device runtime. The handicap
+    is applied HERE in the parent, per pair, so the planted-regression
+    smoke path exercises this kind without the child knowing."""
+    import subprocess
+    import sys
+
+    p = contract.get("params", {})
+    devices = int(p.get("devices", 8))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={devices}"
+    ).strip()
+    # The worker must not see the handicap: it is applied per-arm in
+    # this parent, and double application would square the factor.
+    env.pop("GRAVITY_TPU_PERF_HANDICAP", None)
+    proc = subprocess.run(
+        [sys.executable, "-m", "gravity_tpu.perfgate",
+         "--mesh-ab-worker", json.dumps(p)],
+        capture_output=True, text=True, env=env,
+        timeout=int(p.get("worker_timeout", 600)),
+    )
+    if proc.returncode != 0:
+        tail = "\n".join(proc.stderr.strip().splitlines()[-8:])
+        log(f"  {contract['name']}: mesh worker FAILED "
+            f"(rc={proc.returncode}):\n{tail}")
+        return ContractResult(
+            contract["name"], "mesh_paired_ratio_min", False, None,
+            float(contract["min_ratio"]), None,
+            {"error": "worker_failed", "rc": proc.returncode,
+             "stderr_tail": tail},
+        )
+    doc = json.loads(proc.stdout.strip().splitlines()[-1])
+    ratios = []
+    for t_a, t_b in doc["pairs"]:
+        t_a = apply_handicap(contract["name"], "a", t_a)
+        t_b = apply_handicap(contract["name"], "b", t_b)
+        ratios.append(t_a / max(t_b, 1e-12))
+    med = statistics.median(ratios)
+    ci = bootstrap_ci(ratios)
+    bound = float(contract["min_ratio"])
+    ok = ci[0] >= bound
+    log(f"  {contract['name']}: median allgather/halo ratio "
+        f"{med:.2f} (CI [{ci[0]:.2f}, {ci[1]:.2f}]) vs min {bound} "
+        f"[n={doc['n']}, {doc['devices']} dev, side={doc['side']}]")
+    return ContractResult(
+        contract["name"], "mesh_paired_ratio_min", ok, med, bound, ci,
+        {"ratios": [round(r, 4) for r in ratios], "n": doc["n"],
+         "devices": doc["devices"], "side": doc["side"],
+         "cap": doc["cap"]},
+    )
+
+
 KIND_RUNNERS = {
     "paired_ratio_min": run_paired_ratio,
     "scaling_exponent_max": run_scaling_exponent,
     "frac_max": run_frac_max,
     "count_max": run_count_max,
     "ledger_coverage": run_ledger_coverage,
+    "mesh_paired_ratio_min": run_mesh_paired_ratio,
 }
 
 
@@ -555,7 +690,17 @@ def main(argv: Optional[list] = None) -> int:
                     help="comma-separated contract names (default all)")
     ap.add_argument("--out", default=REPORT_FILE,
                     help="report artifact path ('' disables)")
+    ap.add_argument("--mesh-ab-worker", default=None,
+                    metavar="PARAMS_JSON",
+                    help="internal: measure interleaved halo-vs-"
+                    "allgather pairs on this process's device mesh "
+                    "and print them as JSON (launched by the "
+                    "mesh_paired_ratio_min runner with XLA_FLAGS "
+                    "preset)")
     args = ap.parse_args(argv)
+    if args.mesh_ab_worker is not None:
+        print(json.dumps(mesh_ab_pairs(json.loads(args.mesh_ab_worker))))
+        return 0
     code, _ = run_gate(
         args.baseline,
         contracts=(
@@ -565,3 +710,7 @@ def main(argv: Optional[list] = None) -> int:
         report_path=args.out or None,
     )
     return code
+
+
+if __name__ == "__main__":  # the --mesh-ab-worker subprocess path
+    raise SystemExit(main())

@@ -62,10 +62,21 @@ from .registry import (
 
 # Local kernels the sharded form can run per shard (each must speak the
 # rectangular (targets, sources, m_sources) signature the mesh
-# strategies feed). 'auto'/'direct' resolve at keying time.
-SHARDED_LOCAL_BACKENDS = ("dense", "chunked", "pallas", "pallas-mxu")
+# strategies feed). 'auto'/'direct' resolve at keying time. 'nlist' is
+# the truncated cell-list kernel: its degrade rungs stay rcut-masked
+# all the way to the chunked floor (make_local_kernel masks the direct
+# sum whenever nlist_rcut > 0), so every rung computes the same
+# declared short-range physics.
+SHARDED_LOCAL_BACKENDS = ("dense", "chunked", "pallas", "pallas-mxu",
+                          "nlist")
 
-STRATEGIES = ("allgather", "ring")
+# 'halo' is the domain-decomposed cell-list exchange
+# (parallel/halo.py): slab-partitioned grid, one-cell-deep ghost
+# exchange per step — nlist-only (the other kernels have no cell
+# structure to decompose). It rides the same elastic ladder; a rung
+# whose mesh no longer divides the cell grid falls back to allgather
+# with the identical nlist local kernel.
+STRATEGIES = ("allgather", "ring", "halo")
 
 # Where 'auto' flips the solo/local kernel from the one-shot dense
 # contraction to the chunked form (above it the (n, n) intermediate of
@@ -142,11 +153,28 @@ class ShardedIntegrateJob(JobClass):
                     "range [1, 65536]"
                 )
             params["devices"] = devices
-        strategy = params.get("strategy", "allgather")
+        default_strategy = (
+            "halo" if config.force_backend == "nlist" else "allgather"
+        )
+        strategy = params.get("strategy", default_strategy)
         if strategy not in STRATEGIES:
             raise JobValidationError(
                 f"sharded-integrate: strategy {strategy!r} is not one "
                 f"of {STRATEGIES}"
+            )
+        if strategy == "halo" and config.force_backend != "nlist":
+            raise JobValidationError(
+                "sharded-integrate: strategy 'halo' is the domain-"
+                "decomposed CELL-LIST exchange — it needs "
+                "force_backend='nlist' (the other kernels have no cell "
+                "grid to slab-partition)"
+            )
+        if strategy == "ring" and config.force_backend == "nlist":
+            raise JobValidationError(
+                "sharded-integrate: strategy 'ring' cannot run the "
+                "nlist kernel (per-chunk source binning changes the "
+                "cell-cap overflow contract); use 'halo' or "
+                "'allgather'"
             )
         params["strategy"] = strategy
         if config.force_backend not in ("auto", "direct") \
@@ -191,7 +219,6 @@ class ShardedIntegrateJob(JobClass):
             ("periodic_box", config.periodic_box, 0.0),
             ("external", config.external, ""),
             ("sharding", config.sharding, "none"),
-            ("nlist_rcut", config.nlist_rcut, 0.0),
         ):
             if val != default:
                 raise JobValidationError(
@@ -201,6 +228,26 @@ class ShardedIntegrateJob(JobClass):
         local = config.force_backend
         if local in ("auto", "direct"):
             local = "dense" if config.n <= AUTO_DENSE_MAX else "chunked"
+        # Truncated physics is keyed explicitly: an nlist job must
+        # declare rcut AND side (no state exists at admission to
+        # auto-size from), and only nlist jobs may declare them — the
+        # knobs ride the batch key so every elastic rung (halo mesh,
+        # allgather mesh, solo nlist, chunked floor) computes the same
+        # rcut-masked pair set.
+        if local == "nlist":
+            if config.nlist_rcut <= 0.0 or config.nlist_side <= 0:
+                raise JobValidationError(
+                    "sharded-integrate with force_backend='nlist' "
+                    "needs nlist_rcut > 0 AND nlist_side > 0 (serve "
+                    "jobs size blind at admission: no initial state "
+                    "exists to fit the cell grid from)"
+                )
+        elif config.nlist_rcut != 0.0:
+            raise JobValidationError(
+                f"config.nlist_rcut={config.nlist_rcut!r} is not "
+                "servable by sharded-integrate unless "
+                "force_backend='nlist'; run it solo via `run`"
+            )
         devices = params.get("devices") or len(jax.devices())
         backend = sharded_backend_name(max(1, int(devices)), local)
         if reroute is not None:
@@ -214,6 +261,16 @@ class ShardedIntegrateJob(JobClass):
             backend = rerouted
         d, _loc = parse_backend(backend)
         bucket = -(-config.n // d) * d  # ceil to a multiple of d
+        default_strategy = "halo" if local == "nlist" else "allgather"
+        extra = (("strategy", params.get("strategy", default_strategy)),)
+        if local == "nlist":
+            from ...ops.pallas_nlist import DEFAULT_CAP
+
+            extra += (
+                ("nlist_rcut", float(config.nlist_rcut)),
+                ("nlist_side", int(config.nlist_side)),
+                ("nlist_cap", int(config.nlist_cap or DEFAULT_CAP)),
+            )
         return _engine.BatchKey(
             bucket_n=bucket,
             slots=1,
@@ -224,7 +281,7 @@ class ShardedIntegrateJob(JobClass):
             eps=config.eps,
             cutoff=config.cutoff,
             job_type=self.name,
-            extra=(("strategy", params.get("strategy", "allgather")),),
+            extra=extra,
         )
 
     def initial_state(self, job):
@@ -275,25 +332,54 @@ class ShardedIntegrateJob(JobClass):
             from ...simulation import make_local_kernel
 
             _devices, local = parse_backend(key.backend)
+            extra = dict(key.extra)
+            # The nlist knobs ride EVERY rung's kernel config: the
+            # dense/chunked floor masks its pair set at rcut whenever
+            # nlist_rcut > 0, so degrading off the cell list never
+            # silently widens the physics back to full gravity.
             config = SimulationConfig(
                 n=key.bucket_n, force_backend=local, dtype=key.dtype,
                 g=key.g, eps=key.eps, cutoff=key.cutoff,
+                nlist_rcut=float(extra.get("nlist_rcut", 0.0)),
+                nlist_side=int(extra.get("nlist_side", 0)),
+                nlist_cap=int(extra.get("nlist_cap", 0)),
             )
             engine._kernels[key] = make_local_kernel(config, local)
         return engine._kernels[key]
 
     def _accel_fn(self, engine, key):
         """(positions, masses) -> accelerations for this key's form:
-        the shard_map'd mesh program, or the bare local kernel solo."""
-        kernel = self._local_kernel(engine, key)
+        the halo-exchange mesh program (nlist + 'halo' strategy, when
+        this rung's device count still divides the cell grid), the
+        shard_map'd allgather/ring program, or the bare local kernel
+        solo."""
         mesh = self._mesh_for(engine, key)
+        extra = dict(key.extra)
+        strategy = extra.get("strategy", "allgather")
+        _devices, local = parse_backend(key.backend)
+        if mesh is not None and local == "nlist" and strategy == "halo":
+            side = int(extra.get("nlist_side") or 0)
+            d = mesh.shape[mesh.axis_names[0]]
+            if side % d == 0 and side >= d:
+                from ...parallel.halo import make_halo_nlist_accel
+
+                return make_halo_nlist_accel(
+                    mesh, side=side,
+                    cap=int(extra.get("nlist_cap") or 0),
+                    rcut=float(extra.get("nlist_rcut") or 0.0),
+                    g=key.g, cutoff=key.cutoff, eps=key.eps,
+                )
+            # This rung's mesh no longer splits the grid into whole
+            # cell planes: degrade the EXCHANGE, not the physics —
+            # allgather with the identical nlist local kernel.
+        kernel = self._local_kernel(engine, key)
         if mesh is None:
             return lambda pos, m: kernel(pos, pos, m)
         from ...parallel.sharded import make_sharded_accel2
 
-        strategy = dict(key.extra).get("strategy", "allgather")
         return make_sharded_accel2(
-            mesh, strategy=strategy, local_kernel=kernel,
+            mesh, strategy="allgather" if strategy == "halo"
+            else strategy, local_kernel=kernel,
             g=key.g, cutoff=key.cutoff, eps=key.eps,
         )
 

@@ -17,7 +17,10 @@ Rules, in evidence order:
    the client retries against direct discovery.
 2. **Sharded exclusivity** — ``sharded-integrate`` goes only to
    sharded-capable workers, preferring an idle one (the job IS the
-   batch; docs/serving.md "Job classes").
+   batch; docs/serving.md "Job classes"). Sharded **nlist** jobs
+   additionally require the worker's ``nlist_capable`` capability —
+   the truncated cell-list family must exist on the host for every
+   rung of the halo degrade ladder above the chunked floor.
 3. **Memory pre-check** — the job's required bytes (perf-ledger
    measured peak when the program has compiled anywhere in the fleet,
    the sizing-model estimate cold; computed by the caller so the
@@ -134,6 +137,15 @@ class WorkerView:
     @property
     def sharded_capable(self) -> bool:
         return bool(self.capabilities.get("sharded_capable"))
+
+    @property
+    def nlist_capable(self) -> bool:
+        """Whether this worker can run the truncated cell-list kernel
+        family (sharded-nlist jobs). Absent metadata reads as NOT
+        capable — a worker registered by a build that predates the
+        flag never advertised the kernel, and the router places on
+        evidence, not optimism."""
+        return bool(self.capabilities.get("nlist_capable"))
 
     def open_breakers(self) -> set:
         return {
@@ -267,6 +279,24 @@ def place(
                 {"excluded": [list(x) for x in excluded]},
             )
         cands = capable
+        if job.backend == "nlist":
+            # Sharded cell-list jobs additionally need the nlist
+            # kernel family advertised — the halo exchange degrades
+            # through nlist rungs end-to-end, so a worker without the
+            # kernel would fail every rung above the chunked floor.
+            capable = [w for w in cands if w.nlist_capable]
+            excluded += [
+                (w.worker_id, "not_nlist_capable")
+                for w in cands if not w.nlist_capable
+            ]
+            if not capable:
+                raise PlacementError(
+                    "no_nlist_capable", 400,
+                    f"no nlist-capable worker for sharded nlist job "
+                    f"(n={job.n})",
+                    {"excluded": [list(x) for x in excluded]},
+                )
+            cands = capable
     if job.required_bytes:
         fit = []
         for w in cands:

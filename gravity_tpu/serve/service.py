@@ -92,6 +92,7 @@ def worker_capabilities(*, slots: int) -> dict:
     except Exception:  # noqa: BLE001 — no runtime yet: minimal caps
         devices = 1
     sharded_env = os.environ.get("GRAVITY_TPU_SHARDED_CAPABLE")
+    nlist_env = os.environ.get("GRAVITY_TPU_NLIST_CAPABLE")
     return {
         "devices": int(devices),
         # Every worker can host the sharded class on its local mesh;
@@ -100,6 +101,13 @@ def worker_capabilities(*, slots: int) -> dict:
         "sharded_capable": (
             sharded_env not in ("0", "false", "no")
             if sharded_env is not None else devices >= 1
+        ),
+        # The truncated cell-list kernel family (sharded-nlist halo
+        # jobs route only onto workers advertising it; same env-knob
+        # pattern marks a replica out of the nlist rotation).
+        "nlist_capable": (
+            nlist_env not in ("0", "false", "no")
+            if nlist_env is not None else True
         ),
         "backends": list(ENGINE_BACKENDS),
         "hbm_budget_bytes": device_memory_budget(),

@@ -440,6 +440,69 @@ def _resolve_nlist_config(config: SimulationConfig, positions):
     )
 
 
+def _resolve_halo_nlist_config(
+    config: SimulationConfig, positions, devices: int,
+):
+    """:func:`_resolve_nlist_config` for the domain-decomposed form:
+    the as-run side must split into whole cell planes per device, so
+    auto-sizing goes through parallel/halo.resolve_halo_sizing and an
+    explicit ``--nlist-side`` is validated rather than silently
+    rounded (the solo and halo forms must agree on what was run — the
+    --debug-check audit replays exactly this sizing)."""
+    if config.nlist_rcut <= 0.0:
+        raise ValueError(
+            "force_backend='nlist' needs nlist_rcut > 0 (--nlist-rcut): "
+            "the cell-list kernel computes forces TRUNCATED at rcut — "
+            "declared short-range physics, not an approximation of "
+            "full gravity"
+        )
+    from .ops.pallas_nlist import DEFAULT_CAP
+    from .parallel.halo import resolve_halo_sizing
+
+    side, cap = config.nlist_side, config.nlist_cap
+    if side and side % devices:
+        raise ValueError(
+            f"halo nlist needs --nlist-side divisible by the mesh axis "
+            f"size; got side={side}, devices={devices} (round it, or "
+            "set nlist_mesh='allgather')"
+        )
+    if side and cap:
+        return side, cap
+    if positions is None or not getattr(
+        positions, "is_fully_addressable", True
+    ):
+        if not side:
+            raise ValueError(
+                "nlist sizing needs concrete initial positions or an "
+                "explicit --nlist-side (serve jobs must set it: no "
+                "state exists at admission)"
+            )
+        return side, cap or DEFAULT_CAP
+    return resolve_halo_sizing(
+        np.asarray(positions), config.nlist_rcut, cap=cap,
+        devices=devices, side=side, box=config.periodic_box,
+    )
+
+
+def _p3m_halo_side(config: SimulationConfig, mesh) -> int:
+    """The device-divisible near-field cell side for the halo-sharded
+    p3m form, or 0 when this mesh cannot host it (multi-axis, or the
+    axis no longer fits whole cell planes). Rounding the solo
+    ``binning_side`` DOWN to a multiple of D keeps the 27-neighborhood
+    covering rcut (fewer, larger cells) — rounding up would shrink
+    cells below the truncation radius and silently drop near pairs."""
+    from .ops.p3m import binning_side
+
+    if len(mesh.axis_names) != 1:
+        return 0
+    devices = mesh.shape[mesh.axis_names[0]]
+    side = binning_side(
+        config.pm_grid, config.p3m_sigma_cells, config.p3m_rcut_sigmas
+    )
+    side = (side // devices) * devices
+    return side if side >= max(devices, 2) else 0
+
+
 def _make_nlist_kernel(config: SimulationConfig, positions=None,
                        k_targets=None):
     """LocalKernel for the cutoff-radius cell-list backend. The Pallas
@@ -769,6 +832,16 @@ class Simulator:
         self.backend, self.autotune = _resolve_backend_for_run(
             config, state
         )
+        if "@" in self.backend:
+            # Composite mesh-strategy candidate ("nlist@halo" /
+            # "nlist@allgather"): the measured winner carries its mesh
+            # strategy — pin it into the run's config so the accel
+            # build below takes exactly the probed program.
+            import dataclasses as _dc
+
+            self.backend, _strategy = self.backend.split("@", 1)
+            config = _dc.replace(config, nlist_mesh=_strategy)
+            self.config = config
 
         # Sharding setup: pad N to a multiple of the mesh size, shard the
         # particle axis (the reference pads nothing; zero-mass padding is
@@ -793,6 +866,37 @@ class Simulator:
 
         self.state = state
         self._build_fns()
+
+    def _nlist_mesh_strategy(self) -> str:
+        """Resolved mesh strategy for the cell-list family (the nlist
+        backend, and p3m's erfc near field): 'halo' (slab domain
+        decomposition, parallel/halo.py) or 'allgather'.
+        'auto' takes halo whenever the slab form applies — a
+        single-axis mesh with >= 2 devices — so mesh nlist runs get
+        O(surface) comms by default; 'halo' insists (error when
+        inapplicable); 'allgather' pins the gather-the-world path."""
+        mode = self.config.nlist_mesh
+        if mode not in ("auto", "halo", "allgather"):
+            raise ValueError(
+                f"nlist_mesh must be 'auto', 'halo' or 'allgather'; "
+                f"got {mode!r}"
+            )
+        applicable = (
+            self.mesh is not None
+            and len(self.mesh.axis_names) == 1
+            and self.mesh.shape[self.mesh.axis_names[0]] >= 2
+        )
+        if mode == "halo":
+            if not applicable:
+                raise ValueError(
+                    "nlist_mesh='halo' needs a single-axis mesh with "
+                    ">= 2 devices (the slab decomposition runs over "
+                    "one mesh axis)"
+                )
+            return "halo"
+        if mode == "allgather" or not applicable:
+            return "allgather"
+        return "halo"
 
     def _build_fns(self) -> None:
         """Build the (positions, masses) -> acc function and the jitted
@@ -899,6 +1003,101 @@ class Simulator:
                 ws=config.tree_ws, g=config.g, cutoff=config.cutoff,
                 eps=config.eps,
             )
+        elif self.mesh is not None and self.backend == "nlist" and (
+            self._nlist_mesh_strategy() == "halo"
+        ):
+            # Domain-decomposed slabs (parallel/halo.py): O(surface)
+            # halo comms + O(N/D) local tile work instead of gathering
+            # the world. The as-run sizing is the D-rounded halo form —
+            # audits (--debug-check) and the bench roofline read it,
+            # and re-deriving from the EVOLVED final state (or from the
+            # solo rounding) would audit a different cell list than the
+            # one that ran.
+            from .ops.pallas_nlist import evaluated_pairs_per_eval
+            from .parallel.halo import (
+                make_halo_nlist_accel, resolve_mig_cap,
+            )
+
+            axis = self.mesh.axis_names[0]
+            devices = self.mesh.shape[axis]
+            side, cap = _resolve_halo_nlist_config(
+                config, self.state.positions, devices
+            )
+            self.nlist_sizing = (
+                side, cap, evaluated_pairs_per_eval(side, cap)
+            )
+            mig_cap = config.nlist_mig_cap
+            if not mig_cap and getattr(
+                self.state.positions, "is_fully_addressable", True
+            ):
+                mig_cap = resolve_mig_cap(
+                    np.asarray(self.state.positions), side, devices,
+                    box=config.periodic_box,
+                )
+            self._accel2 = make_halo_nlist_accel(
+                self.mesh, side=side, cap=cap, rcut=config.nlist_rcut,
+                g=config.g, cutoff=config.cutoff, eps=config.eps,
+                box=config.periodic_box, mig_cap=mig_cap,
+            )
+        elif self.mesh is not None and self.backend == "p3m" and (
+            self._nlist_mesh_strategy() == "halo"
+        ) and (
+            _p3m_halo_side(config, self.mesh) > 0
+            or config.nlist_mesh == "halo"
+        ):
+            # Sharded P3M with the halo near field: the PM far pass
+            # stays the replicated-build allgather form (a global FFT
+            # has no slab locality to exploit), while the erfc near
+            # field — the pairwise cost that dominates at scale — runs
+            # the domain-decomposed cell exchange with kind='ewald'.
+            import math as _math
+            import warnings as _warnings
+
+            from .ops.p3m import _mesh_accelerations, check_p3m_sizing
+            from .ops.pm import bounding_cube
+            from .parallel import make_sharded_accel2
+            from .parallel.halo import make_halo_nlist_accel
+
+            side = _p3m_halo_side(config, self.mesh)
+            if not side:
+                raise ValueError(
+                    "nlist_mesh='halo' on sharded p3m needs the near-"
+                    "field cell grid to fit >= 1 whole cell plane per "
+                    "device; this mesh cannot host the slab form — "
+                    "set nlist_mesh='allgather' (or shrink the mesh)"
+                )
+            note = check_p3m_sizing(
+                config.n, config.pm_grid, config.p3m_sigma_cells,
+                config.p3m_rcut_sigmas, config.p3m_cap,
+                positions=self.state.positions,
+            )
+            if note:
+                _warnings.warn(note, stacklevel=2)
+            grid = config.pm_grid
+            sc = config.p3m_sigma_cells
+
+            def _far_local(targets, sources, m_src):
+                origin, span = bounding_cube(sources)
+                return _mesh_accelerations(
+                    targets, sources, m_src, origin, span,
+                    grid=grid, g=config.g, sigma_cells=sc,
+                )
+
+            far = make_sharded_accel2(
+                self.mesh, strategy="allgather",
+                local_kernel=_far_local, g=config.g,
+                cutoff=config.cutoff, eps=config.eps,
+            )
+            near = make_halo_nlist_accel(
+                self.mesh, side=side, cap=config.p3m_cap,
+                g=config.g, cutoff=config.cutoff, eps=config.eps,
+                kind="ewald",
+                ewald_scales=(
+                    (grid - 1) / (_math.sqrt(2.0) * sc),
+                    config.p3m_rcut_sigmas * sc / (grid - 1),
+                ),
+            )
+            self._accel2 = lambda p, m: far(p, m) + near(p, m)
         elif self.mesh is not None:
             from .parallel import make_sharded_accel2
 

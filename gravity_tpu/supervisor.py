@@ -90,6 +90,12 @@ def next_rung(
         return local  # solo form of the same local kernel
     if backend == "cpp":
         return "chunked"
+    if backend == "nlist":
+        # Solo cell-list rung: the masked direct sum is its exact
+        # reference (make_local_kernel applies the rcut mask whenever
+        # nlist_rcut > 0), so degrading to chunked keeps the truncated
+        # physics bit-compatible — same pair set, no cell caps.
+        return "chunked"
     if backend not in ladder:
         return None
     i = ladder.index(backend)

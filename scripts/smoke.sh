@@ -31,6 +31,11 @@
 # workers through `gravity_tpu route` with rationale-bearing routed
 # events, fleet-status router view, drain workflow — docs/serving.md
 # "Pod topology & router"),
+# and the domain-decomposed halo nlist stage (a 2-device CPU-mesh
+# halo-exchange run through the real CLI with --debug-check, <=1e-5
+# final-state parity vs solo, plus a sharded-integrate nlist job
+# completing through a live daemon — docs/scaling.md
+# "Domain-decomposed cell lists"),
 # all on CPU. Exits nonzero on any failure. ~10 min on a laptop-class
 # CPU.
 set -euo pipefail
@@ -38,7 +43,7 @@ cd "$(dirname "$0")/.."
 
 export JAX_PLATFORMS=cpu
 
-echo "== smoke 1/13: pytest -m 'fast and not slow and not heavy' (contract + oracle-parity lane) =="
+echo "== smoke 1/14: pytest -m 'fast and not slow and not heavy' (contract + oracle-parity lane) =="
 # "fast and not slow and not heavy": module-level fast marks would
 # otherwise pull a file's slow-marked wall-clock tests into the lane
 # (pytest -m fast selects anything CARRYING the mark; it does not
@@ -47,7 +52,7 @@ echo "== smoke 1/13: pytest -m 'fast and not slow and not heavy' (contract + ora
 # item 5).
 python -m pytest tests/ -q -m "fast and not slow and not heavy" -p no:cacheprovider
 
-echo "== smoke 2/13: 2-job ensemble serving e2e (CLI daemon) =="
+echo "== smoke 2/14: 2-job ensemble serving e2e (CLI daemon) =="
 SPOOL="$(mktemp -d /tmp/gravity_smoke.XXXXXX)"
 cleanup() {
     # Best-effort daemon shutdown + spool removal.
@@ -100,7 +105,7 @@ print("ensemble e2e OK:", {j: s["status"] for j, s in statuses.items()},
       "| compiles:", metrics["compile_counts"])
 EOF
 
-echo "== smoke 3/13: async host pipeline e2e (cadence run + SIGTERM + resume) =="
+echo "== smoke 3/14: async host pipeline e2e (cadence run + SIGTERM + resume) =="
 IODIR="$(mktemp -d /tmp/gravity_smoke_io.XXXXXX)"
 trap 'cleanup; rm -rf "$IODIR"' EXIT
 # Cadence-on pipelined run; preempt@500 delivers a real SIGTERM to the
@@ -136,7 +141,7 @@ print("io-pipeline e2e OK: resumed", stats["steps"], "steps,",
       "host_gap_frac", round(stats["host_gap_frac"], 3))
 EOF
 
-echo "== smoke 4/13: autotune cache round-trip (probe-on-miss, instant-on-hit) =="
+echo "== smoke 4/14: autotune cache round-trip (probe-on-miss, instant-on-hit) =="
 TUNEDIR="$(mktemp -d /tmp/gravity_smoke_tune.XXXXXX)"
 trap 'cleanup; rm -rf "$IODIR" "$TUNEDIR"' EXIT
 # Fresh cache dir + lowered fast-probe floor so plain `auto` runs a
@@ -173,10 +178,10 @@ print("autotune round-trip OK: backend", s1["backend"],
       "| probe", round(s1["autotune_probe_ms"], 1), "ms -> hit 0 ms")
 EOF
 
-echo "== smoke 5/13: serving chaos harness (kill -9 + adoption + fencing) =="
+echo "== smoke 5/14: serving chaos harness (kill -9 + adoption + fencing) =="
 bash scripts/chaos.sh 1 2
 
-echo "== smoke 6/13: job classes through the CLI daemon (fit + sweep) =="
+echo "== smoke 6/14: job classes through the CLI daemon (fit + sweep) =="
 # One fit + one sweep submitted through the REAL daemon from stage 2
 # (still serving), asserting completion + served-vs-solo parity
 # (docs/serving.md "Job classes").
@@ -286,7 +291,7 @@ z = np.load(sys.argv[1])
 assert 'min_sep' in z.files and len(z['min_sep']) == 4, z.files
 " "$SPOOL/sweep_verdicts.npz"
 
-echo "== smoke 7/13: unified telemetry (Prometheus scrape + Perfetto trace export) =="
+echo "== smoke 7/14: unified telemetry (Prometheus scrape + Perfetto trace export) =="
 # Against the STILL-LIVE stage-2 daemon: (a) a text/plain /metrics
 # scrape must be valid Prometheus exposition (validated by the strict
 # parser the tests use) including per-class latency histograms and
@@ -331,7 +336,7 @@ assert summary["coverage"] is not None and summary["coverage"] >= 0.9, \
 print("perfetto export OK:", summary)
 PYEOF
 
-echo "== smoke 8/13: nlist cell-list near field (p3m parity + standalone truncated parity) =="
+echo "== smoke 8/14: nlist cell-list near field (p3m parity + standalone truncated parity) =="
 # (a) The P3M near pass through the cell-list tile engine must match
 # the chunked gather near pass <= 1e-5 scaled on CPU (the ISSUE-9
 # acceptance bound); (b) the standalone nlist backend must match the
@@ -373,7 +378,7 @@ print("nlist near-field OK: p3m dev", float(dev),
       "| standalone dev", float(dev2))
 PYEOF
 
-echo "== smoke 9/13: numerics observatory (drift gauges + error histogram scrape, injected accuracy breach) =="
+echo "== smoke 9/14: numerics observatory (drift gauges + error histogram scrape, injected accuracy breach) =="
 # (a) Strict-parse the LIVE stage-2 daemon's Prometheus text and
 # assert the numerics families are present with real series: the
 # per-backend force-error histogram (sentinel probes ran — default
@@ -490,7 +495,7 @@ urllib.request.urlopen(req, timeout=5).read()
 EOF
 kill "$NUM_PID" 2>/dev/null || true
 
-echo "== smoke 10/13: sharded adoption-resume chaos (SIGKILL mid-sharded-job -> resume from snapshot) =="
+echo "== smoke 10/14: sharded adoption-resume chaos (SIGKILL mid-sharded-job -> resume from snapshot) =="
 # Chaos scenario 3 through the real CLI daemon on a 2-device CPU mesh:
 # a worker running a sharded-integrate job is SIGKILLed mid-run; the
 # survivor adopts, RESUMES from the last fenced progress snapshot
@@ -500,7 +505,7 @@ echo "== smoke 10/13: sharded adoption-resume chaos (SIGKILL mid-sharded-job -> 
 # modes").
 bash scripts/chaos.sh 3
 
-echo "== smoke 11/13: static analysis (gravity_tpu lint: planted violations -> exit 1, real tree -> exit 0) =="
+echo "== smoke 11/14: static analysis (gravity_tpu lint: planted violations -> exit 1, real tree -> exit 0) =="
 # The AST invariant analyzer (docs/static-analysis.md). First a
 # fixture tree with one planted violation per acceptance class
 # (use-after-donation, time.time in a scanned body, unfenced spool
@@ -567,7 +572,7 @@ rm -rf "$LINTDIR"
 # The real tree: zero non-baselined findings.
 python -m gravity_tpu lint
 
-echo "== smoke 12/13: perf regression gate (planted violation -> exit 1, clean tree -> exit 0) =="
+echo "== smoke 12/14: perf regression gate (planted violation -> exit 1, clean tree -> exit 0) =="
 # The noise-robust perf gate (docs/observability.md "Performance")
 # through the real CLI. (a) A planted regression — an 8x handicap on
 # the nlist arm of the speedup contract — must exit 1 and NAME the
@@ -603,7 +608,7 @@ grep -q "perf gate: all contracts hold" "$GATEDIR/clean.out" || {
 }
 echo "perf gate OK: planted violation exit 1 (contract named), clean tree exit 0 under a 2x both-arm window handicap"
 
-echo "== smoke 13/13: pod router (3 job classes placed over two CLI workers, drain, fleet view) =="
+echo "== smoke 13/14: pod router (3 job classes placed over two CLI workers, drain, fleet view) =="
 # Two CLI workers + the `gravity_tpu route` front door on one spool:
 # every client verb goes through discovery, which prefers the live
 # router — so the same submit/wait/result code exercises placement.
@@ -707,5 +712,102 @@ print("drain OK: post-drain placement landed on rsmoke-b")
 PYEOF
 
 kill "$ROUTE_PID" "$RA_PID" "$RB_PID" 2>/dev/null || true
+
+echo "== smoke 14/14: domain-decomposed halo nlist (2-device mesh CLI parity + sharded-integrate nlist job) =="
+# (a) The real CLI on a 2-device virtual mesh runs the halo exchange
+# with --debug-check (the as-run domain sizing audited against the
+# rcut-masked minimum-image oracle), and its final state must match
+# the IDENTICAL solo run <= 1e-5 scaled (docs/scaling.md
+# "Domain-decomposed cell lists"). Explicit --nlist-side/--nlist-cap
+# pin the same cell grid on both arms (auto-sizing may legally differ
+# between the slab and solo forms).
+HALODIR="$(mktemp -d /tmp/gravity_smoke_halo.XXXXXX)"
+trap 'cleanup; rm -rf "$IODIR" "$TUNEDIR" "$NUMDIR" "$GATEDIR" "$ROUTEDIR" "$HALODIR"' EXIT
+# rcut = 5e12 keeps real neighborhoods inside the plummer core (a
+# tiny rcut audits near-zero forces); cap = n makes the cell list
+# overflow-free, so the audit measures defects, not the documented
+# cap-overflow monopole degradation.
+HALO_ARGS=(--model plummer --n 128 --steps 10 --dt 3600 --eps 1e9
+           --integrator leapfrog --force-backend nlist
+           --nlist-rcut 5e12 --nlist-side 4 --nlist-cap 128
+           --checkpoint-every 10 --debug-check)
+XLA_FLAGS="--xla_force_host_platform_device_count=2" \
+python -m gravity_tpu run "${HALO_ARGS[@]}" \
+    --sharding allgather --mesh-shape 2 --nlist-mesh halo \
+    --checkpoint-dir "$HALODIR/mesh_ckpt" \
+    >"$HALODIR/mesh_run.out" 2>&1 || {
+    echo "mesh halo nlist run failed"; cat "$HALODIR/mesh_run.out";
+    exit 1;
+}
+grep -q "Force cross-check" "$HALODIR/mesh_run.out" || {
+    echo "mesh run missing the --debug-check audit";
+    cat "$HALODIR/mesh_run.out"; exit 1;
+}
+python -m gravity_tpu run "${HALO_ARGS[@]}" \
+    --checkpoint-dir "$HALODIR/solo_ckpt" \
+    >"$HALODIR/solo_run.out" 2>&1 || {
+    echo "solo nlist run failed"; cat "$HALODIR/solo_run.out"; exit 1;
+}
+# The mesh checkpoint restores onto the topology that wrote it.
+XLA_FLAGS="--xla_force_host_platform_device_count=2" \
+python - "$HALODIR" <<'PYEOF'
+import sys
+import numpy as np
+from gravity_tpu.utils.checkpoint import (
+    make_checkpoint_manager, restore_checkpoint)
+
+d = sys.argv[1]
+mesh, step_m = restore_checkpoint(
+    make_checkpoint_manager(f"{d}/mesh_ckpt"))
+solo, step_s = restore_checkpoint(
+    make_checkpoint_manager(f"{d}/solo_ckpt"))
+assert step_m == step_s == 10, (step_m, step_s)
+pm, ps = np.asarray(mesh.positions), np.asarray(solo.positions)
+scale = np.linalg.norm(ps, axis=1).mean()
+dev = np.abs(pm - ps).max() / scale
+assert dev <= 1e-5, f"halo-vs-solo final-state scaled max {dev}"
+print("halo CLI parity OK: 2-device mesh vs solo scaled dev",
+      float(dev))
+PYEOF
+
+# (b) A sharded-integrate job with force_backend=nlist completes
+# through a live 2-device daemon — the serve-admissible wiring
+# (batch key carries rcut/side/cap; strategy defaults to halo).
+XLA_FLAGS="--xla_force_host_platform_device_count=2" \
+python -m gravity_tpu serve --spool-dir "$HALODIR/spool" --slots 2 \
+    --slice-steps 10 --worker-id halo-smoke \
+    >"$HALODIR/serve.stdout" 2>&1 &
+HALO_PID=$!
+for _ in $(seq 1 150); do
+    [ -f "$HALODIR/spool/daemon.json" ] && break
+    sleep 0.2
+done
+[ -f "$HALODIR/spool/daemon.json" ] || {
+    echo "halo daemon never came up"; cat "$HALODIR/serve.stdout";
+    exit 1;
+}
+python - "$HALODIR/spool" <<'PYEOF'
+import json, sys
+from gravity_tpu.config import SimulationConfig
+from gravity_tpu.serve import request, wait_for
+
+spool = sys.argv[1]
+cfg = SimulationConfig(n=64, steps=30, seed=7, model="plummer",
+                       dt=3600.0, eps=1e9, integrator="leapfrog",
+                       force_backend="nlist", nlist_rcut=5e11,
+                       nlist_side=4, nlist_cap=64)
+resp = request(spool, "POST", "/submit",
+               {"config": json.loads(cfg.to_json()),
+                "job_type": "sharded-integrate",
+                "params": {"devices": 2}},
+               retries=5)
+assert "job" in resp, resp
+st = wait_for(spool, [resp["job"]], timeout=300)[resp["job"]]
+assert st["status"] == "completed", st
+out = request(spool, "GET", f"/result?job={resp['job']}")
+assert len(out["positions"]) == 64, len(out["positions"])
+print("sharded-integrate nlist OK: job", resp["job"], "completed")
+PYEOF
+kill "$HALO_PID" 2>/dev/null || true
 
 echo "== smoke: all green =="
